@@ -1,0 +1,35 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's API.
+
+An imperative, asynchronously-scheduled mutable NDArray API, Gluon
+(``Block``/``HybridBlock`` with ``hybridize()`` compiling to a single XLA
+computation), autograd, the Symbol/Module API with a bucketing executor, a
+RecordIO data pipeline and a KVStore data-parallel interface — with XLA/PjRt
+as the execution substrate instead of mshadow/CUDA.  See SURVEY.md for the
+reference blueprint.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+
+# Submodules that layer on the core.  This list grows as subsystems land;
+# the package stays importable at every commit.
+from . import initializer      # noqa: E402
+from . import optimizer        # noqa: E402
+from . import lr_scheduler     # noqa: E402
+from . import metric           # noqa: E402
+from . import kvstore          # noqa: E402
+from . import kvstore as kv    # noqa: E402
+from . import gluon            # noqa: E402

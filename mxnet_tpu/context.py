@@ -1,0 +1,163 @@
+"""Device context abstraction.
+
+Reference: ``python/mxnet/context.py`` (see SURVEY.md §2.2 "base/context" —
+"``mx.tpu()`` goes here").  TPU-native design: a :class:`Context` maps onto a
+concrete ``jax.Device``.  ``tpu(i)`` is the first-class accelerator context;
+``gpu(i)`` is accepted as an alias for portability of reference-era scripts
+and resolves to the accelerator backend too.  ``cpu()`` maps to the JAX CPU
+backend (always present).
+
+Under the test harness (``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=N``) ``tpu(i)`` resolves to virtual
+host device ``i`` so multi-device code paths are exercisable without
+hardware.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_tpus", "num_gpus", "device"]
+
+
+def _accel_platform():
+    """Return the platform name of the accelerator backend, or None."""
+    import jax
+    try:
+        devs = jax.devices()
+    except Exception:
+        return None
+    if not devs:
+        return None
+    plat = devs[0].platform
+    return plat
+
+
+class Context:
+    """Execution device descriptor (reference: ``mxnet.context.Context``).
+
+    ``Context('tpu', 0)`` pins work to accelerator chip 0.  Arithmetic on
+    arrays in different contexts is an error, matching reference semantics
+    (explicit ``copyto``/``as_in_context`` moves data).
+    """
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in Context.devstr2type:
+            raise MXNetError("Unknown device type %r" % device_type)
+        # gpu is accepted as an alias for the accelerator (tpu) backend so
+        # reference-era scripts run unchanged.
+        self.device_type = device_type
+        self.device_id = device_id
+
+    @property
+    def device_typeid(self) -> int:
+        return Context.devstr2type[self.device_type]
+
+    # -- jax integration ---------------------------------------------------
+    @property
+    def jax_device(self):
+        import jax
+        dt = self.device_type
+        if dt in ("cpu", "cpu_pinned", "cpu_shared"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                # CPU backend absent (rare); fall back to default backend.
+                devs = jax.devices()
+            return devs[self.device_id % len(devs)]
+        # tpu/gpu → accelerator backend; under the CPU test harness this is
+        # the virtual host-device array.
+        devs = jax.devices()
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                "Context %s: device_id %d out of range (%d devices visible)"
+                % (self, self.device_id, len(devs)))
+        return devs[self.device_id]
+
+    # -- identity ----------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.stack.pop()
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        stack = getattr(cls._default_ctx, "stack", None)
+        if stack:
+            return stack[-1]
+        return _DEFAULT
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """The TPU context — the reason this framework exists."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias context for reference-era scripts; resolves to the accelerator
+    backend (TPU) at runtime."""
+    return Context("gpu", device_id)
+
+
+def device(dev: str) -> Context:
+    """Parse 'tpu(0)' / 'cpu' style strings."""
+    dev = dev.strip()
+    if "(" in dev:
+        name, rest = dev.split("(", 1)
+        return Context(name.strip(), int(rest.rstrip(")")))
+    return Context(dev, 0)
+
+
+_DEFAULT = Context("cpu", 0)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_tpus() -> int:
+    import jax
+    try:
+        devs = jax.devices()
+    except Exception:
+        return 0
+    return len(devs)
+
+
+def num_gpus() -> int:
+    """Reference-compat: reports accelerator count (TPU chips here)."""
+    return num_tpus()

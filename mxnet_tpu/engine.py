@@ -1,0 +1,167 @@
+"""Execution engine — async scheduling semantics on top of XLA/PjRt.
+
+Reference: ``src/engine/`` (``ThreadedEngine``, ``NaiveEngine``,
+``Engine::PushAsync/WaitForVar/WaitForAll`` — SURVEY.md §2.1 "Engine",
+§3.1 call stack, and the ``note_engine.md`` design doc).
+
+TPU-native design: the reference needed a user-space dataflow scheduler
+because CUDA exposes raw streams.  PjRt already gives us an asynchronous,
+dependency-ordered execution stream per device: every op dispatched through
+JAX returns immediately with a future-like ``jax.Array``; data dependencies
+are tracked by XLA/PjRt itself and transfers/computation overlap
+automatically.  So the *mechanism* (versioned vars, worker threads) dissolves
+— but the *semantics* users rely on are preserved here:
+
+* ``NaiveEngine`` debug mode (``MXNET_ENGINE_TYPE=NaiveEngine``): fully
+  synchronous execution — every op blocks until complete.  The reference's
+  main async-bug-bisection tool (SURVEY.md §5.2).
+* ``wait_for_var`` / ``wait_for_all`` sync points with deferred-exception
+  rethrow (reference: exceptions stored on vars, rethrown at sync —
+  ``tests/python/unittest/test_exc_handling.py``).
+* ``bulk`` scope: hint that a sequence of imperative ops may be batched
+  (reference: ``MXNET_EXEC_BULK_EXEC_*`` op bulking; here it is a no-op hint
+  because XLA fuses inside ``jit`` — kept for API parity).
+* op-start/op-end hooks used by the profiler (the engine is the single
+  choke point for tracing in the reference; we keep that property).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Callable, List, Optional
+
+__all__ = ["Engine", "engine", "bulk", "set_bulk_size"]
+
+
+class _PendingException:
+    """Deferred exception captured from an async op, rethrown at sync."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Engine:
+    """Process-global engine facade.
+
+    ``push`` runs ``fn`` (a closure that issues JAX ops) and returns its
+    result.  In the default (threaded/async) mode the JAX dispatch itself is
+    the async boundary.  In NaiveEngine mode we block on every output.
+    """
+
+    _instance: Optional["Engine"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        import collections
+        etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        self.engine_type = etype
+        self.synchronous = etype == "NaiveEngine"
+        self._op_hooks: List[Callable[[str, str], None]] = []  # (event, name)
+        self._bulk_size = 15
+        # ring of weakrefs to recent op outputs; wait_for_all blocks on
+        # them so it is a true sync point (benchmarks, deferred errors)
+        self._recent = collections.deque(maxlen=512)
+
+    @classmethod
+    def get(cls) -> "Engine":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Engine()
+            return cls._instance
+
+    # -- hooks (profiler attaches here; single choke point) ----------------
+    def add_op_hook(self, hook: Callable[[str, str], None]):
+        self._op_hooks.append(hook)
+
+    def remove_op_hook(self, hook):
+        if hook in self._op_hooks:
+            self._op_hooks.remove(hook)
+
+    def notify(self, event: str, name: str):
+        for h in self._op_hooks:
+            h(event, name)
+
+    # -- execution ---------------------------------------------------------
+    def push(self, fn: Callable[[], Any], name: str = "op") -> Any:
+        """Run an op closure; sync immediately under NaiveEngine."""
+        if self._op_hooks:
+            self.notify("start", name)
+        try:
+            result = fn()
+        finally:
+            if self._op_hooks:
+                self.notify("stop", name)
+        if self.synchronous:
+            _block(result)
+        else:
+            import weakref
+            import jax
+            for leaf in jax.tree_util.tree_leaves(result):
+                if hasattr(leaf, "block_until_ready"):
+                    try:
+                        self._recent.append(weakref.ref(leaf))
+                    except TypeError:
+                        pass
+        return result
+
+    def wait_for_all(self):
+        """Block until all outstanding device work completes; deferred
+        device errors surface here.
+
+        Reference: ``Engine::WaitForAll`` / ``mx.nd.waitall()``.  PjRt has
+        no global barrier from Python, so we block on every recently
+        dispatched output (weakref ring) + the effects barrier.
+        """
+        import jax
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        while self._recent:
+            ref = self._recent.popleft()
+            arr = ref()
+            if arr is not None:
+                arr.block_until_ready()
+
+    @staticmethod
+    def wait_for_var(data):
+        """Block until ``data`` (a jax.Array / pytree) is ready; rethrows any
+        deferred device exception (reference: ``Engine::WaitForVar``)."""
+        _block(data)
+
+    def set_bulk_size(self, size: int) -> int:
+        old = self._bulk_size
+        self._bulk_size = size
+        return old
+
+
+def _block(result):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(result):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def engine() -> Engine:
+    return Engine.get()
+
+
+@contextlib.contextmanager
+def bulk(size: int = 15):
+    """Bulk-execution scope (reference: ``mx.engine.bulk``).
+
+    Under XLA the fusion happens in the compiler, so this is a semantic
+    no-op kept for API parity; it still toggles the engine bulk-size knob so
+    user code reading it back behaves identically.
+    """
+    eng = Engine.get()
+    old = eng.set_bulk_size(size)
+    try:
+        yield
+    finally:
+        eng.set_bulk_size(old)
+
+
+def set_bulk_size(size: int) -> int:
+    return Engine.get().set_bulk_size(size)

@@ -1,0 +1,598 @@
+"""Gluon Block / HybridBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` (SURVEY.md §2.2 "Gluon core",
+§3.3 call stack "hybridize() → CachedOp").
+
+TPU-native design of the compile path: the reference traces
+``hybrid_forward`` with Symbol proxies into an nnvm graph and executes it
+through ``CachedOp`` (static alloc, op bulking).  Here ``hybridize()``
+compiles the *same user code* with ``jax.jit``: the forward is re-run once
+per (input-shape, dtype, training-mode) signature with tracer-backed
+NDArrays swapped into the Parameters, producing a single fused XLA
+computation — XLA's fusion/layout/memory planning subsumes nnvm's
+plan_memory and bulking.  Mutated aux states (BatchNorm running stats) are
+detected during tracing and returned as extra outputs, then swapped back in
+eagerly — preserving the reference's FMutateInputs semantics.  The jit
+cache keyed by input signature IS the reference's bucketing executor
+memory-sharing trick, for free (SURVEY.md §7.2).
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_TRACE_STATE = threading.local()
+
+
+def _in_trace() -> bool:
+    return getattr(_TRACE_STATE, "active", 0) > 0
+
+
+class _BlockScope:
+    """Auto-naming scope (reference: ``_BlockScope`` — dense0_, dense1_…)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_manager().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class _NameManager:
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+
+_NM = threading.local()
+
+
+def _name_manager():
+    if not hasattr(_NM, "nm"):
+        _NM.nm = _NameManager()
+    return _NM.nm
+
+
+def _flatten_nds(args):
+    """Flatten nested lists/tuples of NDArrays; returns (leaves, treedef)."""
+    leaves = []
+
+    def rec(a):
+        if isinstance(a, NDArray):
+            leaves.append(a)
+            return "#"
+        if isinstance(a, (list, tuple)):
+            return [rec(x) for x in a]
+        return ("const", a)
+
+    tree = [rec(a) for a in args]
+    return leaves, tree
+
+
+def _unflatten_nds(tree, leaves):
+    it = iter(leaves)
+
+    def rec(t):
+        if t == "#":
+            return next(it)
+        if isinstance(t, list):
+            return [rec(x) for x in t]
+        if isinstance(t, tuple) and len(t) == 2 and t[0] == "const":
+            return t[1]
+        return t
+
+    return [rec(t) for t in tree]
+
+
+class Block:
+    """Base building block (reference: ``gluon.Block``)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise MXNetError(
+                    "Changing attribute type for %s from %s to %s is not "
+                    "allowed." % (name, type(existing), type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+
+    # -- properties --------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update(OrderedDict(
+                (name, value) for name, value in self.params.items()
+                if pattern.match(name)))
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val.data().copyto(cpu()) if val._data is not None
+                    else None for key, val in params.items()}
+        arg_dict = {k: v for k, v in arg_dict.items() if v is not None}
+        nd.save(filename, arg_dict)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not isinstance(loaded, dict):
+            raise MXNetError("load_parameters needs a name-keyed file")
+        if not any("." in k for k in loaded.keys()):
+            # file saved via ParameterDict.save (full names); match by
+            # parameter full name instead
+            full = {p.name: p for p in self.collect_params().values()}
+            for name, value in loaded.items():
+                if name in full:
+                    p = full[name]
+                    if p._data is None:
+                        p.shape = tuple(value.shape)
+                        if p._deferred_init:
+                            p._finish_deferred_init()
+                        else:
+                            p.initialize(ctx=ctx)
+                    p.set_data(value)
+                elif not ignore_extra:
+                    raise MXNetError("Parameter %s not found in Block"
+                                     % name)
+            return
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        "Parameter '%s' loaded from file is not present in "
+                        "this Block" % name)
+                continue
+            p = params[name]
+            value = loaded[name]
+            if p._data is None:
+                p.shape = tuple(value.shape)
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx)
+            p.set_data(value)
+        if not allow_missing:
+            for name, p in params.items():
+                if name not in loaded and p._data is None and \
+                        not p._deferred_init:
+                    raise MXNetError(
+                        "Parameter '%s' is missing in file" % name)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        summary_rows = []
+
+        def walk(block, depth):
+            pcount = sum(int(_np.prod(p.shape)) if p.shape else 0
+                         for p in block._reg_params.values())
+            summary_rows.append(("  " * depth + type(block).__name__,
+                                 block.name, pcount))
+            for c in block._children.values():
+                walk(c, depth + 1)
+        walk(self, 0)
+        lines = ["%-40s %-30s %12s" % ("Layer", "Name", "Params"),
+                 "-" * 84]
+        total = 0
+        for row in summary_rows:
+            lines.append("%-40s %-30s %12d" % row)
+            total += row[2]
+        lines.append("-" * 84)
+        lines.append("Total params: %d" % total)
+        print("\n".join(lines))
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=key, block=_indent(repr(block), 2))
+            for key, block in self._children.items())
+        if not modstr:
+            return "%s()" % type(self).__name__
+        return s.format(name=type(self).__name__, modstr=modstr)
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line
+                                    for line in lines)
+
+
+class _CachedOp:
+    """One compiled entry: jitted function + parameter binding.
+
+    Reference: ``src/imperative/cached_op.cc`` (§3.3).  The compiled
+    function signature is ``(param_values, arg_values, rng_key) ->
+    (outputs, mutated_aux_values)``.
+    """
+
+    def __init__(self, block, params: List[Parameter], training: bool):
+        self.block = block
+        self.params = params
+        self.training = training
+        self.jitted = None
+        self.out_tree = None
+        self.mutated_idx: List[int] = []
+        self.uses_rng = False
+
+    def build(self, arg_leaves: List[NDArray], arg_tree):
+        import jax
+        from .. import autograd, random as mxrand
+
+        block = self.block
+        params = self.params
+        training = self.training
+        n_params = len(params)
+
+        def pure_fn(param_vals, arg_vals, key):
+            mxrand.push_trace_key(key)
+            _TRACE_STATE.active = getattr(_TRACE_STATE, "active", 0) + 1
+            saved = [(p, dict(p._data)) for p in params]
+            try:
+                for p, v in zip(params, param_vals):
+                    c = next(iter(p._data))
+                    p._data = OrderedDict({c: NDArray(v)})
+                arg_nds = [NDArray(v) for v in arg_vals]
+                full_args = _unflatten_nds(arg_tree, arg_nds)
+                with autograd._scope(False, training):
+                    out = block.forward_raw(*full_args)
+                out_leaves, out_tree = _flatten_nds(
+                    out if isinstance(out, (list, tuple)) else [out])
+                self.out_tree = (out_tree,
+                                 isinstance(out, (list, tuple)))
+                mutated = []
+                for i, p in enumerate(params):
+                    newv = next(iter(p._data.values()))._data
+                    if newv is not param_vals[i]:
+                        mutated.append((i, newv))
+                return ([o._data for o in out_leaves],
+                        [m[1] for m in mutated],
+                        [m[0] for m in mutated])
+            finally:
+                for p, old in saved:
+                    p._data = OrderedDict(old)
+                _TRACE_STATE.active -= 1
+                mxrand.pop_trace_key()
+
+        # First trace (abstract) to discover structure & mutated set.
+        param_shapes = [jax.ShapeDtypeStruct(
+            p.data().shape, _np.dtype(p.dtype)) for p in params]
+        arg_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in arg_leaves]
+        key_shape = jax.ShapeDtypeStruct((2,), _np.uint32)
+
+        mutated_holder = {}
+
+        def traceable(param_vals, arg_vals, key):
+            outs, mvals, midx = pure_fn(list(param_vals), list(arg_vals),
+                                        key)
+            mutated_holder["idx"] = midx
+            return tuple(outs) + tuple(mvals)
+
+        _ = jax.eval_shape(traceable, param_shapes, arg_shapes, key_shape)
+        self.mutated_idx = mutated_holder["idx"]
+        self.n_outputs = None  # set below
+
+        jitted = jax.jit(traceable)
+        self.jitted = jitted
+        return jitted
+
+    def __call__(self, arg_leaves: List[NDArray]):
+        import jax
+        from .. import autograd, random as mxrand
+        from ..ops.registry import OpDef, invoke
+
+        param_nds = [p.data() for p in self.params]
+        key = mxrand.next_key()
+        n_params = len(self.params)
+        n_args = len(arg_leaves)
+        n_mut = len(self.mutated_idx)
+
+        jitted = self.jitted
+
+        def impl(*arrays):
+            pv = arrays[:n_params]
+            av = arrays[n_params:n_params + n_args]
+            k = arrays[-1]
+            return jitted(pv, av, k)
+
+        # outputs = real outputs + mutated aux values; declare aux as
+        # mutations of the corresponding param inputs.
+        op = OpDef("CachedOp_%s" % self.block.name, impl,
+                   num_outputs=-1,
+                   mutate=tuple(self.mutated_idx))
+        inputs = param_nds + list(arg_leaves) + [NDArray(key)]
+        result = invoke(op, inputs)
+        if not isinstance(result, tuple):
+            result = (result,)
+        out_tree, was_seq = self.out_tree
+        outs = _unflatten_nds(out_tree, list(result))
+        if not was_seq and len(outs) == 1:
+            return outs[0]
+        return outs
+
+
+class HybridBlock(Block):
+    """Block that can be compiled to a single XLA computation.
+
+    Subclasses implement ``hybrid_forward(F, x, *, <params...>)`` exactly
+    as in the reference; ``F`` is the ``nd`` namespace (eager) in both
+    modes — under ``hybridize()`` the same code runs once under the JAX
+    tracer and is cached.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_ops: Dict[Any, _CachedOp] = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_ops = {}
+        super().hybridize(active, **kwargs)
+
+    def infer_shape(self, *args):
+        """Hook: layers override ``_infer_param_shapes`` to resolve
+        deferred-init parameter shapes from inputs."""
+        self._infer_param_shapes(*args)
+
+    def _infer_param_shapes(self, *args):
+        pass
+
+    def cast(self, dtype):
+        self._cached_ops = {}
+        super().cast(dtype)
+
+    def _deferred_init_params(self, *args):
+        needs = [p for p in self._reg_params.values()
+                 if p._deferred_init]
+        if needs:
+            self._infer_param_shapes(*args)
+            for p in needs:
+                p._finish_deferred_init()
+
+    def forward_raw(self, *args):
+        """Run hybrid_forward eagerly with params bound (trace target)."""
+        self._deferred_init_params(*args)
+        params = {k: v.data() for k, v in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **params)
+
+    def forward(self, *args):
+        if self._active and not _in_trace():
+            return self._call_cached(*args)
+        return self.forward_raw(*args)
+
+    def _resolve_deferred(self, *args):
+        """Resolve deferred-init parameter shapes across the whole subtree
+        with one eager probe forward — the analog of the reference's
+        symbolic shape-inference pass before CachedOp creation.  Mutation
+        writeback is suppressed (shape_resolve_scope) so aux buffers
+        (BatchNorm running stats) are untouched by the probe."""
+        if not any(p._deferred_init
+                   for p in self.collect_params().values()):
+            return
+        from .. import autograd
+        from ..ops.registry import shape_resolve_scope
+        _TRACE_STATE.active = getattr(_TRACE_STATE, "active", 0) + 1
+        try:
+            with autograd._scope(False, False):
+                with shape_resolve_scope():
+                    self.forward_raw(*args)
+        finally:
+            _TRACE_STATE.active -= 1
+
+    def _call_cached(self, *args):
+        from .. import autograd
+        leaves, tree = _flatten_nds(args)
+        self._resolve_deferred(*args)
+        all_params = [p for p in self.collect_params().values()
+                      if p._data is not None]
+        sig = (tuple((l.shape, str(l.dtype)) for l in leaves),
+               autograd.is_training(),
+               _tree_sig(tree))
+        centry = self._cached_ops.get(sig)
+        if centry is None:
+            centry = _CachedOp(self, all_params, autograd.is_training())
+            centry.build(leaves, tree)
+            self._cached_ops[sig] = centry
+        return centry(leaves)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Serialize params (+ a JSON graph descriptor) for serving.
+
+        Reference: ``HybridBlock.export`` writing ``-symbol.json`` +
+        ``.params``.  The JSON here describes the block tree rather than an
+        nnvm graph (documented divergence; the mount was empty)."""
+        import json
+        params = self._collect_params_with_prefix()
+        arg_dict = {"arg:" + k: v.data() for k, v in params.items()
+                    if v._data is not None}
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+        desc = {"mxnet_tpu_version": 1, "block": type(self).__name__,
+                "name": self.name}
+        with open("%s-symbol.json" % path, "w") as f:
+            json.dump(desc, f)
+
+
+def _tree_sig(tree):
+    if isinstance(tree, list):
+        return tuple(_tree_sig(t) for t in tree)
+    if isinstance(tree, tuple) and len(tree) == 2 and tree[0] == "const":
+        try:
+            hash(tree[1])
+            return tree
+        except TypeError:
+            return ("const", str(tree[1]))
+    return tree
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a Block from a Symbol (reference: ``gluon.SymbolBlock``).
+    Implemented once the Symbol API lands; placeholder that raises."""
+
+    def __init__(self, outputs, inputs, params=None):
+        raise MXNetError("SymbolBlock arrives with the Symbol API "
+                         "(see symbol/symbol.py)")

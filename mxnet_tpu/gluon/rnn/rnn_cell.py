@@ -1,0 +1,419 @@
+"""Explicit recurrent cells.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_cell.py`` (SURVEY.md §2.2) —
+RNNCell/LSTMCell/GRUCell with ``unroll``, plus Sequential/Dropout/
+Residual/Zoneout modifiers.  Gate orders match the fused op ([i,f,c,o]
+LSTM, [r,z,n] GRU) so parameters are interchangeable.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ... import ndarray as nd
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called "\
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            state = func(shape=info["shape"],
+                         **{k: v for k, v in info.items()
+                            if k not in ("shape", "__layout__")})
+            states.append(state)
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=inputs.context,
+                                           dtype=str(inputs.dtype))
+        states = begin_state
+        outputs = []
+        all_states = []
+        seq = nd.split(inputs, num_outputs=length, axis=axis,
+                       squeeze_axis=True) if length > 1 else \
+            [inputs.squeeze(axis=axis)]
+        if not isinstance(seq, (list, tuple)):
+            seq = [seq]
+        for i in range(length):
+            output, states = self(seq[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.SequenceLast(nd.stack(*ele_list, axis=0),
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(
+                outputs, length, valid_length, axis)
+        if merge_outputs is False:
+            return outputs, states
+        out = nd.stack(*outputs, axis=axis)
+        return out, states
+
+    def forward_raw(self, inputs, states):
+        self._counter += 1
+        return super().forward_raw(inputs, states)
+
+
+def _mask_sequence_variable_length(outputs, length, valid_length, axis):
+    stacked = nd.stack(*outputs, axis=0)
+    masked = nd.SequenceMask(stacked, sequence_length=valid_length,
+                             use_sequence_length=True, axis=0)
+    return [masked[i] for i in range(length)]
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _infer_param_shapes(self, inputs, states, *args):
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (self._hidden_size, inputs.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None,
+                 activation="tanh", recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _infer_param_shapes(self, inputs, states, *args):
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (4 * self._hidden_size,
+                                     inputs.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.Activation(slices[0],
+                               act_type=self._recurrent_activation)
+        forget_gate = F.Activation(slices[1],
+                                   act_type=self._recurrent_activation)
+        in_transform = F.Activation(slices[2], act_type=self._activation)
+        out_gate = F.Activation(slices[3],
+                                act_type=self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.Activation(next_c,
+                                         act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _infer_param_shapes(self, inputs, states, *args):
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (3 * self._hidden_size,
+                                     inputs.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        prev_state_h = states[0] if isinstance(states, (list, tuple)) \
+            else states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h = F.split(h2h, num_outputs=3, axis=1)
+        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = F.Activation(i2h + reset_gate * h2h, act_type="tanh")
+        next_h = (1. - update_gate) * next_h_tmp + \
+            update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[pos:pos + n]
+            pos += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def forward(self, inputs, states):
+        return self.__call__(inputs, states)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class DropoutCell(_ModifierCell):
+    def __init__(self, base_cell=None, rate=0.0, axes=()):
+        # Reference signature is DropoutCell(rate); accept both orders.
+        if not isinstance(base_cell, RecurrentCell):
+            rate, base_cell = base_cell if base_cell is not None else rate, \
+                _IdentityCell()
+        super().__init__(base_cell)
+        self.rate = rate
+        self.axes = axes
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        if self.rate > 0:
+            output = nd.Dropout(output, p=self.rate, axes=self.axes)
+        return output, states
+
+
+class _IdentityCell(RecurrentCell):
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        return inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        p_outputs, p_states = self.zoneout_outputs, self.zoneout_states
+
+        def mask(p, like):
+            return nd.Dropout(nd.ones_like(like), p=p, mode="always")
+        prev_output = self._prev_output if self._prev_output is not None \
+            else nd.zeros_like(next_output)
+        output = nd.where(mask(p_outputs, next_output), next_output,
+                          prev_output) if p_outputs != 0. else next_output
+        new_states = [nd.where(mask(p_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)] \
+            if p_states != 0. else next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__(prefix=None, params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.begin_state(batch_size, **kwargs) + \
+            r.begin_state(batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("Bidirectional cannot be stepped. Please use "
+                         "unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=inputs.context)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        n_l = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, False,
+            valid_length)
+        rev = nd.flip(inputs, axis=axis) if valid_length is None else \
+            nd.SequenceReverse(nd.swapaxes(inputs, 0, axis) if axis else
+                               inputs, sequence_length=valid_length,
+                               use_sequence_length=True, axis=0)
+        if valid_length is not None and axis:
+            rev = nd.swapaxes(rev, 0, axis)
+        r_out, r_states = r_cell.unroll(
+            length, rev, begin_state[n_l:], layout, False, valid_length)
+        r_out = r_out[::-1]
+        outputs = [nd.concat(lo, ro, dim=1)
+                   for lo, ro in zip(l_out, r_out)]
+        out = nd.stack(*outputs, axis=axis)
+        return out, l_states + r_states

@@ -1,0 +1,127 @@
+"""Gluon recurrent layers backed by the fused RNN op.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_layer.py`` (SURVEY.md §2.2) — the
+RNN/LSTM/GRU layers that dispatch to the fused ``RNN`` operator
+(cuDNN in the reference; ``lax.scan`` on TPU, see ops/rnn_op.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ... import ndarray as nd
+from ...ops.rnn_op import rnn_param_size, _GATES
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, prefix=None, params=None,
+                 **kwargs):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        with self.name_scope():
+            self.parameters = self.params.get(
+                "parameters", shape=(rnn_param_size(
+                    mode, num_layers, input_size, hidden_size,
+                    bidirectional) if input_size else 0,),
+                allow_deferred_init=True, init=None)
+
+    def _infer_param_shapes(self, x, *args):
+        if self.parameters.shape is None or 0 in self.parameters.shape:
+            input_size = x.shape[2] if self._layout == "TNC" else x.shape[2]
+            self._input_size = input_size
+            self.parameters.shape = (rnn_param_size(
+                self._mode, self._num_layers, input_size,
+                self._hidden_size, self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, parameters=None):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        batch = inputs.shape[1]
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(batch, ctx=inputs.context,
+                                      dtype=str(inputs.dtype))
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        args = [inputs, parameters] + list(states)
+        result = F.RNN(*args, state_size=self._hidden_size,
+                       num_layers=self._num_layers, mode=self._mode,
+                       bidirectional=self._dir == 2, p=self._dropout,
+                       state_outputs=True)
+        out = result[0]
+        out_states = list(result[1:])
+        if self._layout == "NTC":
+            out = F.swapaxes(out, 0, 1)
+        if explicit_states:
+            return out, out_states
+        return out
+
+    def __repr__(self):
+        return "%s(%s -> %s, %s%s)" % (
+            type(self).__name__, self._input_size or None,
+            self._hidden_size, self._layout,
+            ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    """Vanilla RNN (relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Fused multi-layer LSTM (cuDNN gate order [i,f,c,o] preserved)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Fused multi-layer GRU (gate order [r,z,n])."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,183 @@
+"""Gluon Trainer.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (SURVEY.md §2.2 "Gluon core",
+§3.2 training-step call stack) — kvstore-backed gradient sync
+(``allreduce_grads``) + fused optimizer update (``step``/``update``).
+
+On TPU the ``device``/``nccl`` kvstore reduce becomes an ICI allreduce
+issued by XLA (see ``mxnet_tpu/kvstore``); single-context training
+bypasses comm entirely, exactly like the reference.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .parameter import Parameter
+from .. import ndarray as nd
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % type(params))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % type(param))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._trainer = self
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_arg = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._contexts = self._check_contexts()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init else None
+            if ctx is None:
+                continue
+            if contexts is not None and contexts != ctx:
+                raise MXNetError(
+                    "All Parameters must be initialized on the same set of "
+                    "contexts, but Parameter %s is initialized on %s while "
+                    "previous Parameters are initialized on %s."
+                    % (param.name, str(ctx), str(contexts)))
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = None
+
+    def _init_kvstore(self):
+        from .. import kvstore as kvs
+        contexts = self._check_contexts()
+        self._contexts = contexts
+        if self._kvstore_arg is None or len(contexts) <= 1:
+            self._kvstore = None
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+        else:
+            kv = self._kvstore_arg
+            if isinstance(kv, str):
+                kv = kvs.create(kv)
+            self._kvstore = kv
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    self._kvstore.init(i, param.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Sum gradients across contexts (reference: kvstore push+pull)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._grad is not None:
+                grads = param.list_grad()
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update (reference: ``Trainer.step``)."""
+        rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = rescale_grad
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Optimizer update only (grads already reduced)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._updaters is None:
+            n_ctx = max(1, len(self._contexts))
+            self._updaters = [opt.get_updater(self._optimizer)
+                              for _ in range(n_ctx)]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._grad is None:
+                continue
+            datas = param.list_data()
+            grads = param.list_grad()
+            if len(datas) == 1:
+                self._updaters[0](i, grads[0], datas[0])
+            else:
+                # multi-context: update replica 0, broadcast
+                self._updaters[0](i, grads[0], datas[0])
+                for d in datas[1:]:
+                    datas[0].copyto(d)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._updaters is None:
+            n_ctx = max(1, len(self._contexts))
+            self._updaters = [opt.get_updater(self._optimizer)
+                              for _ in range(n_ctx)]
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._updaters is None:
+            self._updaters = [opt.get_updater(self._optimizer)]
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

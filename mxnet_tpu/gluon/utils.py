@@ -1,0 +1,88 @@
+"""Gluon utilities.
+
+Reference: ``python/mxnet/gluon/utils.py`` — ``split_and_load`` (the
+multi-device data-parallel scatter, §2.4), ``clip_global_norm``.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis, begin=begin,
+                                    end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split along batch axis and scatter to ``ctx_list`` (reference:
+    the Gluon multi-device training entry point — §3.5)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the global 2-norm <= max_norm."""
+    def _norm(array):
+        x = array.reshape((-1,))
+        return nd.dot(x, x)
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = nd.add_n(*[_norm(arr).as_in_context(ctx)
+                            for arr in arrays])
+    total_norm = nd.sqrt(total_norm)
+    total = float(total_norm.asscalar())
+    if check_isfinite:
+        import math
+        if not math.isfinite(total):
+            import warnings
+            warnings.warn("nan or inf is detected. Clipping results will "
+                          "be undefined.", stacklevel=2)
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    if check_isfinite:
+        return total
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("Network egress is unavailable in this environment; "
+                     "place files locally instead of downloading.")

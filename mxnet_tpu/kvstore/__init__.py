@@ -1,0 +1,6 @@
+"""KVStore — data-parallel gradient synchronization API.
+
+Reference: ``python/mxnet/kvstore/`` + ``src/kvstore/`` (SURVEY.md §2.1
+"KVStore", §3.4 call stack).
+"""
+from .kvstore import KVStore, KVStoreBase, create

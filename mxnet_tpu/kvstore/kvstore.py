@@ -1,0 +1,163 @@
+"""KVStore implementations.
+
+Reference: ``src/kvstore/kvstore_local.h`` (CPU reduce), ``comm.h`` /
+``kvstore_nccl.h`` (device/NCCL reduce) — SURVEY.md §2.1, §3.4.
+
+TPU-native design: the reference's NCCL allreduce becomes an ICI
+collective issued by XLA.  For arrays living on separate chips
+(per-context replicas, the reference-style Trainer path) the reduce is a
+jitted sum + broadcast via ``jax.device_put``; PjRt routes the transfers
+over ICI.  The sharded-array path (one array over a Mesh, ``psum`` inside
+the step function) lives in ``mxnet_tpu.parallel`` and is the
+high-performance route; this module preserves the reference push/pull API
+on top of it.
+
+``dist_*`` types (multi-host parameter-server semantics) are implemented
+over ``jax.distributed`` in ``mxnet_tpu/parallel/dist.py`` and registered
+here when available.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from ..base import MXNetError, Registry
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["KVStoreBase", "KVStore", "create"]
+
+_REG = Registry("kvstore")
+
+
+class KVStoreBase:
+    """Pluggable backend registry (reference: ``kvstore/base.py``)."""
+
+    @staticmethod
+    def register(name=None, aliases=()):
+        return _REG.register(name, list(aliases))
+
+
+class KVStore:
+    """Single-process multi-device store (types ``local``, ``device``,
+    ``nccl`` — all reduce over ICI on TPU; the names are kept for script
+    compatibility)."""
+
+    def __init__(self, name="local"):
+        self.type = name
+        self._data: Dict = {}
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._data:
+                continue
+            self._data[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        """Reduce values across devices into the stored buffer.
+
+        Reference: ``KVStoreLocal::Push`` / ``KVStoreNCCL::Push``; on TPU
+        the cross-chip adds ride ICI via PjRt transfers + XLA add."""
+        keys, values = _normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            if k not in self._data:
+                raise MXNetError("key %s was not initialized" % str(k))
+            target_ctx = vlist[0].context
+            reduced = vlist[0]
+            for v in vlist[1:]:
+                reduced = reduced + v.as_in_context(target_ctx)
+            if self._updater is not None:
+                # server-side update semantics (update_on_kvstore=True)
+                self._updater(k, reduced, self._data[k])
+            else:
+                self._data[k]._set_data(
+                    reduced.as_in_context(self._data[k].context)._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            src = self._data[k]
+            for o in olist:
+                o._set_data(src.as_in_context(o.context)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        import warnings
+        warnings.warn("row_sparse_pull executes as dense pull on TPU "
+                      "(SURVEY.md §7 hard-part #7)")
+        self.pull(key, out, priority)
+
+    # -- optimizer-on-kvstore (reference: server-side updates) -----------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        import warnings
+        warnings.warn("gradient compression is a no-op in the single "
+                      "process kvstore (bf16 comms cover the use case)")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        nd.waitall()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+for _name, _aliases in [("local", ("local_allreduce_cpu",)),
+                        ("device", ("local_allreduce_device", "nccl"))]:
+    _REG.register(_name, list(_aliases))(
+        (lambda n: (lambda: KVStore(n)))(_name))
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (reference: ``mx.kv.create``).  ``dist_*`` types
+    map to the multi-host runtime in ``mxnet_tpu.parallel.dist``."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    if name.startswith("dist"):
+        from ..parallel import dist
+        return dist.create_dist_kvstore(name)
+    return _REG.create(name)

@@ -1,0 +1,38 @@
+"""``mx.nd`` — the imperative NDArray API.
+
+Reference: ``python/mxnet/ndarray/`` (SURVEY.md §2.2 "NDArray API").
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concat, stack, save, load, waitall, from_numpy)
+from . import ndarray as _ndmod
+from . import register as _register
+from .. import ops as _ops  # ensure registry populated
+
+# creation-op conveniences with MXNet names
+import sys as _sys
+
+_register.populate(globals())
+_ndmod._install_methods()
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    from ..ops.registry import get_op, invoke
+    return invoke(get_op("_eye"), [], attrs={"N": N, "M": M, "k": k,
+                                             "dtype": dtype}, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    from ..ops.registry import get_op, invoke
+    return invoke(get_op("_linspace"), [],
+                  attrs={"start": start, "stop": stop, "num": num,
+                         "endpoint": endpoint, "dtype": dtype}, ctx=ctx)
+
+
+def zeros_like(data, **kw):
+    from ..ops.registry import get_op, invoke
+    return invoke(get_op("zeros_like"), [data])
+
+
+def ones_like(data, **kw):
+    from ..ops.registry import get_op, invoke
+    return invoke(get_op("ones_like"), [data])

@@ -1,0 +1,53 @@
+"""Generated ``nd`` namespace — stubs created by walking the op registry.
+
+Reference: ``python/mxnet/ndarray/register.py`` (SURVEY.md §1: "Python op
+functions are generated at import time by walking the registry").
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+from ..ops import registry as _registry
+from .ndarray import NDArray
+
+
+def _make_stub(op: "_registry.OpDef"):
+    def stub(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ctx = kwargs.pop("ctx", None)
+        arrays = []
+        pos_attrs = []
+        flat_args = []
+        for a in args:
+            if isinstance(a, (list, tuple)) and a and \
+                    all(isinstance(x, NDArray) for x in a):
+                flat_args.extend(a)
+            else:
+                flat_args.append(a)
+        seen_attr = False
+        for a in flat_args:
+            if isinstance(a, NDArray) and not seen_attr:
+                arrays.append(a)
+            else:
+                seen_attr = True
+                pos_attrs.append(a)
+        return _registry.invoke(op, arrays, tuple(pos_attrs), kwargs,
+                                out=out, ctx=ctx)
+
+    stub.__name__ = op.name
+    stub.__doc__ = op.doc
+    return stub
+
+
+def populate(namespace: dict, symbol_mode: bool = False):
+    """Install a stub for every registered op into ``namespace``."""
+    seen = set()
+    for name in _registry.list_ops():
+        op = _registry.get_op(name)
+        if name in namespace:
+            continue
+        namespace[name] = _make_stub(op)
+        seen.add(name)
+    return seen

@@ -1,0 +1,19 @@
+"""Operator library — importing this package registers every op.
+
+Reference: the nnvm registry populated by static initializers in
+``src/operator/*`` (SURVEY.md §2.1).  Python stubs for the ``nd``/``sym``
+namespaces are generated from this registry at import time
+(reference: ``python/mxnet/ndarray/register.py``).
+"""
+from . import registry
+from .registry import get_op, list_ops, invoke, register, OpDef
+
+from . import elemwise      # noqa: F401
+from . import creation_ops  # noqa: F401
+from . import reduce        # noqa: F401
+from . import shape_ops     # noqa: F401
+from . import nn            # noqa: F401
+from . import random_ops    # noqa: F401
+from . import linalg        # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import rnn_op        # noqa: F401

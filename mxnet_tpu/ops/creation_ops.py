@@ -1,0 +1,17 @@
+"""Creation ops already live in shape_ops (``_zeros``/``_ones``/…); this
+module holds the few remaining init ops (reference:
+``src/operator/tensor/init_op.cc``)."""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("_full", no_grad=True)
+def _full(shape=None, value=0.0, dtype="float32", **kw):
+    import numpy as _np
+    return _j().full(shape, value, dtype=_np.dtype(dtype or "float32").name)

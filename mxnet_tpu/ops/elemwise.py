@@ -1,0 +1,277 @@
+"""Elementwise / broadcast operator kernels.
+
+Reference: ``src/operator/tensor/elemwise_*`` + ``broadcast_reduce_op*`` +
+``mshadow_op.h`` functors (SURVEY.md §2.1 "Operator library").  Every impl
+is a pure JAX function lowering to XLA HLO; XLA's fusion pass subsumes the
+reference's mshadow expression templates and NVRTC pointwise fusion
+(``src/operator/fusion/fused_op.cu``) — fused elementwise chains come from
+the compiler, not hand-written kernels.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn, aliases=(), no_grad=False):
+    @register(name, aliases=aliases, no_grad=no_grad)
+    def impl(data, **kw):
+        return fn(_j(), data)
+    impl.__name__ = name
+    return impl
+
+
+_unary("negative", lambda jnp, x: -x)
+_unary("abs", lambda jnp, x: jnp.abs(x))
+_unary("sign", lambda jnp, x: jnp.sign(x))
+_unary("square", lambda jnp, x: jnp.square(x))
+_unary("sqrt", lambda jnp, x: jnp.sqrt(x))
+_unary("rsqrt", lambda jnp, x: 1.0 / jnp.sqrt(x))
+_unary("cbrt", lambda jnp, x: jnp.cbrt(x))
+_unary("rcbrt", lambda jnp, x: 1.0 / jnp.cbrt(x))
+_unary("exp", lambda jnp, x: jnp.exp(x))
+_unary("expm1", lambda jnp, x: jnp.expm1(x))
+_unary("log", lambda jnp, x: jnp.log(x))
+_unary("log2", lambda jnp, x: jnp.log2(x))
+_unary("log10", lambda jnp, x: jnp.log10(x))
+_unary("log1p", lambda jnp, x: jnp.log1p(x))
+_unary("reciprocal", lambda jnp, x: 1.0 / x)
+_unary("sin", lambda jnp, x: jnp.sin(x))
+_unary("cos", lambda jnp, x: jnp.cos(x))
+_unary("tan", lambda jnp, x: jnp.tan(x))
+_unary("arcsin", lambda jnp, x: jnp.arcsin(x))
+_unary("arccos", lambda jnp, x: jnp.arccos(x))
+_unary("arctan", lambda jnp, x: jnp.arctan(x))
+_unary("sinh", lambda jnp, x: jnp.sinh(x))
+_unary("cosh", lambda jnp, x: jnp.cosh(x))
+_unary("tanh", lambda jnp, x: jnp.tanh(x))
+_unary("arcsinh", lambda jnp, x: jnp.arcsinh(x))
+_unary("arccosh", lambda jnp, x: jnp.arccosh(x))
+_unary("arctanh", lambda jnp, x: jnp.arctanh(x))
+_unary("degrees", lambda jnp, x: jnp.degrees(x))
+_unary("radians", lambda jnp, x: jnp.radians(x))
+_unary("floor", lambda jnp, x: jnp.floor(x))
+_unary("ceil", lambda jnp, x: jnp.ceil(x))
+_unary("trunc", lambda jnp, x: jnp.trunc(x))
+_unary("rint", lambda jnp, x: jnp.rint(x))
+_unary("round", lambda jnp, x: jnp.round(x))
+_unary("fix", lambda jnp, x: jnp.fix(x))
+_unary("erf", lambda jnp, x: __import__("jax").scipy.special.erf(x))
+_unary("erfinv", lambda jnp, x: __import__("jax").scipy.special.erfinv(x))
+_unary("gamma", lambda jnp, x: jnp.exp(__import__("jax").scipy.special.gammaln(x)))
+_unary("gammaln", lambda jnp, x: __import__("jax").scipy.special.gammaln(x))
+_unary("relu", lambda jnp, x: jnp.maximum(x, 0))
+_unary("sigmoid", lambda jnp, x: __import__("jax").nn.sigmoid(x))
+_unary("softsign", lambda jnp, x: x / (1 + jnp.abs(x)))
+_unary("hard_sigmoid", lambda jnp, x: jnp.clip(0.2 * x + 0.5, 0, 1))
+_unary("logical_not", lambda jnp, x: (~(x.astype(bool))).astype(x.dtype))
+_unary("identity", lambda jnp, x: x, aliases=("_copy",))
+_unary("erfc", lambda jnp, x: __import__("jax").scipy.special.erfc(x))
+_unary("digamma", lambda jnp, x: __import__("jax").scipy.special.digamma(x))
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data, **kw):
+    import jax
+    return jax.lax.stop_gradient(data)
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null",
+              **kw):
+    return data * grad_scale if grad_scale != 1.0 else data
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None, **kw):
+    return _j().clip(data, a_min, a_max)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0, **kw):
+    jnp = _j()
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("Cast", aliases=("cast",), no_grad=False)
+def cast(data, dtype="float32", **kw):
+    return data.astype(_np.dtype(dtype).name)
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float32", **kw):
+    return data.astype(_np.dtype(dtype).name)
+
+
+@register("amp_multicast", variadic=True, num_outputs=-1)
+def amp_multicast(data, num_outputs=None, cast_narrow=False, **kw):
+    jnp = _j()
+    dtypes = [d.dtype for d in data]
+    widths = [_np.dtype(str(d)).itemsize for d in dtypes]
+    target = dtypes[_np.argmin(widths)] if cast_narrow else \
+        dtypes[_np.argmax(widths)]
+    return tuple(d.astype(target) for d in data)
+
+
+# ---------------------------------------------------------------------------
+# binary (same-shape elemwise + broadcast variants; on XLA both lower to the
+# same HLO so the broadcast impls serve both op families)
+# ---------------------------------------------------------------------------
+
+def _binary(name, fn, aliases=(), no_grad=False):
+    @register(name, aliases=aliases, no_grad=no_grad)
+    def impl(lhs, rhs, **kw):
+        return fn(_j(), lhs, rhs)
+    impl.__name__ = name
+    return impl
+
+
+_binary("broadcast_add", lambda jnp, a, b: a + b,
+        aliases=("elemwise_add", "_plus", "_add", "broadcast_plus"))
+_binary("broadcast_sub", lambda jnp, a, b: a - b,
+        aliases=("elemwise_sub", "_sub", "_minus", "broadcast_minus"))
+_binary("broadcast_mul", lambda jnp, a, b: a * b,
+        aliases=("elemwise_mul", "_mul"))
+_binary("broadcast_div", lambda jnp, a, b: a / b,
+        aliases=("elemwise_div", "_div"))
+_binary("broadcast_mod", lambda jnp, a, b: jnp.mod(a, b), aliases=("_mod",))
+_binary("broadcast_power", lambda jnp, a, b: jnp.power(a, b),
+        aliases=("_power", "pow"))
+_binary("_broadcast_floordiv", lambda jnp, a, b: jnp.floor_divide(a, b))
+_binary("broadcast_maximum", lambda jnp, a, b: jnp.maximum(a, b),
+        aliases=("_maximum", "maximum"))
+_binary("broadcast_minimum", lambda jnp, a, b: jnp.minimum(a, b),
+        aliases=("_minimum", "minimum"))
+_binary("broadcast_hypot", lambda jnp, a, b: jnp.hypot(a, b))
+_binary("arctan2", lambda jnp, a, b: jnp.arctan2(a, b))
+
+_binary("broadcast_equal", lambda jnp, a, b: (a == b).astype(a.dtype),
+        aliases=("_equal",), no_grad=True)
+_binary("broadcast_not_equal", lambda jnp, a, b: (a != b).astype(a.dtype),
+        aliases=("_not_equal",), no_grad=True)
+_binary("broadcast_greater", lambda jnp, a, b: (a > b).astype(a.dtype),
+        aliases=("_greater",), no_grad=True)
+_binary("broadcast_greater_equal",
+        lambda jnp, a, b: (a >= b).astype(a.dtype),
+        aliases=("_greater_equal",), no_grad=True)
+_binary("broadcast_lesser", lambda jnp, a, b: (a < b).astype(a.dtype),
+        aliases=("_lesser",), no_grad=True)
+_binary("broadcast_lesser_equal",
+        lambda jnp, a, b: (a <= b).astype(a.dtype),
+        aliases=("_lesser_equal",), no_grad=True)
+_binary("broadcast_logical_and",
+        lambda jnp, a, b: (a.astype(bool) & b.astype(bool)).astype(a.dtype),
+        no_grad=True)
+_binary("broadcast_logical_or",
+        lambda jnp, a, b: (a.astype(bool) | b.astype(bool)).astype(a.dtype),
+        no_grad=True)
+_binary("broadcast_logical_xor",
+        lambda jnp, a, b: (a.astype(bool) ^ b.astype(bool)).astype(a.dtype),
+        no_grad=True)
+_binary("_npi_matmul", lambda jnp, a, b: jnp.matmul(a, b),
+        aliases=("matmul",))
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"), variadic=True)
+def add_n(args, **kw):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar ops (reference: _plus_scalar etc. backing the Python operators)
+# ---------------------------------------------------------------------------
+
+def _scalar(name, fn, no_grad=False):
+    @register(name, no_grad=no_grad)
+    def impl(data, scalar=0.0, **kw):
+        return fn(_j(), data, scalar)
+    impl.__name__ = name
+    return impl
+
+
+_scalar("_plus_scalar", lambda jnp, x, s: x + _tc(jnp, x, s))
+_scalar("_minus_scalar", lambda jnp, x, s: x - _tc(jnp, x, s))
+_scalar("_rminus_scalar", lambda jnp, x, s: _tc(jnp, x, s) - x)
+_scalar("_mul_scalar", lambda jnp, x, s: x * _tc(jnp, x, s))
+_scalar("_div_scalar", lambda jnp, x, s: x / _tc(jnp, x, s))
+_scalar("_rdiv_scalar", lambda jnp, x, s: _tc(jnp, x, s) / x)
+_scalar("_mod_scalar", lambda jnp, x, s: jnp.mod(x, _tc(jnp, x, s)))
+_scalar("_rmod_scalar", lambda jnp, x, s: jnp.mod(_tc(jnp, x, s), x))
+_scalar("_power_scalar", lambda jnp, x, s: jnp.power(x, _tc(jnp, x, s)))
+_scalar("_rpower_scalar", lambda jnp, x, s: jnp.power(_tc(jnp, x, s), x))
+_scalar("_floordiv_scalar",
+        lambda jnp, x, s: jnp.floor_divide(x, _tc(jnp, x, s)))
+_scalar("_maximum_scalar", lambda jnp, x, s: jnp.maximum(x, _tc(jnp, x, s)))
+_scalar("_minimum_scalar", lambda jnp, x, s: jnp.minimum(x, _tc(jnp, x, s)))
+_scalar("_equal_scalar", lambda jnp, x, s: (x == s).astype(x.dtype),
+        no_grad=True)
+_scalar("_not_equal_scalar", lambda jnp, x, s: (x != s).astype(x.dtype),
+        no_grad=True)
+_scalar("_greater_scalar", lambda jnp, x, s: (x > s).astype(x.dtype),
+        no_grad=True)
+_scalar("_greater_equal_scalar", lambda jnp, x, s: (x >= s).astype(x.dtype),
+        no_grad=True)
+_scalar("_lesser_scalar", lambda jnp, x, s: (x < s).astype(x.dtype),
+        no_grad=True)
+_scalar("_lesser_equal_scalar", lambda jnp, x, s: (x <= s).astype(x.dtype),
+        no_grad=True)
+
+
+def _tc(jnp, x, s):
+    """Type-consistent scalar: keep the array dtype (MXNet semantics — a
+    Python float does not promote float16/bfloat16 arrays)."""
+    if _np.issubdtype(_np.dtype(str(x.dtype)), _np.integer) and \
+            float(s) == int(s):
+        return int(s)
+    return jnp.asarray(s, dtype=x.dtype)
+
+
+@register("where")
+def where(condition, x, y, **kw):
+    return _j().where(condition.astype(bool), x, y)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True, **kw):
+    jnp = _j()
+    return jnp.all(jnp.isfinite(data)).reshape((1,)).astype("float32")
+
+
+@register("multi_all_finite", variadic=True)
+def multi_all_finite(data, num_arrays=None, init_output=True, **kw):
+    jnp = _j()
+    ok = jnp.asarray(True)
+    for d in data:
+        ok = ok & jnp.all(jnp.isfinite(d))
+    return ok.reshape((1,)).astype("float32")
+
+
+@register("isnan", no_grad=True)
+def isnan(data, **kw):
+    return _j().isnan(data).astype("float32")
+
+
+@register("isinf", no_grad=True)
+def isinf(data, **kw):
+    return _j().isinf(data).astype("float32")
+
+
+@register("isfinite", no_grad=True)
+def isfinite(data, **kw):
+    return _j().isfinite(data).astype("float32")

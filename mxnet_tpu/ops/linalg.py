@@ -1,0 +1,158 @@
+"""Linear-algebra kernels (reference: ``src/operator/tensor/la_op.cc`` —
+the ``linalg_*`` family, SURVEY.md §2.1).  Lowers to jax.scipy /
+lax.linalg, which XLA maps to MXU-friendly blocked algorithms."""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2, **kw):
+    jnp = _j()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2, **kw):
+    jnp = _j()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A, **kw):
+    import jax
+    return jax.scipy.linalg.cholesky(A, lower=True)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A, **kw):
+    import jax
+    jnp = _j()
+    # A is the Cholesky factor L; potri returns (L L^T)^{-1}
+    n = A.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kw):
+    import jax
+    jnp = _j()
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    lo = lower != transpose
+    if rightside:
+        # X A = alpha B  ->  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lo)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=lo)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kw):
+    jnp = _j()
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = jnp.swapaxes(tri, -1, -2) if transpose else tri
+    if rightside:
+        return alpha * jnp.matmul(B, tri)
+    return alpha * jnp.matmul(tri, B)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
+    jnp = _j()
+    at = jnp.swapaxes(A, -1, -2)
+    if transpose:
+        return alpha * jnp.matmul(at, A)
+    return alpha * jnp.matmul(A, at)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(A, **kw):
+    jnp = _j()
+    w, v = jnp.linalg.eigh(A)
+    # MXNet returns (U, L) with rows of U the eigenvectors: A = U^T diag(L) U
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A, **kw):
+    jnp = _j()
+    # LQ decomposition via QR of A^T: A = L Q
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A, **kw):
+    jnp = _j()
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0, **kw):
+    return _j().diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(A, offset=0, **kw):
+    jnp = _j()
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(A)
+    return out.at[..., idx - offset, idx].set(A)
+
+
+@register("_linalg_extracttrian", aliases=("linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True, **kw):
+    import numpy as np
+    jnp = _j()
+    n = A.shape[-1]
+    if lower:
+        ii, jj = np.tril_indices(n, k=offset)
+    else:
+        ii, jj = np.triu_indices(n, k=offset)
+    return A[..., ii, jj]
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse", "inverse"))
+def linalg_inverse(A, **kw):
+    return _j().linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det", "det"))
+def linalg_det(A, **kw):
+    return _j().linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet", "slogdet"),
+          num_outputs=2)
+def linalg_slogdet(A, **kw):
+    sign, logdet = _j().linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("moments", num_outputs=2)
+def moments(data, axes=None, keepdims=False, **kw):
+    jnp = _j()
+    ax = tuple(axes) if axes is not None else None
+    return (jnp.mean(data, axis=ax, keepdims=keepdims),
+            jnp.var(data, axis=ax, keepdims=keepdims))

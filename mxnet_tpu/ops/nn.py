@@ -1,0 +1,609 @@
+"""Neural-network operator kernels.
+
+Reference: ``src/operator/nn/`` — convolution, fully_connected, pooling,
+activation, batch/layer/instance/group norm, dropout, softmax family,
+embedding (SURVEY.md §2.1 "Operator library").  The cuDNN/oneDNN bridges of
+the reference dissolve: XLA's convolution/matmul emitters target the MXU
+directly, and elementwise epilogues (bias, relu, BN scale) are fused by XLA
+rather than by hand-written vendor-library glue.
+
+Layout note: the API preserves MXNet's NCHW/NCW/NCDHW default layouts;
+XLA's layout assignment re-tiles internally for the MXU, so no NHWC
+conversion is forced on the user.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected / dot / batch_dot — the MXU ops
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kw):
+    jnp = _j()
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    jnp = _j()
+    a = lhs.T if transpose_a and lhs.ndim == 2 else lhs
+    b = rhs.T if transpose_b and rhs.ndim == 2 else rhs
+    if transpose_a and lhs.ndim > 2:
+        a = jnp.moveaxis(lhs, list(range(lhs.ndim)),
+                         list(range(lhs.ndim))[::-1])
+    if transpose_b and rhs.ndim > 2:
+        b = jnp.moveaxis(rhs, list(range(rhs.ndim)),
+                         list(range(rhs.ndim))[::-1])
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    jnp = _j()
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", variadic=True)
+def khatri_rao(args, **kw):
+    jnp = _j()
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            (-1,) + out.shape[1:])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution family
+# ---------------------------------------------------------------------------
+
+def _tup(v, n):
+    if v is None:
+        return (0,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_dims(kernel):
+    return len(kernel) if not isinstance(kernel, int) else 1
+
+
+@register("Convolution")
+def convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, cudnn_tune=None,
+                cudnn_off=False, workspace=1024, **kw):
+    """ND convolution, NC(D)HW layout (reference:
+    ``src/operator/nn/convolution.cc``).  Lowers to
+    ``lax.conv_general_dilated`` → XLA conv emitter → MXU."""
+    jax = _jax()
+    nd = _conv_dims(kernel)
+    stride = _tup(stride or 1, nd)
+    dilate = _tup(dilate or 1, nd)
+    pad = _tup(pad or 0, nd)
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    if spatial is None:
+        raise MXNetError("Convolution supports 1/2/3 spatial dims")
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=num_group,
+        preferred_element_type=None)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, target_shape=None,
+                  num_filter=None, num_group=1, no_bias=True, layout=None,
+                  **kw):
+    """Transposed convolution (reference: ``deconvolution.cc``)."""
+    jax = _jax()
+    jnp = _j()
+    nd = _conv_dims(kernel)
+    stride = _tup(stride or 1, nd)
+    dilate = _tup(dilate or 1, nd)
+    pad = _tup(pad or 0, nd)
+    adj = _tup(adj or 0, nd)
+    spatial = "DHW"[-nd:]
+    lhs_spec = "NC" + spatial
+    rhs_spec = "IO" + spatial  # deconv weight is (in, out/g, *k) in MXNet
+    kdims = weight.shape[2:]
+    # transposed conv = gradient of conv: spatially flip the kernel (conv
+    # vs correlation) and use grad-of-conv padding e-1-p with lhs dilation
+    weight = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    pads = []
+    for k_, d_, p_, s_, a_ in zip(kdims, dilate, pad, stride, adj):
+        e = (k_ - 1) * d_ + 1
+        lo = e - 1 - p_
+        hi = e - 1 - p_ + a_
+        pads.append((lo, hi))
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Pooling")
+def pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, layout=None, cudnn_off=False, p_value=2,
+            **kw):
+    """Max/avg/sum/lp pooling (reference: ``pooling.cc``)."""
+    jax = _jax()
+    jnp = _j()
+    nd = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        if pool_type in ("avg", "lp"):
+            return jnp.mean(data, axis=ax, keepdims=True)
+        return jnp.sum(data, axis=ax, keepdims=True)
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride or 1, nd)
+    pad = _tup(pad or 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: add extra high padding so last window fits
+        extra = []
+        for i, (k_, s_, p_) in enumerate(zip(kernel, stride, pad)):
+            size = data.shape[2 + i]
+            out_full = -(-(size + 2 * p_ - k_) // s_) + 1
+            needed = (out_full - 1) * s_ + k_ - size - p_
+            extra.append(max(needed, p_))
+        pads = ((0, 0), (0, 0)) + tuple(
+            (p, e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window,
+                                     strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window,
+                                       strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for k_ in kernel:
+                denom *= k_
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        powd = jnp.power(jnp.abs(data), p_value)
+        summed = jax.lax.reduce_window(powd, 0.0, jax.lax.add, window,
+                                       strides, pads)
+        return jnp.power(summed, 1.0 / p_value)
+    raise MXNetError("unknown pool_type %r" % pool_type)
+
+
+@register("UpSampling", variadic=True)
+def upsampling(data, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", **kw):
+    jnp = _j()
+    outs = []
+    for d in data:
+        n, c, h, w = d.shape
+        x = jnp.repeat(jnp.repeat(d, scale, axis=2), scale, axis=3)
+        outs.append(x)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        out = outs[0]
+        for o in outs[1:]:
+            out = out + o
+        return out
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def activation(data, act_type="relu", **kw):
+    jax = _jax()
+    jnp = _j()
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise MXNetError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, _key=None, **kw):
+    jax = _jax()
+    jnp = _j()
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim == 1 and data.ndim > 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise MXNetError("unknown act_type %r" % act_type)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None,
+            use_length=False, dtype=None, **kw):
+    jax = _jax()
+    jnp = _j()
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if use_length and length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = steps.reshape(shape) < jnp.expand_dims(length, axis)
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if use_length and length is not None:
+        out = jnp.where(mask, out, 0.0)
+    if dtype is not None:
+        out = out.astype(_np.dtype(dtype).name)
+    return out
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None, **kw):
+    jax = _jax()
+    x = data if not temperature or temperature == 1.0 else data / temperature
+    out = jax.nn.log_softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(_np.dtype(dtype).name)
+    return out
+
+
+@register("softmin")
+def softmin(data, axis=-1, **kw):
+    return softmax(-data, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label, **kw):
+    jax = _jax()
+    jnp = _j()
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype("int32")
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# ---------------------------------------------------------------------------
+# Output heads with fused-loss gradients (reference semantics: the backward
+# of SoftmaxOutput is (p - onehot)/N, not the gradient of its forward).
+# Implemented with jax.custom_vjp to preserve those exact semantics.
+# ---------------------------------------------------------------------------
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, **kw):
+    jax = _jax()
+    jnp = _j()
+
+    attrs = dict(grad_scale=grad_scale, ignore_label=ignore_label,
+                 multi_output=multi_output, use_ignore=use_ignore,
+                 normalization=normalization, smooth_alpha=smooth_alpha)
+
+    @jax.custom_vjp
+    def _so(x, lab):
+        if multi_output:
+            return jax.nn.softmax(x, axis=1)
+        return jax.nn.softmax(x, axis=-1)
+
+    def _fwd(x, lab):
+        return _so(x, lab), (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        axis = 1 if multi_output else -1
+        p = jax.nn.softmax(x, axis=axis)
+        k = x.shape[axis]
+        labi = lab.astype("int32")
+        oh = jax.nn.one_hot(labi, k, dtype=x.dtype, axis=axis)
+        if smooth_alpha:
+            oh = oh * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - oh)
+        grad = p - oh
+        if use_ignore:
+            mask = (lab != ignore_label).astype(x.dtype)
+            grad = grad * jnp.expand_dims(mask, axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / x.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(lab != ignore_label), 1)
+            scale = scale / valid
+        grad = grad * scale
+        return (grad.astype(x.dtype), jnp.zeros_like(lab))
+
+    _so.defvjp(_fwd, _bwd)
+    return _so(data, label)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0, **kw):
+    jax = _jax()
+    jnp = _j()
+
+    @jax.custom_vjp
+    def _lro(x, lab):
+        return x
+
+    def _fwd(x, lab):
+        return x, (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        n = x.shape[0]
+        grad = (x - lab.reshape(x.shape)) * (grad_scale / n)
+        return (grad, jnp.zeros_like(lab))
+
+    _lro.defvjp(_fwd, _bwd)
+    return _lro(data, label)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0, **kw):
+    jax = _jax()
+    jnp = _j()
+
+    @jax.custom_vjp
+    def _lro(x, lab):
+        return jax.nn.sigmoid(x)
+
+    def _fwd(x, lab):
+        return jax.nn.sigmoid(x), (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        n = x.shape[0]
+        grad = (jax.nn.sigmoid(x) - lab.reshape(x.shape)) * (grad_scale / n)
+        return (grad, jnp.zeros_like(lab))
+
+    _lro.defvjp(_fwd, _bwd)
+    return _lro(data, label)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0, **kw):
+    jax = _jax()
+    jnp = _j()
+
+    @jax.custom_vjp
+    def _mro(x, lab):
+        return x
+
+    def _fwd(x, lab):
+        return x, (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        n = x.shape[0]
+        grad = jnp.sign(x - lab.reshape(x.shape)) * (grad_scale / n)
+        return (grad, jnp.zeros_like(lab))
+
+    _mro.defvjp(_fwd, _bwd)
+    return _mro(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", aliases=("BatchNorm_v1",), mutate=(3, 4),
+          training_aware=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _training=False, **kw):
+    """Batch normalization with running-stat mutation (reference:
+    ``batch_norm.cc``; aux-state update is the mutate=(3,4) contract)."""
+    jnp = _j()
+    red_ax = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red_ax)
+        var = jnp.var(data, axis=red_ax)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+
+    inv = 1.0 / jnp.sqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + \
+        beta.reshape(bshape)
+    out = out.astype(data.dtype)
+    import jax
+    return (out, jax.lax.stop_gradient(new_mean),
+            jax.lax.stop_gradient(new_var))
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False,
+               **kw):
+    jnp = _j()
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    out = (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    jnp = _j()
+    ax = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) / jnp.sqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    jnp = _j()
+    n, c = data.shape[:2]
+    rest = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    ax = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    x = (x - mean) / jnp.sqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (training-aware, rng-threaded)
+# ---------------------------------------------------------------------------
+
+@register("Dropout", needs_rng=True, training_aware=True)
+def dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False,
+            _training=False, **kw):
+    import jax
+    jnp = _j()
+    if not _training and mode != "always":
+        return data
+    if p <= 0:
+        return data
+    shape = list(data.shape)
+    if axes:
+        for i in range(len(shape)):
+            if i not in axes:
+                shape[i] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape=tuple(shape))
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False, **kw):
+    jnp = _j()
+    idx = data.astype("int32")
+    return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: ``src/operator/nn/ctc_loss.cc``) via optax
+# ---------------------------------------------------------------------------
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", **kw):
+    import optax
+    jnp = _j()
+    # data: (T, N, C) per MXNet; optax expects (N, T, C) logits
+    logits = jnp.transpose(data, (1, 0, 2))
+    N, T, C = logits.shape
+    if blank_label == "first":
+        blank_id = 0
+        labels = label.astype("int32")
+    else:
+        blank_id = C - 1
+        labels = label.astype("int32")
+    if use_data_lengths and data_lengths is not None:
+        t_ar = jnp.arange(T)[None, :]
+        logitpad = (t_ar >= data_lengths[:, None].astype("int32")
+                    ).astype("float32")
+    else:
+        logitpad = jnp.zeros((N, T), dtype="float32")
+    L = labels.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        l_ar = jnp.arange(L)[None, :]
+        labpad = (l_ar >= label_lengths[:, None].astype("int32")
+                  ).astype("float32")
+    else:
+        # MXNet convention: labels padded with 0 (when blank is 'last') or
+        # -1; treat values < (1 if blank first else 0) as padding
+        pad_val = 0 if blank_label == "first" else -1
+        labpad = (labels <= pad_val).astype("float32") \
+            if blank_label == "first" else (labels < 0).astype("float32")
+    loss = optax.ctc_loss(logits, logitpad, labels, labpad,
+                          blank_id=blank_id)
+    return loss

@@ -1,0 +1,180 @@
+"""Random sampling kernels.
+
+Reference: ``src/operator/random/`` (SURVEY.md §2.1).  Every sampler takes
+its PRNG key as the first (auto-injected) input — see
+``mxnet_tpu/random.py`` for how this preserves MXNet's stateful-RNG API on
+JAX's functional keys.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _dt(dtype):
+    if dtype is None or dtype == "None":
+        return "float32"
+    return _np.dtype(dtype).name
+
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"),
+          needs_rng=True, no_grad=True)
+def random_uniform(key, low=0.0, high=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.uniform(key, tuple(shape), dtype=_dt(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"),
+          needs_rng=True, no_grad=True)
+def random_normal(key, loc=0.0, scale=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.normal(key, tuple(shape), dtype=_dt(dtype)) * scale \
+        + loc
+
+
+@register("_random_gamma", aliases=("random_gamma",), needs_rng=True,
+          no_grad=True)
+def random_gamma(key, alpha=1.0, beta=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.gamma(key, alpha, tuple(shape),
+                            dtype=_dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",),
+          needs_rng=True, no_grad=True)
+def random_exponential(key, lam=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.exponential(key, tuple(shape), dtype=_dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), needs_rng=True,
+          no_grad=True)
+def random_poisson(key, lam=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial",
+          aliases=("random_negative_binomial",), needs_rng=True,
+          no_grad=True)
+def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype=None, **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    k1, k2 = jax.random.split(key)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(k1, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("randint", "random_randint"),
+          needs_rng=True, no_grad=True)
+def random_randint(key, low=0, high=1, shape=(1,), dtype="int32", **kw):
+    import jax
+    if isinstance(shape, int):
+        shape = (shape,)
+    return jax.random.randint(key, tuple(shape), int(low), int(high),
+                              dtype=_np.dtype(dtype or "int32").name)
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          needs_rng=True, no_grad=True)
+def sample_multinomial(key, data, shape=1, get_prob=False, dtype="int32",
+                       **kw):
+    import jax
+    jnp = _j()
+    n = shape if isinstance(shape, int) else int(_np.prod(shape))
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    if data.ndim == 1:
+        samples = jax.random.categorical(key, logits, shape=(n,))
+        out = samples if n > 1 else samples.reshape(())
+    else:
+        samples = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                         shape=(data.shape[0], n))
+        out = samples if n > 1 else samples.reshape((data.shape[0],))
+    out = out.astype(_np.dtype(dtype).name)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype("int32").reshape(data.shape[:-1] + (-1,)), axis=-1)
+        return (out, lp.reshape(out.shape))
+    return out
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True, no_grad=True)
+def shuffle(key, data, **kw):
+    import jax
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", needs_rng=True, no_grad=True)
+def sample_unique_zipfian(key, range_max=None, shape=(1,), **kw):
+    import jax
+    jnp = _j()
+    if isinstance(shape, int):
+        shape = (shape,)
+    u = jax.random.uniform(key, tuple(shape))
+    out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype("int64")
+    return jnp.clip(out, 0, range_max - 1)
+
+
+def _param_sample(name, sampler):
+    """sample_* family: per-element distribution parameters as arrays."""
+    @register(name, needs_rng=True, no_grad=True)
+    def impl(key, *params, shape=None, dtype=None, **kw):
+        import jax
+        if shape in (None, ()):
+            extra = ()
+        elif isinstance(shape, int):
+            extra = (shape,)
+        else:
+            extra = tuple(shape)
+        out_shape = params[0].shape + extra
+        return sampler(jax, key, params, out_shape).astype(_dt(dtype))
+    impl.__name__ = name
+    return impl
+
+
+def _expand(p, out_shape):
+    jnp = _j()
+    return jnp.broadcast_to(
+        p.reshape(p.shape + (1,) * (len(out_shape) - p.ndim)), out_shape)
+
+
+_param_sample(
+    "_sample_uniform",
+    lambda jax, key, ps, s: jax.random.uniform(key, s) *
+    (_expand(ps[1], s) - _expand(ps[0], s)) + _expand(ps[0], s))
+_param_sample(
+    "_sample_normal",
+    lambda jax, key, ps, s: jax.random.normal(key, s) * _expand(ps[1], s) +
+    _expand(ps[0], s))
+_param_sample(
+    "_sample_gamma",
+    lambda jax, key, ps, s: jax.random.gamma(key, _expand(ps[0], s), s) *
+    _expand(ps[1], s))
+_param_sample(
+    "_sample_exponential",
+    lambda jax, key, ps, s: jax.random.exponential(key, s) /
+    _expand(ps[0], s))
+_param_sample(
+    "_sample_poisson",
+    lambda jax, key, ps, s: jax.random.poisson(
+        key, _expand(ps[0], s), s).astype("float32"))

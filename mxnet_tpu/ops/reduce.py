@@ -1,0 +1,166 @@
+"""Reduction / sorting / indexing-reduction kernels.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_value.cc``,
+``ordering_op.cc``, ``matrix_op.cc`` reductions (SURVEY.md §2.1).
+MXNet reduction semantics preserved: ``axis=None`` reduces all, ``keepdims``,
+``exclude`` inverts the axis set.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        ax = None
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    if ax is not None:
+        ax = tuple(a % ndim for a in ax)
+    if exclude:
+        all_ax = set(range(ndim))
+        ax = tuple(sorted(all_ax - set(ax or ())))
+    return ax
+
+
+def _reduce(name, fn, aliases=(), no_grad=False):
+    @register(name, aliases=aliases, no_grad=no_grad)
+    def impl(data, axis=None, keepdims=False, exclude=False, **kw):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(_j(), data, ax, keepdims)
+    impl.__name__ = name
+    return impl
+
+
+_reduce("sum", lambda jnp, x, ax, kd: jnp.sum(x, axis=ax, keepdims=kd),
+        aliases=("sum_axis",))
+_reduce("mean", lambda jnp, x, ax, kd: jnp.mean(x, axis=ax, keepdims=kd))
+_reduce("prod", lambda jnp, x, ax, kd: jnp.prod(x, axis=ax, keepdims=kd))
+_reduce("max", lambda jnp, x, ax, kd: jnp.max(x, axis=ax, keepdims=kd),
+        aliases=("max_axis",))
+_reduce("min", lambda jnp, x, ax, kd: jnp.min(x, axis=ax, keepdims=kd),
+        aliases=("min_axis",))
+_reduce("nansum", lambda jnp, x, ax, kd: jnp.nansum(x, axis=ax, keepdims=kd))
+_reduce("nanprod",
+        lambda jnp, x, ax, kd: jnp.nanprod(x, axis=ax, keepdims=kd))
+
+
+@register("argmax", no_grad=True)
+def argmax(data, axis=None, keepdims=False, **kw):
+    jnp = _j()
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype("float32")
+
+
+@register("argmin", no_grad=True)
+def argmin(data, axis=None, keepdims=False, **kw):
+    jnp = _j()
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype("float32")
+
+
+@register("argmax_channel", no_grad=True)
+def argmax_channel(data, **kw):
+    return _j().argmax(data, axis=1).astype("float32")
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False, **kw):
+    jnp = _j()
+    ax = _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    jnp = _j()
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        ax = tuple(range(1, data.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / denom
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True, **kw):
+    jnp = _j()
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", no_grad=True)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
+    jnp = _j()
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(_np.dtype(dtype).name)
+
+
+@register("topk", no_grad=True, num_outputs=-1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32", **kw):
+    import jax
+    jnp = _j()
+    axis = axis if axis is not None else -1
+    neg = data if not is_ascend else -data
+    moved = jnp.moveaxis(neg, axis, -1)
+    vals, idx = jax.lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(_np.dtype(dtype).name)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx)
+    if ret_typ == "mask":
+        mask = jnp.zeros(moved.shape, dtype=data.dtype)
+        mask = mask.at[..., :].set(0)
+        oh = jax.nn.one_hot(idx.astype("int32"), data.shape[axis],
+                            dtype=data.dtype)
+        m = jnp.sum(jnp.moveaxis(oh, axis, -2), axis=axis)
+        return jnp.moveaxis(m, -1, axis)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+@register("cumsum")
+def cumsum(data, axis=None, dtype=None, **kw):
+    jnp = _j()
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(data, axis=axis)
+    if dtype is not None:
+        out = out.astype(_np.dtype(dtype).name)
+    return out

@@ -1,0 +1,150 @@
+"""Fused RNN operator (LSTM/GRU/vanilla, multi-layer, bidirectional).
+
+Reference: ``src/operator/rnn-inl.h`` — the fused ``RNN`` op that the
+reference dispatches to cuDNN (SURVEY.md §2.1; gluon/rnn uses it).
+TPU-native design: the time loop is a ``lax.scan`` (static-shape, XLA
+compiles it to a single fused while loop on device); the layer loop is
+unrolled in the trace (num_layers is static).  Weight layout follows the
+reference's cuDNN-canonical packing: all gate weights (per layer, per
+direction: W then R), then all biases — gate order LSTM ``[i, f, c, o]``,
+GRU ``[r, z, n]`` — so checkpoints round-trip.
+"""
+from __future__ import annotations
+
+from .registry import register
+from ..base import MXNetError
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def _unpack_params(params, mode, num_layers, input_size, H, D):
+    """Split the flat parameter vector into per-layer (W, R, bW, bR)."""
+    import jax.numpy as jnp
+    G = _GATES[mode]
+    weights = []
+    offset = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        layer_w = []
+        for d in range(D):
+            W = params[offset:offset + G * H * in_sz].reshape(G * H, in_sz)
+            offset += G * H * in_sz
+            R = params[offset:offset + G * H * H].reshape(G * H, H)
+            offset += G * H * H
+            layer_w.append((W, R))
+        weights.append(layer_w)
+    biases = []
+    for layer in range(num_layers):
+        layer_b = []
+        for d in range(D):
+            bW = params[offset:offset + G * H]
+            offset += G * H
+            bR = params[offset:offset + G * H]
+            offset += G * H
+            layer_b.append((bW, bR))
+        biases.append(layer_b)
+    return weights, biases
+
+
+def rnn_param_size(mode, num_layers, input_size, H, bidirectional=False):
+    """Total packed parameter count (used by gluon.rnn for allocation)."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        size += D * (G * H * in_sz + G * H * H + 2 * G * H)
+    return size
+
+
+def _cell_step(mode, x_proj, h, c, R, bR):
+    """One timestep given precomputed input projection x_proj."""
+    import jax
+    import jax.numpy as jnp
+    H = h.shape[-1]
+    if mode == "lstm":
+        gates = x_proj + jnp.matmul(h, R.T) + bR
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "gru":
+        rproj = jnp.matmul(h, R.T) + bR
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hr, hz, hn = jnp.split(rproj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+    h_new = act(x_proj + jnp.matmul(h, R.T) + bR)
+    return h_new, c
+
+
+def _run_direction(mode, data, h0, c0, W, R, bW, bR, reverse):
+    """Scan one direction of one layer.  data: (T, N, I)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.flip(data, axis=0) if reverse else data
+    # hoist the input projection out of the scan: one big MXU matmul
+    x_proj = jnp.einsum("tni,gi->tng", x, W) + bW
+
+    def step(carry, xp):
+        h, c = carry
+        h_new, c_new = _cell_step(mode, xp, h, c, R, bR)
+        return (h_new, c_new), h_new
+
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), x_proj)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT, cT
+
+
+@register("RNN", num_outputs=-1, needs_rng=True, training_aware=True)
+def rnn(key, data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None, sequence_length=None,
+        use_sequence_length=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, _training=False, **kw):
+    import jax
+    import jax.numpy as jnp
+    if mode not in _GATES:
+        raise MXNetError("RNN mode %r not supported" % mode)
+    if projection_size:
+        raise MXNetError("RNN projection_size is not implemented")
+    T, N, I = data.shape
+    H = state_size
+    D = 2 if bidirectional else 1
+    weights, biases = _unpack_params(parameters, mode, num_layers, I, H, D)
+
+    h_states = state  # (L*D, N, H)
+    c_states = state_cell if mode == "lstm" else jnp.zeros_like(state)
+
+    x = data
+    hs_out, cs_out = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(D):
+            sidx = layer * D + d
+            W, R = weights[layer][d]
+            bW, bR = biases[layer][d]
+            out, hT, cT = _run_direction(
+                mode, x, h_states[sidx], c_states[sidx], W, R, bW, bR,
+                reverse=(d == 1))
+            outs.append(out)
+            hs_out.append(hT)
+            cs_out.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and _training and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p, shape=x.shape)
+            x = jnp.where(mask, x / (1 - p), 0.0).astype(x.dtype)
+
+    hN = jnp.stack(hs_out, axis=0)
+    if mode == "lstm":
+        cN = jnp.stack(cs_out, axis=0)
+        return x, hN, cN
+    return x, hN

@@ -1,0 +1,399 @@
+"""Shape-manipulation / indexing / layout kernels.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (reshape, transpose, slice,
+concat, …), ``indexing_op.cc`` (take, pick, gather_nd, scatter_nd, one_hot,
+Embedding-adjacent ops), ``init_op.cc`` (SURVEY.md §2.1).  MXNet special
+reshape codes (0, -1, -2, -3, -4) are implemented to spec.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _mx_reshape_shape(src_shape, target):
+    """Implements MXNet reshape's special codes:
+    0 copy dim, -1 infer, -2 copy rest, -3 merge two, -4 split (with -1
+    allowed inside the split pair)."""
+    src = list(src_shape)
+    out = []
+    i = 0  # cursor into src
+    t = list(target)
+    k = 0
+    while k < len(t):
+        d = t[k]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = t[k + 1], t[k + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; k += 2
+        else:
+            out.append(d)
+            i += 1
+        k += 1
+    # fix up -1 inference
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False, **kw):
+    if shape is None:
+        raise MXNetError("reshape requires shape")
+    if isinstance(shape, int):
+        shape = (shape,)
+    tgt = _mx_reshape_shape(data.shape, tuple(shape))
+    return data.reshape(tgt)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, **kw):
+    return lhs.reshape(rhs.shape)
+
+
+@register("shape_array", no_grad=True)
+def shape_array(data, **kw):
+    return _j().asarray(data.shape, dtype="int64")
+
+
+@register("size_array", no_grad=True)
+def size_array(data, **kw):
+    return _j().asarray([data.size], dtype="int64")
+
+
+@register("transpose")
+def transpose(data, axes=None, **kw):
+    jnp = _j()
+    if axes is None or axes == ():
+        return jnp.transpose(data)
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0, **kw):
+    return _j().swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0, **kw):
+    return _j().expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None, **kw):
+    return _j().squeeze(data, axis=axis)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data, **kw):
+    return data.reshape((data.shape[0], -1))
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=None, **kw):
+    return _j().flip(data, axis=axis)
+
+
+@register("tile")
+def tile(data, reps=None, **kw):
+    return _j().tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None, **kw):
+    return _j().repeat(data, repeats, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None, **kw):
+    jnp = _j()
+    # MXNet allows 0 meaning "keep this dim"
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None, **kw):
+    return _j().broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=None, size=None, **kw):
+    jnp = _j()
+    if axis is None:
+        return data
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("Concat", aliases=("concat",), variadic=True)
+def concat_op(data, dim=1, num_args=None, **kw):
+    return _j().concatenate(data, axis=dim)
+
+
+@register("stack", variadic=True)
+def stack_op(data, axis=0, num_args=None, **kw):
+    return _j().stack(data, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=-1)
+def split(data, num_outputs=None, axis=1, squeeze_axis=False, **kw):
+    jnp = _j()
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2", num_outputs=-1)
+def split_v2(data, indices_or_sections=None, axis=0, squeeze_axis=False,
+             sections=0, **kw):
+    jnp = _j()
+    if sections and not indices_or_sections:
+        indices_or_sections = sections
+    parts = jnp.split(data, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=None, end=None, step=None, **kw):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return data[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None, **kw):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=(), **kw):
+    idx = [slice(None)] * data.ndim
+    if not axes:
+        axes = range(min(data.ndim, shape_like.ndim))
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip", **kw):
+    jnp = _j()
+    idx = indices.astype("int32")
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    jnp = _j()
+    idx = jnp.clip(index.astype("int32"), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def gather_nd(data, indices, **kw):
+    jnp = _j()
+    idx = indices.astype("int32")
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", no_grad=False)
+def scatter_nd(data, indices, shape=None, **kw):
+    jnp = _j()
+    idx = indices.astype("int32")
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("one_hot", no_grad=True)
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0,
+            dtype="float32", **kw):
+    import jax
+    oh = jax.nn.one_hot(indices.astype("int32"), depth,
+                        dtype=_np.dtype(dtype).name)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kw):
+    jnp = _j()
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    bshape = [1] * data.ndim
+    bshape[axis] = T
+    steps = steps.reshape(bshape)
+    batch_axis = 1 if axis == 0 else 0
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    lens = sequence_length.reshape(lshape)
+    mask = steps < lens
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kw):
+    jnp = _j()
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype("int32") - 1)
+    moved = jnp.moveaxis(data, axis, 0)
+    batch = moved.shape[1]
+    return moved[last, jnp.arange(batch)]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kw):
+    jnp = _j()
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    lens = sequence_length.astype("int32")
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)
+    rev = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(rev, 0, axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=None, constant_value=0.0, **kw):
+    jnp = _j()
+    pw = list(zip(pad_width[::2], pad_width[1::2]))
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise MXNetError("unknown pad mode %r" % mode)
+
+
+@register("diag")
+def diag(data, k=0, axis1=0, axis2=1, **kw):
+    jnp = _j()
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("zeros_like")
+def zeros_like(data, **kw):
+    return _j().zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data, **kw):
+    return _j().ones_like(data)
+
+
+@register("_full_like")
+def full_like(data, fill_value=0.0, **kw):
+    return _j().full_like(data, fill_value)
+
+
+@register("_zeros", no_grad=True)
+def _zeros(shape=None, dtype="float32", **kw):
+    return _j().zeros(shape, dtype=_np.dtype(dtype or "float32").name)
+
+
+@register("_ones", no_grad=True)
+def _ones(shape=None, dtype="float32", **kw):
+    return _j().ones(shape, dtype=_np.dtype(dtype or "float32").name)
+
+
+@register("_arange", no_grad=True)
+def _arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32", **kw):
+    jnp = _j()
+    out = jnp.arange(start, stop, step, dtype=_np.dtype(dtype).name)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", no_grad=True)
+def _eye(N=0, M=0, k=0, dtype="float32", **kw):
+    return _j().eye(int(N), int(M) if M else None, k=int(k),
+                    dtype=_np.dtype(dtype).name)
+
+
+@register("_linspace", no_grad=True)
+def _linspace(start=0, stop=1, num=50, endpoint=True, dtype="float32", **kw):
+    return _j().linspace(start, stop, int(num), endpoint=endpoint,
+                         dtype=_np.dtype(dtype).name)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1, **kw):
+    jnp = _j()
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1, **kw):
+    jnp = _j()
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (b * b), h * b, w * b)

@@ -1,0 +1,4 @@
+"""Optimizer API (reference: ``python/mxnet/optimizer/``)."""
+from .optimizer import (Optimizer, SGD, Adam, AdaGrad, RMSProp, FTRL, NAG,
+                        Signum, LAMB, LARS, AdaDelta, Adamax, Nadam, Test,
+                        Updater, get_updater, create, register)

@@ -1,0 +1,595 @@
+"""Optimizer API.
+
+Reference: ``python/mxnet/optimizer/`` (SURVEY.md §2.2 "Optimizers") —
+registry-created optimizers whose ``update`` dispatches to the fused
+``*_update`` ops (``src/operator/optimizer_op.cc``), per-weight lr/wd
+multipliers, multi-precision (fp32 master weights for low-precision
+params), and the ``Updater`` state-holder used by Module/KVStore.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+import numpy as _np
+
+from ..base import Registry, MXNetError
+from .. import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdaGrad", "RMSProp", "FTRL", "NAG",
+           "Signum", "LAMB", "AdaDelta", "Adamax", "Nadam", "LARS", "Test",
+           "Updater", "get_updater", "create", "register"]
+
+_REG = Registry("optimizer")
+register = _REG.register
+
+
+class Optimizer:
+    """Base optimizer (reference: ``mxnet.optimizer.Optimizer``)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None,
+                 aggregate_num=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.param_dict = param_dict if param_dict else {}
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = weight.astype("float32")
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            original_state, weight32 = state[0], state[1]
+            grad32 = grad.astype("float32")
+            self.update(index, weight32, grad32, original_state)
+            weight._set_data(weight32.astype(weight.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- per-weight multipliers -------------------------------------------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            lr *= getattr(p, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            wd *= getattr(p, "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def __repr__(self):
+        return "%s(lr=%s)" % (type(self).__name__, self.lr)
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _REG.create(name, **kwargs)
+
+
+def _common_kwargs(opt, index):
+    kw = {"rescale_grad": opt.rescale_grad}
+    if opt.clip_gradient is not None:
+        kw["clip_gradient"] = opt.clip_gradient
+    return kw
+
+
+@register("sgd")
+class SGD(Optimizer):
+    """SGD with momentum; dispatches to fused ``sgd_update`` /
+    ``sgd_mom_update`` / ``mp_*`` variants."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            self._update_count(index)
+            lr = self._get_lr(index)
+            wd = self._get_wd(index)
+            kw = _common_kwargs(self, index)
+            mom, w32 = state
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32, out=weight,
+                                     lr=lr, wd=wd, momentum=self.momentum,
+                                     **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, out=weight, lr=lr,
+                                 wd=wd, **kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register("nag")
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.nag_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register("adam")
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        kw = _common_kwargs(self, index)
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, **kw)
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        state._set_data((state + grad * grad)._data)
+        weight._set_data(
+            (weight - lr * grad / (nd.sqrt(state) +
+                                   self.float_stable_eps))._data)
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, ctx=weight.context),
+                    nd.zeros(weight.shape, ctx=weight.context),
+                    nd.zeros(weight.shape, ctx=weight.context))
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            nd.rmsprop_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                              gamma1=self.gamma1, epsilon=self.epsilon,
+                              **kw)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta, out=weight,
+                                  lr=lr, wd=wd, gamma1=self.gamma1,
+                                  gamma2=self.gamma2, epsilon=self.epsilon,
+                                  **kw)
+
+
+@register("ftrl")
+class FTRL(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, out=weight, lr=lr, wd=wd,
+                       lamda1=self.lamda1, beta=self.beta, **kw)
+
+
+@register("signum")
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.signum_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                             momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+        else:
+            nd.signsgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register("lamb")
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = _common_kwargs(self, index)
+        g = nd.lamb_update_phase1(weight, grad, mean, var, beta1=self.beta1,
+                                  beta2=self.beta2, epsilon=self.epsilon,
+                                  t=t, bias_correction=self.bias_correction,
+                                  wd=wd, **kw)
+        # phase1's new mean/var must persist: recompute & swap
+        beta1, beta2 = self.beta1, self.beta2
+        gr = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            gr = nd.clip(gr, -self.clip_gradient, self.clip_gradient)
+        mean._set_data((beta1 * mean + (1 - beta1) * gr)._data)
+        var._set_data((beta2 * var + (1 - beta2) * (gr * gr))._data)
+        r1 = nd.norm(weight)
+        r2 = nd.norm(g)
+        kw2 = {}
+        if self.lower_bound is not None:
+            kw2["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw2["upper_bound"] = self.upper_bound
+        nd.lamb_update_phase2(weight, g, r1, r2, out=weight, lr=lr, **kw2)
+
+
+@register("lars")
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: contrib LARS)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        w_norm = float(nd.norm(weight).asscalar())
+        g_norm = float(nd.norm(grad * self.rescale_grad).asscalar())
+        if w_norm > 0 and g_norm > 0:
+            lr = lr * self.eta * w_norm / (g_norm + wd * w_norm +
+                                           self.epsilon)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g +
+                         (1 - self.rho) * grad * grad)._data)
+        delta = (nd.sqrt(acc_delta + self.epsilon) /
+                 nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta._set_data((self.rho * acc_delta +
+                             (1 - self.rho) * delta * delta)._data)
+        weight._set_data((weight - delta)._data)
+
+
+@register("adamax")
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        m_t, u_t = state
+        m_t._set_data((self.beta1 * m_t + (1 - self.beta1) * grad)._data)
+        u_t._set_data(nd.maximum(self.beta2 * u_t, nd.abs(grad))._data)
+        weight._set_data((weight - lr * m_t / (u_t + 1e-8))._data)
+
+
+@register("nadam")
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 **
+                                   (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._set_data((self.beta1 * m_t + (1. - self.beta1) * grad)._data)
+        v_t._set_data((self.beta2 * v_t +
+                       (1. - self.beta2) * grad * grad)._data)
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - self.beta2 ** t)
+        m_t_bar = ((1. - momentum_t) * grad_prime +
+                   momentum_t_1 * m_t_prime)
+        weight._set_data((weight - lr * m_t_bar /
+                          (nd.sqrt(v_t_prime) + self.epsilon))._data)
+
+
+@register("test")
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad)._data)
+        state._set_data(weight._data)
+
+
+class Updater:
+    """State-holding update closure (reference: ``mxnet.optimizer.Updater``,
+    used by KVStore server-side updates and Module)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def _np_state(s):
+            if s is None:
+                return None
+            if isinstance(s, (list, tuple)):
+                return tuple(_np_state(x) for x in s)
+            return s.asnumpy()
+        states = {k: _np_state(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            states, self.optimizer = data
+        else:
+            states = data
+
+        def _nd_state(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(_nd_state(x) for x in s)
+            return nd.array(s)
+        self.states = {k: _nd_state(v) for k, v in states.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)

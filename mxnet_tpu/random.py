@@ -1,0 +1,79 @@
+"""Global random state — MXNet's stateful RNG API on JAX's explicit keys.
+
+Reference: ``mx.random.seed`` + per-ctx PRNG resources
+(``src/resource.cc`` ``ResourceManager``, SURVEY.md §2.1 "Init/runtime
+misc").
+
+TPU-native design: JAX randomness is functional (explicit keys).  This
+module owns a process-global key that random *ops* consume by splitting —
+each consumed key is recorded on the autograd tape / passed as a traced
+argument, so:
+
+* eager replay (autograd backward) reproduces the forward sample exactly;
+* under ``hybridize()`` the CachedOp threads a fresh key argument per call
+  (``push_trace_key``), so compiled dropout gets new randomness every step
+  without retracing.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["seed", "next_key", "push_trace_key", "pop_trace_key"]
+
+
+class _RandomState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_stack: List = []
+
+
+_STATE = _RandomState()
+_SEED_LOCK = threading.Lock()
+_GLOBAL_SEED = [0]
+
+
+def seed(seed_state: int, ctx="all"):
+    """Seed the global RNG (reference: ``mx.random.seed``)."""
+    import jax
+    with _SEED_LOCK:
+        _GLOBAL_SEED[0] = int(seed_state)
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def _ensure_key():
+    import jax
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(_GLOBAL_SEED[0])
+    return _STATE.key
+
+
+def next_key():
+    """Return a fresh PRNG key.
+
+    Inside a CachedOp trace, splits from the traced key argument so that the
+    compiled function re-randomizes per call; otherwise splits the global
+    stateful key.
+    """
+    import jax
+    if _STATE.trace_stack:
+        cur = _STATE.trace_stack[-1]
+        new, sub = jax.random.split(cur)
+        _STATE.trace_stack[-1] = new
+        return sub
+    cur = _ensure_key()
+    new, sub = jax.random.split(cur)
+    _STATE.key = new
+    return sub
+
+
+def push_trace_key(key):
+    _STATE.trace_stack.append(key)
+
+
+def pop_trace_key():
+    return _STATE.trace_stack.pop()
+
+
+def uses_rng_in_trace() -> bool:
+    return bool(_STATE.trace_stack)

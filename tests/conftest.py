@@ -1,0 +1,27 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh so
+multi-chip code paths are exercised without TPU hardware (SURVEY.md §4 /
+task brief).  Must run before jax is imported anywhere."""
+import os
+
+# Tests run on CPU; unsetting the axon pool IP makes the TPU sitecustomize
+# skip tunnel registration entirely (robust against a busy/wedged tunnel).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    """Seeded-reproducible tests (reference: @with_seed decorator in
+    tests/python/unittest/common.py)."""
+    seed = int(os.environ.get("MXNET_TEST_SEED", "42"))
+    _np.random.seed(seed)
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    yield

@@ -1,0 +1,179 @@
+"""Autograd tests (reference model: ``tests/python/unittest/test_autograd.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_chain_and_branches():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = x * x
+        y = a + b * a  # 3x + 3x^3
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [3 + 9 * 4.0])
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])
+    with autograd.record():
+        y2 = nd.BlockGrad(x * x) * x
+    y2.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_pause_and_modes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    g = autograd.grad(y, [x])
+    assert np.allclose(g[0].asnumpy(), 2 * x.asnumpy())
+    # .grad buffer untouched by grad()
+    assert np.allclose(x.grad.asnumpy(), 0.0)
+
+
+def test_higher_order():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # y = x^3
+        dy = autograd.grad(y, [x], create_graph=True)[0]  # 3x^2
+        assert np.allclose(dy.asnumpy(), [12.0])
+        d2y = autograd.grad(dy, [x])[0]  # 6x
+    assert np.allclose(d2y.asnumpy(), [12.0])
+
+
+def test_multiple_variables():
+    a = nd.array([1.0])
+    b = nd.array([2.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = a * b + a
+    y.backward()
+    assert np.allclose(a.grad.asnumpy(), [3.0])
+    assert np.allclose(b.grad.asnumpy(), [1.0])
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    assert np.allclose(g.asnumpy(), [4.0])
+
+
+def test_random_replay_consistency():
+    """Dropout backward must see the same mask as forward (keys are tape
+    constants)."""
+    mx.random.seed(7)
+    x = nd.ones((1000,))
+    x.attach_grad()
+    with autograd.record():
+        with autograd.train_mode():
+            y = nd.Dropout(x, p=0.5)
+        s = y.sum()
+    s.backward()
+    # gradient equals the forward mask scaling exactly
+    yv = y.asnumpy()
+    gv = x.grad.asnumpy()
+    assert np.allclose(gv, yv)  # since x==1, y = mask*2 = grad
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), sig * (1 - sig), rtol=1e-5)
+
+
+def test_exception_on_untracked_backward():
+    x = nd.array([1.0])
+    y = x * 2  # not recorded
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_inplace_rejected_under_recording():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y += 1

@@ -1,0 +1,244 @@
+"""Gluon tests (reference model: ``tests/python/unittest/test_gluon.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert p.grad().shape == (3, 4)
+    assert p.list_ctx() == [mx.cpu()]
+    p.zero_grad()
+    assert np.all(p.grad().asnumpy() == 0)
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        dense.weight.data()
+    out = dense(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_block_naming():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(3), nn.Dense(4))
+    names = [p for p in net.collect_params()]
+    assert len(names) == 4
+    assert all(n.startswith(net.prefix) for n in names)
+    # two Dense children get distinct prefixes
+    assert net[0].prefix != net[1].prefix
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(3, use_bias=True))
+    net.initialize()
+    net(nd.ones((1, 2)))
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 1
+
+
+def test_save_load_parameters(tmp_path):
+    fname = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    y0 = net(x).asnumpy()
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    y1 = net2(x).asnumpy()
+    assert np.allclose(y0, y1)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.array(np.random.randn(4, 10).astype("float32"))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+    # second call uses the cache; different batch size recompiles
+    y2 = net(nd.ones((2, 10)))
+    assert y2.shape == (2, 8)
+
+
+def test_hybridize_training_grads_match():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+    np.random.seed(0)
+    x = nd.array(np.random.randn(4, 5).astype("float32"))
+    net = build()
+    net.initialize(mx.initializer.Xavier())
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = net[0].weight.grad().asnumpy().copy()
+
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_hybrid = net[0].weight.grad().asnumpy()
+    assert np.allclose(g_eager, g_hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_layer_updates_running_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array(np.random.randn(4, 3, 2, 2).astype("float32") * 3 + 1)
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)
+    # inference mode: no update
+    rm1c = rm1.copy()
+    net(x)
+    assert np.allclose(net.running_mean.data().asnumpy(), rm1c)
+
+
+def test_batchnorm_hybrid_updates_running_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(4, 3, 2, 2).astype("float32") * 2)
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    x = nd.ones((2, 3, 8, 8))
+    out = net(x)
+    assert out.shape == (2, 10)
+    net.hybridize()
+    assert net(x).shape == (2, 10)
+
+
+def test_trainer_sgd_momentum_training_converges():
+    np.random.seed(1)
+    X = np.random.randn(64, 4).astype("float32")
+    true_w = np.array([[1.0], [-2.0], [3.0], [0.5]], dtype="float32")
+    Y = X.dot(true_w)
+    net = nn.Dense(1, use_bias=False)
+    net.initialize(mx.initializer.Zero())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(100):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(64)
+    w = net.weight.data().asnumpy().reshape(-1, 1)
+    assert np.allclose(w, true_w, atol=0.05)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((1, 3)))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    with autograd.record():
+        loss = net(nd.ones((1, 3))).sum()
+    loss.backward()
+    trainer.step(1)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype("float32"))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    # vs numpy reference
+    p = pred.asnumpy()
+    logp = p - p.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    ref = -logp[np.arange(4), [0, 1, 2, 3]]
+    assert np.allclose(l.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.ones((2, 3)), nd.zeros((2, 3)))
+    assert np.allclose(l2.asnumpy(), 0.5)
+    l1 = gluon.loss.L1Loss()(nd.ones((2, 3)), nd.zeros((2, 3)))
+    assert np.allclose(l1.asnumpy(), 1.0)
+    h = gluon.loss.HuberLoss()(nd.ones((2,)) * 3, nd.zeros((2,)))
+    assert np.allclose(h.asnumpy(), 3 - 0.5)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.zeros((2, 1)), nd.ones((2, 1)))
+    assert np.allclose(bce.asnumpy(), np.log(2), rtol=1e-5)
+
+
+def test_sequential_getitem_len():
+    net = nn.Sequential()
+    net.add(nn.Dense(2), nn.Dense(3), nn.Dense(4))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 2, 3]))
+    assert out.shape == (3, 4)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    d.initialize()
+    x = nd.ones((100,))
+    # inference: identity
+    assert np.allclose(d(x).asnumpy(), 1.0)
+    with autograd.record():
+        y = d(x)
+    v = y.asnumpy()
+    assert set(np.unique(v)).issubset({0.0, 2.0})
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.split_and_load(data, [mx.cpu(0)])
+    assert len(parts) == 1
+    parts = gluon.utils.split_data(data, 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-4

@@ -1,0 +1,159 @@
+"""NDArray tests (reference model: ``tests/python/unittest/test_ndarray.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = nd.ones((4,), dtype="float16")
+    assert b.dtype == np.float16
+    c = nd.full((2, 2), 7.0)
+    assert np.all(c.asnumpy() == 7.0)
+    d = nd.arange(0, 10, 2)
+    assert d.shape == (5,)
+    e = nd.array([[1, 2], [3, 4]])
+    assert e.shape == (2, 2)
+    # float64 input downcast to float32 (MXNet default behavior)
+    f = nd.array(np.ones((2, 2), dtype=np.float64))
+    assert f.dtype == np.float32
+
+
+def test_arith_operators():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert np.allclose((a + b).asnumpy(), [[6, 8], [10, 12]])
+    assert np.allclose((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    assert np.allclose((a * b).asnumpy(), [[5, 12], [21, 32]])
+    assert np.allclose((b / a).asnumpy(), [[5, 3], [7 / 3, 2]])
+    assert np.allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 + a).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    assert np.allclose((2 / a).asnumpy(), [[2, 1], [2 / 3, 0.5]])
+    assert np.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    assert np.allclose(abs(-a).asnumpy(), a.asnumpy())
+
+
+def test_scalar_dtype_preserved():
+    a = nd.ones((2, 2), dtype="float16")
+    assert (a + 1).dtype == np.float16
+    assert (a * 0.5).dtype == np.float16
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    v0 = a.version
+    a += 1
+    assert np.all(a.asnumpy() == 2)
+    assert a.version > v0
+    a *= 3
+    assert np.all(a.asnumpy() == 6)
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    npy = a.asnumpy()
+    assert np.allclose(a[0].asnumpy(), npy[0])
+    assert np.allclose(a[1, 2].asnumpy(), npy[1, 2])
+    assert np.allclose(a[:, 1].asnumpy(), npy[:, 1])
+    assert np.allclose(a[0, 1:3].asnumpy(), npy[0, 1:3])
+    idx = nd.array([0, 1])
+    assert np.allclose(a[idx].asnumpy(), npy[[0, 1]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1] = 5.0
+    assert np.allclose(a.asnumpy()[1], 5.0)
+    a[0, 0:2] = nd.array([1.0, 2.0])
+    assert np.allclose(a.asnumpy()[0], [1, 2, 0])
+    a[:] = 9.0
+    assert np.all(a.asnumpy() == 9.0)
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 1, 3, 4)).shape == (2, 1, 3, 4)
+    assert a.reshape(6, 4).shape == (6, 4)
+
+
+def test_methods():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(a.sum().asscalar()) == 10.0
+    assert float(a.mean().asscalar()) == 2.5
+    assert float(a.max().asscalar()) == 4.0
+    assert a.sum(axis=1).shape == (2,)
+    assert a.T.shape == (2, 2)
+    assert np.allclose(a.T.asnumpy(), a.asnumpy().T)
+    assert a.expand_dims(0).shape == (1, 2, 2)
+    assert a.flatten().shape == (2, 2)
+    assert a.astype("int32").dtype == np.int32
+
+
+def test_copy_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    b = a.copy()
+    b += 1
+    assert np.all(a.asnumpy() == 1)
+    c = a.as_in_context(mx.cpu())
+    assert c is a
+    d = a.copyto(mx.cpu(0))
+    assert np.all(d.asnumpy() == 1)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "test.params")
+    d = {"w": nd.array([[1.0, 2.0]]), "b": nd.array([3.0])}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert np.allclose(loaded["w"].asnumpy(), [[1, 2]])
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(nd.arange(0, 12).reshape((2, 6)), num_outputs=3,
+                     axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_wait_and_scalar():
+    a = nd.ones((1,))
+    a.wait_to_read()
+    assert a.asscalar() == 1.0
+    assert float(a) == 1.0
+    assert int(a) == 1
+    nd.waitall()
+
+
+def test_iter_len():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    assert len(a) == 3
+    rows = list(a)
+    assert len(rows) == 3 and rows[0].shape == (2,)
