@@ -1,0 +1,64 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput, single chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: 385 img/s = indicative 1xV100 fp32 MXNet figure (BASELINE.md —
+unverified order-of-magnitude; the real target is the v5e-8 vs 8xV100
+aggregate once multi-chip hardware exists).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S = 385.0
+
+
+def main():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    net = vision.resnet50_v1()
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mesh = make_mesh({"dp": -1})
+    trainer = DataParallelTrainer(net, loss_fn, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9},
+                                  mesh=mesh)
+
+    np.random.seed(0)
+    data = nd.array(np.random.randn(batch, 3, 224, 224).astype("float32"),
+                    ctx=ctx)
+    label = nd.array(np.random.randint(0, 1000, (batch,)), ctx=ctx)
+
+    for _ in range(warmup):
+        loss = trainer.step(data, label)
+    loss.wait_to_read()
+
+    t0 = time.time()
+    for _ in range(iters):
+        loss = trainer.step(data, label)
+    loss.wait_to_read()
+    dt = time.time() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,4 +32,9 @@ from . import lr_scheduler     # noqa: E402
 from . import metric           # noqa: E402
 from . import kvstore          # noqa: E402
 from . import kvstore as kv    # noqa: E402
+from . import recordio         # noqa: E402
+from . import io               # noqa: E402
+from . import image            # noqa: E402
 from . import gluon            # noqa: E402
+from . import parallel         # noqa: E402
+from . import models           # noqa: E402

@@ -1,0 +1,236 @@
+"""Vision datasets (reference:
+``python/mxnet/gluon/data/vision/datasets.py``).
+
+Zero-egress environment: datasets read from local files under ``root``
+(standard IDX/pickle formats), or generate deterministic synthetic data
+when ``synthetic=True`` / the files are absent and ``allow_synthetic`` —
+so convergence tests (SURVEY.md §4.4) run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import numpy as _np
+
+from ....base import MXNetError
+from .... import ndarray as nd
+from ..dataset import _DownloadedDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "SyntheticMNIST"]
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+
+
+def _synthetic_classification(n, shape, num_classes, seed):
+    """Deterministic learnable synthetic data: class-dependent means."""
+    rng = _np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(_np.int32)
+    protos = rng.uniform(0, 255, size=(num_classes,) + shape)
+    data = protos[labels] + rng.normal(0, 16, size=(n,) + shape)
+    return _np.clip(data, 0, 255).astype(_np.uint8), labels
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local IDX files; synthetic fallback for hermetic tests."""
+
+    _files = {True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+              False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "mnist"),
+                 train=True, transform=None, synthetic=None,
+                 synthetic_size=None):
+        self._train = train
+        self._synthetic = synthetic
+        self._synthetic_size = synthetic_size
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        img_path = os.path.join(self._root, img_name)
+        lbl_path = os.path.join(self._root, lbl_name)
+        use_synth = self._synthetic
+        if use_synth is None:
+            use_synth = not (os.path.exists(img_path) or
+                             os.path.exists(img_path + ".gz"))
+        if use_synth:
+            n = self._synthetic_size or (6000 if self._train else 1000)
+            data, labels = _synthetic_classification(
+                n, self._shape, self._classes,
+                seed=42 if self._train else 43)
+        else:
+            if not os.path.exists(img_path) and \
+                    os.path.exists(img_path + ".gz"):
+                img_path += ".gz"
+                lbl_path += ".gz"
+            data = _read_idx_images(img_path)
+            labels = _read_idx_labels(lbl_path)
+            if self._shape[2] == 3 and data.shape[-1] == 1:
+                data = _np.repeat(data, 3, axis=3)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = labels
+
+    def __getitem__(self, idx):
+        img = self._data[idx]
+        label = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None, synthetic=None,
+                 synthetic_size=None):
+        super().__init__(root, train, transform, synthetic, synthetic_size)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from local binary batches; synthetic fallback."""
+
+    _shape = (32, 32, 3)
+    _classes = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None, synthetic=None,
+                 synthetic_size=None):
+        self._train = train
+        self._synthetic = synthetic
+        self._synthetic_size = synthetic_size
+        super().__init__(root, transform)
+
+    def _file_list(self):
+        if self._train:
+            return ["data_batch_%d.bin" % i for i in range(1, 6)]
+        return ["test_batch.bin"]
+
+    def _get_data(self):
+        files = [os.path.join(self._root, f) for f in self._file_list()]
+        use_synth = self._synthetic
+        if use_synth is None:
+            use_synth = not os.path.exists(files[0])
+        if use_synth:
+            n = self._synthetic_size or (5000 if self._train else 1000)
+            data, labels = _synthetic_classification(
+                n, self._shape, self._classes,
+                seed=44 if self._train else 45)
+        else:
+            data_list, label_list = [], []
+            for path in files:
+                with open(path, "rb") as f:
+                    raw = _np.frombuffer(f.read(), dtype=_np.uint8)
+                raw = raw.reshape(-1, 3073)
+                label_list.append(raw[:, 0].astype(_np.int32))
+                data_list.append(
+                    raw[:, 1:].reshape(-1, 3, 32, 32).transpose(
+                        0, 2, 3, 1))
+            data = _np.concatenate(data_list)
+            labels = _np.concatenate(label_list)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = labels
+
+    def __getitem__(self, idx):
+        img = self._data[idx]
+        label = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None,
+                 synthetic=None, synthetic_size=None):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform, synthetic, synthetic_size)
+
+    def _file_list(self):
+        return ["train.bin"] if self._train else ["test.bin"]
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over a .rec file of packed images (reference:
+    ``gluon.data.vision.ImageRecordDataset``)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._rec = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._rec)
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+        record = self._rec[idx]
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if isinstance(label, _np.ndarray) and label.size == 1:
+            label = float(label[0])
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/<class>/<img> layout (reference:
+    ``gluon.data.vision.ImageFolderDataset``)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename),
+                                       label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+SyntheticMNIST = MNIST  # alias used by hermetic convergence tests

@@ -1,0 +1,2 @@
+"""Image API (reference: ``python/mxnet/image/``)."""
+from .image import *

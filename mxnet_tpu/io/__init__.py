@@ -1,0 +1,3 @@
+"""IO API (reference: ``python/mxnet/io/``)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, MXDataIter, register_iter, list_iters)

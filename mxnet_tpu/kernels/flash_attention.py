@@ -1,0 +1,145 @@
+"""Flash attention — Pallas TPU kernel.
+
+No reference counterpart (MXNet 1.x predates flash attention; SURVEY.md
+§5.7 marks sequence-scale attention as a TPU-build extension).  Design per
+/opt/skills/guides/pallas_guide.md: grid over (batch·heads, q-blocks),
+online-softmax accumulation over k-blocks held in VMEM, fp32 accumulators,
+MXU matmuls via ``jnp.dot`` with ``preferred_element_type``.
+
+Backward: ``jax.custom_vjp`` with a jnp reference backward (recompute) —
+correct gradients today; a fused backward kernel is a later optimization.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale):
+    import jax
+    import jax.numpy as jnp
+
+    q = q_ref[0]                      # (BQ, dh)
+    bq, dh = q.shape
+    T = k_ref.shape[1]
+    nk = T // block_k
+
+    m0 = jnp.full((bq, 1), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((bq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((bq, dh), dtype=jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
+        msk = mask_ref[0, pl.dslice(i * block_k, block_k)]
+        s = jnp.where(msk[None, :] != 0, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_tpu(q, k, v, mask, block_q=128, block_k=128):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, T, H, dh = q.shape
+    sm_scale = 1.0 / math.sqrt(dh)
+    # layout: (B*H, T, dh)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    if mask is None:
+        mask_arr = jnp.ones((B, T), dtype=jnp.int8)
+    else:
+        mask_arr = mask.astype(jnp.int8)
+
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    grid = (B * H, T // block_q)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T), lambda bh, qi, H=H: (bh // H, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh),
+                               lambda bh, qi: (bh, qi, 0)),
+    )(qt, kt, vt, mask_arr)
+    return out.reshape(B, H, T, dh).transpose(0, 2, 1, 3)
+
+
+def _reference_attention(q, k, v, mask):
+    import jax
+    import jax.numpy as jnp
+    dh = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _make_flash():
+    import jax
+
+    @jax.custom_vjp
+    def _flash(q, k, v, mask):
+        return _flash_fwd_tpu(q, k, v, mask)
+
+    def fwd(q, k, v, mask):
+        return _flash(q, k, v, mask), (q, k, v, mask)
+
+    def bwd(res, g):
+        q, k, v, mask = res
+        # reference backward via recompute (fused bwd kernel: future work)
+        _, vjp_fn = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, mask),
+            q, k, v)
+        dq, dk, dv = vjp_fn(g)
+        return dq, dk, dv, None
+
+    _flash.defvjp(fwd, bwd)
+    return _flash
+
+
+_flash_cached = None
+
+
+def flash_attention(q, k, v, mask=None):
+    """(B, T, H, dh) attention with a fused online-softmax TPU kernel.
+
+    Falls back to the jnp reference off-TPU (CPU tests) or when shapes
+    don't tile (T not divisible by the 128 block, dh not lane-aligned).
+    """
+    import jax
+    global _flash_cached
+    platform = jax.devices()[0].platform
+    B, T, H, dh = q.shape
+    if platform == "cpu" or T % 128 != 0 or dh not in (64, 128, 256):
+        return _reference_attention(q, k, v, mask)
+    if _flash_cached is None:
+        _flash_cached = _make_flash()
+    return _flash_cached(q, k, v, mask)

@@ -1,0 +1,6 @@
+"""Model families (TPU-first functional cores + Gluon wrappers).
+
+``gluon.model_zoo.vision`` holds the reference CNN zoo; this package holds
+the transformer/BERT family and future additions.
+"""
+from . import transformer

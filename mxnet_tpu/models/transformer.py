@@ -1,0 +1,305 @@
+"""Flagship transformer (BERT-style encoder) — TPU-first functional core.
+
+Reference scope: GluonNLP BERT-base pretraining is a BASELINE.json config;
+MXNet 1.x itself has no transformer in-tree, so this module is the
+TPU-native implementation the Gluon/Module frontends wrap.
+
+Design (scaling-book recipe): pure functions over a param pytree; the
+train step is jitted over a ``Mesh`` with NamedShardings —
+
+* params: attention/FFN hidden dims sharded over ``tp``; everything else
+  replicated
+* batch: sharded over ``dp``; activations sequence-sharded over ``sp``
+  when the mesh has that axis (XLA GSPMD inserts the all-gathers;
+  ring-attention via shard_map lives in ``parallel/ring_attention.py``)
+* XLA inserts the gradient psum over ``dp`` because params are replicated
+  w.r.t. ``dp`` while batch is sharded — no hand-written allreduce
+  (this IS the ``kvstore_nccl`` path, compiled)
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["TransformerConfig", "init_params", "forward", "make_train_step",
+           "bert_base", "bert_tiny"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522
+    max_len: int = 512
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.1
+    dtype: str = "bfloat16"       # MXU-native compute dtype
+    param_dtype: str = "float32"  # master params
+    use_flash: bool = True        # pallas flash attention on TPU
+    remat: bool = True            # jax.checkpoint per layer
+    type_vocab_size: int = 2
+
+
+def bert_base(**kw):
+    return TransformerConfig(**kw)
+
+
+def bert_tiny(**kw):
+    base = dict(vocab_size=1024, max_len=128, d_model=64, n_heads=4,
+                n_layers=2, d_ff=128)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    def dense_init(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape) * scale).astype(
+            cfg.param_dtype)
+
+    keys = jax.random.split(key, 6 + cfg.n_layers)
+    D, F, H = cfg.d_model, cfg.d_ff, cfg.n_heads
+    params = {
+        "tok_emb": dense_init(keys[0], (cfg.vocab_size, D)),
+        "pos_emb": dense_init(keys[1], (cfg.max_len, D)),
+        "type_emb": dense_init(keys[2], (cfg.type_vocab_size, D)),
+        "emb_ln": {"g": jnp.ones((D,), cfg.param_dtype),
+                   "b": jnp.zeros((D,), cfg.param_dtype)},
+        "mlm_dense": dense_init(keys[3], (D, D)),
+        "mlm_ln": {"g": jnp.ones((D,), cfg.param_dtype),
+                   "b": jnp.zeros((D,), cfg.param_dtype)},
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), cfg.param_dtype),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[6 + i], 8)
+        layer = {
+            "wq": dense_init(k[0], (D, D)),
+            "wk": dense_init(k[1], (D, D)),
+            "wv": dense_init(k[2], (D, D)),
+            "wo": dense_init(k[3], (D, D)),
+            "bq": jnp.zeros((D,), cfg.param_dtype),
+            "bk": jnp.zeros((D,), cfg.param_dtype),
+            "bv": jnp.zeros((D,), cfg.param_dtype),
+            "bo": jnp.zeros((D,), cfg.param_dtype),
+            "ln1": {"g": jnp.ones((D,), cfg.param_dtype),
+                    "b": jnp.zeros((D,), cfg.param_dtype)},
+            "w1": dense_init(k[4], (D, F)),
+            "b1": jnp.zeros((F,), cfg.param_dtype),
+            "w2": dense_init(k[5], (F, D)),
+            "b2": jnp.zeros((D,), cfg.param_dtype),
+            "ln2": {"g": jnp.ones((D,), cfg.param_dtype),
+                    "b": jnp.zeros((D,), cfg.param_dtype)},
+        }
+        params["layers"].append(layer)
+    return params
+
+
+def param_shardings(cfg: TransformerConfig, mesh):
+    """NamedSharding pytree matching init_params: tp shards the hidden
+    dims, everything else replicated (scaling-book megatron layout)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    has_tp = "tp" in mesh.axis_names
+    tp = "tp" if has_tp else None
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    rep = ns()
+    layer = {
+        "wq": ns(None, tp), "wk": ns(None, tp), "wv": ns(None, tp),
+        "wo": ns(tp, None),
+        "bq": ns(tp), "bk": ns(tp), "bv": ns(tp), "bo": rep,
+        "ln1": {"g": rep, "b": rep},
+        "w1": ns(None, tp), "b1": ns(tp),
+        "w2": ns(tp, None), "b2": rep,
+        "ln2": {"g": rep, "b": rep},
+    }
+    return {
+        "tok_emb": ns(None, tp),
+        "pos_emb": ns(None, tp),
+        "type_emb": ns(None, tp),
+        "emb_ln": {"g": rep, "b": rep},
+        "mlm_dense": ns(None, tp),
+        "mlm_ln": {"g": rep, "b": rep},
+        "mlm_bias": rep,
+        "layers": [layer for _ in range(cfg.n_layers)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, g, b, eps=1e-12):
+    import jax.numpy as jnp
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * g + b
+
+
+def _attention(q, k, v, mask, cfg: TransformerConfig):
+    """(B, T, H, dh) attention.  Uses the pallas flash kernel on TPU when
+    enabled; jnp reference otherwise (also the CPU/test path)."""
+    import jax
+    import jax.numpy as jnp
+    if cfg.use_flash:
+        try:
+            from ..kernels.flash_attention import flash_attention
+            return flash_attention(q, k, v, mask=mask)
+        except Exception:
+            pass
+    dh = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key):
+    import jax
+    import jax.numpy as jnp
+    B, T, D = x.shape
+    H = cfg.n_heads
+    dh = D // H
+    cdt = x.dtype
+
+    def dn(w):
+        return w.astype(cdt)
+
+    q = (x @ dn(layer["wq"]) + dn(layer["bq"])).reshape(B, T, H, dh)
+    k = (x @ dn(layer["wk"]) + dn(layer["bk"])).reshape(B, T, H, dh)
+    v = (x @ dn(layer["wv"]) + dn(layer["bv"])).reshape(B, T, H, dh)
+    attn = _attention(q, k, v, mask, cfg).reshape(B, T, D)
+    attn = attn @ dn(layer["wo"]) + dn(layer["bo"])
+    if train and cfg.dropout > 0:
+        key, sub = jax.random.split(key)
+        keep = jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape)
+        attn = jnp.where(keep, attn / (1 - cfg.dropout), 0).astype(cdt)
+    x = _layer_norm(x + attn, dn(layer["ln1"]["g"]), dn(layer["ln1"]["b"]))
+    h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
+                    approximate=True)
+    h = h @ dn(layer["w2"]) + dn(layer["b2"])
+    if train and cfg.dropout > 0:
+        key, sub = jax.random.split(key)
+        keep = jax.random.bernoulli(sub, 1 - cfg.dropout, h.shape)
+        h = jnp.where(keep, h / (1 - cfg.dropout), 0).astype(cdt)
+    x = _layer_norm(x + h, dn(layer["ln2"]["g"]), dn(layer["ln2"]["b"]))
+    return x
+
+
+def forward(params, tokens, cfg: TransformerConfig, *, type_ids=None,
+            mask=None, train=False, rng=None, mesh=None):
+    """tokens (B, T) int32 -> MLM logits (B, T, V)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    cdt = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    x = params["tok_emb"][tokens].astype(cdt)
+    x = x + params["pos_emb"][:T][None].astype(cdt)
+    if type_ids is not None:
+        x = x + params["type_emb"][type_ids].astype(cdt)
+    x = _layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                    params["emb_ln"]["b"].astype(cdt))
+
+    if mesh is not None:
+        spec = _act_spec(mesh)
+        x = jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    layer_fn = _encoder_layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            _encoder_layer, static_argnums=(3, 4),
+            policy=jax.checkpoint_policies.nothing_saveable)
+    for i, layer in enumerate(params["layers"]):
+        rng, sub = jax.random.split(rng)
+        x = layer_fn(x, layer, mask, cfg, train, sub)
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, _act_spec(mesh)))
+
+    # MLM head (weight-tied to token embedding)
+    h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt), approximate=True)
+    h = _layer_norm(h, params["mlm_ln"]["g"].astype(cdt),
+                    params["mlm_ln"]["b"].astype(cdt))
+    logits = h @ params["tok_emb"].T.astype(cdt) + \
+        params["mlm_bias"].astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+def _act_spec(mesh):
+    from jax.sharding import PartitionSpec as P
+    names = mesh.axis_names
+    batch_ax = "dp" if "dp" in names else None
+    seq_ax = "sp" if "sp" in names else None
+    return P(batch_ax, seq_ax, None)
+
+
+# ---------------------------------------------------------------------------
+# train step
+# ---------------------------------------------------------------------------
+
+def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
+                    weight_decay=0.01):
+    """Build (init_state, step) for MLM pretraining.
+
+    ``step(state, batch, rng) -> (state, loss)`` is jitted; with a mesh it
+    is jitted with NamedShardings so GSPMD places tp/dp/sp collectives.
+    ``batch`` = dict(tokens, labels, weights) — labels -100 ≡ unmasked.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay,
+                     b1=0.9, b2=0.999, eps=1e-6)
+
+    def loss_fn(params, batch, rng):
+        logits = forward(params, batch["tokens"], cfg,
+                         type_ids=batch.get("type_ids"),
+                         mask=batch.get("mask"), train=True, rng=rng,
+                         mesh=mesh)
+        labels = batch["labels"]
+        valid = (labels >= 0)
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_loss = -jnp.take_along_axis(logp, safe[..., None],
+                                        axis=-1)[..., 0]
+        tok_loss = jnp.where(valid, tok_loss, 0.0)
+        return tok_loss.sum() / jnp.maximum(valid.sum(), 1)
+
+    def step(state, batch, rng):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    def init_state(key):
+        params = init_params(key, cfg)
+        if mesh is not None:
+            shardings = param_shardings(cfg, mesh)
+            params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, s), params, shardings)
+        opt_state = tx.init(params)
+        return (params, opt_state)
+
+    jit_step = jax.jit(step, donate_argnums=(0,))
+    return init_state, jit_step

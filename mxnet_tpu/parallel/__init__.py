@@ -1,0 +1,7 @@
+"""Parallelism subsystem — mesh, sharded training, collectives.
+
+Replaces the reference's KVStore/NCCL/ps-lite stack (SURVEY.md §2.4) with
+XLA collectives over a ``jax.sharding.Mesh``.
+"""
+from .mesh import make_mesh, default_mesh, current_mesh, mesh_scope
+from .data_parallel import DataParallelTrainer

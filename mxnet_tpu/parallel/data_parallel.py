@@ -1,0 +1,154 @@
+"""Sharded data-parallel training for Gluon blocks.
+
+Reference semantics: ``DataParallelExecutorGroup`` + KVStore allreduce
+(SURVEY.md §2.4 row 1, §3.4).  TPU-native mechanism: ONE jitted train step
+over a Mesh — params placed replicated, batch sharded over ``dp`` — and
+XLA GSPMD emits the gradient psum over ICI.  This subsumes
+``split_and_load`` + push/pull: no Python-level per-device loop, no
+explicit collective calls.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["DataParallelTrainer"]
+
+
+class DataParallelTrainer:
+    """Compile a (block, loss, optimizer) triple into one sharded step.
+
+    Usage::
+
+        mesh = make_mesh({"dp": 8})
+        dpt  = DataParallelTrainer(net, loss_fn, "sgd",
+                                   {"learning_rate": 0.1}, mesh)
+        loss = dpt.step(data_batch, label_batch)   # batch sharded on dp
+
+    The Gluon block's parameters are read once into a pytree; updates run
+    inside the jitted step (fused with the backward, like the reference's
+    engine-overlapped ``*_update`` ops); ``sync_back()`` writes final
+    values into the Parameter buffers for checkpointing.
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd",
+                 optimizer_params=None, mesh=None, grad_clip=None):
+        import jax
+        import optax
+        from .mesh import default_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else default_mesh()
+        optimizer_params = dict(optimizer_params or {})
+        lr = optimizer_params.pop("learning_rate", 0.01)
+        momentum = optimizer_params.pop("momentum", 0.0)
+        wd = optimizer_params.pop("wd", 0.0)
+        if optimizer == "sgd":
+            tx = optax.sgd(lr, momentum=momentum)
+            if wd:
+                tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        elif optimizer == "adam":
+            tx = optax.adam(lr)
+        elif optimizer == "adamw":
+            tx = optax.adamw(lr, weight_decay=wd)
+        elif optimizer == "lamb":
+            tx = optax.lamb(lr, weight_decay=wd)
+        else:
+            raise MXNetError("DataParallelTrainer: unknown optimizer %r"
+                             % optimizer)
+        if grad_clip:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        self.tx = tx
+
+        self._param_objs = list(block.collect_params().values())
+        self._rep = NamedSharding(self.mesh, P())
+        self._batch_sharding = None
+        self._state = None
+        self._jit_step = None
+
+    # -- param pytree <-> gluon Parameters --------------------------------
+    def _gather_params(self):
+        import jax
+        vals = [p.data()._data for p in self._param_objs]
+        return [jax.device_put(v, self._rep) for v in vals]
+
+    def sync_back(self):
+        """Write trained values back into the Gluon Parameters."""
+        if self._state is None:
+            return
+        params = self._state[0]
+        for p, v in zip(self._param_objs, params):
+            for c in p._data:
+                p._data[c]._set_data(v)
+
+    # -- the step ----------------------------------------------------------
+    def _build(self, data, label):
+        import jax
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..gluon.block import _CachedOp
+        from .. import autograd
+
+        block = self.block
+        loss_fn = self.loss_fn
+        params = self._param_objs
+        tx = self.tx
+
+        # trace block+loss into a pure function of (param_list, data, label)
+        from ..ndarray.ndarray import NDArray
+        from collections import OrderedDict
+        from ..gluon.block import _TRACE_STATE
+
+        # resolve any deferred-init parameter shapes before gathering
+        if hasattr(block, "_resolve_deferred"):
+            block._resolve_deferred(NDArray(data))
+
+        def pure_loss(param_vals, d, l):
+            from .. import random as mxrand
+            mxrand.push_trace_key(jax.random.PRNGKey(0))
+            _TRACE_STATE.active = getattr(_TRACE_STATE, "active", 0) + 1
+            saved = [(p, dict(p._data)) for p in params]
+            try:
+                for p, v in zip(params, param_vals):
+                    c = next(iter(p._data))
+                    p._data = OrderedDict({c: NDArray(v)})
+                with autograd._scope(False, True):
+                    out = block.forward_raw(NDArray(d))
+                    loss = loss_fn(out, NDArray(l))
+                return loss._data.mean()
+            finally:
+                for p, old in saved:
+                    p._data = OrderedDict(old)
+                _TRACE_STATE.active -= 1
+                mxrand.pop_trace_key()
+
+        def step(state, d, l):
+            pvals, opt_state = state
+            loss, grads = jax.value_and_grad(pure_loss)(pvals, d, l)
+            updates, opt_state = tx.update(grads, opt_state, pvals)
+            pvals = optax.apply_updates(pvals, updates)
+            return (pvals, opt_state), loss
+
+        pvals = self._gather_params()
+        opt_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._rep), self.tx.init(pvals))
+        self._state = (pvals, opt_state)
+        self._batch_sharding = NamedSharding(
+            self.mesh, P(self.mesh.axis_names[0]))
+        self._jit_step = jax.jit(step, donate_argnums=(0,))
+
+    def step(self, data, label):
+        """One data-parallel training step; returns scalar loss."""
+        import jax
+        from ..ndarray.ndarray import NDArray, _wrap
+        d = data._data if isinstance(data, NDArray) else data
+        l = label._data if isinstance(label, NDArray) else label
+        if self._jit_step is None:
+            self._build(d, l)
+        d = jax.device_put(d, self._batch_sharding)
+        l = jax.device_put(l, self._batch_sharding)
+        self._state, loss = self._jit_step(self._state, d, l)
+        return _wrap(loss)

@@ -1,0 +1,82 @@
+"""Device-mesh utilities — the substrate for all parallelism.
+
+No reference counterpart: MXNet 1.x scales via per-device replicas + NCCL
+(SURVEY.md §2.4).  The TPU-native design replaces that with one logical
+array sharded over a ``jax.sharding.Mesh``; XLA GSPMD inserts the ICI
+collectives (psum/all-gather/reduce-scatter) that ``kvstore_nccl.h``
+issued by hand.  Axes follow scaling-book conventions:
+
+* ``dp`` — data parallel (batch dim)
+* ``tp`` — tensor parallel (hidden dims of attention/FFN weights)
+* ``pp`` — pipeline stages
+* ``sp`` — sequence/context parallel (ring attention)
+* ``ep`` — expert parallel (MoE)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "default_mesh", "current_mesh", "mesh_scope"]
+
+_CURRENT = []
+
+
+def make_mesh(shape: Optional[dict] = None, devices=None):
+    """Create a Mesh.  ``shape`` maps axis name -> size; sizes must
+    multiply to the device count.  ``{"dp": -1}`` means "all devices"."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not shape:
+        shape = {"dp": n}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    n_auto = sizes.count(-1)
+    if n_auto > 1:
+        raise MXNetError("At most one mesh axis may be -1")
+    if n_auto == 1:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        if n % known:
+            raise MXNetError("Mesh %s does not divide %d devices"
+                             % (shape, n))
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError("Mesh %s needs %d devices but %d are visible"
+                         % (dict(zip(names, sizes)), total, n))
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def default_mesh():
+    """All devices on one ``dp`` axis."""
+    return make_mesh()
+
+
+class mesh_scope:
+    """Context manager setting the current mesh."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _CURRENT.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *a):
+        _CURRENT.pop()
+
+
+def current_mesh():
+    return _CURRENT[-1] if _CURRENT else None

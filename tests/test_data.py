@@ -1,0 +1,210 @@
+"""Data pipeline tests (reference models: ``tests/python/unittest/test_io.py``,
+``test_recordio.py``, ``test_image.py``, ``test_gluon_data.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio, io as mxio, gluon
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(b"record%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == b"record%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_recordio_embedded_magic(tmp_path):
+    """Payloads containing the magic bytes must roundtrip (continuation
+    encoding)."""
+    import struct
+    path = str(tmp_path / "magic.rec")
+    payload = b"abc" + struct.pack("<I", 0xced7230a) + b"def" + \
+        struct.pack("<I", 0xced7230a)
+    w = recordio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        writer.write_idx(i, b"record%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert reader.read_idx(7) == b"record7"
+    assert reader.read_idx(2) == b"record2"
+    assert len(reader.keys) == 10
+    reader.close()
+
+
+def test_pack_unpack():
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"imagedata")
+    h2, data = recordio.unpack(s)
+    assert data == b"imagedata"
+    assert h2.label == 3.0 and h2.id == 7
+    # multi-label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 9, 0)
+    s = recordio.pack(header, b"x")
+    h2, data = recordio.unpack(s)
+    assert np.allclose(h2.label, [1, 2, 3])
+    assert data == b"x"
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          quality=100, img_fmt=".png")
+    header, decoded = recordio.unpack_img(s)
+    assert decoded.shape == (32, 32, 3)
+    assert np.array_equal(decoded, img)  # png is lossless
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    label = np.arange(10).astype("float32")
+    it = mxio.NDArrayIter(data, label, batch_size=3,
+                          last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    # discard
+    it = mxio.NDArrayIter(data, label, batch_size=3,
+                          last_batch_handle="discard")
+    assert len(list(it)) == 3
+    # shuffle keeps data-label pairing
+    it = mxio.NDArrayIter(data, label, batch_size=5, shuffle=True)
+    b = next(iter(it))
+    d, l = b.data[0].asnumpy(), b.label[0].asnumpy()
+    assert np.allclose(d[:, 0] / 4.0, l)
+
+
+def test_ndarray_iter_reset():
+    it = mxio.NDArrayIter(np.zeros((7, 2)), np.zeros(7), batch_size=2)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 4
+
+
+def test_prefetching_iter():
+    data = np.random.rand(20, 3).astype("float32")
+    base = mxio.NDArrayIter(data, np.zeros(20), batch_size=5)
+    pre = mxio.PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 4
+    pre.reset()
+    assert len(list(pre)) == 4
+
+
+def test_image_imdecode_resize():
+    import cv2
+    img = (np.random.rand(40, 60, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    decoded = mx.image.imdecode(buf.tobytes())
+    assert decoded.shape == (40, 60, 3)
+    resized = mx.image.imresize(decoded, 30, 20)
+    assert resized.shape == (20, 30, 3)
+    short = mx.image.resize_short(decoded, 20)
+    assert min(short.shape[:2]) == 20
+
+
+def test_image_augmenters():
+    img = nd.array((np.random.rand(50, 50, 3) * 255).astype(np.uint8))
+    auglist = mx.image.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                       rand_mirror=True, mean=True,
+                                       std=True, brightness=0.1)
+    out = img
+    for aug in auglist:
+        out = aug(out)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter_rec(tmp_path):
+    import cv2
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(8):
+        img = (np.random.rand(36, 36, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img,
+            img_fmt=".png"))
+    w.close()
+    it = mx.image.ImageIter(4, (3, 32, 32), path_imgrec=rec_path,
+                            path_imgidx=idx_path)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+    # registry path
+    it2 = mxio.MXDataIter("ImageRecordIter", batch_size=4,
+                          data_shape=(3, 32, 32), path_imgrec=rec_path,
+                          path_imgidx=idx_path, prefetch=False)
+    batch2 = it2.next()
+    assert batch2.data[0].shape == (4, 3, 32, 32)
+
+
+def test_gluon_dataset_dataloader():
+    X = np.random.rand(17, 5).astype("float32")
+    Y = np.arange(17).astype("float32")
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 17
+    x0, y0 = ds[3]
+    assert np.allclose(x0, X[3]) and y0 == 3.0
+    loader = gluon.data.DataLoader(ds, batch_size=5, shuffle=True,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (5, 5)
+    loader = gluon.data.DataLoader(ds, batch_size=5, last_batch="discard")
+    assert len(list(loader)) == 3
+
+
+def test_gluon_dataset_transform():
+    ds = gluon.data.ArrayDataset(np.ones((4, 2), dtype="float32"),
+                                 np.zeros(4, dtype="float32"))
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x, y = ds2[0]
+    assert np.allclose(x, 2.0)
+
+
+def test_mnist_synthetic_dataset():
+    ds = gluon.data.vision.MNIST(train=True, synthetic=True,
+                                 synthetic_size=64)
+    assert len(ds) == 64
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= label < 10
+    tf = gluon.data.vision.transforms.ToTensor()
+    loader = gluon.data.DataLoader(
+        ds.transform_first(lambda x: tf(x)), batch_size=16)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (16, 1, 28, 28)
+    assert float(xb.max().asscalar()) <= 1.0
+
+
+def test_sampler():
+    s = gluon.data.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    rs = gluon.data.RandomSampler(100)
+    vals = list(rs)
+    assert sorted(vals) == list(range(100))
+    bs = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3,
+                                 "rollover")
+    assert len(list(bs)) == 2
