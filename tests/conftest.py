@@ -12,6 +12,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon, so env vars alone are too late; jax>=0.9 also ignores
+# xla_force_host_platform_device_count in favor of jax_num_cpu_devices.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import numpy as _np
 import pytest
 
