@@ -38,3 +38,4 @@ from . import image            # noqa: E402
 from . import gluon            # noqa: E402
 from . import parallel         # noqa: E402
 from . import models           # noqa: E402
+from . import test_utils       # noqa: E402

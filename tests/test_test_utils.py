@@ -1,0 +1,68 @@
+"""Tests for the testing toolkit itself (SURVEY.md §4 oracles)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_assert_almost_equal_pass_and_fail():
+    a = np.ones((3, 3), np.float32)
+    tu.assert_almost_equal(a, a + 1e-7)
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(a, a + 1.0)
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(a, np.ones((3, 2), np.float32))
+
+
+def test_assert_almost_equal_ndarray():
+    a = mx.nd.ones((2, 2))
+    tu.assert_almost_equal(a, np.ones((2, 2)))
+
+
+def test_check_numeric_gradient_elemwise():
+    tu.check_numeric_gradient(
+        lambda x: (x * x).sum(),
+        [np.random.randn(3, 4)])
+
+
+def test_check_numeric_gradient_dot():
+    tu.check_numeric_gradient(
+        lambda a, b: mx.nd.dot(a, b),
+        [np.random.randn(3, 4), np.random.randn(4, 2)])
+
+
+def test_check_numeric_gradient_catches_wrong_grad():
+    # exp's gradient is exp(x); sqrt(x)'s is not — a deliberately wrong
+    # pairing must FAIL the oracle.
+    with pytest.raises(AssertionError):
+        tu.check_numeric_gradient(
+            lambda x: mx.nd.sqrt(mx.nd.abs(x) + 2.0) + x.detach() * 0 +
+            mx.nd.exp(x * 0) * 0 + _wrong(x),
+            [np.random.rand(3) + 0.5])
+
+
+def _wrong(x):
+    # a custom Function with an intentionally wrong backward
+    class Bad(mx.autograd.Function):
+        def forward(self, a):
+            return a * 2
+
+        def backward(self, g):
+            return g * 3.0  # wrong: should be 2.0
+
+    return Bad()(x)
+
+
+def test_check_consistency_dtypes():
+    # same ctx, two dtypes — exercises the tolerance machinery end to end
+    tu.check_consistency(
+        lambda x: mx.nd.exp(x),
+        [np.random.randn(4, 4)],
+        ctx_list=[mx.cpu(), mx.cpu()],
+        dtypes=[np.float32, np.float16])
+
+
+def test_rand_ndarray_shape():
+    a = tu.rand_ndarray((2, 5))
+    assert a.shape == (2, 5)
