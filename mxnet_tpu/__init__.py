@@ -38,4 +38,10 @@ from . import image            # noqa: E402
 from . import gluon            # noqa: E402
 from . import parallel         # noqa: E402
 from . import models           # noqa: E402
+from . import symbol           # noqa: E402
+from . import symbol as sym    # noqa: E402
+from . import callback         # noqa: E402
+from . import model            # noqa: E402
+from . import module           # noqa: E402
+from . import module as mod    # noqa: E402
 from . import test_utils       # noqa: E402

@@ -1,0 +1,42 @@
+"""Checkpoint save/load helpers.
+
+Reference: ``python/mxnet/model.py`` ``save_checkpoint``/``load_checkpoint``
+(SURVEY.md §5.4 "Checkpoint/resume": ``prefix-symbol.json`` +
+``prefix-%04d.params`` with ``arg:``/``aux:`` prefixed keys).
+"""
+from __future__ import annotations
+
+from . import ndarray as nd
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_params(fname):
+    """Split a saved dict into (arg_params, aux_params)."""
+    save_dict = nd.load(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params)."""
+    from . import symbol as sym
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
+    return symbol, arg_params, aux_params

@@ -1,0 +1,7 @@
+"""``mx.mod`` — the symbolic Module training API.
+
+Reference: ``python/mxnet/module/`` (SURVEY.md §2.2 "Module (legacy)").
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule

@@ -1,0 +1,262 @@
+"""Executor — bound, compiled evaluation of a Symbol graph.
+
+Reference: ``src/executor/graph_executor.cc`` + ``python/mxnet/executor.py``
+(SURVEY.md §3.6).  The reference runs nnvm passes (infer shape/type, plan
+memory, inplace) then pushes bulked segments to the engine; here the entire
+graph is one ``jax.jit`` computation — XLA's fusion/layout/memory planner
+subsumes those passes, and the jit cache keyed by input signature provides
+bucketing-executor memory sharing for free (SURVEY.md §7 step 7).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _wrap
+from .symbol import Symbol, eval_graph
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol: Symbol, ctx, args, args_grad=None,
+                 grad_req: Union[str, Dict[str, str]] = "write",
+                 aux_states=None):
+        from .. import ndarray as nd
+
+        self._sym = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.arg_dict: Dict[str, NDArray] = self._to_dict(
+            args, self.arg_names, "args")
+        missing = [n for n in self.arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+
+        self.aux_dict: Dict[str, NDArray] = self._to_dict(
+            aux_states or {}, self.aux_names, "aux_states")
+        for n in self.aux_names:
+            if n not in self.aux_dict:
+                # allocate zeros lazily from inferred shape
+                shapes = {k: v.shape for k, v in self.arg_dict.items()}
+                _, _, aux_shapes = self._sym.infer_shape(**shapes)
+                self.aux_dict = {
+                    nm: self.aux_dict.get(nm, nd.zeros(s))
+                    for nm, s in zip(self.aux_names, aux_shapes)}
+                break
+
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        else:
+            self.grad_req = {n: grad_req.get(n, "null")
+                             for n in self.arg_names}
+
+        self.grad_dict: Dict[str, NDArray] = self._to_dict(
+            args_grad or {}, self.arg_names, "args_grad")
+        for n in self.arg_names:
+            if self.grad_req[n] != "null" and n not in self.grad_dict:
+                self.grad_dict[n] = nd.zeros_like(self.arg_dict[n])
+
+        self._diff_names = [n for n in self.arg_names
+                            if self.grad_req[n] != "null"]
+        self._outputs: Optional[List[NDArray]] = None
+        self._pending = None        # stashed inputs for lazy training fwd
+        self._is_train = False
+        self._build_funcs()
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _to_dict(values, names, what) -> Dict[str, NDArray]:
+        if values is None:
+            return {}
+        if isinstance(values, dict):
+            return dict(values)
+        if isinstance(values, (list, tuple)):
+            if len(values) > len(names):
+                raise MXNetError("%s: too many entries" % what)
+            return {n: v for n, v in zip(names, values) if v is not None}
+        raise MXNetError("%s must be dict or list" % what)
+
+    def _build_funcs(self):
+        import jax
+        import jax.numpy as jnp
+
+        heads = self._sym._outputs
+        arg_names = tuple(self.arg_names)
+        aux_names = tuple(self.aux_names)
+        diff_names = tuple(self._diff_names)
+        nodiff_names = tuple(n for n in arg_names if n not in diff_names)
+
+        def run(var_values, is_train, key):
+            outs, auxu = eval_graph(heads, var_values, is_train, key)
+            aux_new = [auxu.get(n, var_values[n]) for n in aux_names]
+            return outs, aux_new
+
+        def fwd_infer(arg_vals, aux_vals, key):
+            var_values = dict(zip(arg_names, arg_vals))
+            var_values.update(zip(aux_names, aux_vals))
+            outs, _ = run(var_values, False, key)
+            return outs
+
+        def fwd_train(arg_vals, aux_vals, key):
+            var_values = dict(zip(arg_names, arg_vals))
+            var_values.update(zip(aux_names, aux_vals))
+            return run(var_values, True, key)
+
+        def fwd_bwd(diff_vals, nodiff_vals, aux_vals, key, out_grads):
+            def f(dv):
+                var_values = dict(zip(diff_names, dv))
+                var_values.update(zip(nodiff_names, nodiff_vals))
+                var_values.update(zip(aux_names, aux_vals))
+                return run(var_values, True, key)
+
+            (outs, aux_new), vjp = jax.vjp(f, list(diff_vals))
+            cot_aux = [jnp.zeros_like(a) for a in aux_new]
+            grads, = vjp((list(out_grads), cot_aux))
+            return outs, aux_new, grads
+
+        self._jit_fwd_infer = jax.jit(fwd_infer)
+        self._jit_fwd_train = jax.jit(fwd_train)
+        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs is None and self._pending is not None:
+            self._run_forward_only()
+        return self._outputs or []
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else v)
+        self._is_train = is_train
+        arg_vals = [self.arg_dict[n]._data for n in self.arg_names]
+        aux_vals = [self.aux_dict[n]._data for n in self.aux_names]
+        from .. import random as _random
+        key = _random.next_key()
+        if is_train:
+            # Lazy: stash inputs; backward() runs one fused fwd+bwd XLA
+            # computation (reference: bulked forward/backward segments).
+            self._pending = (arg_vals, aux_vals, key)
+            self._outputs = None
+            return self.outputs if False else _LazyOutputs(self)
+        outs = self._jit_fwd_infer(arg_vals, aux_vals, key)
+        self._pending = None
+        self._outputs = [_wrap(o) for o in outs]
+        return self._outputs
+
+    def _run_forward_only(self):
+        arg_vals, aux_vals, key = self._pending
+        outs, aux_new = self._jit_fwd_train(arg_vals, aux_vals, key)
+        self._write_aux(aux_new)
+        self._outputs = [_wrap(o) for o in outs]
+
+    def _write_aux(self, aux_new):
+        for n, v in zip(self.aux_names, aux_new):
+            self.aux_dict[n]._set_data(v)
+
+    def backward(self, out_grads=None):
+        import jax.numpy as jnp
+
+        if self._pending is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        arg_vals, aux_vals, key = self._pending
+        diff_vals = [self.arg_dict[n]._data for n in self._diff_names]
+        nodiff_vals = [self.arg_dict[n]._data for n in self.arg_names
+                       if n not in self._diff_names]
+
+        if out_grads is None:
+            # loss-head semantics: output ops' custom VJPs ignore the
+            # cotangent; ones is the identity seed for true losses
+            import jax
+            out_structs = jax.eval_shape(
+                lambda a, x, k: self._jit_fwd_train.__wrapped__(a, x, k)[0],
+                arg_vals, aux_vals, key)
+            og = [jnp.ones(s.shape, s.dtype) for s in out_structs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            og = [g._data if isinstance(g, NDArray) else g
+                  for g in out_grads]
+
+        outs, aux_new, grads = self._jit_fwd_bwd(
+            diff_vals, nodiff_vals, aux_vals, key, og)
+        self._write_aux(aux_new)
+        self._outputs = [_wrap(o) for o in outs]
+        for n, g in zip(self._diff_names, grads):
+            req = self.grad_req[n]
+            tgt = self.grad_dict[n]
+            if req == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+        self._pending = None
+
+    # ------------------------------------------------------------------
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new input shapes.  The jit cache is keyed by shape,
+        so this is just re-allocating the changed arrays (the reference's
+        shared-memory rebinding for bucketing is free here)."""
+        from .. import ndarray as nd
+        shapes = {k: v.shape for k, v in self.arg_dict.items()}
+        shapes.update(kwargs)
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**shapes)
+        for n, s in zip(self.arg_names, arg_shapes):
+            if tuple(self.arg_dict[n].shape) != tuple(s):
+                self.arg_dict[n] = nd.zeros(s)
+                if n in self.grad_dict:
+                    self.grad_dict[n] = nd.zeros(s)
+        for n, s in zip(self.aux_names, aux_shapes):
+            if tuple(self.aux_dict[n].shape) != tuple(s):
+                self.aux_dict[n] = nd.zeros(s)
+        return self
+
+
+class _LazyOutputs(list):
+    """List-like placeholder returned by forward(is_train=True): touching it
+    forces the forward computation (otherwise backward() runs one fused
+    forward+backward)."""
+
+    def __init__(self, exe: Executor):
+        super().__init__()
+        self._exe = exe
+
+    def _force(self):
+        outs = self._exe.outputs
+        if not list.__len__(self):
+            self.extend(outs)
+        return outs
+
+    def __len__(self):
+        return len(self._force())
+
+    def __getitem__(self, i):
+        return self._force()[i]
+
+    def __iter__(self):
+        return iter(self._force())

@@ -1,0 +1,201 @@
+"""Symbol/Module API tests (reference: test_symbol.py, test_module.py —
+SURVEY.md §4.3, plus a small convergence test per §4.4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax",
+                                normalization="batch")
+
+
+def test_symbol_compose_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    a, o, _ = out.infer_shape(data=(8, 16))
+    assert a[1] == (32, 16) and o[0] == (8, 4)
+
+
+def test_symbol_arithmetic_and_eval():
+    x = mx.sym.Variable("x")
+    y = x * 2 + 1
+    exe = y._bind(mx.cpu(), {"x": mx.nd.ones((2, 2))}, grad_req="null")
+    out = exe.forward()
+    tu.assert_almost_equal(out[0], np.full((2, 2), 3.0))
+
+
+def test_symbol_grouping_and_internals():
+    x = mx.sym.Variable("x")
+    a = mx.nd  # noqa: F841
+    s1 = mx.sym.exp(x)
+    s2 = mx.sym.sqrt(x)
+    g = mx.sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    internals = _mlp().get_internals()
+    assert any("fc1" in n for n in internals.list_outputs())
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp()
+    fname = str(tmp_path / "net-symbol.json")
+    out.save(fname)
+    loaded = mx.sym.load(fname)
+    assert loaded.list_arguments() == out.list_arguments()
+    a1, o1, _ = out.infer_shape(data=(4, 16))
+    a2, o2, _ = loaded.infer_shape(data=(4, 16))
+    assert o1 == o2 and a1 == a2
+
+
+def test_executor_forward_backward_matches_autograd():
+    np.random.seed(0)
+    x = np.random.randn(4, 8).astype(np.float32)
+    w = np.random.randn(5, 8).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, no_bias=True, name="fc")
+    loss = mx.sym.sum(fc * fc)
+    exe = loss._bind(mx.cpu(), {"data": mx.nd.array(x),
+                                "fc_weight": mx.nd.array(w)})
+    exe.forward(is_train=True)
+    exe.backward()
+
+    # imperative oracle
+    xa, wa = mx.nd.array(x), mx.nd.array(w)
+    xa.attach_grad()
+    wa.attach_grad()
+    with mx.autograd.record():
+        out = (mx.nd.FullyConnected(xa, wa, num_hidden=5, no_bias=True) ** 2
+               ).sum()
+    out.backward()
+    tu.assert_almost_equal(exe.grad_dict["fc_weight"], wa.grad, rtol=1e-4,
+                           atol=1e-4)
+    tu.assert_almost_equal(exe.grad_dict["data"], xa.grad, rtol=1e-4,
+                           atol=1e-4)
+
+
+def test_executor_grad_req_add_and_null():
+    x = np.ones((2, 3), np.float32)
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    loss = mx.sym.sum(data * w)
+    exe = loss._bind(mx.cpu(), {"data": mx.nd.array(x),
+                                "w": mx.nd.ones((2, 3))},
+                     grad_req={"data": "null", "w": "add"})
+    for _ in range(2):
+        exe.forward(is_train=True)
+        exe.backward()
+    tu.assert_almost_equal(exe.grad_dict["w"], 2 * x)
+    assert "data" not in exe.grad_dict
+
+
+def test_batchnorm_aux_update():
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(d, name="bn0", momentum=0.5)
+    exe = bn.simple_bind(ctx=mx.cpu(), data=(16, 4))
+    exe.arg_dict["bn0_gamma"]._set_data(np.ones(4, np.float32))
+    exe.aux_dict["bn0_moving_var"]._set_data(np.ones(4, np.float32))
+    x = np.random.randn(16, 4).astype(np.float32) * 3 + 1
+    exe.forward(is_train=True, data=mx.nd.array(x))
+    exe.backward()
+    # moving mean moved toward batch mean
+    mm = exe.aux_dict["bn0_moving_mean"].asnumpy()
+    assert np.abs(mm).max() > 0, "aux state not updated"
+    # inference mode must NOT update aux
+    before = exe.aux_dict["bn0_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=False, data=mx.nd.array(x))
+    after = exe.aux_dict["bn0_moving_mean"].asnumpy()
+    tu.assert_almost_equal(before, after)
+
+
+def test_module_fit_convergence():
+    """MNIST-scale convergence test (SURVEY.md §4.4): linearly separable
+    blobs must reach high train accuracy in a few epochs."""
+    np.random.seed(42)
+    n, d, k = 512, 16, 4
+    centers = np.random.randn(k, d) * 3
+    labels = np.random.randint(0, k, n)
+    xs = centers[labels] + np.random.randn(n, d) * 0.5
+
+    train = mx.io.NDArrayIter(xs.astype(np.float32),
+                              labels.astype(np.float32),
+                              batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=12,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.95, "did not converge: %s" % (score,)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    prefix = str(tmp_path / "mlp")
+    xs = np.random.randn(64, 16).astype(np.float32)
+    ys = np.random.randint(0, 4, 64).astype(np.float32)
+    train = mx.io.NDArrayIter(xs, ys, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1,
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(train.provide_data, train.provide_label, for_training=False)
+    mod2.init_params()
+    p1, _ = mod.get_params()
+    p2, _ = mod2.get_params()
+    for k in p1:
+        tu.assert_almost_equal(p1[k], p2[k])
+
+
+def test_bucketing_module():
+    """Per-bucket executors sharing parameters (Sockeye-style bucketing)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc",
+                                   flatten=False)
+        fc = mx.sym.mean(fc, axis=1)
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 16, 8))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params(initializer=mx.initializer.Xavier())
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    from mxnet_tpu.io import DataBatch
+    for L in (16, 8, 16, 12):
+        batch = DataBatch(
+            data=[mx.nd.array(np.random.randn(4, L, 8).astype(np.float32))],
+            label=[mx.nd.array(np.random.randint(0, 8, 4).astype(
+                np.float32))],
+            bucket_key=L,
+            provide_data=[("data", (4, L, 8))],
+            provide_label=[("softmax_label", (4,))])
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+    assert set(bm._buckets) == {16, 8, 12}
+
+
+def test_check_symbolic_oracles():
+    data = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s = data * b
+    a_np = np.random.randn(3, 3)
+    b_np = np.random.randn(3, 3)
+    tu.check_symbolic_forward(s, [a_np, b_np], [a_np * b_np])
+    og = np.ones((3, 3))
+    tu.check_symbolic_backward(s, [a_np, b_np], [og],
+                               {"a": b_np, "b": a_np})
