@@ -44,4 +44,5 @@ from . import callback         # noqa: E402
 from . import model            # noqa: E402
 from . import module           # noqa: E402
 from . import module as mod    # noqa: E402
+from . import contrib          # noqa: E402
 from . import test_utils       # noqa: E402

@@ -1,0 +1,3 @@
+"""``mx.contrib`` — contrib subsystems (AMP, quantization, ONNX, control
+flow).  Reference: ``python/mxnet/contrib/``."""
+from . import amp

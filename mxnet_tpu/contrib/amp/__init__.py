@@ -1,0 +1,8 @@
+"""``mx.contrib.amp`` — automatic mixed precision (bf16-first).
+
+Reference: ``python/mxnet/contrib/amp/`` (SURVEY.md §2.2 "AMP").
+"""
+from .amp import (init, is_initialized, disable, init_trainer, scale_loss,
+                  convert_symbol, convert_model)
+from .loss_scaler import LossScaler
+from . import lists

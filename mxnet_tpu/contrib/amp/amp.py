@@ -1,0 +1,187 @@
+"""Automatic mixed precision.
+
+Reference: ``python/mxnet/contrib/amp/amp.py`` (SURVEY.md §2.2 "AMP":
+``amp.init()`` patches the op namespace to insert ``amp_cast`` /
+``amp_multicast``; ``init_trainer``; ``convert_model`` via the nnvm
+low_precision_pass).
+
+TPU-native: bfloat16 is the default target (MXU native); float16 is kept
+for parity and engages the dynamic loss scaler.  Instead of monkey-patching
+generated Python stubs, casting runs as a hook on the single op-invoke
+choke point (``ops.registry.invoke``) — one interception covers eager
+``nd``, Gluon forward, and ``hybridize()`` traces.  ``convert_symbol``
+rewrites Symbol graphs by inserting ``amp_cast`` nodes, standing in for
+the reference's nnvm ``low_precision_pass``.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import types
+from typing import Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ops import registry as _registry
+from . import lists
+from .loss_scaler import LossScaler
+
+_state = {"initialized": False, "target_dtype": None}
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32")
+
+
+def _is_float(arr) -> bool:
+    return hasattr(arr, "dtype") and str(arr.dtype) in _FLOAT_DTYPES
+
+
+def _make_hook(target_dtype: str):
+    import jax.numpy as jnp
+
+    target = jnp.dtype(target_dtype)
+    f32 = jnp.dtype("float32")
+    targets = set(lists.TARGET_DTYPE_OPS)
+    fp32s = set(lists.FP32_OPS)
+    widest = set(lists.WIDEST_TYPE_CASTS)
+
+    def hook(op, arrays):
+        name = op.name
+        if name in targets:
+            return [a.astype(target) if _is_float(a) and a.dtype != target
+                    else a for a in arrays]
+        if name in fp32s:
+            return [a.astype(f32) if _is_float(a) and a.dtype != f32
+                    else a for a in arrays]
+        if name in widest:
+            floats = [a.dtype for a in arrays if _is_float(a)]
+            if not floats:
+                return arrays
+            w = f32 if f32 in floats else (
+                target if target in floats else floats[0])
+            return [a.astype(w) if _is_float(a) and a.dtype != w else a
+                    for a in arrays]
+        return arrays
+
+    return hook
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Turn on AMP for all subsequent imperative/Gluon computation."""
+    target_dtype = str(_np.dtype(target_dtype)) if target_dtype != \
+        "bfloat16" else "bfloat16"
+    if target_dtype not in ("float16", "bfloat16"):
+        raise MXNetError("target_dtype must be float16 or bfloat16")
+    if target_precision_ops:
+        lists.TARGET_DTYPE_OPS.extend(target_precision_ops)
+    if fp32_ops:
+        lists.FP32_OPS.extend(fp32_ops)
+    _registry.set_cast_hook(_make_hook(target_dtype))
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+    logging.info("AMP initialized (target_dtype=%s)", target_dtype)
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def disable():
+    """Turn AMP back off (not in the reference API; debugging aid)."""
+    _registry.set_cast_hook(None)
+    _state["initialized"] = False
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Gluon Trainer and patch ``step``
+    to skip updates on overflow (reference: amp.init_trainer)."""
+    if not _state["initialized"]:
+        raise MXNetError("call amp.init() before init_trainer()")
+    scaler = LossScaler() if _state["target_dtype"] == "float16" \
+        else LossScaler(init_scale=1.0, scale_factor=1.0)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    original_step = trainer.step
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        scaler = self._amp_loss_scaler
+        if scaler.loss_scale != 1.0 or _state["target_dtype"] == "float16":
+            overflow = scaler.has_overflow(self._params)
+            scaler.update_scale(overflow)
+            if overflow:
+                logging.warning(
+                    "AMP: gradient overflow, skipping update "
+                    "(loss_scale=%g)", scaler.loss_scale)
+                for p in self._params:
+                    if p._grad is not None:
+                        p.zero_grad()
+                return
+        original_step(batch_size, ignore_stale_grad)
+
+    trainer.step = types.MethodType(step, trainer)
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as L: L.backward()`` —
+    multiplies the loss by the current scale and arranges for ``step`` to
+    divide gradients back down."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+# ---------------------------------------------------------------------------
+# Symbol-graph conversion (≡ nnvm low_precision_pass)
+# ---------------------------------------------------------------------------
+
+def convert_symbol(sym, target_dtype="bfloat16", target_precision_ops=None,
+                   fp32_ops=None, cast_optional_params=False):
+    """Insert ``amp_cast`` nodes into a Symbol graph per the op lists."""
+    from ...symbol.symbol import Symbol, _Node
+    targets = set(lists.TARGET_DTYPE_OPS) | set(target_precision_ops or ())
+    fp32s = set(lists.FP32_OPS) | set(fp32_ops or ())
+    cast_op = _registry.get_op("amp_cast")
+
+    memo = {}
+
+    def cast_input(entry, dtype, tag):
+        node, oi = entry
+        cname = "%s_amp_cast_%s" % (node.name, tag)
+        cnode = _Node(cast_op, cname, [(node, oi)], (), {"dtype": dtype})
+        return (cnode, 0)
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_var:
+            memo[id(node)] = node
+            return node
+        new_inputs = [(rebuild(n), oi) for (n, oi) in node.inputs]
+        if node.op.name in targets:
+            new_inputs = [cast_input(e, target_dtype, target_dtype)
+                          for e in new_inputs]
+        elif node.op.name in fp32s:
+            new_inputs = [cast_input(e, "float32", "fp32")
+                          for e in new_inputs]
+        new = _Node(node.op, node.name, new_inputs, node.pos_attrs,
+                    node.attrs, node.user_attrs)
+        memo[id(node)] = new
+        return new
+
+    return Symbol([(rebuild(n), i) for (n, i) in sym._outputs])
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  **kwargs):
+    """Convert a symbolic model for low-precision inference (params stay
+    float32; casts are inserted in the graph — XLA fuses them away)."""
+    return (convert_symbol(sym, target_dtype=target_dtype, **kwargs),
+            arg_params, aux_params)

@@ -1,0 +1,42 @@
+"""AMP op lists.
+
+Reference: ``python/mxnet/contrib/amp/lists/symbol_fp16.py`` — the
+FP16/FP32/conditional op classification (SURVEY.md §2.2 "AMP" row).
+
+TPU-native: bfloat16 is the native MXU dtype, so the same lists serve
+``target_dtype='bfloat16'`` (the default here) and ``'float16'`` (parity).
+"""
+
+# Ops that run in the low-precision target dtype — the MXU-bound matmul
+# and conv FLOPs (reference: FP16_FUNCS).
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "RNN",
+    "dot", "batch_dot", "_npi_matmul",
+    "_linalg_gemm", "_linalg_gemm2", "_linalg_trmm", "_linalg_syrk",
+]
+
+# Numerically-sensitive ops forced to float32 (reference: FP32_FUNCS).
+FP32_OPS = [
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+    "L2Normalization", "softmax", "log_softmax", "softmin",
+    "SoftmaxOutput", "softmax_cross_entropy", "CTCLoss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "make_loss",
+    "exp", "expm1", "log", "log10", "log1p", "log2",
+    "rsqrt", "rcbrt", "reciprocal", "square", "sqrt", "cbrt",
+    "pow", "broadcast_power", "_power_scalar", "_rpower_scalar",
+    "gamma", "gammaln", "digamma", "erf", "erfc", "erfinv",
+    "sum", "mean", "prod", "nansum", "nanprod", "norm", "moments",
+    "cumsum", "smooth_l1", "sin", "cos", "tan", "sinh", "cosh", "tanh",
+    "arcsin", "arccos", "arctan", "arcsinh", "arccosh", "arctanh",
+    "softsign",
+]
+
+# Ops whose float inputs must agree — cast to the widest participating
+# dtype (reference: WIDEST_TYPE_CASTS / amp_multicast).
+WIDEST_TYPE_CASTS = [
+    "add_n", "Concat", "stack", "where",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_hypot",
+    "broadcast_mod",
+]

@@ -1,0 +1,45 @@
+"""Dynamic loss scaling.
+
+Reference: ``python/mxnet/contrib/amp/loss_scaler.py`` (SURVEY.md §2.2
+"AMP": dynamic scaling, overflow check via ``multi_all_finite``).
+
+bfloat16 has float32's exponent range, so scaling is a no-op there; the
+dynamic scaler exists for float16 parity.
+"""
+from __future__ import annotations
+
+
+class LossScaler:
+    def __init__(self, init_scale=2. ** 16, scale_factor=2.,
+                 scale_window=2000, tolerance=0.):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """True if any gradient is non-finite (reference: chunked
+        ``multi_all_finite`` over the grads)."""
+        from ... import ndarray as nd
+        grads = [p.grad() for p in params
+                 if getattr(p, "grad_req", "write") != "null"
+                 and p._grad is not None]
+        if not grads:
+            return False
+        CHUNK = 200
+        for i in range(0, len(grads), CHUNK):
+            ok = nd.multi_all_finite(grads[i:i + CHUNK],
+                                     num_arrays=len(grads[i:i + CHUNK]))
+            if not bool(ok.asnumpy().reshape(()) != 0):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

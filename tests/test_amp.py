@@ -1,0 +1,108 @@
+"""AMP tests (reference: tests/python/gpu/test_amp.py — SURVEY.md §4.3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import amp
+from mxnet_tpu import test_utils as tu
+
+
+@pytest.fixture
+def amp_on():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp.disable()
+
+
+def test_amp_casts_matmul_to_bf16(amp_on):
+    a = mx.nd.ones((4, 8))
+    b = mx.nd.ones((8, 4))
+    out = mx.nd.dot(a, b)
+    assert str(out.dtype) == "bfloat16"
+    # fp32-forced op comes back to float32
+    s = mx.nd.softmax(out)
+    assert str(s.dtype) == "float32"
+
+
+def test_amp_widest_cast(amp_on):
+    a = mx.nd.ones((2, 2))                        # f32
+    b = mx.nd.ones((2, 2)).astype("bfloat16")
+    out = mx.nd.broadcast_add(a, b)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_gluon_training_converges(amp_on):
+    np.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"))
+    net.add(mx.gluon.nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    x = np.random.randn(64, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        data, label = mx.nd.array(x), mx.nd.array(y)
+        with mx.autograd.record():
+            out = net(data)
+            L = loss_fn(out, label)
+            with amp.scale_loss(L, trainer) as scaled:
+                mx.autograd.backward(scaled)
+        trainer.step(64)
+        losses.append(float(L.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=1024, scale_factor=2, scale_window=3)
+    s.update_scale(True)
+    assert s.loss_scale == 512
+    for _ in range(3):
+        s.update_scale(False)
+    assert s.loss_scale == 1024
+
+
+def test_overflow_skips_update():
+    net = mx.gluon.nn.Dense(2)
+    net.initialize()
+    x = mx.nd.ones((2, 4))
+    with mx.autograd.record():
+        out = net(x)
+    out.backward()
+    amp.init(target_dtype="float16")
+    try:
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        w0 = net.weight.data().asnumpy().copy()
+        # poison the gradient
+        g = net.weight.grad()
+        g._set_data(np.full(g.shape, np.inf, np.float32))
+        scale0 = trainer._amp_loss_scaler.loss_scale
+        trainer.step(2)
+        assert trainer._amp_loss_scaler.loss_scale < scale0
+        tu.assert_almost_equal(net.weight.data(), w0)
+    finally:
+        amp.disable()
+
+
+def test_convert_symbol_inserts_casts():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.softmax(fc)
+    conv = amp.convert_symbol(out, target_dtype="bfloat16")
+    js = conv.tojson()
+    assert "amp_cast" in js
+    # converted graph still evaluates and matches fp32 within bf16 tol
+    x = np.random.randn(2, 8).astype(np.float32)
+    w = np.random.randn(4, 8).astype(np.float32)
+    args = {"data": mx.nd.array(x), "fc_weight": mx.nd.array(w),
+            "fc_bias": mx.nd.zeros((4,))}
+    o1 = out._bind(mx.cpu(), dict(args), grad_req="null").forward()
+    o2 = conv._bind(mx.cpu(), dict(args), grad_req="null").forward()
+    tu.assert_almost_equal(o1[0], o2[0], rtol=3e-2, atol=3e-2)
