@@ -41,6 +41,10 @@ class TransformerConfig:
     use_flash: bool = True        # pallas flash attention on TPU
     remat: bool = True            # jax.checkpoint per layer
     type_vocab_size: int = 2
+    # sequence/context parallelism over the mesh's 'sp' axis:
+    # None = let GSPMD handle it; 'ring' = ring attention (ppermute K/V
+    # blocks over ICI); 'ulysses' = all-to-all head scatter.
+    seq_parallel: Optional[str] = None
 
 
 def bert_base(**kw):
@@ -148,11 +152,19 @@ def _layer_norm(x, g, b, eps=1e-12):
     return (x - mean) / jnp.sqrt(var + eps) * g + b
 
 
-def _attention(q, k, v, mask, cfg: TransformerConfig):
-    """(B, T, H, dh) attention.  Uses the pallas flash kernel on TPU when
-    enabled; jnp reference otherwise (also the CPU/test path)."""
+def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None):
+    """(B, T, H, dh) attention.  With ``cfg.seq_parallel`` and an 'sp'
+    mesh axis the sequence stays sharded and attention runs as ring /
+    Ulysses over ICI; otherwise the pallas flash kernel on TPU when
+    enabled, jnp reference elsewhere (also the CPU/test path)."""
     import jax
     import jax.numpy as jnp
+    if cfg.seq_parallel and mesh is not None and "sp" in mesh.axis_names \
+            and mesh.shape["sp"] > 1:
+        from ..parallel.ring_attention import sequence_parallel_attention
+        return sequence_parallel_attention(
+            q, k, v, mask, mesh=mesh, seq_axis="sp",
+            method=cfg.seq_parallel)
     if cfg.use_flash:
         try:
             from ..kernels.flash_attention import flash_attention
@@ -168,7 +180,8 @@ def _attention(q, k, v, mask, cfg: TransformerConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key):
+def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key,
+                   mesh=None):
     import jax
     import jax.numpy as jnp
     B, T, D = x.shape
@@ -182,7 +195,7 @@ def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key):
     q = (x @ dn(layer["wq"]) + dn(layer["bq"])).reshape(B, T, H, dh)
     k = (x @ dn(layer["wk"]) + dn(layer["bk"])).reshape(B, T, H, dh)
     v = (x @ dn(layer["wv"]) + dn(layer["bv"])).reshape(B, T, H, dh)
-    attn = _attention(q, k, v, mask, cfg).reshape(B, T, D)
+    attn = _attention(q, k, v, mask, cfg, mesh).reshape(B, T, D)
     attn = attn @ dn(layer["wo"]) + dn(layer["bo"])
     if train and cfg.dropout > 0:
         key, sub = jax.random.split(key)
@@ -226,11 +239,11 @@ def forward(params, tokens, cfg: TransformerConfig, *, type_ids=None,
     layer_fn = _encoder_layer
     if cfg.remat:
         layer_fn = jax.checkpoint(
-            _encoder_layer, static_argnums=(3, 4),
+            _encoder_layer, static_argnums=(3, 4, 6),
             policy=jax.checkpoint_policies.nothing_saveable)
     for i, layer in enumerate(params["layers"]):
         rng, sub = jax.random.split(rng)
-        x = layer_fn(x, layer, mask, cfg, train, sub)
+        x = layer_fn(x, layer, mask, cfg, train, sub, mesh)
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(
                 x, jax.sharding.NamedSharding(mesh, _act_spec(mesh)))

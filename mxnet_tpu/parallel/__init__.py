@@ -5,3 +5,5 @@ XLA collectives over a ``jax.sharding.Mesh``.
 """
 from .mesh import make_mesh, default_mesh, current_mesh, mesh_scope
 from .data_parallel import DataParallelTrainer
+from .ring_attention import (ring_attention, ulysses_attention,
+                             sequence_parallel_attention)
