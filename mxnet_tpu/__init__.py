@@ -45,4 +45,7 @@ from . import model            # noqa: E402
 from . import module           # noqa: E402
 from . import module as mod    # noqa: E402
 from . import contrib          # noqa: E402
+from . import profiler         # noqa: E402
+from . import monitor          # noqa: E402
+from .monitor import Monitor   # noqa: E402
 from . import test_utils       # noqa: E402

@@ -244,6 +244,9 @@ class Module(BaseModule):
 
     # ------------------------------------------------------------------
 
+    def install_monitor(self, monitor):
+        monitor.install(self._exec)
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         from ..model import save_checkpoint
         arg, aux = self.get_params()

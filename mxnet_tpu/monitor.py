@@ -1,0 +1,86 @@
+"""Monitor — per-layer output statistics for training debugging.
+
+Reference: ``python/mxnet/monitor.py`` (SURVEY.md §5.5): collects stats
+(e.g. abs-mean) of layer outputs/weights/gradients matching a regex every
+``interval`` batches.
+
+TPU-native caveat: inside a compiled executor XLA fuses intermediate ops
+away, so per-internal-op observation would force a debug recompile.  The
+Monitor therefore reports the observable arrays — bound arguments,
+gradients, auxiliary states and outputs — which covers the reference's
+main uses (weight/grad/output health).  Gluon Blocks can register eager
+forward hooks for internals when needed.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x):
+    return nd.norm(x) / (x.size ** 0.5)
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = interval
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self._execs = []
+
+    def install(self, exe):
+        """Attach to an Executor (called by Module.install_monitor)."""
+        self._execs.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self._execs:
+            for name, arr in exe.arg_dict.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name, self.stat_func(arr)))
+            for name, arr in exe.aux_dict.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name, self.stat_func(arr)))
+            for name, arr in getattr(exe, "grad_dict", {}).items():
+                gname = name + "_grad"
+                if self.re_pattern.match(gname):
+                    self.queue.append((self.step, gname,
+                                       self.stat_func(arr)))
+            for name, arr in zip(exe.output_names, exe.outputs):
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name, self.stat_func(arr)))
+        res = []
+        queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \
+            else self.queue
+        for n, k, v_arr in queue:
+            if isinstance(v_arr, NDArray):
+                v = v_arr.asnumpy()
+                s = str(v.reshape(-1)[0]) if v.size == 1 else str(v)
+            else:
+                s = str(v_arr)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,248 @@
+"""Profiler — chrome-trace op/event recording + aggregate stats.
+
+Reference: ``src/profiler/profiler.cc`` + ``python/mxnet/profiler.py``
+(SURVEY.md §5.1): the engine wraps every operator in start/stop events,
+dumps ``chrome://tracing`` JSON and aggregate per-op tables; custom user
+scopes (Task/Frame/Event/Counter); config via ``set_config`` /
+``set_state``.
+
+TPU-native: the imperative layer hooks the engine choke point exactly like
+the reference; compiled (jit) regions and on-device timing come from
+``jax.profiler`` (XPlane → Perfetto/TensorBoard), started alongside when
+``xla_profile=True``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+from .engine import Engine
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "xla_profile": False,
+    "xla_trace_dir": "/tmp/mxnet_tpu_xla_trace",
+}
+_events: List[dict] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_state = {"running": False, "paused": False, "hook": None,
+          "xla_running": False}
+_starts = threading.local()
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def _op_hook(event: str, name: str):
+    if _state["paused"] or not _state["running"]:
+        return
+    if event == "start":
+        if not hasattr(_starts, "stack"):
+            _starts.stack = []
+        _starts.stack.append((name, _now_us()))
+    elif event == "stop":
+        stack = getattr(_starts, "stack", None)
+        if not stack:
+            return
+        n, t0 = stack.pop()
+        dur = _now_us() - t0
+        with _lock:
+            _events.append({
+                "name": n, "ph": "X", "ts": t0, "dur": dur,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "cat": "operator",
+            })
+            if _config["aggregate_stats"]:
+                _agg[n].append(dur)
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference: MXSetProcessProfilerConfig)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError("profiler.set_config: unknown keys %s" % unknown)
+    _config.update(kwargs)
+    if _config["profile_all"]:
+        _config["profile_imperative"] = True
+        _config["profile_symbolic"] = True
+
+
+def set_state(state_name: str = "stop"):
+    """'run' starts collection, 'stop' ends it (reference parity).  Env
+    ``MXNET_PROFILER_AUTOSTART=1`` arms it at import (see bottom)."""
+    if state_name == "run":
+        if not _state["running"]:
+            hook = _op_hook
+            Engine.get().add_op_hook(hook)
+            _state["hook"] = hook
+            _state["running"] = True
+            if _config["xla_profile"] and not _state["xla_running"]:
+                import jax
+                try:
+                    jax.profiler.start_trace(_config["xla_trace_dir"])
+                    _state["xla_running"] = True
+                except Exception:
+                    pass
+    elif state_name == "stop":
+        if _state["running"]:
+            Engine.get().remove_op_hook(_state["hook"])
+            _state["running"] = False
+            if _state["xla_running"]:
+                import jax
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                _state["xla_running"] = False
+    else:
+        raise MXNetError("set_state expects 'run' or 'stop'")
+
+
+def state() -> str:
+    return "run" if _state["running"] else "stop"
+
+
+def pause():
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def dump(finished: bool = True, filename: Optional[str] = None):
+    """Write chrome-trace JSON (load in chrome://tracing / Perfetto)."""
+    fname = filename or _config["filename"]
+    with _lock:
+        trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(fname, "w") as f:
+        json.dump(trace, f)
+    if finished:
+        with _lock:
+            _events.clear()
+    return fname
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate per-op stats table (reference: aggregate_stats.cc)."""
+    lines = ["Profile Statistics:",
+             "%-40s %8s %12s %12s %12s %12s" % (
+                 "Name", "Calls", "Total(us)", "Min(us)", "Max(us)",
+                 "Avg(us)")]
+    with _lock:
+        for name in sorted(_agg, key=lambda n: -sum(_agg[n])):
+            ds = _agg[name]
+            lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" % (
+                name, len(ds), sum(ds), min(ds), max(ds),
+                sum(ds) / len(ds)))
+        if reset:
+            _agg.clear()
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Custom user scopes (reference: profiler.Task/Frame/Event/Counter)
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    _cat = "user"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = _now_us()
+        return self
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        with _lock:
+            _events.append({
+                "name": self.name, "ph": "X", "ts": self._t0,
+                "dur": _now_us() - self._t0, "pid": os.getpid(),
+                "tid": threading.get_ident(), "cat": self._cat,
+            })
+        self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scope):
+    _cat = "task"
+
+
+class Frame(_Scope):
+    _cat = "frame"
+
+
+class Event(_Scope):
+    _cat = "event"
+
+
+class Marker:
+    """Instant event (reference: profiler.Marker)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _lock:
+            _events.append({
+                "name": self.name, "ph": "i", "ts": _now_us(),
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "s": "p" if scope == "process" else "t",
+            })
+
+
+class Counter:
+    """Named counter series (reference: profiler.Counter)."""
+
+    def __init__(self, name: str, value: float = 0):
+        self.name = name
+        self._value = value
+        self._emit()
+
+    def _emit(self):
+        with _lock:
+            _events.append({
+                "name": self.name, "ph": "C", "ts": _now_us(),
+                "pid": os.getpid(),
+                "args": {self.name: self._value},
+            })
+
+    def set_value(self, value: float):
+        self._value = value
+        self._emit()
+
+    def increment(self, delta: float = 1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta: float = 1):
+        self.set_value(self._value - delta)
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_config(profile_all=True)
+    set_state("run")

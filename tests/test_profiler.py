@@ -1,0 +1,75 @@
+"""Profiler + Monitor tests (reference: test_profiler.py — SURVEY.md
+§4.3, §5.1, §5.5)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_profiler_records_op_events(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname, aggregate_stats=True)
+    profiler.set_state("run")
+    a = mx.nd.ones((8, 8))
+    b = mx.nd.dot(a, a)
+    b.wait_to_read()
+    profiler.set_state("stop")
+    out = profiler.dump()
+    with open(out) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "dot" in names
+    stats = profiler.dumps(reset=True)
+    assert "dot" in stats
+
+
+def test_profiler_pause_resume(tmp_path):
+    fname = str(tmp_path / "p.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    profiler.pause()
+    _ = mx.nd.exp(mx.nd.ones((4,)))
+    profiler.resume()
+    _ = mx.nd.sqrt(mx.nd.ones((4,)))
+    profiler.set_state("stop")
+    with open(profiler.dump()) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert "sqrt" in names and "exp" not in names
+
+
+def test_custom_scopes_and_counters(tmp_path):
+    fname = str(tmp_path / "s.json")
+    profiler.set_config(filename=fname)
+    with profiler.Task("data_loading"):
+        pass
+    c = profiler.Counter("samples", 0)
+    c.increment(64)
+    profiler.Marker("epoch_end").mark()
+    with open(profiler.dump()) as f:
+        evs = json.load(f)["traceEvents"]
+    cats = {e["name"] for e in evs}
+    assert {"data_loading", "samples", "epoch_end"} <= cats
+
+
+def test_monitor_collects_stats():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind([("data", (8, 16))], [("softmax_label", (8,))])
+    mod.init_params()
+    mon = mx.Monitor(interval=1, pattern=".*weight.*")
+    mod.install_monitor(mon)
+
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[mx.nd.ones((8, 16))],
+                      label=[mx.nd.zeros((8,))])
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    res = mon.toc()
+    names = [k for (_, k, _) in res]
+    assert "fc_weight" in names
+    assert all("bias" not in n for n in names)
