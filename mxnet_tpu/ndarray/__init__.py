@@ -14,6 +14,8 @@ import sys as _sys
 _register.populate(globals())
 _ndmod._install_methods()
 
+from . import contrib  # noqa: E402  (control flow: foreach/while_loop/cond)
+
 
 def eye(N, M=0, k=0, ctx=None, dtype="float32"):
     from ..ops.registry import get_op, invoke

@@ -1,0 +1,210 @@
+"""``mx.nd.contrib`` — control-flow operators.
+
+Reference: ``src/operator/control_flow.cc`` + ``python/mxnet/ndarray/
+contrib.py`` (``foreach`` / ``while_loop`` / ``cond`` — SURVEY.md §2.1
+"Operator library" row).
+
+TPU-native design: the reference interprets the body with per-step
+executors; here each construct lowers to the corresponding XLA structured
+control-flow primitive (``lax.scan`` / ``lax.cond``), so the loop compiles
+to ONE fused computation with static shapes — the idiom jit requires
+(task brief: "no data-dependent Python control flow inside jit").
+``while_loop`` deliberately lowers to a masked ``lax.scan`` over
+``max_iterations`` instead of ``lax.while_loop``: bounded iteration keeps
+it reverse-mode differentiable (XLA's while is not), matching the
+reference's requirement that callers provide ``max_iterations`` anyway.
+
+Each construct is invoked as an ephemeral op through the registry, so the
+autograd tape records one node whose replay re-traces the body — gradients
+flow through ``jax.vjp`` of the whole scan.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+from ..base import MXNetError
+from ..ops.registry import OpDef, invoke
+from .ndarray import NDArray, _wrap
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x) -> Tuple[List, bool]:
+    """Returns (list, was_list)."""
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _wrap_all(arrs):
+    return [_wrap(a) for a in arrs]
+
+
+def _run_body_pure(body, *nd_args):
+    """Call user body with NDArray views over tracers, autograd paused
+    (the scan itself is the single tape node)."""
+    from .. import autograd
+    with autograd.pause():
+        return body(*nd_args)
+
+
+def foreach(body: Callable, data, init_states):
+    """Iterate ``body(data_slice, states) -> (outputs, new_states)`` over
+    axis 0 of ``data`` (reference: mx.nd.contrib.foreach).
+
+    Returns (outputs, final_states) with per-step outputs stacked on
+    axis 0.  Compiles to one ``lax.scan``.
+    """
+    data_list, data_was_list = _as_list(data)
+    states_list, states_was_list = _as_list(init_states)
+    n_data, n_states = len(data_list), len(states_list)
+    meta = {}
+
+    def impl(*arrays):
+        import jax.numpy as jnp
+        from jax import lax
+
+        xs = tuple(arrays[:n_data])
+        init = tuple(arrays[n_data:])
+
+        def step(carry, x_slice):
+            x_nd = _wrap_all(x_slice)
+            s_nd = _wrap_all(carry)
+            outs, new_states = _run_body_pure(
+                body,
+                x_nd if data_was_list else x_nd[0],
+                s_nd if states_was_list else s_nd[0])
+            outs_l, outs_was_list = _as_list(outs)
+            ns_l, _ = _as_list(new_states)
+            if len(ns_l) != n_states:
+                raise MXNetError("foreach: body returned %d states, "
+                                 "expected %d" % (len(ns_l), n_states))
+            meta["n_out"] = len(outs_l)
+            meta["outs_was_list"] = outs_was_list
+            return (tuple(_unwrap(s) for s in ns_l),
+                    tuple(_unwrap(o) for o in outs_l))
+
+        final, ys = lax.scan(step, init, xs)
+        return tuple(ys) + tuple(final)
+
+    op = OpDef("_foreach", impl, num_outputs=-1)
+    results = invoke(op, data_list + states_list)
+    rlist = list(results) if isinstance(results, (tuple, list)) else [results]
+    n_out = meta["n_out"]
+    outputs = rlist[:n_out]
+    final_states = rlist[n_out:]
+    if not meta["outs_was_list"]:
+        outputs = outputs[0]
+    if not states_was_list:
+        final_states = final_states[0]
+    return outputs, final_states
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """``while cond(*loop_vars): outputs, loop_vars = func(*loop_vars)``
+    (reference: mx.nd.contrib.while_loop).
+
+    Returns (outputs, final_loop_vars); outputs are stacked buffers of
+    length ``max_iterations`` (steps beyond termination hold zeros, as in
+    the reference's padded semantics).
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    lv_list, was_list = _as_list(loop_vars)
+    n_vars = len(lv_list)
+    meta = {}
+
+    def impl(*arrays):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        init = tuple(arrays)
+
+        def pred(vars_):
+            r = _run_body_pure(cond, *_wrap_all(vars_))
+            r = _unwrap(r)
+            return jnp.reshape(r.astype(bool), ())
+
+        def step(carry, _):
+            vars_, alive = carry
+
+            def take(v):
+                outs, new_vars = _run_body_pure(func, *_wrap_all(v))
+                outs_l, outs_was_list = _as_list(outs)
+                nv_l, _ = _as_list(new_vars)
+                if len(nv_l) != n_vars:
+                    raise MXNetError(
+                        "while_loop: func returned %d loop_vars, "
+                        "expected %d" % (len(nv_l), n_vars))
+                meta["n_out"] = len(outs_l)
+                meta["outs_was_list"] = outs_was_list
+                return (tuple(_unwrap(x) for x in nv_l),
+                        tuple(_unwrap(o) for o in outs_l))
+
+            alive_now = alive & pred(vars_)
+            new_vars, outs = take(vars_)
+            new_vars = tuple(
+                jnp.where(alive_now, nv, v) for nv, v in zip(new_vars,
+                                                             vars_))
+            outs = tuple(jnp.where(alive_now, o, jnp.zeros_like(o))
+                         for o in outs)
+            return (new_vars, alive_now), outs + (alive_now,)
+
+        (final_vars, _), ys = lax.scan(
+            step, (init, jnp.asarray(True)), None, length=max_iterations)
+        n_out = meta["n_out"]
+        n_steps = jnp.sum(ys[-1].astype(jnp.int32))
+        return tuple(ys[:n_out]) + tuple(final_vars) + (n_steps,)
+
+    op = OpDef("_while_loop", impl, num_outputs=-1)
+    results = invoke(op, lv_list)
+    rlist = list(results)
+    n_out = meta["n_out"]
+    outputs = rlist[:n_out]
+    final_vars = rlist[n_out:n_out + n_vars]
+    if not meta["outs_was_list"]:
+        outputs = outputs[0]
+    if not was_list:
+        final_vars = final_vars[0]
+    return outputs, final_vars
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
+    """``then_func() if pred else else_func()`` compiled as ``lax.cond``
+    (reference: mx.nd.contrib.cond).  Both branches must return the same
+    shapes/dtypes."""
+    in_list, _ = _as_list(inputs if inputs is not None else [])
+    meta = {}
+
+    def impl(*arrays):
+        import jax.numpy as jnp
+        from jax import lax
+
+        p = arrays[0]
+        rest = arrays[1:]
+
+        def mk(branch):
+            def run(ops):
+                r = _run_body_pure(branch, *_wrap_all(ops)) \
+                    if ops else _run_body_pure(branch)
+                r_l, was_list = _as_list(r)
+                meta["was_list"] = was_list
+                return tuple(_unwrap(x) for x in r_l)
+            return run
+
+        return lax.cond(jnp.reshape(p.astype(bool), ()),
+                        mk(then_func), mk(else_func), rest)
+
+    op = OpDef("_cond", impl, num_outputs=-1)
+    pred_nd = pred if isinstance(pred, NDArray) else _wrap(pred)
+    results = invoke(op, [pred_nd] + in_list)
+    rlist = list(results) if isinstance(results, (tuple, list)) else [results]
+    if not meta["was_list"]:
+        return rlist[0]
+    return rlist

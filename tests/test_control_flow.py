@@ -1,0 +1,110 @@
+"""Control-flow operator tests (reference: test_contrib_control_flow.py —
+SURVEY.md §2.1 control_flow.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_foreach_cumsum():
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+    outs, final = mx.nd.contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    tu.assert_almost_equal(outs, expect)
+    tu.assert_almost_equal(final, expect[-1])
+
+
+def test_foreach_multiple_states_and_grad():
+    def body(x, states):
+        s1, s2 = states
+        ns1 = s1 * x
+        ns2 = s2 + x
+        return ns1 + ns2, [ns1, ns2]
+
+    data = mx.nd.array(np.random.rand(5, 4).astype(np.float32) + 0.5)
+    s1, s2 = mx.nd.ones((4,)), mx.nd.zeros((4,))
+    data.attach_grad()
+    with mx.autograd.record():
+        outs, _ = mx.nd.contrib.foreach(body, data, [s1, s2])
+        loss = outs.sum()
+    loss.backward()
+    assert data.grad is not None
+    assert np.all(np.isfinite(data.grad.asnumpy()))
+
+
+def test_foreach_rnn_like_matches_unrolled():
+    """foreach over an RNN-cell-like body ≡ the Python loop."""
+    W = mx.nd.array(np.random.randn(8, 8).astype(np.float32) * 0.1)
+
+    def body(x, h):
+        new_h = mx.nd.tanh(mx.nd.dot(x, W) + h)
+        return new_h, new_h
+
+    xs = np.random.randn(6, 2, 8).astype(np.float32)
+    outs, final = mx.nd.contrib.foreach(body, mx.nd.array(xs),
+                                        mx.nd.zeros((2, 8)))
+    # unrolled reference
+    h = np.zeros((2, 8), np.float32)
+    for t in range(6):
+        h = np.tanh(xs[t] @ W.asnumpy() + h)
+    tu.assert_almost_equal(final, h, rtol=1e-5, atol=1e-5)
+    tu.assert_almost_equal(outs[-1], h, rtol=1e-5, atol=1e-5)
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i, (i + 1, s + i)   # outputs=i, new vars
+
+    outs, (i_f, s_f) = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.zeros((1,)), mx.nd.zeros((1,))],
+        max_iterations=8)
+    assert float(i_f.asnumpy()[0]) == 5
+    assert float(s_f.asnumpy()[0]) == 0 + 1 + 2 + 3 + 4
+    # outputs padded to max_iterations, zeros past termination
+    o = outs.asnumpy()
+    assert o.shape[0] == 8
+    assert o[5:].sum() == 0
+
+
+def test_cond():
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+
+    out = mx.nd.contrib.cond(x < y,
+                             lambda a, b: a + b,
+                             lambda a, b: a - b,
+                             [x, y])
+    assert float(out.asnumpy()[0]) == 5.0
+    out = mx.nd.contrib.cond(x > y,
+                             lambda a, b: a + b,
+                             lambda a, b: a - b,
+                             [x, y])
+    assert float(out.asnumpy()[0]) == -1.0
+
+
+def test_foreach_in_hybridized_block():
+    """Control flow must survive hybridize (single jit trace)."""
+    class Scanner(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, final = mx.nd.contrib.foreach(
+                lambda xt, s: (s + xt, s + xt), x,
+                mx.nd.zeros((x.shape[1],) if hasattr(x, 'shape') else ()))
+            return final
+
+    net = Scanner()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((3, 4))
+    out = net(x)
+    tu.assert_almost_equal(out, np.full((4,), 3.0))
+    out = net(mx.nd.ones((3, 4)) * 2)
+    tu.assert_almost_equal(out, np.full((4,), 6.0))
