@@ -1,0 +1,369 @@
+"""Distributed KVStore — multi-host parameter-server semantics.
+
+Reference: ``src/kvstore/kvstore_dist.h`` + ``kvstore_dist_server.h`` over
+``3rdparty/ps-lite`` (SURVEY.md §2.1 "KVStore distributed"/"ps-lite",
+§3.4 call stack, §2.4 row "Data parallel, multi-node").
+
+TPU-native split of responsibilities:
+
+* The PERFORMANCE path for multi-chip/multi-host gradients is XLA
+  collectives over ICI/DCN emitted by GSPMD for mesh-sharded arrays
+  (``mxnet_tpu.parallel``) — that replaces NCCL/ps-lite for throughput,
+  as the scaling-book recipe prescribes.
+* THIS module preserves the reference's *API and semantics* —
+  ``dist_sync`` (aggregate-all-workers-then-update + barrier),
+  ``dist_async`` (apply-on-arrival), server-side optimizer
+  (``update_on_kvstore``) — over a real TCP transport, so existing MXNet
+  distributed scripts and the §4.5-style multi-process tests run
+  unchanged.  Like ps-lite it uses ``DMLC_*`` env vars for rendezvous.
+
+Protocol: length-prefixed pickled (cmd, key, payload) messages; one server
+process (the reference shards keys over servers — noted extension).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["DistServer", "DistKVStore", "create_dist_kvstore",
+           "run_server"]
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def _send(sock: socket.socket, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv(sock: socket.socket):
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<Q", hdr)
+    data = _recv_exact(sock, n)
+    if data is None:
+        return None
+    return pickle.loads(data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class DistServer:
+    """The server role (reference: ``KVStoreDistServer``).
+
+    dist_sync: buffers pushes until all workers contributed, then applies
+    the updater (or plain sum) once and wakes blocked pulls — the
+    aggregate-then-update semantics.  dist_async: applies each push as it
+    arrives.  The optimizer arrives from worker-0 as a serialized command
+    (reference: the updater shipped via ``_send_command_to_servers``).
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1,
+                 sync_mode=True):
+        self.num_workers = num_workers
+        self.sync_mode = sync_mode
+        self.store: Dict[object, np.ndarray] = {}
+        self._pending: Dict[object, list] = {}
+        self._push_count: Dict[object, int] = {}
+        self._version: Dict[object, int] = {}
+        self._updater = None
+        self._cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(num_workers * 2 + 8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._threads = []
+
+    def serve_forever(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- handlers ---------------------------------------------------------
+
+    def _apply_push(self, key, agg):
+        cur = self.store.get(key)
+        if self._updater is not None and cur is not None:
+            # updaters run on NDArrays (fused *_update ops)
+            from .. import ndarray as nd
+            w = nd.array(cur)
+            g = nd.array(agg)
+            idx = key if isinstance(key, int) else abs(hash(key)) % (2**31)
+            self._updater(idx, g, w)
+            self.store[key] = w.asnumpy()
+        elif cur is not None:
+            self.store[key] = cur + agg
+        else:
+            self.store[key] = agg
+        self._version[key] = self._version.get(key, 0) + 1
+
+    def _handle(self, conn):
+        while True:
+            msg = _recv(conn)
+            if msg is None:
+                break
+            cmd = msg[0]
+            if cmd == "init":
+                _, key, value = msg
+                with self._cv:
+                    if key not in self.store:
+                        self.store[key] = np.asarray(value)
+                        self._version[key] = 1
+                    self._cv.notify_all()
+                _send(conn, ("ok",))
+            elif cmd == "push":
+                _, key, value = msg
+                value = np.asarray(value)
+                with self._cv:
+                    if self.sync_mode:
+                        self._pending.setdefault(key, []).append(value)
+                        if len(self._pending[key]) == self.num_workers:
+                            agg = np.sum(self._pending.pop(key), axis=0)
+                            self._apply_push(key, agg)
+                            self._cv.notify_all()
+                    else:
+                        self._apply_push(key, value)
+                        self._cv.notify_all()
+                _send(conn, ("ok",))
+            elif cmd == "pull":
+                _, key, min_version = msg
+                with self._cv:
+                    while (key not in self.store or
+                           self._version.get(key, 0) < min_version):
+                        self._cv.wait(timeout=60)
+                    val = self.store[key]
+                _send(conn, ("val", val))
+            elif cmd == "version":
+                _, key = msg
+                with self._cv:
+                    _send(conn, ("ver", self._version.get(key, 0)))
+            elif cmd == "barrier":
+                with self._cv:
+                    gen = self._barrier_gen
+                    self._barrier_count += 1
+                    if self._barrier_count == self.num_workers:
+                        self._barrier_count = 0
+                        self._barrier_gen += 1
+                        self._cv.notify_all()
+                    else:
+                        while self._barrier_gen == gen:
+                            self._cv.wait(timeout=60)
+                _send(conn, ("ok",))
+            elif cmd == "optimizer":
+                _, blob = msg
+                from .. import optimizer as opt
+                optimizer = pickle.loads(blob)
+                self._updater = opt.get_updater(optimizer)
+                _send(conn, ("ok",))
+            elif cmd == "stop":
+                _send(conn, ("ok",))
+                self.shutdown()
+                break
+            else:
+                _send(conn, ("err", "unknown command %r" % (cmd,)))
+        conn.close()
+
+
+def run_server():
+    """Entry point for the server role (reference: the process started by
+    the tracker with DMLC_ROLE=server; ``kvstore_server.py``)."""
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
+    server = DistServer(host=host, port=port, num_workers=nworkers,
+                        sync_mode=sync)
+    server.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+class DistKVStore:
+    """Worker-side distributed store (reference: ``KVStoreDist``).
+
+    Local multi-device reduce happens first (as in the reference, where
+    gradients are reduced on-node before ZPush); the cross-process
+    aggregate runs on the server."""
+
+    def __init__(self, name="dist_sync"):
+        self.type = name
+        self._sync = "async" not in name
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._rank = int(os.environ.get("DMLC_WORKER_ID",
+                                        os.environ.get("DMLC_RANK", "0")))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._sock = None
+        deadline = time.time() + float(
+            os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "30"))
+        last_err = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=60)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        if self._sock is None:
+            raise MXNetError("cannot reach kvstore server at %s:%d (%s)"
+                             % (host, port, last_err))
+        self._lock = threading.Lock()
+        self._pull_version: Dict[object, int] = {}
+
+    # -- api --------------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send(self._sock, msg)
+            return _recv(self._sock)
+
+    def init(self, key, value):
+        keys, values = _kv_lists(key, value)
+        for k, v in zip(keys, values):
+            if self._rank == 0:
+                self._rpc("init", k, _to_numpy(v))
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, values = _kv_lists(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            # local reduce across devices first
+            reduced = vlist[0]
+            for v in vlist[1:]:
+                reduced = reduced + v
+            self._rpc("push", k, _to_numpy(reduced))
+            if self._sync:
+                # one aggregate-update per round of pushes
+                self._pull_version[k] = \
+                    self._pull_version.get(k, 1) + 1
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from ..ndarray.ndarray import NDArray
+        from .. import ndarray as nd
+        keys, outs = _kv_lists(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            tag, val = self._rpc("pull", k,
+                                 self._pull_version.get(k, 1))
+            if tag != "val":
+                raise MXNetError("pull failed for key %r" % (k,))
+            for o in olist:
+                if isinstance(o, NDArray):
+                    o._set_data(nd.array(val)._data)
+        return None
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        self.pull(key, out, priority)
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the server (reference: serialized updater
+        command from worker-0 → server applies updates)."""
+        if self._rank == 0:
+            blob = pickle.dumps(optimizer,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            self._rpc("optimizer", blob)
+        self.barrier()
+
+    def set_gradient_compression(self, compression_params):
+        import warnings
+        warnings.warn("gradient compression not applied on the TCP "
+                      "parity path (bf16 comms cover the TPU use case)")
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise MXNetError("Cannot save states on a distributed worker "
+                         "(reference behavior)")
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _kv_lists(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _to_numpy(v):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    return np.asarray(v)
+
+
+def create_dist_kvstore(name: str):
+    if os.environ.get("DMLC_ROLE", "worker") == "server":
+        run_server()
+        raise SystemExit(0)
+    return DistKVStore(name)

@@ -1,0 +1,51 @@
+"""Self-checking distributed-kvstore worker script.
+
+Reference: ``tests/nightly/dist_sync_kvstore.py`` (SURVEY.md §4.5 —
+launched as ``tools/launch.py -n 2 --launcher local python
+tests/dist_sync_kvstore.py``: real transport, fake topology, asserts
+value == nworkers × grad and barrier semantics)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np           # noqa: E402
+import mxnet_tpu as mx       # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    shape = (3, 4)
+
+    # init (worker 0 seeds; all see it)
+    kv.init("w", mx.nd.zeros(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    assert np.all(out.asnumpy() == 0), "init pull mismatch"
+
+    # sync push: server aggregates ALL workers before updating
+    for step in range(3):
+        kv.push("w", mx.nd.ones(shape))
+        kv.pull("w", out=out)
+        expect = (step + 1) * nw
+        got = out.asnumpy()
+        assert np.all(got == expect), \
+            "rank %d step %d: got %r want %r" % (rank, step, got[0, 0],
+                                                 expect)
+    kv.barrier()
+
+    # keyed list API
+    kv.init([1, 2], [mx.nd.zeros(shape), mx.nd.ones(shape)])
+    kv.push([1, 2], [mx.nd.ones(shape), mx.nd.ones(shape)])
+    o1, o2 = mx.nd.zeros(shape), mx.nd.zeros(shape)
+    kv.pull([1, 2], out=[o1, o2])
+    assert np.all(o1.asnumpy() == nw)
+    assert np.all(o2.asnumpy() == 1 + nw)
+    kv.barrier()
+    print("dist_sync_kvstore: rank %d/%d OK" % (rank, nw))
+
+
+if __name__ == "__main__":
+    main()

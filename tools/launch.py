@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Reference: ``tools/launch.py`` + the dmlc tracker (SURVEY.md §2.3
+"launch.py", §4.5: ``launch.py -n 3 -s 1 --launcher local python
+script.py`` forks scheduler/servers/workers as local processes with
+``DMLC_*`` env — real transport, fake topology).
+
+Supported launchers: ``local`` (fork all roles on this host — the test
+topology) and ``ssh`` (one worker per host from a hostfile; each host gets
+the same DMLC_* rendezvous env).  On TPU pods the heavy data path is XLA
+collectives over ICI/DCN inside each worker; this launcher only provides
+role/rendezvous plumbing, like the reference's tracker.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, command):
+    port = args.port or _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    })
+    procs = []
+
+    for i in range(args.num_servers):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = "server"
+        env["DMLC_SERVER_ID"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "from mxnet_tpu.parallel.dist import run_server; run_server()"],
+            env=env))
+
+    for i in range(args.num_workers):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = "worker"
+        env["DMLC_WORKER_ID"] = str(i)
+        procs.append(subprocess.Popen(command, env=env))
+
+    workers = procs[args.num_servers:]
+    code = 0
+    try:
+        for p in workers:
+            p.wait()
+            code = code or p.returncode
+    finally:
+        for p in procs[:args.num_servers]:
+            p.send_signal(signal.SIGTERM)
+    return code
+
+
+def launch_ssh(args, command):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    port = args.port or 9091
+    root = hosts[0]
+    procs = []
+    for i, host in enumerate(hosts[:args.num_workers]):
+        env_fwd = " ".join([
+            "DMLC_PS_ROOT_URI=%s" % root,
+            "DMLC_PS_ROOT_PORT=%d" % port,
+            "DMLC_NUM_WORKER=%d" % args.num_workers,
+            "DMLC_NUM_SERVER=%d" % args.num_servers,
+            "DMLC_ROLE=worker", "DMLC_WORKER_ID=%d" % i,
+        ])
+        procs.append(subprocess.Popen(
+            ["ssh", host, env_fwd + " " + " ".join(command)]))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--launcher", choices=["local", "ssh"],
+                    default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-p", "--port", type=int, default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args, args.command))
+    sys.exit(launch_ssh(args, args.command))
+
+
+if __name__ == "__main__":
+    main()
