@@ -20,7 +20,7 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "MXDataIter"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter"]
 
 _ITER_REG = Registry("data_iter")
 
@@ -389,6 +389,204 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class ImageRecordIter(DataIter):
+    """High-throughput image iterator over ``.rec``/``.idx`` packs.
+
+    Reference: ``ImageRecordIter`` registered by
+    ``src/io/iter_image_recordio_2.cc`` (SURVEY.md §2.1 "Data IO", §3.5 call
+    stack): sharded RecordIO parse → threaded JPEG decode → augment
+    (crop/flip/normalize) → batch → prefetch.  The hot path runs in the
+    native C++ pipeline (``native/src/image_loader.cc``); when the native
+    library is unavailable it falls back to a Python decode loop with the
+    same semantics (slow, correctness-only).
+
+    TPU note: pass ``layout="NHWC"`` to produce the conv-friendly layout
+    directly in the decode threads instead of transposing on device.
+    """
+
+    def __init__(self, path_imgrec, path_imgidx=None, data_shape=(3, 224, 224),
+                 batch_size=32, shuffle=False, seed=0, part_index=0,
+                 num_parts=1, rand_crop=False, rand_mirror=False,
+                 resize=0, label_width=1, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 preprocess_threads=4, layout="NCHW", round_batch=True,
+                 data_name="data", label_name="softmax_label", ctx=None,
+                 **kwargs):
+        super().__init__(batch_size)
+        if path_imgidx is None:
+            path_imgidx = path_imgrec[:-4] + ".idx" \
+                if path_imgrec.endswith(".rec") else path_imgrec + ".idx"
+        self._layout = layout
+        c, h, w = data_shape
+        self._data_shape = (batch_size, c, h, w) if layout == "NCHW" \
+            else (batch_size, h, w, c)
+        self._label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self.data_name = data_name
+        self.label_name = label_name
+        self._ctx = ctx
+        self._pad = 0
+        self._batch = None
+        from .. import native
+        if native.available():
+            self._impl = native.ImageRecordLoader(
+                path_imgrec, path_imgidx, batch_size, data_shape,
+                num_threads=preprocess_threads, shuffle=shuffle, seed=seed,
+                part_index=part_index, num_parts=num_parts,
+                rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
+                label_width=label_width,
+                mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
+                scale=scale, layout=layout, round_batch=round_batch)
+            self._py = None
+        else:
+            self._impl = None
+            self._py = _PyImageRecordImpl(
+                path_imgrec, path_imgidx, batch_size, data_shape,
+                shuffle=shuffle, seed=seed, part_index=part_index,
+                num_parts=num_parts, rand_crop=rand_crop,
+                rand_mirror=rand_mirror, resize=resize,
+                label_width=label_width,
+                mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
+                scale=scale, layout=layout, round_batch=round_batch)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, self._data_shape,
+                         layout=self._layout)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, self._label_shape)]
+
+    def reset(self):
+        (self._impl or self._py).reset()
+
+    def iter_next(self):
+        res = (self._impl or self._py).next()
+        if res is None:
+            return False
+        data_np, label_np, pad = res
+        self._batch = (nd.array(data_np, ctx=self._ctx),
+                       nd.array(label_np, ctx=self._ctx))
+        self._pad = pad
+        return True
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=[self._batch[0]], label=[self._batch[1]],
+                         pad=self._pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getdata(self):
+        return [self._batch[0]]
+
+    def getlabel(self):
+        return [self._batch[1]]
+
+    def getpad(self):
+        return self._pad
+
+
+class _PyImageRecordImpl:
+    """Pure-Python fallback for ImageRecordIter: same record format and
+    augmentation order as the native pipeline, one sample at a time."""
+
+    def __init__(self, path_imgrec, path_imgidx, batch_size, data_shape,
+                 shuffle=False, seed=0, part_index=0, num_parts=1,
+                 rand_crop=False, rand_mirror=False, resize=0, label_width=1,
+                 mean=(0, 0, 0), std=(1, 1, 1), scale=1.0, layout="NCHW",
+                 round_batch=True):
+        from .. import recordio
+        self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        n = len(self._rec.keys)
+        begin, end = n * part_index // num_parts, \
+            n * (part_index + 1) // num_parts
+        self._keys = self._rec.keys[begin:end]
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.label_width = label_width
+        self.mean = _np.asarray(mean, dtype=_np.float32)
+        self.std = _np.asarray(std, dtype=_np.float32)
+        self.scale = scale
+        self.layout = layout
+        self.round_batch = round_batch
+        self._rng = _np.random.RandomState(seed)
+        self._order = None
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._order = _np.arange(len(self._keys))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _load_one(self, key):
+        from .. import recordio
+        from ..image import image as img_mod
+        header, blob = recordio.unpack(self._rec.read_idx(key))
+        im = img_mod.imdecode(blob)  # HWC RGB uint8 numpy
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            im = img_mod.resize_short(im, self.resize)
+        ih, iw = im.shape[:2]
+        if ih < h or iw < w:
+            im = img_mod.imresize(im, max(iw, w), max(ih, h))
+            ih, iw = im.shape[:2]
+        if self.rand_crop:
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        im = im[y0:y0 + h, x0:x0 + w]
+        if self.rand_mirror and self._rng.randint(2):
+            im = im[:, ::-1]
+        out = (im.astype(_np.float32) * self.scale - self.mean) / self.std
+        if self.layout == "NCHW":
+            out = out.transpose(2, 0, 1)
+        label = header.label
+        if isinstance(label, (int, float)):
+            label = _np.full((self.label_width,), label, dtype=_np.float32)
+        else:
+            label = _np.asarray(label, dtype=_np.float32)[:self.label_width]
+        return out, label
+
+    def next(self):
+        n_total = len(self._order)
+        if self._cursor >= n_total:
+            return None
+        c, h, w = self.data_shape
+        shape = (self.batch_size, c, h, w) if self.layout == "NCHW" \
+            else (self.batch_size, h, w, c)
+        data = _np.zeros(shape, dtype=_np.float32)
+        label = _np.zeros((self.batch_size, self.label_width),
+                          dtype=_np.float32)
+        pad = 0
+        for i in range(self.batch_size):
+            idx = self._cursor + i
+            if idx >= n_total:
+                if not self.round_batch:
+                    return None
+                idx %= n_total
+                pad += 1
+            d, l = self._load_one(self._keys[self._order[idx]])
+            data[i] = d
+            label[i] = l
+        self._cursor += self.batch_size
+        if self.label_width == 1:
+            label = label[:, 0]
+        return data, label, pad
+
+
+_ITER_REG.register("ImageRecordIter")(ImageRecordIter)
 
 
 def register_iter(name):

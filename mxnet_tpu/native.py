@@ -1,0 +1,408 @@
+"""ctypes bridge to the native runtime (``native/lib/libmxnet_tpu.so``).
+
+Reference: ``python/mxnet/base.py`` (ctypes library load + ``check_call`` +
+``MXGetLastError`` pattern — SURVEY.md §2.2 "base/context") and the C ABI it
+wraps (``include/mxnet/c_api.h`` — §2.1 "C API").
+
+The native library provides the runtime *around* the XLA compute path:
+RecordIO parsing, the threaded JPEG/PNG decode + augment pipeline, the
+dependency engine, pooled host storage, and shm segments for DataLoader
+worker IPC.  Everything degrades gracefully: ``available()`` is False when
+the library is absent and callers fall back to pure-Python paths, so the
+package works on hosts without a toolchain.  The library is built on demand
+(``make -C native``) the first time it is requested.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["available", "lib", "check_call", "RecordIOReader",
+           "RecordIOWriter", "ImageRecordLoader", "imdecode",
+           "NativeEngine", "Shm", "storage_stats", "features"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "lib", "libmxnet_tpu.so")
+
+_lib = None
+_load_failed = False
+_lock = threading.Lock()
+
+_EngineFn = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                             ctypes.c_void_p, ctypes.c_int)
+_EngineDeleter = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _try_build(force=False):
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    cmd = ["make", "-C", _NATIVE_DIR, "-j4"] + (["-B"] if force else [])
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _try_build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # stale/foreign-arch binary: force-rebuild once and retry
+            if not _try_build(force=True):
+                _load_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                _load_failed = True
+                return None
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        lib.MXLibInfoFeatures.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def lib():
+    l = _load()
+    if l is None:
+        raise MXNetError("native library unavailable (build native/ first)")
+    return l
+
+
+def check_call(ret: int):
+    """Raise the thread-local native error on nonzero return (reference:
+    ``base.check_call``)."""
+    if ret != 0:
+        raise MXNetError(lib().MXGetLastError().decode("utf-8"))
+
+
+def features():
+    """Native feature list (reference: ``mx.runtime.Features()`` backing
+    ``src/libinfo.cc``)."""
+    if not available():
+        return []
+    return lib().MXLibInfoFeatures().decode("utf-8").split(",")
+
+
+# ---------------------------------------------------------------- RecordIO --
+class RecordIOReader:
+    """Native sequential RecordIO reader (drop-in for the hot path of
+    ``recordio.MXRecordIO`` reads)."""
+
+    def __init__(self, path):
+        self.handle = ctypes.c_void_p()
+        check_call(lib().MXRecordIOReaderCreate(
+            path.encode(), ctypes.byref(self.handle)))
+
+    def read(self):
+        out = ctypes.c_void_p()
+        size = ctypes.c_size_t()
+        check_call(lib().MXRecordIOReaderReadRecord(
+            self.handle, ctypes.byref(out), ctypes.byref(size)))
+        if not out:          # NULL pointer → EOF
+            return None
+        return ctypes.string_at(out, size.value)
+
+    def seek(self, offset):
+        check_call(lib().MXRecordIOReaderSeek(
+            self.handle, ctypes.c_uint64(offset)))
+
+    def tell(self):
+        out = ctypes.c_uint64()
+        check_call(lib().MXRecordIOReaderTell(self.handle, ctypes.byref(out)))
+        return out.value
+
+    def close(self):
+        if self.handle:
+            lib().MXRecordIOReaderFree(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordIOWriter:
+    def __init__(self, path):
+        self.handle = ctypes.c_void_p()
+        check_call(lib().MXRecordIOWriterCreate(
+            path.encode(), ctypes.byref(self.handle)))
+
+    def write(self, buf):
+        buf = bytes(buf)
+        check_call(lib().MXRecordIOWriterWriteRecord(
+            self.handle, buf, ctypes.c_size_t(len(buf))))
+
+    def tell(self):
+        out = ctypes.c_uint64()
+        check_call(lib().MXRecordIOWriterTell(self.handle, ctypes.byref(out)))
+        return out.value
+
+    def close(self):
+        if self.handle:
+            lib().MXRecordIOWriterFree(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------- image pipeline --
+class ImageRecordLoader:
+    """Threaded native decode+augment pipeline over a ``.rec``/``.idx`` pair
+    (reference: ``ImageRecordIOParser2`` — SURVEY.md §3.5)."""
+
+    def __init__(self, rec_path, idx_path, batch_size, data_shape,
+                 num_threads=4, shuffle=False, seed=0, part_index=0,
+                 num_parts=1, rand_crop=False, rand_mirror=False,
+                 resize=0, label_width=1, mean=None, std=None, scale=1.0,
+                 layout="NCHW", round_batch=True):
+        c, h, w = data_shape
+        self._shape = (batch_size, c, h, w) if layout == "NCHW" \
+            else (batch_size, h, w, c)
+        self._label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self.batch_size = batch_size
+        mean_arr = (ctypes.c_float * 3)(*(mean or (0.0, 0.0, 0.0)))
+        std_arr = (ctypes.c_float * 3)(*(std or (1.0, 1.0, 1.0)))
+        self.handle = ctypes.c_void_p()
+        check_call(lib().MXImageRecordLoaderCreate(
+            rec_path.encode(), idx_path.encode(), batch_size, h, w, c,
+            num_threads, int(shuffle), ctypes.c_uint64(seed), part_index,
+            num_parts, int(rand_crop), int(rand_mirror), int(resize),
+            label_width, mean_arr, std_arr, ctypes.c_float(scale),
+            int(layout == "NHWC"), int(round_batch),
+            ctypes.byref(self.handle)))
+
+    @property
+    def num_samples(self):
+        out = ctypes.c_int64()
+        check_call(lib().MXImageRecordLoaderNumSamples(
+            self.handle, ctypes.byref(out)))
+        return out.value
+
+    def next(self):
+        """Returns ``(data, label, pad)`` numpy views (valid until the next
+        call) or None at epoch end."""
+        data = ctypes.POINTER(ctypes.c_float)()
+        label = ctypes.POINTER(ctypes.c_float)()
+        pad = ctypes.c_int()
+        bs = ctypes.c_int()
+        check_call(lib().MXImageRecordLoaderNext(
+            self.handle, ctypes.byref(data), ctypes.byref(label),
+            ctypes.byref(pad), ctypes.byref(bs)))
+        if bs.value == 0:
+            return None
+        n = 1
+        for d in self._shape:
+            n *= d
+        data_np = _np.ctypeslib.as_array(data, shape=(n,)).reshape(self._shape)
+        ln = 1
+        for d in self._label_shape:
+            ln *= d
+        label_np = _np.ctypeslib.as_array(label, shape=(ln,)).reshape(
+            self._label_shape)
+        return data_np, label_np, pad.value
+
+    def reset(self):
+        check_call(lib().MXImageRecordLoaderReset(self.handle))
+
+    def close(self):
+        if self.handle:
+            lib().MXImageRecordLoaderFree(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def imdecode(buf):
+    """Native JPEG/PNG decode → HWC uint8 numpy array (reference:
+    ``mx.image.imdecode`` backed by OpenCV; here libjpeg/libpng).
+    Single decode pass via MXImageDecodeAlloc."""
+    buf = bytes(buf)
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    ptr = ctypes.POINTER(ctypes.c_uint8)()
+    check_call(lib().MXImageDecodeAlloc(
+        buf, len(buf), ctypes.byref(h), ctypes.byref(w), ctypes.byref(c),
+        ctypes.byref(ptr)))
+    try:
+        n = h.value * w.value * c.value
+        out = _np.ctypeslib.as_array(ptr, shape=(n,)).reshape(
+            (h.value, w.value, c.value)).copy()
+    finally:
+        lib().MXBufferFree(ptr)
+    return out
+
+
+# ------------------------------------------------------------------ engine --
+_engine_initialized = False
+
+
+class NativeEngine:
+    """Binding to the C++ threaded dependency engine (reference semantics:
+    ``Engine::PushAsync`` with const/mutate var sets, versioned vars,
+    deferred exceptions — SURVEY.md §2.1 "Engine").
+
+    The underlying engine is process-global (like ``Engine::Get()``).  With
+    default arguments, constructing a binding attaches to the existing
+    engine; passing an explicit ``engine_type``/``num_workers`` RESETS the
+    process engine (draining outstanding ops first) — the reference
+    equivalent of restarting with a different ``MXNET_ENGINE_TYPE``.
+    """
+
+    def __init__(self, engine_type=None, num_workers=0):
+        global _engine_initialized
+        if engine_type is not None or num_workers or not _engine_initialized:
+            check_call(lib().MXEngineInit(
+                1 if engine_type == "naive" else 0, num_workers))
+            _engine_initialized = True
+        self._callbacks = []  # keep ctypes thunks alive until completion
+        self._cb_lock = threading.Lock()
+
+    def new_var(self):
+        out = ctypes.c_void_p()
+        check_call(lib().MXEngineNewVar(ctypes.byref(out)))
+        return out
+
+    def delete_var(self, var):
+        check_call(lib().MXEngineDeleteVar(var))
+
+    def push(self, fn, const_vars=(), mutate_vars=(), priority=0, name="op"):
+        """Push a Python callable; exceptions raised by ``fn`` become
+        deferred engine errors surfacing at wait_* (async exception
+        semantics of the reference)."""
+        holder = {}
+
+        def _thunk(_param, err_buf, err_len):
+            try:
+                fn()
+                return 0
+            except Exception as e:  # deferred: stored on mutate vars
+                msg = ("%s: %s" % (type(e).__name__, e)).encode()[:err_len - 1]
+                ctypes.memmove(err_buf, msg + b"\x00", len(msg) + 1)
+                return -1
+            finally:
+                with self._cb_lock:
+                    self._callbacks.remove(holder["cb"])
+
+        cb = _EngineFn(_thunk)
+        holder["cb"] = cb
+        with self._cb_lock:
+            self._callbacks.append(cb)
+        n_c, n_m = len(const_vars), len(mutate_vars)
+        c_arr = (ctypes.c_void_p * max(n_c, 1))(*const_vars)
+        m_arr = (ctypes.c_void_p * max(n_m, 1))(*mutate_vars)
+        check_call(lib().MXEnginePushAsync(
+            cb, None, ctypes.cast(None, _EngineDeleter), c_arr, n_c,
+            m_arr, n_m, priority, name.encode()))
+
+    def wait_for_var(self, var):
+        check_call(lib().MXEngineWaitForVar(var))
+
+    def wait_for_all(self):
+        check_call(lib().MXEngineWaitForAll())
+
+    def var_version(self, var):
+        out = ctypes.c_uint64()
+        check_call(lib().MXEngineVarVersion(var, ctypes.byref(out)))
+        return out.value
+
+
+# ----------------------------------------------------------------- storage --
+def storage_alloc(size):
+    out = ctypes.c_void_p()
+    check_call(lib().MXStorageAlloc(ctypes.c_size_t(size), ctypes.byref(out)))
+    return out
+
+
+def storage_free(ptr):
+    check_call(lib().MXStorageFree(ptr))
+
+
+def storage_release_all():
+    check_call(lib().MXStorageReleaseAll())
+
+
+def storage_stats():
+    a = ctypes.c_uint64()
+    p = ctypes.c_uint64()
+    n = ctypes.c_uint64()
+    check_call(lib().MXStorageStats(ctypes.byref(a), ctypes.byref(p),
+                                    ctypes.byref(n)))
+    return {"allocated": a.value, "pooled": p.value, "num_allocs": n.value}
+
+
+# --------------------------------------------------------------------- shm --
+class Shm:
+    """Named shm segment (reference: ``cpu_shared_storage_manager.h`` —
+    DataLoader workers pass batches through these without pickling)."""
+
+    def __init__(self, name, size=0, create=False):
+        self.handle = ctypes.c_void_p()
+        if create:
+            check_call(lib().MXShmCreate(name.encode(),
+                                         ctypes.c_size_t(size),
+                                         ctypes.byref(self.handle)))
+        else:
+            check_call(lib().MXShmAttach(name.encode(),
+                                         ctypes.byref(self.handle)))
+        self.name = name
+
+    def asarray(self, shape, dtype=_np.float32):
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_size_t()
+        check_call(lib().MXShmData(self.handle, ctypes.byref(ptr),
+                                   ctypes.byref(size)))
+        n = int(_np.prod(shape))
+        buf = (ctypes.c_char * size.value).from_address(ptr.value)
+        # anchor the mapping: the view must keep this Shm alive, or the
+        # segment unmaps under a live array when the handle is collected
+        buf._shm_owner = self
+        arr = _np.frombuffer(buf, dtype=dtype, count=n).reshape(shape)
+        return arr
+
+    def unlink(self):
+        check_call(lib().MXShmUnlink(self.handle))
+
+    def close(self):
+        if self.handle:
+            lib().MXShmFree(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -158,13 +158,20 @@ class DistServer:
                     self._cv.notify_all()
                 _send(conn, ("ok",))
             elif cmd == "push":
-                _, key, value = msg
+                # (cmd, key, value, rank, round): sync aggregation is
+                # per-(key, round) keyed by worker rank, so a fast worker
+                # pushing round N+1 before a slow worker finishes round N
+                # cannot be double-counted into N (reference: ps-lite
+                # timestamps serve the same purpose)
+                _, key, value, rank, rnd = msg
                 value = np.asarray(value)
                 with self._cv:
                     if self.sync_mode:
-                        self._pending.setdefault(key, []).append(value)
-                        if len(self._pending[key]) == self.num_workers:
-                            agg = np.sum(self._pending.pop(key), axis=0)
+                        bucket = self._pending.setdefault((key, rnd), {})
+                        bucket[rank] = value
+                        if len(bucket) == self.num_workers:
+                            del self._pending[(key, rnd)]
+                            agg = np.sum(list(bucket.values()), axis=0)
                             self._apply_push(key, agg)
                             self._cv.notify_all()
                     else:
@@ -258,6 +265,7 @@ class DistKVStore:
                              % (host, port, last_err))
         self._lock = threading.Lock()
         self._pull_version: Dict[object, int] = {}
+        self._push_round: Dict[object, int] = {}
 
     # -- api --------------------------------------------------------------
 
@@ -290,7 +298,9 @@ class DistKVStore:
             reduced = vlist[0]
             for v in vlist[1:]:
                 reduced = reduced + v
-            self._rpc("push", k, _to_numpy(reduced))
+            rnd = self._push_round.get(k, 0)
+            self._push_round[k] = rnd + 1
+            self._rpc("push", k, _to_numpy(reduced), self._rank, rnd)
             if self._sync:
                 # one aggregate-update per round of pushes
                 self._pull_version[k] = \

@@ -1,0 +1,115 @@
+// Threaded dependency engine — versioned vars, read/write dependency
+// tracking, priority worker pool, async exception propagation.
+//
+// Reference: src/engine/threaded_engine.cc / threaded_engine_perdevice.cc /
+// naive_engine.cc (SURVEY.md §2.1 "Engine", §3.1, and the note_engine.md
+// design doc).  Semantics preserved from the reference:
+//   * every var is versioned; writers are serialized per var, readers run
+//     concurrently between writes (multi-reader single-writer per var);
+//   * ops are pushed with (const_vars, mutate_vars) and dispatch when all
+//     dependencies are satisfied; completion bumps mutate-var versions and
+//     unblocks dependents;
+//   * exceptions raised by an op are stored on its mutate vars, propagate
+//     through dependent ops without running them, and rethrow at
+//     WaitForVar/WaitForAll sync points (test_exc_handling.py semantics);
+//   * NaiveEngine mode executes synchronously in the caller thread.
+//
+// TPU-native role: JAX/PjRt already orders device computation, so this
+// engine schedules the *host-side* runtime around it — data-pipeline
+// stages, checkpoint IO, KVStore server work — anything the reference ran
+// on its engine that is not an XLA computation.
+#ifndef MXNET_TPU_ENGINE_H_
+#define MXNET_TPU_ENGINE_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxnet_tpu {
+
+class Engine;
+
+struct EngineVar {
+  std::mutex mu;
+  uint64_t version = 0;
+  int active_reads = 0;
+  bool active_write = false;
+  struct Waiter { struct Opr* op; bool is_write; };
+  std::deque<Waiter> queue;
+  std::shared_ptr<std::string> exception;  // deferred error, set by a failed op
+};
+
+struct Opr {
+  std::function<int(std::string*)> fn;  // returns nonzero on error
+  std::vector<EngineVar*> const_vars, mutate_vars;
+  int priority = 0;
+  uint64_t seq = 0;  // FIFO tiebreak
+  std::string name;
+  bool always_run = false;  // run fn even when an input carries an exception
+                            // (sync/wait ops must always signal)
+  EngineVar* delete_target = nullptr;  // var freed after this op completes
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  // num_workers <= 0 → hardware_concurrency; naive=true → synchronous.
+  explicit Engine(int num_workers = 0, bool naive = false);
+  ~Engine();
+
+  EngineVar* NewVar();
+  // Deletes when all pending ops on the var complete (reference:
+  // Engine::DeleteVariable pushes a deletion op).
+  void DeleteVar(EngineVar* var);
+
+  void PushAsync(std::function<int(std::string*)> fn,
+                 std::vector<EngineVar*> const_vars,
+                 std::vector<EngineVar*> mutate_vars,
+                 int priority = 0, const char* name = "",
+                 bool always_run = false);
+
+  // Returns empty string on success, else the deferred error (cleared).
+  std::string WaitForVar(EngineVar* var);
+  std::string WaitForAll();
+
+  bool naive() const { return naive_; }
+
+ private:
+  void Schedule(Opr* op);
+  void Dispatch(Opr* op);
+  void Execute(Opr* op);
+  void OnComplete(Opr* op, const std::string& err);
+  void ProcessQueue(EngineVar* var);  // var->mu must be held
+  void DecWait(Opr* op);
+  void WorkerLoop();
+
+  struct Cmp {
+    bool operator()(Opr* a, Opr* b) const {
+      if (a->priority != b->priority) return a->priority < b->priority;
+      return a->seq > b->seq;  // lower seq first
+    }
+  };
+
+  bool naive_;
+  std::atomic<bool> stop_{false};
+  std::atomic<uint64_t> seq_{0};
+  std::atomic<int64_t> outstanding_{0};
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_, all_done_cv_;
+  std::priority_queue<Opr*, std::vector<Opr*>, Cmp> ready_;
+  std::vector<std::thread> workers_;
+  std::mutex err_mu_;
+  std::string global_err_;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_ENGINE_H_

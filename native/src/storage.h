@@ -1,0 +1,65 @@
+// Pooled host storage manager.
+//
+// Reference: src/storage/storage.cc, pooled_storage_manager.h (SURVEY.md
+// §2.1 "Storage"): pooled device allocators with rounding
+// (MXNET_GPU_MEM_POOL_*), pinned host memory, POSIX-shm for DataLoader IPC.
+//
+// TPU-native role: device HBM is owned by PjRt/XLA, so this manages the
+// HOST side — staging buffers for the data pipeline (64-byte-aligned for
+// fast device_put DMA) and shm segments the Gluon DataLoader workers use
+// to pass batches without pickling (cpu_shared_storage_manager.h analog).
+// Pool policy mirrors the reference's pow2 rounding strategy.
+#ifndef MXNET_TPU_STORAGE_H_
+#define MXNET_TPU_STORAGE_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu {
+
+class PooledStorage {
+ public:
+  static PooledStorage* Get();
+
+  void* Alloc(size_t size);
+  void Free(void* ptr);
+  // Release all cached free blocks back to the OS.
+  void ReleaseAll();
+  void Stats(uint64_t* allocated, uint64_t* pooled, uint64_t* num_allocs);
+
+ private:
+  PooledStorage() = default;
+  static size_t RoundSize(size_t size);
+
+  std::mutex mu_;
+  std::map<void*, size_t> live_;                    // ptr → rounded size
+  std::map<size_t, std::vector<void*>> free_pool_;  // rounded size → blocks
+  uint64_t bytes_live_ = 0, bytes_pooled_ = 0, num_allocs_ = 0;
+};
+
+// POSIX shm segment (named) for DataLoader worker IPC.
+class ShmSegment {
+ public:
+  // create=true: O_CREAT|O_EXCL with the given size; else attach existing.
+  ShmSegment(const std::string& name, size_t size, bool create);
+  ~ShmSegment();
+  void* data() const { return data_; }
+  size_t size() const { return size_; }
+  const std::string& name() const { return name_; }
+  // Unlink the name (segment lives until all mappings close).
+  void Unlink();
+
+ private:
+  std::string name_;
+  size_t size_ = 0;
+  void* data_ = nullptr;
+  int fd_ = -1;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_STORAGE_H_

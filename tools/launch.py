@@ -73,21 +73,38 @@ def launch_ssh(args, command):
         hosts = [h.strip() for h in f if h.strip()]
     port = args.port or 9091
     root = hosts[0]
-    procs = []
-    for i, host in enumerate(hosts[:args.num_workers]):
-        env_fwd = " ".join([
-            "DMLC_PS_ROOT_URI=%s" % root,
-            "DMLC_PS_ROOT_PORT=%d" % port,
-            "DMLC_NUM_WORKER=%d" % args.num_workers,
-            "DMLC_NUM_SERVER=%d" % args.num_servers,
-            "DMLC_ROLE=worker", "DMLC_WORKER_ID=%d" % i,
-        ])
-        procs.append(subprocess.Popen(
+    base = [
+        "DMLC_PS_ROOT_URI=%s" % root,
+        "DMLC_PS_ROOT_PORT=%d" % port,
+        "DMLC_NUM_WORKER=%d" % args.num_workers,
+        "DMLC_NUM_SERVER=%d" % args.num_servers,
+    ]
+    server_cmd = (sys.executable + " -c \"from mxnet_tpu.parallel.dist "
+                  "import run_server; run_server()\"")
+    server_procs = []
+    # servers ride the first hosts round-robin (reference: tracker assigns
+    # server roles across the same host pool)
+    for i in range(args.num_servers):
+        host = hosts[i % len(hosts)]
+        env_fwd = " ".join(base + ["DMLC_ROLE=server",
+                                   "DMLC_SERVER_ID=%d" % i])
+        server_procs.append(subprocess.Popen(
+            ["ssh", host, env_fwd + " " + server_cmd]))
+    worker_procs = []
+    for i in range(args.num_workers):
+        host = hosts[i % len(hosts)]
+        env_fwd = " ".join(base + ["DMLC_ROLE=worker",
+                                   "DMLC_WORKER_ID=%d" % i])
+        worker_procs.append(subprocess.Popen(
             ["ssh", host, env_fwd + " " + " ".join(command)]))
     code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+    try:
+        for p in worker_procs:
+            p.wait()
+            code = code or p.returncode
+    finally:
+        for p in server_procs:
+            p.send_signal(signal.SIGTERM)
     return code
 
 
