@@ -45,6 +45,9 @@ from . import model            # noqa: E402
 from . import module           # noqa: E402
 from . import module as mod    # noqa: E402
 from . import contrib          # noqa: E402
+from . import util             # noqa: E402
+from . import numpy as np      # noqa: E402
+from . import numpy_extension as npx  # noqa: E402
 from . import profiler         # noqa: E402
 from . import monitor          # noqa: E402
 from .monitor import Monitor   # noqa: E402

@@ -1,0 +1,396 @@
+"""NumPy-semantics operators (the ``_np_*`` registry namespace).
+
+Reference: ``src/operator/numpy/`` (SURVEY.md §2.1 "Operator library" row,
+"numpy/ (mx.np ops)") — the reference implements a parallel op namespace
+with NumPy semantics (``_npi_*`` kernels) because classic MXNet ops diverge
+from NumPy (reshape shape-codes, axis defaults, comparison dtypes).  Same
+split here: classic ops keep MXNet semantics, these keep NumPy's.  Every
+impl is a pure JAX function (jnp follows NumPy), so most are one-liners and
+autograd/AMP/jit come from the shared registry infrastructure.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..ops.registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ------------------------------------------------------------ manipulation --
+
+@register("_np_reshape")
+def _np_reshape(a, newshape=None, order="C", **kw):
+    return _j().reshape(a, newshape, order=order)
+
+
+@register("_np_transpose")
+def _np_transpose(a, axes=None, **kw):
+    return _j().transpose(a, axes)
+
+
+@register("_np_concatenate", variadic=True)
+def _np_concatenate(seq, axis=0, **kw):
+    return _j().concatenate(seq, axis=axis)
+
+
+@register("_np_stack", variadic=True)
+def _np_stack(seq, axis=0, **kw):
+    return _j().stack(seq, axis=axis)
+
+
+@register("_np_split", num_outputs=-1)
+def _np_split(a, indices_or_sections=None, axis=0, **kw):
+    out = _j().split(a, indices_or_sections, axis=axis)
+    return tuple(out)
+
+
+@register("_np_pad")
+def _np_pad(a, pad_width=None, mode="constant", constant_values=0, **kw):
+    if mode == "constant":
+        return _j().pad(a, pad_width, mode=mode,
+                        constant_values=constant_values)
+    return _j().pad(a, pad_width, mode=mode)
+
+
+@register("_np_moveaxis")
+def _np_moveaxis(a, source=None, destination=None, **kw):
+    return _j().moveaxis(a, source, destination)
+
+
+@register("_np_rollaxis")
+def _np_rollaxis(a, axis=0, start=0, **kw):
+    return _j().rollaxis(a, axis, start)
+
+
+@register("_np_roll")
+def _np_roll(a, shift=None, axis=None, **kw):
+    return _j().roll(a, shift, axis=axis)
+
+
+@register("_np_rot90")
+def _np_rot90(a, k=1, axes=(0, 1), **kw):
+    return _j().rot90(a, k=k, axes=tuple(axes))
+
+
+@register("_np_flip")
+def _np_flip(a, axis=None, **kw):
+    return _j().flip(a, axis=axis)
+
+
+@register("_np_trace")
+def _np_trace(a, offset=0, axis1=0, axis2=1, **kw):
+    return _j().trace(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("_np_tril")
+def _np_tril(a, k=0, **kw):
+    return _j().tril(a, k=k)
+
+
+@register("_np_triu")
+def _np_triu(a, k=0, **kw):
+    return _j().triu(a, k=k)
+
+
+@register("_np_diag")
+def _np_diag(a, k=0, **kw):
+    return _j().diag(a, k=k)
+
+
+@register("_np_diagonal")
+def _np_diagonal(a, offset=0, axis1=0, axis2=1, **kw):
+    return _j().diagonal(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ------------------------------------------------------------------ linalg --
+
+@register("_np_matmul")
+def _np_matmul(a, b, **kw):
+    return _j().matmul(a, b)
+
+
+@register("_np_tensordot")
+def _np_tensordot(a, b, axes=2, **kw):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                     for x in axes)
+    return _j().tensordot(a, b, axes=axes)
+
+
+@register("_np_einsum", variadic=True)
+def _np_einsum(operands, subscripts=None, **kw):
+    return _j().einsum(subscripts, *operands)
+
+
+@register("_np_outer")
+def _np_outer(a, b, **kw):
+    return _j().outer(a, b)
+
+
+@register("_np_inner")
+def _np_inner(a, b, **kw):
+    return _j().inner(a, b)
+
+
+@register("_np_kron")
+def _np_kron(a, b, **kw):
+    return _j().kron(a, b)
+
+
+@register("_np_vdot")
+def _np_vdot(a, b, **kw):
+    return _j().vdot(a, b)
+
+
+@register("_np_cross")
+def _np_cross(a, b, axis=-1, **kw):
+    return _j().cross(a, b, axis=axis)
+
+
+def _linalg(name, fn, num_outputs=1, no_grad=False):
+    @register("_np_linalg_" + name, num_outputs=num_outputs, no_grad=no_grad)
+    def impl(*arrays, **kw):
+        return fn(_j(), *arrays, **{k: v for k, v in kw.items()
+                                    if k != "_training"})
+    impl.__name__ = "_np_linalg_" + name
+    return impl
+
+
+_linalg("norm", lambda jnp, a, ord=None, axis=None, keepdims=False:
+        jnp.linalg.norm(a, ord=ord, axis=axis, keepdims=keepdims))
+_linalg("inv", lambda jnp, a: jnp.linalg.inv(a))
+_linalg("det", lambda jnp, a: jnp.linalg.det(a))
+_linalg("slogdet", lambda jnp, a: tuple(jnp.linalg.slogdet(a)),
+        num_outputs=2)
+_linalg("cholesky", lambda jnp, a: jnp.linalg.cholesky(a))
+_linalg("qr", lambda jnp, a: tuple(jnp.linalg.qr(a)), num_outputs=2)
+_linalg("svd", lambda jnp, a: tuple(jnp.linalg.svd(a, full_matrices=False)),
+        num_outputs=3)
+_linalg("eigh", lambda jnp, a: tuple(jnp.linalg.eigh(a)), num_outputs=2)
+_linalg("eigvalsh", lambda jnp, a: jnp.linalg.eigvalsh(a))
+_linalg("solve", lambda jnp, a, b: jnp.linalg.solve(a, b))
+_linalg("lstsq", lambda jnp, a, b: jnp.linalg.lstsq(a, b)[0])
+_linalg("pinv", lambda jnp, a: jnp.linalg.pinv(a))
+_linalg("matrix_rank", lambda jnp, a: jnp.linalg.matrix_rank(a),
+        no_grad=True)
+_linalg("matrix_power", lambda jnp, a, n=1: jnp.linalg.matrix_power(a, n))
+
+
+# -------------------------------------------------------------- reductions --
+
+def _np_reduce(name, fn, no_grad=False):
+    @register("_np_" + name, no_grad=no_grad)
+    def impl(a, axis=None, keepdims=False, **kw):
+        if isinstance(axis, list):
+            axis = tuple(axis)
+        return fn(_j(), a, axis, keepdims, kw)
+    impl.__name__ = "_np_" + name
+    return impl
+
+
+_np_reduce("sum", lambda jnp, a, ax, kd, kw:
+           jnp.sum(a, axis=ax, keepdims=kd, dtype=kw.get("dtype")))
+_np_reduce("mean", lambda jnp, a, ax, kd, kw:
+           jnp.mean(a, axis=ax, keepdims=kd, dtype=kw.get("dtype")))
+_np_reduce("prod", lambda jnp, a, ax, kd, kw:
+           jnp.prod(a, axis=ax, keepdims=kd, dtype=kw.get("dtype")))
+_np_reduce("max", lambda jnp, a, ax, kd, kw: jnp.max(a, axis=ax, keepdims=kd))
+_np_reduce("min", lambda jnp, a, ax, kd, kw: jnp.min(a, axis=ax, keepdims=kd))
+_np_reduce("std", lambda jnp, a, ax, kd, kw:
+           jnp.std(a, axis=ax, keepdims=kd, ddof=kw.get("ddof", 0)))
+_np_reduce("var", lambda jnp, a, ax, kd, kw:
+           jnp.var(a, axis=ax, keepdims=kd, ddof=kw.get("ddof", 0)))
+_np_reduce("median", lambda jnp, a, ax, kd, kw:
+           jnp.median(a, axis=ax, keepdims=kd))
+_np_reduce("all", lambda jnp, a, ax, kd, kw:
+           jnp.all(a, axis=ax, keepdims=kd), no_grad=True)
+_np_reduce("any", lambda jnp, a, ax, kd, kw:
+           jnp.any(a, axis=ax, keepdims=kd), no_grad=True)
+_np_reduce("nanmean", lambda jnp, a, ax, kd, kw:
+           jnp.nanmean(a, axis=ax, keepdims=kd))
+
+
+@register("_np_average")
+def _np_average(a, weights=None, axis=None, **kw):
+    jnp = _j()
+    if weights is None:
+        return jnp.mean(a, axis=axis)
+    return jnp.average(a, axis=axis, weights=weights)
+
+
+@register("_np_cumsum")
+def _np_cumsum(a, axis=None, dtype=None, **kw):
+    return _j().cumsum(a, axis=axis, dtype=dtype)
+
+
+@register("_np_cumprod")
+def _np_cumprod(a, axis=None, dtype=None, **kw):
+    return _j().cumprod(a, axis=axis, dtype=dtype)
+
+
+@register("_np_ptp", no_grad=True)
+def _np_ptp(a, axis=None, keepdims=False, **kw):
+    return _j().ptp(a, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------- search / logic --
+
+@register("_np_unique", no_grad=True, num_outputs=1)
+def _np_unique(a, **kw):
+    # jnp.unique needs static size: fall back to host computation (the
+    # reference's np.unique is likewise not a kernel op)
+    return _j().asarray(_np.unique(_np.asarray(a)))
+
+
+@register("_np_nonzero", no_grad=True, num_outputs=-1)
+def _np_nonzero(a, **kw):
+    return tuple(_j().asarray(ix) for ix in _np.nonzero(_np.asarray(a)))
+
+
+@register("_np_bincount", no_grad=True)
+def _np_bincount(a, minlength=0, **kw):
+    return _j().asarray(_np.bincount(_np.asarray(a), minlength=minlength))
+
+
+@register("_np_searchsorted", no_grad=True)
+def _np_searchsorted(a, v, side="left", **kw):
+    return _j().searchsorted(a, v, side=side)
+
+
+@register("_np_where")
+def _np_where(cond, x, y, **kw):
+    return _j().where(cond, x, y)
+
+
+@register("_np_meshgrid", variadic=True, num_outputs=-1)
+def _np_meshgrid(seq, indexing="xy", **kw):
+    return tuple(_j().meshgrid(*seq, indexing=indexing))
+
+
+@register("_np_isclose", no_grad=True)
+def _np_isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False, **kw):
+    return _j().isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("_np_allclose", no_grad=True)
+def _np_allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False, **kw):
+    return _j().allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("_np_array_equal", no_grad=True)
+def _np_array_equal(a, b, **kw):
+    return _j().array_equal(a, b)
+
+
+# --------------------------------------------------------- missing elemwise --
+
+def _np_binary(name, fn, no_grad=False):
+    @register("_np_" + name, no_grad=no_grad)
+    def impl(a, b, **kw):
+        return fn(_j(), a, b)
+    impl.__name__ = "_np_" + name
+    return impl
+
+
+_np_binary("floor_divide", lambda jnp, a, b: jnp.floor_divide(a, b))
+_np_binary("fmod", lambda jnp, a, b: jnp.fmod(a, b))
+_np_binary("arctan2", lambda jnp, a, b: jnp.arctan2(a, b))
+_np_binary("hypot", lambda jnp, a, b: jnp.hypot(a, b))
+_np_binary("copysign", lambda jnp, a, b: jnp.copysign(a, b))
+_np_binary("logaddexp", lambda jnp, a, b: jnp.logaddexp(a, b))
+_np_binary("heaviside", lambda jnp, a, b: jnp.heaviside(a, b))
+_np_binary("bitwise_and", lambda jnp, a, b: jnp.bitwise_and(a, b),
+           no_grad=True)
+_np_binary("bitwise_or", lambda jnp, a, b: jnp.bitwise_or(a, b),
+           no_grad=True)
+_np_binary("bitwise_xor", lambda jnp, a, b: jnp.bitwise_xor(a, b),
+           no_grad=True)
+_np_binary("left_shift", lambda jnp, a, b: jnp.left_shift(a, b),
+           no_grad=True)
+_np_binary("right_shift", lambda jnp, a, b: jnp.right_shift(a, b),
+           no_grad=True)
+
+
+@register("_np_interp", no_grad=True)
+def _np_interp(x, xp, fp, **kw):
+    return _j().interp(x, xp, fp)
+
+
+@register("_np_clip")
+def _np_clip(a, a_min=None, a_max=None, **kw):
+    return _j().clip(a, a_min, a_max)
+
+
+@register("_np_round")
+def _np_round(a, decimals=0, **kw):
+    return _j().round(a, decimals=decimals)
+
+
+@register("_np_nan_to_num")
+def _np_nan_to_num(a, nan=0.0, posinf=None, neginf=None, **kw):
+    return _j().nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("_np_take")
+def _np_take(a, indices, axis=None, mode="clip", **kw):
+    return _j().take(a, indices, axis=axis, mode=mode)
+
+
+@register("_np_take_along_axis")
+def _np_take_along_axis(a, indices, axis=None, **kw):
+    return _j().take_along_axis(a, indices, axis=axis)
+
+
+@register("_np_repeat")
+def _np_repeat(a, repeats=1, axis=None, **kw):
+    return _j().repeat(a, repeats, axis=axis)
+
+
+@register("_np_tile")
+def _np_tile(a, reps=None, **kw):
+    return _j().tile(a, reps)
+
+
+@register("_np_broadcast_to")
+def _np_broadcast_to(a, shape=None, **kw):
+    return _j().broadcast_to(a, tuple(shape))
+
+
+@register("_np_expand_dims")
+def _np_expand_dims(a, axis=0, **kw):
+    return _j().expand_dims(a, axis)
+
+
+@register("_np_squeeze")
+def _np_squeeze(a, axis=None, **kw):
+    return _j().squeeze(a, axis=axis)
+
+
+@register("_np_swapaxes")
+def _np_swapaxes(a, axis1=0, axis2=1, **kw):
+    return _j().swapaxes(a, axis1, axis2)
+
+
+@register("_np_flatten")
+def _np_ravel(a, **kw):
+    return _j().ravel(a)
+
+
+@register("_np_sort")
+def _np_sort(a, axis=-1, **kw):
+    return _j().sort(a, axis=axis)
+
+
+@register("_np_argsort", no_grad=True)
+def _np_argsort(a, axis=-1, **kw):
+    return _j().argsort(a, axis=axis)
+
+
+@register("_np_gradient", num_outputs=-1)
+def _np_gradient(a, axis=None, **kw):
+    out = _j().gradient(a, axis=axis)
+    return tuple(out) if isinstance(out, (list, tuple)) else out

@@ -219,12 +219,17 @@ class DistServer:
 
 def run_server():
     """Entry point for the server role (reference: the process started by
-    the tracker with DMLC_ROLE=server; ``kvstore_server.py``)."""
+    the tracker with DMLC_ROLE=server; ``kvstore_server.py``).
+
+    Server ``i`` listens on ``DMLC_PS_ROOT_PORT + i`` (all servers co-locate
+    with the root URI host; keys are sharded over them by stable hash —
+    reference: ps-lite key-range sharding over server nodes)."""
     host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
     nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
-    server = DistServer(host=host, port=port, num_workers=nworkers,
+    server = DistServer(host=host, port=port + sid, num_workers=nworkers,
                         sync_mode=sync)
     server.serve_forever()
 
@@ -248,21 +253,29 @@ class DistKVStore:
         self._rank = int(os.environ.get("DMLC_WORKER_ID",
                                         os.environ.get("DMLC_RANK", "0")))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        self._sock = None
+        self._num_servers = max(1, int(os.environ.get("DMLC_NUM_SERVER",
+                                                      "1")))
+        # one connection per server; keys shard over servers by stable hash
+        # (reference: ps-lite key-range partitioning over server nodes)
+        self._socks = []
         deadline = time.time() + float(
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "30"))
-        last_err = None
-        while time.time() < deadline:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=60)
-                break
-            except OSError as e:
-                last_err = e
-                time.sleep(0.05)
-        if self._sock is None:
-            raise MXNetError("cannot reach kvstore server at %s:%d (%s)"
-                             % (host, port, last_err))
+        for sid in range(self._num_servers):
+            sock = None
+            last_err = None
+            while time.time() < deadline:
+                try:
+                    sock = socket.create_connection((host, port + sid),
+                                                    timeout=60)
+                    break
+                except OSError as e:
+                    last_err = e
+                    time.sleep(0.05)
+            if sock is None:
+                raise MXNetError(
+                    "cannot reach kvstore server %d at %s:%d (%s)"
+                    % (sid, host, port + sid, last_err))
+            self._socks.append(sock)
         self._lock = threading.Lock()
         self._pull_version: Dict[object, int] = {}
         self._push_round: Dict[object, int] = {}
@@ -277,16 +290,32 @@ class DistKVStore:
     def num_workers(self) -> int:
         return self._num_workers
 
-    def _rpc(self, *msg):
+    def _server_of(self, key) -> int:
+        import zlib
+        return zlib.crc32(str(key).encode()) % self._num_servers
+
+    def _rpc(self, *msg, key=None):
+        """Send to the server owning ``key`` (or server 0 if keyless)."""
+        sock = self._socks[self._server_of(key) if key is not None else 0]
         with self._lock:
-            _send(self._sock, msg)
-            return _recv(self._sock)
+            _send(sock, msg)
+            return _recv(sock)
+
+    def _rpc_all(self, *msg):
+        """Send to every server; returns the replies (barrier/optimizer)."""
+        out = []
+        with self._lock:
+            for sock in self._socks:
+                _send(sock, msg)
+            for sock in self._socks:
+                out.append(_recv(sock))
+        return out
 
     def init(self, key, value):
         keys, values = _kv_lists(key, value)
         for k, v in zip(keys, values):
             if self._rank == 0:
-                self._rpc("init", k, _to_numpy(v))
+                self._rpc("init", k, _to_numpy(v), key=k)
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -300,7 +329,8 @@ class DistKVStore:
                 reduced = reduced + v
             rnd = self._push_round.get(k, 0)
             self._push_round[k] = rnd + 1
-            self._rpc("push", k, _to_numpy(reduced), self._rank, rnd)
+            self._rpc("push", k, _to_numpy(reduced), self._rank, rnd,
+                      key=k)
             if self._sync:
                 # one aggregate-update per round of pushes
                 self._pull_version[k] = \
@@ -314,7 +344,7 @@ class DistKVStore:
             if not isinstance(olist, (list, tuple)):
                 olist = [olist]
             tag, val = self._rpc("pull", k,
-                                 self._pull_version.get(k, 1))
+                                 self._pull_version.get(k, 1), key=k)
             if tag != "val":
                 raise MXNetError("pull failed for key %r" % (k,))
             for o in olist:
@@ -340,7 +370,7 @@ class DistKVStore:
         if self._rank == 0:
             blob = pickle.dumps(optimizer,
                                 protocol=pickle.HIGHEST_PROTOCOL)
-            self._rpc("optimizer", blob)
+            self._rpc_all("optimizer", blob)
         self.barrier()
 
     def set_gradient_compression(self, compression_params):
@@ -349,7 +379,7 @@ class DistKVStore:
                       "parity path (bf16 comms cover the TPU use case)")
 
     def barrier(self):
-        self._rpc("barrier")
+        self._rpc_all("barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         raise MXNetError("Cannot save states on a distributed worker "

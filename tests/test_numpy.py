@@ -1,0 +1,274 @@
+"""mx.np / npx tests (reference: tests/python/unittest/test_numpy_op.py,
+test_numpy_ndarray.py — SURVEY.md §4.1: NumPy-reference oracles)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+
+def _assert_close(a, b, rtol=1e-5, atol=1e-6):
+    onp.testing.assert_allclose(a.asnumpy() if hasattr(a, "asnumpy") else a,
+                                b, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_array_dtypes(self):
+        a = np.array([1, 2, 3])
+        assert a.dtype == onp.int32  # int64→int32 TPU policy
+        b = np.array([1.0, 2.0])
+        assert b.dtype == onp.float32
+        c = np.array([1, 2], dtype="float64")
+        assert c.dtype == onp.float64 or c.dtype == onp.float32  # x64 flag
+
+    def test_creation_fns(self):
+        assert np.zeros((2, 3)).shape == (2, 3)
+        assert np.ones(4).sum().item() == 4.0
+        _assert_close(np.full((2,), 7.0), onp.full((2,), 7.0))
+        _assert_close(np.arange(5), onp.arange(5))
+        _assert_close(np.linspace(0, 1, 5), onp.linspace(0, 1, 5))
+        _assert_close(np.eye(3), onp.eye(3))
+        x = np.array([[1.0, 2.0]])
+        _assert_close(np.zeros_like(x), onp.zeros((1, 2)))
+        g = np.meshgrid(np.arange(2), np.arange(3))
+        assert g[0].shape == (3, 2)
+
+    def test_interop_with_nd(self):
+        """np.ndarray IS an NDArray: classic ops and Gluon accept it."""
+        a = np.ones((2, 2))
+        assert isinstance(a, mx.nd.NDArray)
+        out = mx.nd.sum(a)
+        assert float(out.asnumpy()) == 4.0
+        back = a.as_nd_ndarray()
+        assert isinstance(back, mx.nd.NDArray)
+
+
+class TestElementwise:
+    def test_unary_oracle(self):
+        x = onp.random.RandomState(0).rand(3, 4).astype("float32") + 0.1
+        a = np.array(x)
+        for name in ["exp", "log", "sqrt", "sin", "cos", "tanh", "abs",
+                     "floor", "ceil", "square", "sign"]:
+            _assert_close(getattr(np, name)(a), getattr(onp, name)(x),
+                          rtol=1e-5)
+
+    def test_binary_oracle(self):
+        r = onp.random.RandomState(1)
+        x = r.rand(3, 4).astype("float32") + 0.5
+        y = r.rand(4).astype("float32") + 0.5  # broadcasts
+        a, b = np.array(x), np.array(y)
+        for name in ["add", "subtract", "multiply", "divide", "power",
+                     "maximum", "minimum", "arctan2", "hypot"]:
+            _assert_close(getattr(np, name)(a, b), getattr(onp, name)(x, y),
+                          rtol=1e-5)
+
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0])
+        b = np.array([2.0, 2.0, 2.0])
+        assert np.equal(a, b).asnumpy().tolist() == [False, True, False]
+        assert np.greater(a, b).asnumpy().tolist() == [False, False, True]
+        assert (a > 2).asnumpy().tolist() == [False, False, True]
+
+    def test_scalar_mixing(self):
+        a = np.array([1.0, 2.0])
+        _assert_close(a + 1, [2.0, 3.0])
+        _assert_close(2 * a, [2.0, 4.0])
+        _assert_close(1 / a, [1.0, 0.5])
+
+
+class TestReductions:
+    def test_axis_tuples(self):
+        x = onp.random.RandomState(2).rand(2, 3, 4).astype("float32")
+        a = np.array(x)
+        _assert_close(np.sum(a, axis=(0, 2)), x.sum(axis=(0, 2)), rtol=1e-5)
+        _assert_close(np.mean(a, axis=(1,)), x.mean(axis=1), rtol=1e-5)
+        _assert_close(np.max(a, axis=0, keepdims=True),
+                      x.max(axis=0, keepdims=True))
+        _assert_close(np.std(a), x.std(), rtol=1e-4)
+        _assert_close(np.var(a, ddof=1), x.var(ddof=1), rtol=1e-4)
+        _assert_close(np.prod(a, axis=2), x.prod(axis=2), rtol=1e-4)
+
+    def test_arg_and_cum(self):
+        x = onp.array([[3.0, 1.0], [2.0, 4.0]], dtype="float32")
+        a = np.array(x)
+        assert np.argmax(a).item() == 3
+        _assert_close(np.argmin(a, axis=0), x.argmin(axis=0))
+        _assert_close(np.cumsum(a, axis=1), x.cumsum(axis=1))
+
+    def test_methods(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum().item() == 10.0
+        assert a.mean(axis=0).shape == (2,)
+        assert a.max().item() == 4.0
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(6)
+        b = a.reshape(2, 3)
+        assert b.shape == (2, 3)
+        assert b.reshape((3, 2)).shape == (3, 2)
+        assert b.reshape(-1).shape == (6,)
+        assert b.T.shape == (3, 2)
+        c = np.arange(24).reshape(2, 3, 4)
+        assert np.transpose(c, (2, 0, 1)).shape == (4, 2, 3)
+        assert c.transpose(2, 0, 1).shape == (4, 2, 3)
+
+    def test_concat_stack_split(self):
+        a = np.ones((2, 3))
+        assert np.concatenate([a, a], axis=1).shape == (2, 6)
+        assert np.stack([a, a]).shape == (2, 2, 3)
+        assert np.vstack([a, a]).shape == (4, 3)
+        assert np.hstack([a, a]).shape == (2, 6)
+        parts = np.split(np.arange(9), 3)
+        assert len(parts) == 3 and parts[0].shape == (3,)
+
+    def test_misc_manip(self):
+        x = onp.arange(12, dtype="float32").reshape(3, 4)
+        a = np.array(x)
+        _assert_close(np.flip(a, 0), onp.flip(x, 0))
+        _assert_close(np.roll(a, 1, axis=1), onp.roll(x, 1, axis=1))
+        _assert_close(np.tile(a, (2, 1)), onp.tile(x, (2, 1)))
+        _assert_close(np.repeat(a, 2, axis=0), onp.repeat(x, 2, axis=0))
+        _assert_close(np.expand_dims(a, 0), x[None])
+        _assert_close(np.broadcast_to(np.array([1.0, 2.0]), (3, 2)),
+                      onp.broadcast_to([1.0, 2.0], (3, 2)))
+        _assert_close(np.pad(a, ((1, 1), (0, 0))),
+                      onp.pad(x, ((1, 1), (0, 0))))
+        _assert_close(np.tril(a), onp.tril(x))
+        _assert_close(np.where(a > 5, a, -1.0), onp.where(x > 5, x, -1.0))
+        _assert_close(np.clip(a, 2, 8), onp.clip(x, 2, 8))
+
+
+class TestLinalg:
+    def test_products(self):
+        r = onp.random.RandomState(3)
+        x = r.rand(3, 4).astype("float32")
+        y = r.rand(4, 5).astype("float32")
+        _assert_close(np.dot(np.array(x), np.array(y)), x.dot(y), rtol=1e-4)
+        _assert_close(np.matmul(np.array(x), np.array(y)), x @ y, rtol=1e-4)
+        _assert_close(np.tensordot(np.array(x), np.array(y), axes=1),
+                      onp.tensordot(x, y, axes=1), rtol=1e-4)
+        _assert_close(np.einsum("ij,jk->ik", np.array(x), np.array(y)),
+                      onp.einsum("ij,jk->ik", x, y), rtol=1e-4)
+        v = r.rand(3).astype("float32")
+        _assert_close(np.outer(np.array(v), np.array(v)), onp.outer(v, v),
+                      rtol=1e-5)
+
+    def test_decompositions(self):
+        r = onp.random.RandomState(4)
+        m = r.rand(4, 4).astype("float32")
+        spd = m @ m.T + 4 * onp.eye(4, dtype="float32")
+        a = np.array(spd)
+        _assert_close(np.linalg.det(a), onp.linalg.det(spd), rtol=1e-3)
+        _assert_close(np.linalg.inv(a) , onp.linalg.inv(spd), rtol=1e-3,
+                      atol=1e-4)
+        L = np.linalg.cholesky(a)
+        _assert_close(np.matmul(L, L.T), spd, rtol=1e-4, atol=1e-4)
+        w, v = np.linalg.eigh(a)
+        _assert_close(np.linalg.norm(a), onp.linalg.norm(spd), rtol=1e-5)
+        b = r.rand(4).astype("float32")
+        _assert_close(np.linalg.solve(a, np.array(b)),
+                      onp.linalg.solve(spd, b), rtol=1e-3, atol=1e-4)
+
+
+class TestAutogradThroughNp:
+    def test_grad_unary_chain(self):
+        x = np.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = np.sum(np.exp(x) * 2)
+        y.backward()
+        _assert_close(x.grad, 2 * onp.exp([1.0, 2.0, 3.0]), rtol=1e-5)
+
+    def test_grad_matmul(self):
+        a = np.ones((2, 3))
+        b = np.ones((3, 4))
+        a.attach_grad()
+        with autograd.record():
+            out = np.sum(np.matmul(a, b))
+        out.backward()
+        _assert_close(a.grad, onp.full((2, 3), 4.0))
+
+    def test_grad_reduction_axis(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]])
+        x.attach_grad()
+        with autograd.record():
+            y = np.sum(np.mean(x, axis=1) ** 2)
+        y.backward()
+        # d/dx_ij (sum_i mean_i^2) = 2*mean_i / 2
+        m = onp.array([1.5, 3.5])
+        _assert_close(x.grad, onp.stack([m, m], axis=1), rtol=1e-5)
+
+
+class TestRandomNp:
+    def test_shapes_and_ranges(self):
+        u = np.random.uniform(0, 1, size=(100,))
+        assert u.shape == (100,)
+        x = u.asnumpy()
+        assert (x >= 0).all() and (x < 1).all()
+        n = np.random.normal(5.0, 0.01, size=(200,))
+        assert abs(n.asnumpy().mean() - 5.0) < 0.1
+        r = np.random.randint(0, 10, size=(50,))
+        assert r.asnumpy().max() < 10
+        assert np.random.randn(2, 3).shape == (2, 3)
+
+    def test_seed_reproducible(self):
+        np.random.seed(42)
+        a = np.random.uniform(size=(4,)).asnumpy()
+        np.random.seed(42)
+        b = np.random.uniform(size=(4,)).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+
+
+class TestNpx:
+    def test_activations(self):
+        x = np.array([-1.0, 0.0, 2.0])
+        _assert_close(npx.relu(x), [0.0, 0.0, 2.0])
+        _assert_close(npx.sigmoid(x), 1 / (1 + onp.exp([1.0, 0.0, -2.0])),
+                      rtol=1e-5)
+        s = npx.softmax(np.array([1.0, 2.0, 3.0]))
+        _assert_close(np.sum(s), 1.0, rtol=1e-5)
+        ls = npx.log_softmax(np.array([1.0, 2.0, 3.0]))
+        _assert_close(np.exp(ls).sum(), 1.0, rtol=1e-5)
+
+    def test_nn_ops(self):
+        x = np.ones((2, 8))
+        w = np.ones((4, 8))
+        b = np.zeros((4,))
+        out = npx.fully_connected(x, w, b, num_hidden=4)
+        _assert_close(out, onp.full((2, 4), 8.0))
+        oh = npx.one_hot(np.array([0, 2]), 3)
+        _assert_close(oh, onp.eye(3)[[0, 2]])
+
+    def test_set_np_switch(self):
+        from mxnet_tpu import util
+        assert not util.is_np_array()
+        npx.set_np()
+        assert util.is_np_array()
+        npx.reset_np()
+        assert not util.is_np_array()
+
+        @util.use_np
+        def inner():
+            return util.is_np_array()
+        assert inner() is True
+
+
+class TestSearchLogic:
+    def test_search(self):
+        x = onp.array([3.0, 0.0, 5.0, 0.0], dtype="float32")
+        a = np.array(x)
+        nz = np.nonzero(a)
+        assert nz[0].asnumpy().tolist() == [0, 2]
+        assert np.unique(np.array([3, 1, 3, 2])).asnumpy().tolist() == [1, 2, 3]
+        _assert_close(np.sort(a), onp.sort(x))
+        _assert_close(np.argsort(a), onp.argsort(x))
+        assert np.allclose(a, a + 1e-9)
+        assert np.array_equal(a, a)
+        assert not np.array_equal(a, a + 1)
+        _assert_close(np.bincount(np.array([0, 1, 1, 3])),
+                      onp.bincount([0, 1, 1, 3]))

@@ -82,14 +82,13 @@ def launch_ssh(args, command):
     server_cmd = (sys.executable + " -c \"from mxnet_tpu.parallel.dist "
                   "import run_server; run_server()\"")
     server_procs = []
-    # servers ride the first hosts round-robin (reference: tracker assigns
-    # server roles across the same host pool)
+    # All servers co-locate on the root host, server i on ROOT_PORT + i —
+    # workers key-shard their connections across those ports (run_server).
     for i in range(args.num_servers):
-        host = hosts[i % len(hosts)]
         env_fwd = " ".join(base + ["DMLC_ROLE=server",
                                    "DMLC_SERVER_ID=%d" % i])
         server_procs.append(subprocess.Popen(
-            ["ssh", host, env_fwd + " " + server_cmd]))
+            ["ssh", root, env_fwd + " " + server_cmd]))
     worker_procs = []
     for i in range(args.num_workers):
         host = hosts[i % len(hosts)]
