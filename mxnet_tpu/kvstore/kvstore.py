@@ -69,12 +69,26 @@ class KVStore:
 
         Reference: ``KVStoreLocal::Push`` / ``KVStoreNCCL::Push``; on TPU
         the cross-chip adds ride ICI via PjRt transfers + XLA add."""
+        from ..ndarray.sparse import RowSparseNDArray, add_n
         keys, values = _normalize(key, value)
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, (list, tuple)):
                 vlist = [vlist]
             if k not in self._data:
                 raise MXNetError("key %s was not initialized" % str(k))
+            if all(isinstance(v, RowSparseNDArray) for v in vlist):
+                # sparse reduce: union-merge row blocks, stays row_sparse
+                reduced = add_n(vlist) if len(vlist) > 1 else vlist[0]
+                stored = self._data[k]
+                if self._updater is not None:
+                    self._updater(k, reduced, stored)
+                elif stored.stype == "row_sparse":
+                    # jax buffers are immutable, so sharing them is safe;
+                    # copyto preserves the stored object's identity
+                    reduced.copyto(stored)
+                else:
+                    stored._set_data(reduced._to_dense_jax())
+                continue
             target_ctx = vlist[0].context
             reduced = vlist[0]
             for v in vlist[1:]:
@@ -93,7 +107,10 @@ class KVStore:
                 olist = [olist]
             src = self._data[k]
             for o in olist:
-                o._set_data(src.as_in_context(o.context)._data)
+                if src.stype != "default":
+                    src.copyto(o)  # densifies when o is dense
+                else:
+                    o._set_data(src.as_in_context(o.context)._data)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -105,10 +122,32 @@ class KVStore:
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        import warnings
-        warnings.warn("row_sparse_pull executes as dense pull on TPU "
-                      "(SURVEY.md §7 hard-part #7)")
-        self.pull(key, out, priority)
+        """Pull only the rows named in ``row_ids`` (reference:
+        ``KVStoreLocal::PullRowSparse`` → ``_retain``)."""
+        from ..ndarray import sparse as _sp
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _normalize(key, out)
+        rid_list = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        import numpy as _hnp
+        import jax.numpy as _jnp
+        for k, olist, rid in zip(keys, outs, rid_list):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            src = self._data[k]
+            if src.stype == "row_sparse":
+                picked = _sp.retain(src, rid)
+            else:
+                # dense store: device-side row gather, no host cast
+                rows = _hnp.unique(_hnp.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid
+                ).astype(_hnp.int64))
+                picked = _sp.RowSparseNDArray(
+                    src._data[_jnp.asarray(rows)],
+                    {"indices": _jnp.asarray(rows, _jnp.int32)}, src.shape)
+            for o in olist:
+                picked.copyto(o)
 
     # -- optimizer-on-kvstore (reference: server-side updates) -----------
     def set_optimizer(self, optimizer):

@@ -15,6 +15,7 @@ _register.populate(globals())
 _ndmod._install_methods()
 
 from . import contrib  # noqa: E402  (control flow: foreach/while_loop/cond)
+from . import sparse  # noqa: E402  (row_sparse / csr storage types)
 
 
 def eye(N, M=0, k=0, ctx=None, dtype="float32"):

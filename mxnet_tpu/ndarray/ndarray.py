@@ -425,11 +425,13 @@ class NDArray:
         return self.broadcast_to(other.shape)
 
     def tostype(self, stype):
-        if stype != "default":
-            import warnings
-            warnings.warn("Sparse storage types are TPU-hostile and execute "
-                          "as dense fallbacks (SURVEY.md §7 hard-part #7)")
-        return self
+        if stype == "default":
+            return self.copy()  # reference tostype always returns a new array
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    def todense(self):
+        return self.copy()
 
     def __repr__(self):
         return "\n%s\n<NDArray %s @%s>" % (

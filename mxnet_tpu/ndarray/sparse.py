@@ -1,0 +1,592 @@
+"""Sparse NDArray storage types — ``row_sparse`` and ``csr``.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` + the stype machinery in
+``src/ndarray/ndarray.cc`` / per-op ``FInferStorageType`` (SURVEY.md §2.1
+"NDArray core", §7 hard-part #7).
+
+TPU-native stance: sparse storage is host-describable metadata (row ids /
+col ids / indptr) around dense *value* blocks that live on device.  The ops
+that are genuinely sparse-friendly on TPU — ``dot(csr, dense)`` via
+gather + ``segment_sum``, ``retain``, lazy row-wise optimizer updates,
+storage casts — run as real sparse kernels (XLA maps gather/scatter/segment
+ops onto the hardware well).  General elementwise math *falls back to dense*
+with a one-time warning, mirroring the reference's own stype-fallback
+machinery (``operator/elemwise_op_common.h`` dispatches to dense when no
+``FComputeEx`` matches).  Structure discovery (nonzero detection, index
+union/intersection) happens eagerly on host — these arrays are concrete in
+the imperative API, never traced.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from .ndarray import NDArray, _wrap
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "csr_matrix", "row_sparse_array", "array", "empty", "zeros",
+           "cast_storage", "retain", "dot", "add_n", "elemwise_add",
+           "elemwise_sub", "elemwise_mul", "sgd_update", "sgd_mom_update",
+           "adam_update"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_warned_fallback = set()
+
+
+def _fallback_warn(opname):
+    if opname not in _warned_fallback:
+        _warned_fallback.add(opname)
+        warnings.warn(
+            "sparse %s executes as a dense fallback on TPU (reference "
+            "behavior: stype fallback when no FComputeEx is registered)"
+            % opname, stacklevel=3)
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base of :class:`CSRNDArray` / :class:`RowSparseNDArray`.
+
+    ``_data`` holds the compact value block (device array); aux index
+    arrays live in ``_aux``; the logical dense shape in ``_sparse_shape``.
+    """
+
+    __slots__ = ("_aux", "_sparse_shape")
+
+    def __init__(self, values, aux, shape, ctx=None):
+        super().__init__(values, ctx=ctx)
+        self._aux = aux
+        self._sparse_shape = tuple(int(d) for d in shape)
+
+    # -- overridden dense-NDArray surface ------------------------------
+    @property
+    def shape(self):
+        return self._sparse_shape
+
+    @property
+    def data(self) -> NDArray:
+        """The compact values block."""
+        return _wrap(self._data)
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._aux["indices"])
+
+    def asnumpy(self):
+        return _np.asarray(self._to_dense_jax())
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+        return self
+
+    def todense(self) -> NDArray:
+        return _wrap(self._to_dense_jax())
+
+    to_dense = todense
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def copy(self):
+        return self.__class__(self._data, dict(self._aux),
+                              self._sparse_shape)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return self.copy()
+        if isinstance(other, BaseSparseNDArray):
+            other._set_data(self._data)
+            other._aux = dict(self._aux)
+            other._sparse_shape = self._sparse_shape
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self._to_dense_jax())
+            return other
+        raise MXNetError("copyto: unsupported target %r" % (other,))
+
+    def _dense(self) -> NDArray:
+        return _wrap(self._to_dense_jax())
+
+    # dense fallbacks for arithmetic (one-time warning per op) ----------
+    def _fb(self, opname, fn, *others):
+        _fallback_warn(opname)
+        args = [o._dense() if isinstance(o, BaseSparseNDArray) else o
+                for o in others]
+        return fn(self._dense(), *args)
+
+    def __add__(self, other):
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            return elemwise_add(self, other)
+        return self._fb("add", lambda a, b: a + b, other)
+
+    def __sub__(self, other):
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            return elemwise_sub(self, other)
+        return self._fb("sub", lambda a, b: a - b, other)
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return self.__class__(self._data * other, dict(self._aux),
+                                  self._sparse_shape)
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            return elemwise_mul(self, other)
+        return self._fb("mul", lambda a, b: a * b, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, (int, float)):
+            return self.__class__(self._data / other, dict(self._aux),
+                                  self._sparse_shape)
+        return self._fb("div", lambda a, b: a / b, other)
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (
+            type(self).__name__,
+            "x".join(str(d) for d in self._sparse_shape), self.context)
+
+    def check_format(self, full_check=True):
+        raise NotImplementedError
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """``row_sparse``: a subset of rows stored densely.
+
+    ``indices``: sorted unique int32 row ids (int32 is the TPU-native index
+    dtype; the reference uses int64), shape ``(nnz_rows,)``;
+    ``data``: shape ``(nnz_rows,) + shape[1:]``.  The storage type used by
+    the reference for sparse gradients (Embedding ``sparse_grad``) and
+    kvstore ``row_sparse_pull``.
+    """
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def _to_dense_jax(self):
+        jnp = _jnp()
+        dense = jnp.zeros(self._sparse_shape, dtype=self._data.dtype)
+        if self._data.shape[0] == 0:
+            return dense
+        return dense.at[self._aux["indices"]].set(self._data)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def check_format(self, full_check=True):
+        idx = _np.asarray(self._aux["indices"])
+        if idx.ndim != 1:
+            raise MXNetError("row_sparse indices must be 1-D")
+        if idx.size and ((idx[1:] <= idx[:-1]).any() or idx[0] < 0
+                         or idx[-1] >= self._sparse_shape[0]):
+            raise MXNetError("row_sparse indices must be sorted, unique and "
+                             "within [0, num_rows)")
+        if tuple(self._data.shape) != (idx.size,) + self._sparse_shape[1:]:
+            raise MXNetError("row_sparse data shape mismatch")
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._dense()[key]
+        raise MXNetError("row_sparse only supports integer row indexing")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """``csr``: compressed sparse row, 2-D only.
+
+    ``data``: nnz values; ``indices``: nnz column ids; ``indptr``: row
+    pointer of length ``num_rows + 1``.
+    """
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return _wrap(self._aux["indptr"])
+
+    def _to_dense_jax(self):
+        jnp = _jnp()
+        dense = jnp.zeros(self._sparse_shape, dtype=self._data.dtype)
+        nnz = self._data.shape[0]
+        if nnz == 0:
+            return dense
+        rows = _csr_row_of_nnz(self._aux["indptr"], nnz)
+        return dense.at[rows, self._aux["indices"]].set(self._data)
+
+    def asscipy(self):
+        import scipy.sparse as sps
+        return sps.csr_matrix(
+            (_np.asarray(self._data), _np.asarray(self._aux["indices"]),
+             _np.asarray(self._aux["indptr"])), shape=self._sparse_shape)
+
+    def check_format(self, full_check=True):
+        indptr = _np.asarray(self._aux["indptr"])
+        idx = _np.asarray(self._aux["indices"])
+        if len(self._sparse_shape) != 2:
+            raise MXNetError("csr must be 2-D")
+        if indptr.shape != (self._sparse_shape[0] + 1,):
+            raise MXNetError("csr indptr length must be num_rows+1")
+        if indptr[0] != 0 or indptr[-1] != idx.size or \
+                (indptr[1:] < indptr[:-1]).any():
+            raise MXNetError("csr indptr must be monotone from 0 to nnz")
+        if idx.size and (idx.min() < 0 or idx.max() >= self._sparse_shape[1]):
+            raise MXNetError("csr indices out of range")
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sparse_shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing requires step 1")
+            indptr = _np.asarray(self._aux["indptr"])
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            jnp = _jnp()
+            new_indptr = jnp.asarray(indptr[start:stop + 1] - indptr[start])
+            return CSRNDArray(self._data[lo:hi],
+                              {"indices": self._aux["indices"][lo:hi],
+                               "indptr": new_indptr},
+                              (stop - start, self._sparse_shape[1]))
+        raise MXNetError("csr supports int/slice row indexing only")
+
+
+def _csr_row_of_nnz(indptr, nnz):
+    """Row id of each nnz entry (device op: searchsorted over indptr)."""
+    jnp = _jnp()
+    return jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def _as_jax(x, dtype=None):
+    jnp = _jnp()
+    if isinstance(x, NDArray):
+        x = x._data
+    return jnp.asarray(x, dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a :class:`RowSparseNDArray` from ``(data, indices)``, a dense
+    source, or another row_sparse array."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy()
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not \
+            _np.isscalar(arg1[0]):
+        data = _as_jax(arg1[0], dtype)
+        indices = _np.asarray(_as_jax(arg1[1])).astype(_np.int64)
+        order = _np.argsort(indices, kind="stable")
+        jnp = _jnp()
+        if not (indices[:-1] < indices[1:]).all():
+            indices = indices[order]
+            data = data[jnp.asarray(order)]
+        if shape is None:
+            nrows = int(indices[-1]) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(data.shape[1:])
+        return RowSparseNDArray(data, {"indices": jnp.asarray(indices)},
+                                shape, ctx=ctx)
+    # dense source
+    dense = _as_jax(arg1, dtype)
+    return cast_storage(_wrap(dense), "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a :class:`CSRNDArray` from ``(data, indices, indptr)``, a
+    dense 2-D source, a scipy.sparse matrix, or ``(data, (row, col))``."""
+    jnp = _jnp()
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(arg1):
+            m = arg1.tocsr()
+            return CSRNDArray(jnp.asarray(m.data, dtype=dtype),
+                              {"indices": jnp.asarray(m.indices, dtype=jnp.int32),
+                               "indptr": jnp.asarray(m.indptr, dtype=jnp.int32)},
+                              m.shape, ctx=ctx)
+    except ImportError:
+        pass
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        indptr = _as_jax(indptr).astype(jnp.int32)
+        if shape is None:
+            ncols = int(_np.asarray(indices).max()) + 1 if len(indices) else 0
+            shape = (int(indptr.shape[0]) - 1, ncols)
+        return CSRNDArray(_as_jax(data, dtype),
+                          {"indices": _as_jax(indices).astype(jnp.int32),
+                           "indptr": indptr}, shape, ctx=ctx)
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and \
+            isinstance(arg1[1], (tuple, list)):
+        import scipy.sparse as sps
+        data, (row, col) = arg1
+        m = sps.csr_matrix((_np.asarray(data), (_np.asarray(row),
+                                                _np.asarray(col))),
+                           shape=shape)
+        return csr_matrix(m, ctx=ctx, dtype=dtype)
+    return cast_storage(_wrap(_as_jax(arg1, dtype)), "csr")
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware ``array``: scipy matrices → csr, sparse NDArrays copy."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.copy()
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(source_array):
+            return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    raise MXNetError("sparse.array expects a sparse source; use nd.array "
+                     "for dense")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    jnp = _jnp()
+    dtype = dtype or "float32"
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        data = jnp.zeros((0,) + tuple(shape[1:]), dtype=dtype)
+        return RowSparseNDArray(data,
+                                {"indices": jnp.zeros((0,), jnp.int32)},
+                                shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype=dtype),
+                          {"indices": jnp.zeros((0,), jnp.int32),
+                           "indptr": jnp.zeros((shape[0] + 1,), jnp.int32)},
+                          shape, ctx=ctx)
+    if stype == "default":
+        from . import ndarray as _nd
+        return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown stype %r" % (stype,))
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts (reference: cast_storage op, src/operator/tensor/cast_storage*)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    jnp = _jnp()
+    cur = arr.stype
+    if cur == stype:
+        return arr.copy() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "default":
+        return arr.todense()
+    if cur != "default":
+        return cast_storage(arr.todense(), stype)
+    dense_np = arr.asnumpy()
+    if stype == "row_sparse":
+        reduce_axes = tuple(range(1, dense_np.ndim))
+        nz = _np.nonzero(_np.abs(dense_np).sum(axis=reduce_axes)
+                         if reduce_axes else dense_np)[0]
+        data = jnp.asarray(dense_np[nz])
+        return RowSparseNDArray(data,
+                                {"indices": jnp.asarray(nz, jnp.int32)},
+                                dense_np.shape)
+    if stype == "csr":
+        if dense_np.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        import scipy.sparse as sps
+        return csr_matrix(sps.csr_matrix(dense_np))
+    raise MXNetError("unknown stype %r" % (stype,))
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels
+# ---------------------------------------------------------------------------
+
+def retain(rsp, indices):
+    """Keep only the rows of ``rsp`` whose ids appear in ``indices``
+    (reference: ``_retain`` — the kvstore row_sparse_pull primitive)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    jnp = _jnp()
+    want = _np.unique(_np.asarray(_as_jax(indices)).astype(_np.int64))
+    have = _np.asarray(rsp._aux["indices"])
+    mask = _np.isin(have, want)
+    pos = _np.nonzero(mask)[0]
+    data = rsp._data[jnp.asarray(pos)] if pos.size else \
+        rsp._data[:0]
+    return RowSparseNDArray(data, {"indices": jnp.asarray(have[pos])},
+                            rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse matrix product.  TPU fast paths:
+
+    * ``dot(csr, dense)`` → dense: gather rhs rows by col id, multiply by
+      values, ``segment_sum`` by row id — all on device.
+    * ``dot(csr.T, dense)`` → row_sparse: ``segment_sum`` by col id; the
+      output keeps only columns that appear in the csr structure.
+
+    Anything else falls back to dense matmul with a warning.
+    """
+    import jax
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+        rd = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        vec_rhs = rd.ndim == 1
+        if vec_rhs:
+            rd = rd[:, None]
+        vals, cols = lhs._data, lhs._aux["indices"]
+        nnz = vals.shape[0]
+        nrows, ncols = lhs.shape
+        if nnz == 0:
+            tail = () if vec_rhs else tuple(rd.shape[1:])
+            if transpose_a:
+                return zeros("row_sparse", (ncols,) + tail)
+            return _wrap(jnp.zeros((nrows,) + tail, dtype=vals.dtype))
+        rows = _csr_row_of_nnz(lhs._aux["indptr"], nnz)
+        if not transpose_a:
+            prod = vals[:, None] * rd[cols]
+            out = jax.ops.segment_sum(prod, rows, num_segments=nrows)
+            return _wrap(out[:, 0] if vec_rhs else out)
+        # csr.T @ dense → row_sparse over the csr's column ids
+        prod = vals[:, None] * rd[rows]
+        out = jax.ops.segment_sum(prod, cols, num_segments=ncols)
+        nz_cols = _np.unique(_np.asarray(cols))
+        data = out[jnp.asarray(nz_cols)]
+        if vec_rhs:
+            data = data[:, 0]
+        return RowSparseNDArray(data,
+                                {"indices": jnp.asarray(nz_cols, jnp.int32)},
+                                (ncols,) + tuple(rd.shape[1:])
+                                if not vec_rhs else (ncols,))
+    _fallback_warn("dot")
+    ld = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs._dense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    a = ld._data.T if transpose_a else ld._data
+    b = rd._data.T if transpose_b else rd._data
+    return _wrap(jnp.matmul(a, b))
+
+
+def _merge_rowsparse(arrs):
+    """Union-merge row_sparse arrays: concat + host-unique + segment_sum."""
+    import jax
+    jnp = _jnp()
+    shape = arrs[0].shape
+    all_idx = _np.concatenate([_np.asarray(a._aux["indices"]) for a in arrs])
+    if all_idx.size == 0:
+        return zeros("row_sparse", shape, dtype=str(arrs[0].dtype))
+    uniq, inverse = _np.unique(all_idx, return_inverse=True)
+    vals = jnp.concatenate([a._data for a in arrs], axis=0)
+    merged = jax.ops.segment_sum(vals, jnp.asarray(inverse),
+                                 num_segments=uniq.size)
+    return RowSparseNDArray(merged, {"indices": jnp.asarray(uniq, jnp.int32)},
+                            shape)
+
+
+def add_n(*arrs):
+    """Sum of arrays; all-row_sparse stays row_sparse (the gradient
+    aggregation path for sparse grads)."""
+    arrs = list(arrs[0]) if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)) \
+        else list(arrs)
+    if all(isinstance(a, RowSparseNDArray) for a in arrs):
+        return _merge_rowsparse(arrs)
+    _fallback_warn("add_n")
+    jnp = _jnp()
+    out = None
+    for a in arrs:
+        d = a._dense()._data if isinstance(a, BaseSparseNDArray) else a._data
+        out = d if out is None else out + d
+    return _wrap(out)
+
+
+def elemwise_add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _merge_rowsparse([lhs, rhs])
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return csr_matrix(lhs.asscipy() + rhs.asscipy())
+    _fallback_warn("elemwise_add")
+    return _wrap(lhs._dense()._data + rhs._dense()._data)
+
+
+def elemwise_sub(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _merge_rowsparse([lhs, rhs * -1.0])
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return csr_matrix(lhs.asscipy() - rhs.asscipy())
+    _fallback_warn("elemwise_sub")
+    return _wrap(lhs._dense()._data - rhs._dense()._data)
+
+
+def elemwise_mul(lhs, rhs):
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return csr_matrix(lhs.asscipy().multiply(rhs.asscipy()).tocsr())
+    _fallback_warn("elemwise_mul")
+    ld = lhs._dense()._data if isinstance(lhs, BaseSparseNDArray) else lhs._data
+    rd = rhs._dense()._data if isinstance(rhs, BaseSparseNDArray) else rhs._data
+    return _wrap(ld * rd)
+
+
+# ---------------------------------------------------------------------------
+# lazy (row-wise) optimizer updates — reference: sgd_update FComputeEx with
+# row_sparse grad + lazy_update=True touches only the grad's rows.
+# ---------------------------------------------------------------------------
+
+def _rows_and_grad(grad, rescale_grad, clip_gradient):
+    jnp = _jnp()
+    rows = grad._aux["indices"]
+    g = grad._data * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return rows, g
+
+
+def sgd_update(weight, grad, out=None, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True, **kw):
+    """Row-lazy SGD: only rows present in the row_sparse grad are touched
+    (matches reference lazy_update semantics: wd applies to touched rows)."""
+    assert isinstance(grad, RowSparseNDArray)
+    rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows]
+    new_rows = wr - lr * (g + wd * wr)
+    out = out if out is not None else weight
+    out._set_data(w.at[rows].set(new_rows))
+    return out
+
+
+def sgd_mom_update(weight, grad, mom, out=None, lr=0.01, momentum=0.0,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   lazy_update=True, **kw):
+    assert isinstance(grad, RowSparseNDArray)
+    rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
+    w, m = weight._data, mom._data
+    wr, mr = w[rows], m[rows]
+    new_m = momentum * mr - lr * (g + wd * wr)
+    mom._set_data(m.at[rows].set(new_m))
+    out = out if out is not None else weight
+    out._set_data(w.at[rows].set(wr + new_m))
+    return out
+
+
+def adam_update(weight, grad, mean, var, out=None, lr=0.001, beta1=0.9,
+                beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True, **kw):
+    jnp = _jnp()
+    assert isinstance(grad, RowSparseNDArray)
+    rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
+    w, m, v = weight._data, mean._data, var._data
+    wr = w[rows]
+    g = g + wd * wr
+    new_m = beta1 * m[rows] + (1 - beta1) * g
+    new_v = beta2 * v[rows] + (1 - beta2) * jnp.square(g)
+    mean._set_data(m.at[rows].set(new_m))
+    var._set_data(v.at[rows].set(new_v))
+    out = out if out is not None else weight
+    out._set_data(w.at[rows].set(
+        wr - lr * new_m / (jnp.sqrt(new_v) + epsilon)))
+    return out

@@ -161,6 +161,16 @@ class SGD(Optimizer):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         kw = _common_kwargs(self, index)
+        if grad.stype == "row_sparse" and self.lazy_update:
+            from ..ndarray import sparse as _sp
+            if state is not None:
+                _sp.sgd_mom_update(weight, grad, state, out=weight, lr=lr,
+                                   wd=wd, momentum=self.momentum, **kw)
+            else:
+                _sp.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+            return
+        if grad.stype != "default":
+            grad = grad.todense()
         if state is not None:
             nd.sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
                               momentum=self.momentum, **kw)
@@ -217,6 +227,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context,
@@ -234,9 +245,17 @@ class Adam(Optimizer):
         lr *= math.sqrt(coef2) / coef1
         kw = _common_kwargs(self, index)
         mean, var = state
-        nd.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
-                       beta1=self.beta1, beta2=self.beta2,
-                       epsilon=self.epsilon, **kw)
+        if grad.stype == "row_sparse" and self.lazy_update:
+            from ..ndarray import sparse as _sp
+            _sp.adam_update(weight, grad, mean, var, out=weight, lr=lr,
+                            wd=wd, beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon, **kw)
+        else:
+            if grad.stype != "default":
+                grad = grad.todense()
+            nd.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                           beta1=self.beta1, beta2=self.beta2,
+                           epsilon=self.epsilon, **kw)
 
 
 @register("adagrad")

@@ -1,0 +1,274 @@
+"""Sparse NDArray tests (reference: tests/python/unittest/test_sparse_ndarray.py
+and test_sparse_operator.py — SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def dense_rand(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(*shape) < density
+    return d * mask
+
+
+class TestRowSparse:
+    def test_create_from_tuple_and_dense_roundtrip(self):
+        data = np.arange(6, dtype=np.float32).reshape(3, 2)
+        idx = [1, 4, 0]
+        rsp = sparse.row_sparse_array((data, idx), shape=(6, 2))
+        assert rsp.stype == "row_sparse"
+        assert rsp.shape == (6, 2)
+        dense = rsp.asnumpy()
+        # indices get sorted; row 0 ← data[2], row 1 ← data[0], row 4 ← data[1]
+        np.testing.assert_allclose(dense[0], data[2])
+        np.testing.assert_allclose(dense[1], data[0])
+        np.testing.assert_allclose(dense[4], data[1])
+        assert dense[2].sum() == 0 and dense[3].sum() == 0
+        rsp.check_format()
+
+    def test_cast_storage_both_ways(self):
+        d = dense_rand((8, 3))
+        rsp = nd.array(d).tostype("row_sparse")
+        assert rsp.stype == "row_sparse"
+        np.testing.assert_allclose(rsp.asnumpy(), d)
+        back = rsp.tostype("default")
+        assert back.stype == "default"
+        np.testing.assert_allclose(back.asnumpy(), d)
+
+    def test_retain(self):
+        d = dense_rand((10, 4), density=0.9, seed=1)
+        rsp = sparse.cast_storage(nd.array(d), "row_sparse")
+        kept = sparse.retain(rsp, [0, 3, 7])
+        out = kept.asnumpy()
+        for r in range(10):
+            if r in (0, 3, 7):
+                np.testing.assert_allclose(out[r], d[r])
+            else:
+                assert np.abs(out[r]).sum() == 0
+
+    def test_add_n_merges_rows(self):
+        a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 2]),
+                                    shape=(5, 3))
+        b = sparse.row_sparse_array((2 * np.ones((2, 3), np.float32), [2, 4]),
+                                    shape=(5, 3))
+        s = sparse.add_n(a, b)
+        assert s.stype == "row_sparse"
+        out = s.asnumpy()
+        np.testing.assert_allclose(out[0], np.ones(3))
+        np.testing.assert_allclose(out[2], 3 * np.ones(3))
+        np.testing.assert_allclose(out[4], 2 * np.ones(3))
+        assert np.abs(out[1]).sum() == 0
+
+    def test_scalar_mul_keeps_sparse(self):
+        a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [1, 3]),
+                                    shape=(4, 3))
+        b = a * 2.5
+        assert b.stype == "row_sparse"
+        np.testing.assert_allclose(b.asnumpy()[1], 2.5 * np.ones(3))
+
+
+class TestCSR:
+    def test_create_and_scipy_roundtrip(self):
+        import scipy.sparse as sps
+        d = dense_rand((6, 5), seed=2)
+        csr = nd.array(d).tostype("csr")
+        assert csr.stype == "csr"
+        np.testing.assert_allclose(csr.asnumpy(), d)
+        sp = csr.asscipy()
+        assert isinstance(sp, sps.csr_matrix)
+        np.testing.assert_allclose(sp.toarray(), d)
+        csr.check_format()
+
+    def test_create_from_data_indices_indptr(self):
+        csr = sparse.csr_matrix(([1., 2., 3.], [0, 2, 1], [0, 2, 2, 3]),
+                                shape=(3, 3))
+        expect = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], np.float32)
+        np.testing.assert_allclose(csr.asnumpy(), expect)
+
+    def test_row_slice(self):
+        d = dense_rand((8, 4), seed=3)
+        csr = sparse.csr_matrix(d)
+        sub = csr[2:5]
+        assert sub.stype == "csr"
+        np.testing.assert_allclose(sub.asnumpy(), d[2:5])
+
+    def test_dot_csr_dense(self):
+        d = dense_rand((7, 9), seed=4)
+        rhs = np.random.RandomState(5).randn(9, 3).astype(np.float32)
+        csr = sparse.csr_matrix(d)
+        out = sparse.dot(csr, nd.array(rhs))
+        assert out.stype == "default"
+        np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_dot_csr_T_dense_is_row_sparse(self):
+        d = dense_rand((7, 9), seed=6)
+        rhs = np.random.RandomState(7).randn(7, 4).astype(np.float32)
+        csr = sparse.csr_matrix(d)
+        out = sparse.dot(csr, nd.array(rhs), transpose_a=True)
+        assert out.stype == "row_sparse"
+        np.testing.assert_allclose(out.asnumpy(), d.T @ rhs, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_csr_add(self):
+        a = dense_rand((5, 5), seed=8)
+        b = dense_rand((5, 5), seed=9)
+        out = sparse.elemwise_add(sparse.csr_matrix(a), sparse.csr_matrix(b))
+        assert out.stype == "csr"
+        np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+
+
+class TestSparseOptimizer:
+    def _check_lazy(self, opt_name, **opt_kw):
+        from mxnet_tpu import optimizer as optmod
+        shape = (10, 4)
+        w0 = np.random.RandomState(10).randn(*shape).astype(np.float32)
+        grad_rows = [1, 5]
+        gdata = np.random.RandomState(11).randn(2, 4).astype(np.float32)
+
+        opt = optmod.create(opt_name, learning_rate=0.1, **opt_kw)
+        w = nd.array(w0.copy())
+        state = opt.create_state(0, w)
+        grs = sparse.row_sparse_array((gdata, grad_rows), shape=shape)
+        opt.update(0, w, grs, state)
+        out = w.asnumpy()
+        # untouched rows identical (lazy), touched rows changed
+        for r in range(shape[0]):
+            if r in grad_rows:
+                assert np.abs(out[r] - w0[r]).max() > 0
+            else:
+                np.testing.assert_array_equal(out[r], w0[r])
+
+        # dense equivalence (wd=0 ⇒ lazy == dense on touched rows)
+        dense_g = np.zeros(shape, np.float32)
+        dense_g[grad_rows] = gdata
+        opt2 = optmod.create(opt_name, learning_rate=0.1, **opt_kw)
+        w2 = nd.array(w0.copy())
+        state2 = opt2.create_state(0, w2)
+        opt2.update(0, w2, nd.array(dense_g), state2)
+        np.testing.assert_allclose(out, w2.asnumpy(), rtol=1e-5, atol=1e-6)
+
+    def test_sgd_lazy(self):
+        self._check_lazy("sgd", wd=0.0)
+
+    def test_sgd_momentum_lazy(self):
+        self._check_lazy("sgd", momentum=0.9, wd=0.0)
+
+    def test_adam_lazy(self):
+        # adam with zero-init state: dense update moves untouched rows by 0
+        from mxnet_tpu import optimizer as optmod
+        shape = (6, 3)
+        w0 = np.random.RandomState(12).randn(*shape).astype(np.float32)
+        opt = optmod.create("adam", learning_rate=0.01, wd=0.0)
+        w = nd.array(w0.copy())
+        state = opt.create_state(0, w)
+        grs = sparse.row_sparse_array(
+            (np.ones((2, 3), np.float32), [0, 4]), shape=shape)
+        opt.update(0, w, grs, state)
+        out = w.asnumpy()
+        np.testing.assert_array_equal(out[1], w0[1])
+        assert np.abs(out[0] - w0[0]).max() > 0
+
+
+class TestSparseKVStore:
+    def test_rowsparse_push_and_row_sparse_pull(self):
+        import mxnet_tpu.kvstore as kv
+        store = kv.create("local")
+        shape = (8, 2)
+        store.init("w", nd.zeros(shape))
+        g1 = sparse.row_sparse_array((np.ones((2, 2), np.float32), [0, 3]),
+                                     shape=shape)
+        g2 = sparse.row_sparse_array((np.ones((2, 2), np.float32), [3, 6]),
+                                     shape=shape)
+        store.push("w", [g1, g2])
+        out = sparse.zeros("row_sparse", shape)
+        store.row_sparse_pull("w", out=out, row_ids=nd.array([0, 3]))
+        dense = out.asnumpy()
+        np.testing.assert_allclose(dense[0], np.ones(2))
+        np.testing.assert_allclose(dense[3], 2 * np.ones(2))
+        assert np.abs(dense[6]).sum() == 0  # not pulled
+
+    def test_dense_pull_of_sparse_pushed_value(self):
+        import mxnet_tpu.kvstore as kv
+        store = kv.create("local")
+        shape = (4, 2)
+        store.init("w", nd.zeros(shape))
+        g = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                    shape=shape)
+        store.push("w", g)
+        out = nd.zeros(shape)
+        store.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy()[2], np.ones(2))
+
+
+class TestReviewRegressions:
+    """Fixes from the round-1 sparse code review."""
+
+    def test_kvstore_sparse_push_no_aliasing(self):
+        import mxnet_tpu.kvstore as kv
+        store = kv.create("local")
+        store.init("w", sparse.zeros("row_sparse", (4, 3)))
+        g = sparse.row_sparse_array((np.ones((1, 3), np.float32), [1]),
+                                    shape=(4, 3))
+        store.push("w", g)
+        g._set_data(g._data * 99)  # caller mutates grad after push
+        out = nd.zeros((4, 3))
+        store.pull("w", out=out)
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out.asnumpy()[1], np.ones(3))
+
+    def test_sgd_non_lazy_densifies(self):
+        from mxnet_tpu import optimizer as optmod
+        opt = optmod.create("sgd", learning_rate=0.1, lazy_update=False)
+        w = nd.zeros((4, 3))
+        g = sparse.row_sparse_array((np.ones((1, 3), np.float32), [1]),
+                                    shape=(4, 3))
+        opt.update(0, w, g, None)
+        out = w.asnumpy()
+        np.testing.assert_allclose(out[1], -0.1 * np.ones(3), rtol=1e-6)
+        assert np.abs(out[[0, 2, 3]]).sum() == 0
+
+    def test_adam_non_lazy_densifies(self):
+        from mxnet_tpu import optimizer as optmod
+        opt = optmod.create("adam", learning_rate=0.1, lazy_update=False)
+        w = nd.zeros((4, 3))
+        state = opt.create_state(0, w)
+        g = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 2]),
+                                    shape=(4, 3))
+        opt.update(0, w, g, state)  # would shape-error without densify
+        out = w.asnumpy()
+        assert np.abs(out[0]).max() > 0 and np.abs(out[1]).max() == 0
+
+    def test_dot_csr_vector(self):
+        d = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+        v = np.array([1., 1., 1.], np.float32)
+        csr = sparse.csr_matrix(d)
+        out = sparse.dot(csr, nd.array(v))
+        assert out.shape == (2,)
+        np.testing.assert_allclose(out.asnumpy(), d @ v)
+        outT = sparse.dot(csr, nd.array(np.array([1., 1.], np.float32)),
+                          transpose_a=True)
+        assert outT.stype == "row_sparse" and outT.shape == (3,)
+        np.testing.assert_allclose(outT.asnumpy(), d.T @ np.ones(2))
+
+    def test_row_sparse_pull_dense_store_keeps_zero_rows(self):
+        import mxnet_tpu.kvstore as kv
+        store = kv.create("local")
+        w = np.zeros((5, 2), np.float32)
+        w[3] = 7.0
+        store.init("w", nd.array(w))
+        out = sparse.zeros("row_sparse", (5, 2))
+        store.row_sparse_pull("w", out=out, row_ids=nd.array([1, 3]))
+        # row 1 is all-zero in the store but still pulled (present in indices)
+        assert 1 in np.asarray(out.indices.asnumpy())
+        np.testing.assert_allclose(out.asnumpy()[3], 7 * np.ones(2))
+
+    def test_tostype_default_returns_copy(self):
+        a = nd.ones((2, 2))
+        b = a.tostype("default")
+        b += 1
+        np.testing.assert_allclose(a.asnumpy(), np.ones((2, 2)))
