@@ -1,3 +1,4 @@
 """``mx.contrib`` — contrib subsystems (AMP, quantization, ONNX, control
 flow).  Reference: ``python/mxnet/contrib/``."""
 from . import amp
+from . import quantization

@@ -27,6 +27,11 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["KVStoreBase", "KVStore", "create"]
 
+
+def _jax():
+    import jax
+    return jax
+
 _REG = Registry("kvstore")
 
 
@@ -86,8 +91,13 @@ class KVStore:
                     # jax buffers are immutable, so sharing them is safe;
                     # copyto preserves the stored object's identity
                     reduced.copyto(stored)
-                else:
+                elif stored.stype == "default":
                     stored._set_data(reduced._to_dense_jax())
+                else:
+                    raise MXNetError(
+                        "push of row_sparse values into a %r-stored key is "
+                        "not supported (reference supports default/"
+                        "row_sparse targets only)" % stored.stype)
                 continue
             target_ctx = vlist[0].context
             reduced = vlist[0]
@@ -109,6 +119,9 @@ class KVStore:
             for o in olist:
                 if src.stype != "default":
                     src.copyto(o)  # densifies when o is dense
+                    if o.context != src.context:
+                        o._set_data(_jax().device_put(
+                            o._data, o.context.jax_device))
                 else:
                     o._set_data(src.as_in_context(o.context)._data)
 
@@ -128,8 +141,17 @@ class KVStore:
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
         keys, outs = _normalize(key, out)
-        rid_list = row_ids if isinstance(row_ids, (list, tuple)) else \
-            [row_ids] * len(keys)
+        # row_ids is per-key only when the key itself is a list; a plain
+        # python list for a single key is that key's row ids (reference:
+        # KVStoreLocal::PullRowSparse accepts one NDArray per key)
+        if isinstance(key, (list, tuple)):
+            if not isinstance(row_ids, (list, tuple)) or \
+                    len(row_ids) != len(keys):
+                raise MXNetError("row_sparse_pull: need one row_ids entry "
+                                 "per key")
+            rid_list = list(row_ids)
+        else:
+            rid_list = [row_ids]
         import numpy as _hnp
         import jax.numpy as _jnp
         for k, olist, rid in zip(keys, outs, rid_list):

@@ -446,7 +446,8 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         if nnz == 0:
             tail = () if vec_rhs else tuple(rd.shape[1:])
             if transpose_a:
-                return zeros("row_sparse", (ncols,) + tail)
+                return zeros("row_sparse", (ncols,) + tail,
+                             dtype=vals.dtype)
             return _wrap(jnp.zeros((nrows,) + tail, dtype=vals.dtype))
         rows = _csr_row_of_nnz(lhs._aux["indptr"], nnz)
         if not transpose_a:
@@ -545,32 +546,69 @@ def _rows_and_grad(grad, rescale_grad, clip_gradient):
     return rows, g
 
 
+def _row_view(x, rows):
+    """``(values_at_rows, write)`` for global row ids ``rows`` of ``x``.
+
+    ``x`` may be a dense NDArray (direct gather/scatter) or a
+    RowSparseNDArray (kvstore keeps server-side weights/states
+    row_sparse): its compact block is grown with zero rows for ids not
+    yet present, so missing rows read as implicit zeros and updates to
+    them materialize — the reference's FComputeEx rsp-weight kernels
+    behave the same way."""
+    jnp = _jnp()
+    if isinstance(x, RowSparseNDArray):
+        rows_np = _np.asarray(rows)
+        idx_np = _np.asarray(x._aux["indices"])
+        union = _np.union1d(idx_np, rows_np)
+        if union.shape[0] != idx_np.shape[0]:
+            block = jnp.zeros((union.shape[0],) + x._data.shape[1:],
+                              x._data.dtype)
+            if idx_np.shape[0]:
+                block = block.at[
+                    jnp.asarray(_np.searchsorted(union, idx_np))].set(
+                    x._data)
+            x._aux = dict(x._aux,
+                          indices=jnp.asarray(union, jnp.int32))
+            x._set_data(block)
+        else:
+            block = x._data
+        pos = jnp.asarray(_np.searchsorted(union, rows_np))
+    else:
+        block = x._data
+        pos = rows
+
+    def write(new_vals):
+        x._set_data(block.at[pos].set(new_vals))
+
+    return block[pos], write
+
+
 def sgd_update(weight, grad, out=None, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True, **kw):
     """Row-lazy SGD: only rows present in the row_sparse grad are touched
     (matches reference lazy_update semantics: wd applies to touched rows)."""
     assert isinstance(grad, RowSparseNDArray)
+    if out is not None and out is not weight:
+        raise MXNetError("lazy sparse updates write in place (out=weight)")
     rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
-    w = weight._data
-    wr = w[rows]
-    new_rows = wr - lr * (g + wd * wr)
-    out = out if out is not None else weight
-    out._set_data(w.at[rows].set(new_rows))
-    return out
+    wr, write_w = _row_view(weight, rows)
+    write_w(wr - lr * (g + wd * wr))
+    return weight
 
 
 def sgd_mom_update(weight, grad, mom, out=None, lr=0.01, momentum=0.0,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    lazy_update=True, **kw):
     assert isinstance(grad, RowSparseNDArray)
+    if out is not None and out is not weight:
+        raise MXNetError("lazy sparse updates write in place (out=weight)")
     rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
-    w, m = weight._data, mom._data
-    wr, mr = w[rows], m[rows]
+    wr, write_w = _row_view(weight, rows)
+    mr, write_m = _row_view(mom, rows)
     new_m = momentum * mr - lr * (g + wd * wr)
-    mom._set_data(m.at[rows].set(new_m))
-    out = out if out is not None else weight
-    out._set_data(w.at[rows].set(wr + new_m))
-    return out
+    write_m(new_m)
+    write_w(wr + new_m)
+    return weight
 
 
 def adam_update(weight, grad, mean, var, out=None, lr=0.001, beta1=0.9,
@@ -578,15 +616,16 @@ def adam_update(weight, grad, mean, var, out=None, lr=0.001, beta1=0.9,
                 clip_gradient=-1.0, lazy_update=True, **kw):
     jnp = _jnp()
     assert isinstance(grad, RowSparseNDArray)
+    if out is not None and out is not weight:
+        raise MXNetError("lazy sparse updates write in place (out=weight)")
     rows, g = _rows_and_grad(grad, rescale_grad, clip_gradient)
-    w, m, v = weight._data, mean._data, var._data
-    wr = w[rows]
+    wr, write_w = _row_view(weight, rows)
+    mr, write_m = _row_view(mean, rows)
+    vr, write_v = _row_view(var, rows)
     g = g + wd * wr
-    new_m = beta1 * m[rows] + (1 - beta1) * g
-    new_v = beta2 * v[rows] + (1 - beta2) * jnp.square(g)
-    mean._set_data(m.at[rows].set(new_m))
-    var._set_data(v.at[rows].set(new_v))
-    out = out if out is not None else weight
-    out._set_data(w.at[rows].set(
-        wr - lr * new_m / (jnp.sqrt(new_v) + epsilon)))
-    return out
+    new_m = beta1 * mr + (1 - beta1) * g
+    new_v = beta2 * vr + (1 - beta2) * jnp.square(g)
+    write_m(new_m)
+    write_v(new_v)
+    write_w(wr - lr * new_m / (jnp.sqrt(new_v) + epsilon))
+    return weight

@@ -1,0 +1,266 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/`` — ``quantize.cc``,
+``quantize_v2.cc``, ``dequantize.cc``, ``requantize.cc``,
+``quantized_fully_connected.cc``, ``quantized_conv.cc``,
+``quantized_pooling.cc``, ``quantized_flatten.cc`` (SURVEY.md §2.1
+"Operator library" quantization/ and §2.2 "Quantization").
+
+TPU-native design:
+
+* Quantized matmul/conv lower to ``lax.dot_general`` /
+  ``lax.conv_general_dilated`` with int8 operands and
+  ``preferred_element_type=int32`` — the MXU executes s8×s8→s32 natively,
+  so there is no cuDNN-int8/oneDNN bridge to replicate: the same XLA op
+  that serves the fp32 path serves the int8 path at double the MAC rate.
+* Quantization is **symmetric** for int8 (zero-point 0, scale
+  ``127 / max|x|``), matching the reference's GPU int8 path; uint8
+  (affine, zero-point 0 at ``min==0``) is supported for quantize/
+  dequantize only.
+* Every quantized op follows the reference calling convention: inputs are
+  ``(qdata..., min..., max...)`` triples and outputs are
+  ``(qout, out_min, out_max)`` so graphs thread value ranges alongside
+  the int tensors.  ``dequantize(qout, out_min, out_max)`` always recovers
+  the float value — int32 accumulator outputs report the range
+  ``±INT32_MAX / (scale_lhs * scale_rhs)`` exactly like the reference's
+  ``quantization_range_for_multiplication``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+from ..base import MXNetError
+
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax
+    return jax.lax
+
+
+def _real_range(min_range, max_range):
+    jnp = _j()
+    return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+
+
+def _q_range(out_type):
+    if out_type == "int8":
+        return 127.0
+    if out_type == "uint8":
+        return 255.0
+    if out_type == "int32":
+        return _INT32_MAX
+    raise MXNetError("unsupported quantized dtype %r" % (out_type,))
+
+
+@register("_contrib_quantize", num_outputs=3, no_grad=True,
+          aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="uint8", **kw):
+    """Quantize float → int8/uint8 given an explicit range
+    (reference: ``quantize.cc``)."""
+    jnp = _j()
+    if out_type == "int8":
+        r = _real_range(min_range, max_range)
+        scale = 127.0 / jnp.maximum(r, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+        return q, -r, r
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(max_range - min_range, 1e-30)
+        q = jnp.clip(jnp.round((data - min_range) * scale), 0, 255)
+        return q.astype(jnp.uint8), min_range, max_range
+    raise MXNetError("quantize: out_type must be int8/uint8")
+
+
+@register("_contrib_quantize_v2", num_outputs=3, no_grad=True,
+          aliases=("quantize_v2",))
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None, **kw):
+    """Quantize with a calibrated or data-derived range
+    (reference: ``quantize_v2.cc``)."""
+    jnp = _j()
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(float(min_calib_range))
+        mx = jnp.asarray(float(max_calib_range))
+    return quantize(data, mn, mx,
+                    out_type=("int8" if out_type == "auto" else out_type))
+
+
+@register("_contrib_dequantize", no_grad=True, aliases=("dequantize",))
+def dequantize(qdata, min_range, max_range, out_type="float32", **kw):
+    """Int → float (reference: ``dequantize.cc``)."""
+    jnp = _j()
+    if qdata.dtype == jnp.uint8:
+        scale = (max_range - min_range) / 255.0
+        return qdata.astype(jnp.float32) * scale + min_range
+    qrange = 127.0 if qdata.dtype == jnp.int8 else _INT32_MAX
+    r = _real_range(min_range, max_range)
+    return qdata.astype(jnp.float32) * (r / qrange)
+
+
+@register("_contrib_requantize", num_outputs=3, no_grad=True,
+          aliases=("requantize",))
+def requantize(qdata, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, **kw):
+    """Int32 accumulator → int8, with calibrated or runtime-computed range
+    (reference: ``requantize.cc``)."""
+    jnp = _j()
+    r_in = _real_range(min_range, max_range)
+    fdata = qdata.astype(jnp.float32) * (r_in / _INT32_MAX)
+    if min_calib_range is not None and max_calib_range is not None:
+        r_out = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        r_out = jnp.asarray(r_out)
+    else:
+        r_out = jnp.maximum(jnp.max(jnp.abs(fdata)), 1e-30)
+    q = jnp.clip(jnp.round(fdata * (127.0 / r_out)), -127, 127)
+    return q.astype(jnp.int8), -r_out, r_out
+
+
+def _mul_out_range(min_a, max_a, min_b, max_b):
+    """Output range of an s8×s8→s32 product chain: the int32 value equals
+    ``float * scale_a * scale_b``, so reporting ``±INT32_MAX/(sa*sb)``
+    makes ``dequantize`` exact (reference:
+    ``quantization_range_for_multiplication``)."""
+    jnp = _j()
+    ra = _real_range(min_a, max_a)
+    rb = _real_range(min_b, max_b)
+    sa = 127.0 / jnp.maximum(ra, 1e-30)
+    sb = 127.0 / jnp.maximum(rb, 1e-30)
+    r_out = _INT32_MAX / (sa * sb)
+    return -r_out, r_out, sa * sb
+
+
+def _check_int8(name, *arrs):
+    jnp = _j()
+    for a in arrs:
+        if a is not None and a.dtype != jnp.int8:
+            raise MXNetError("%s requires int8 inputs (got %s); quantize "
+                             "with out_type='int8'" % (name, a.dtype))
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3, no_grad=True,
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias=None, min_data=None,
+                              max_data=None, min_weight=None,
+                              max_weight=None, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False,
+                              flatten=True, **kw):
+    """Int8 FullyConnected with int32 accumulation on the MXU
+    (reference: ``quantized_fully_connected.cc``)."""
+    jnp = _j()
+    lax = _lax()
+    if no_bias and min_bias is None and bias is not None:
+        # arity without bias: (data, weight, min_d, max_d, min_w, max_w)
+        data, weight, min_data, max_data, min_weight, max_weight = (
+            data, weight, bias, min_data, max_data, min_weight)
+        bias = None
+    _check_int8("quantized_fully_connected", data, weight)
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    mn, mx, scale_out = _mul_out_range(min_data, max_data,
+                                       min_weight, max_weight)
+    if bias is not None and not no_bias:
+        # re-scale int8 bias into the int32 accumulator's scale
+        rb = _real_range(min_bias, max_bias)
+        bias_f = bias.astype(jnp.float32) * (rb / 127.0)
+        out = out + jnp.round(bias_f * scale_out).astype(jnp.int32)
+    return out, mn, mx
+
+
+@register("_contrib_quantized_conv", num_outputs=3, no_grad=True,
+          aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None,
+                   min_bias=None, max_bias=None, kernel=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=None,
+                   num_group=1, no_bias=False, layout="NCHW", **kw):
+    """Int8 convolution with int32 accumulation (reference:
+    ``quantized_conv.cc``).  NCHW in/out; XLA re-tiles for the MXU."""
+    jnp = _j()
+    lax = _lax()
+    if no_bias and min_bias is None and bias is not None:
+        data, weight, min_data, max_data, min_weight, max_weight = (
+            data, weight, bias, min_data, max_data, min_weight)
+        bias = None
+    _check_int8("quantized_conv", data, weight)
+    nd_spatial = data.ndim - 2
+    stride = tuple(stride)[:nd_spatial] or (1,) * nd_spatial
+    pad = tuple(pad)[:nd_spatial] or (0,) * nd_spatial
+    dilate = tuple(dilate)[:nd_spatial] or (1,) * nd_spatial
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd_spatial == 2
+        else ("NCW", "OIW", "NCW"))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    mn, mx, scale_out = _mul_out_range(min_data, max_data,
+                                       min_weight, max_weight)
+    if bias is not None and not no_bias:
+        rb = _real_range(min_bias, max_bias)
+        bias_f = bias.astype(jnp.float32) * (rb / 127.0)
+        bias32 = jnp.round(bias_f * scale_out).astype(jnp.int32)
+        out = out + bias32.reshape((1, -1) + (1,) * nd_spatial)
+    return out, mn, mx
+
+
+@register("_contrib_quantized_pooling", num_outputs=3, no_grad=True,
+          aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=None, pool_type="max",
+                      stride=None, pad=None, global_pool=False, **kw):
+    """Pooling straight on int8 (max) or via int32 mean (avg); range is
+    unchanged (reference: ``quantized_pooling.cc``)."""
+    jnp = _j()
+    lax = _lax()
+    nd_spatial = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd_spatial
+        pad = (0,) * nd_spatial
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else kernel
+    pad = tuple(pad) if pad else (0,) * nd_spatial
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        out = lax.reduce_window(data, jnp.iinfo(jnp.int8).min, lax.max,
+                                dims, strides, padding)
+    elif pool_type == "avg":
+        s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
+                              dims, strides, padding)
+        out = jnp.round(s / float(_np.prod(kernel))).astype(jnp.int8)
+    else:
+        raise MXNetError("quantized_pooling: pool_type must be max/avg")
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, no_grad=True,
+          aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data, **kw):
+    return data.reshape((data.shape[0], -1)), min_data, max_data
+
+
+@register("_contrib_quantized_act", num_outputs=3, no_grad=True,
+          aliases=("quantized_act",))
+def quantized_act(data, min_data, max_data, act_type="relu", **kw):
+    """Int8 relu: clamp at zero, range unchanged (reference:
+    ``quantized_activation.cc``)."""
+    jnp = _j()
+    if act_type != "relu":
+        raise MXNetError("quantized_act supports relu only")
+    return jnp.maximum(data, 0), min_data, max_data

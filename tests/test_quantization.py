@@ -1,0 +1,160 @@
+"""INT8 quantization tests (reference: tests/python/quantization/
+test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_int8_roundtrip():
+    np.random.seed(0)
+    x = np.random.uniform(-3, 3, (4, 7)).astype(np.float32)
+    a = nd.array(x)
+    q, mn, mx_ = nd.quantize_v2(a, out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.dequantize(q, mn, mx_)
+    # max quantization error is half a level: range/127/2
+    r = np.max(np.abs(x))
+    assert np.max(np.abs(back.asnumpy() - x)) <= r / 127.0 + 1e-6
+
+
+def test_quantize_uint8():
+    x = np.random.uniform(0, 5, (3, 5)).astype(np.float32)
+    a = nd.array(x)
+    q, mn, mx_ = nd.quantize(a, nd.array(0.0), nd.array(5.0),
+                             out_type="uint8")
+    assert q.dtype == np.uint8
+    back = nd.dequantize(q, mn, mx_)
+    assert np.max(np.abs(back.asnumpy() - x)) <= 5.0 / 255.0 + 1e-6
+
+
+def test_quantized_fully_connected_matches_fp32():
+    np.random.seed(1)
+    x = np.random.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 16)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+
+    ref = x @ w.T + b
+
+    qx, mnx, mxx = nd.quantize_v2(nd.array(x), out_type="int8")
+    qw, mnw, mxw = nd.quantize_v2(nd.array(w), out_type="int8")
+    qb, mnb, mxb = nd.quantize_v2(nd.array(b), out_type="int8")
+    out32, mno, mxo = nd.quantized_fully_connected(
+        qx, qw, qb, mnx, mxx, mnw, mxw, mnb, mxb, num_hidden=4)
+    assert out32.dtype == np.int32
+    out = nd.dequantize(out32, mno, mxo).asnumpy()
+    # int8 quantization of both operands: ~1% relative error on this scale
+    assert np.max(np.abs(out - ref)) < 0.1
+
+
+def test_quantized_conv_matches_fp32():
+    np.random.seed(2)
+    x = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (5, 3, 3, 3)).astype(np.float32)
+
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=5, no_bias=True).asnumpy()
+
+    qx, mnx, mxx = nd.quantize_v2(nd.array(x), out_type="int8")
+    qw, mnw, mxw = nd.quantize_v2(nd.array(w), out_type="int8")
+    out32, mno, mxo = nd.quantized_conv(
+        qx, qw, mnx, mxx, mnw, mxw, kernel=(3, 3), num_filter=5,
+        no_bias=True)
+    out = nd.dequantize(out32, mno, mxo).asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.2
+
+
+def test_requantize_int32_to_int8():
+    x = np.random.uniform(-2, 2, (6, 6)).astype(np.float32)
+    q, mn, mx_ = nd.quantize_v2(nd.array(x), out_type="int8")
+    # promote to an int32 "accumulator" with the int32 range convention
+    q32 = q.astype("int32") * (2 ** 24)
+    r = float(mx_.asnumpy())
+    mn32 = nd.array(-r * (2 ** 31 - 1) / (127.0 * 2 ** 24))
+    mx32 = nd.array(r * (2 ** 31 - 1) / (127.0 * 2 ** 24))
+    q8, mn8, mx8 = nd.requantize(q32, mn32, mx32)
+    back = nd.dequantize(q8, mn8, mx8).asnumpy()
+    assert np.max(np.abs(back - x)) < r / 127.0 * 2 + 1e-5
+
+
+def test_optimal_threshold_kl():
+    # a gaussian with a lone outlier: KL threshold should clip the outlier
+    np.random.seed(3)
+    arr = np.random.normal(0, 1, 20000)
+    arr = np.concatenate([arr, [40.0]])
+    coll = qz.LayerHistogramCollector()
+    coll.collect("x", arr)
+    (lo, hi), = [coll.thresholds()["x"]]
+    assert hi < 20.0  # outlier clipped
+    assert hi > 2.0   # bulk preserved
+
+
+def test_minmax_collector():
+    coll = qz.LayerOutputMinMaxCollector()
+    coll.collect("x", np.array([-1.0, 2.0]))
+    coll.collect("x", np.array([-3.0, 1.0]))
+    assert coll.thresholds()["x"] == (-3.0, 2.0)
+
+
+def _small_mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return fc2
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_model_end_to_end(calib_mode):
+    np.random.seed(4)
+    sym = _small_mlp_symbol()
+    args = {
+        "fc1_weight": nd.array(np.random.uniform(-1, 1, (16, 8))
+                               .astype(np.float32)),
+        "fc1_bias": nd.array(np.zeros(16, np.float32)),
+        "fc2_weight": nd.array(np.random.uniform(-1, 1, (4, 16))
+                               .astype(np.float32)),
+        "fc2_bias": nd.array(np.zeros(4, np.float32)),
+    }
+    x = np.random.uniform(-1, 1, (32, 8)).astype(np.float32)
+
+    fp_exe = sym.bind(ctx=mx.cpu(), args={**args, "data": nd.array(x)},
+                      grad_req="null")
+    ref = fp_exe.forward(is_train=False)[0].asnumpy()
+
+    qsym, qargs, qaux = qz.quantize_model(
+        sym, args, {}, data_names=("data",), ctx=mx.cpu(),
+        calib_mode=calib_mode, calib_data=nd.array(x),
+        quantized_dtype="int8")
+
+    # offline weights became int8 params
+    assert any(k.endswith("_quantize") for k in qargs)
+    qexe = qsym.bind(ctx=mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    out = qexe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == ref.shape
+    # int8 end-to-end: loose tolerance, but must track fp32 closely
+    err = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-6)
+    assert err < 0.1, "relative error %.3f too high (mode=%s)" \
+        % (err, calib_mode)
+
+
+def test_quantize_model_excluded_layer():
+    sym = _small_mlp_symbol()
+    args = {
+        "fc1_weight": nd.array(np.random.uniform(-1, 1, (16, 8))
+                               .astype(np.float32)),
+        "fc1_bias": nd.array(np.zeros(16, np.float32)),
+        "fc2_weight": nd.array(np.random.uniform(-1, 1, (4, 16))
+                               .astype(np.float32)),
+        "fc2_bias": nd.array(np.zeros(4, np.float32)),
+    }
+    qsym, qargs, _ = qz.quantize_model(
+        sym, args, {}, calib_mode="none",
+        excluded_sym_names=("fc2",), ctx=mx.cpu())
+    # fc2 stays fp32: its weight must NOT be quantized
+    assert "fc2_weight" in qargs
+    assert not any(k.startswith("fc2_weight_quantize") for k in qargs)
+    assert any(k.startswith("fc1_weight_quantize") for k in qargs)

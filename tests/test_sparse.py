@@ -272,3 +272,73 @@ class TestReviewRegressions:
         b = a.tostype("default")
         b += 1
         np.testing.assert_allclose(a.asnumpy(), np.ones((2, 2)))
+
+
+class TestSparseWeightUpdates:
+    """Regressions: lazy optimizer updates on a row_sparse *weight*
+    (kvstore server-side update path) must touch the right global rows."""
+
+    def test_kvstore_optimizer_updates_rowsparse_weight(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.ndarray import sparse
+        store = mx.kv.create("local")
+        w0 = sparse.row_sparse_array(
+            (np.ones((4, 3), np.float32), np.arange(4, dtype=np.int32)),
+            shape=(4, 3))
+        store.init("w", w0)
+        store.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        grad = sparse.row_sparse_array(
+            (np.ones((1, 3), np.float32), np.array([1], np.int32)),
+            shape=(4, 3))
+        store.push("w", grad)
+        out = sparse.zeros("row_sparse", (4, 3))
+        store.row_sparse_pull("w", out=out, row_ids=nd.array([1]))
+        np.testing.assert_allclose(out.asnumpy()[1], 0.9 * np.ones(3),
+                                   rtol=1e-5)
+        # untouched row stays 1.0
+        store.row_sparse_pull("w", out=out, row_ids=nd.array([2]))
+        np.testing.assert_allclose(out.asnumpy()[2], np.ones(3), rtol=1e-6)
+
+    def test_lazy_update_grows_rowsparse_weight(self):
+        from mxnet_tpu.ndarray import sparse
+        # weight has rows {0}; grad touches row 2 (implicit zero row)
+        w = sparse.row_sparse_array(
+            (np.ones((1, 2), np.float32), np.array([0], np.int32)),
+            shape=(3, 2))
+        g = sparse.row_sparse_array(
+            (np.ones((1, 2), np.float32), np.array([2], np.int32)),
+            shape=(3, 2))
+        sparse.sgd_update(w, g, lr=0.5)
+        dense = w.asnumpy()
+        np.testing.assert_allclose(dense[0], np.ones(2))
+        np.testing.assert_allclose(dense[2], -0.5 * np.ones(2))
+
+    def test_row_sparse_pull_list_row_ids_single_key(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.ndarray import sparse
+        store = mx.kv.create("local")
+        store.init("w", nd.array(np.arange(12, dtype=np.float32)
+                                 .reshape(4, 3)))
+        out = sparse.zeros("row_sparse", (4, 3))
+        store.row_sparse_pull("w", out=out, row_ids=[1, 3])
+        got = np.asarray(out.indices.asnumpy())
+        np.testing.assert_array_equal(np.sort(got), [1, 3])
+
+    def test_push_rowsparse_into_csr_key_raises(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.ndarray import sparse
+        store = mx.kv.create("local")
+        store.init("c", sparse.zeros("csr", (4, 3)))
+        grad = sparse.row_sparse_array(
+            (np.ones((1, 3), np.float32), np.array([1], np.int32)),
+            shape=(4, 3))
+        with pytest.raises(MXNetError):
+            store.push("c", grad)
+
+    def test_empty_csr_dot_transpose_keeps_dtype(self):
+        from mxnet_tpu.ndarray import sparse
+        csr = sparse.zeros("csr", (3, 4), dtype="bfloat16")
+        rhs = nd.ones((3, 2), dtype="bfloat16")
+        out = sparse.dot(csr, rhs, transpose_a=True)
+        assert str(out.dtype) == "bfloat16"
