@@ -1,0 +1,7 @@
+"""``gluon.contrib`` — estimator fit-loop and contrib layers.
+
+Reference: ``python/mxnet/gluon/contrib/`` (SURVEY.md §2.2 "Gluon layers"
+row: "gluon/contrib/ (estimator fit-loop w/ event handlers)").
+"""
+from . import estimator
+from . import nn

@@ -1,0 +1,6 @@
+"""Contrib layers (reference: ``gluon/contrib/nn/basic_layers.py``)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm, PixelShuffle2D)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]

@@ -1,0 +1,130 @@
+"""Contrib layers.
+
+Reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py`` —
+``Concurrent``, ``HybridConcurrent``, ``Identity``, ``SparseEmbedding``,
+``SyncBatchNorm``, ``PixelShuffle*D`` (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import BatchNorm, Embedding, HybridSequential, \
+    Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs on ``axis``
+    (reference: ``contrib.nn.Concurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable :class:`Concurrent` (reference:
+    ``contrib.nn.HybridConcurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward_raw(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, useful in :class:`HybridConcurrent` skip
+    branches (reference: ``contrib.nn.Identity``)."""
+
+    def forward_raw(self, x):
+        return x
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose gradient is ``row_sparse`` (reference:
+    ``contrib.nn.SparseEmbedding``); pairs with kvstore
+    ``row_sparse_pull`` for large vocabularies."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._inner = Embedding(input_dim, output_dim, dtype=dtype,
+                                sparse_grad=True)
+        self.register_child(self._inner)
+
+    def forward(self, x):
+        return self._inner(x)
+
+    def __repr__(self):
+        return repr(self._inner).replace("Embedding", "SparseEmbedding", 1)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference:
+    ``contrib.nn.SyncBatchNorm``, backed by NCCL-style key comm).
+
+    TPU-native: inside ``pjit``/``shard_map`` the batch axis is a mesh
+    axis and XLA computes batch statistics with a ``psum`` over it, so a
+    sharded ``BatchNorm`` is *already* synchronized — this subclass
+    exists for API parity and documents that ``num_devices`` has no
+    effect under GSPMD.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         in_channels=in_channels, **kwargs)
+        self.num_devices = num_devices
+
+
+class PixelShuffle2D(HybridBlock):
+    """Rearrange ``(N, C*f1*f2, H, W)`` → ``(N, C, H*f1, W*f2)``
+    (reference: ``contrib.nn.PixelShuffle2D``)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            if len(self._factors) != 2:
+                raise MXNetError("factor must be int or pair")
+
+    def forward_raw(self, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        if c % (f1 * f2):
+            raise MXNetError("channels %d not divisible by %d" %
+                             (c, f1 * f2))
+        co = c // (f1 * f2)
+        out = nd.reshape(x, (n, co, f1, f2, h, w))
+        out = nd.transpose(out, (0, 1, 4, 2, 5, 3))
+        return nd.reshape(out, (n, co, h * f1, w * f2))
+
+    def hybrid_forward(self, F, x):
+        return self.forward_raw(x)
+
+    def __repr__(self):
+        return "PixelShuffle2D(%s)" % (self._factors,)

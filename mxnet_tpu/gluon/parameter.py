@@ -52,6 +52,7 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
+        self._grad_stype = grad_stype
         self._data: Optional[Dict[Context, NDArray]] = None
         self._grad: Optional[Dict[Context, NDArray]] = None
         self._deferred_init = ()
@@ -164,6 +165,25 @@ class Parameter:
     def list_data(self):
         self._check_initialized()
         return list(self._data.values())
+
+    @property
+    def grad_stype(self):
+        """Declared gradient storage type.
+
+        TPU-native divergence: XLA computes the embedding backward as a
+        dense scatter-add, so the dense buffer stays the source of truth
+        and ``grad()`` returns it (writable, identity-stable for
+        allreduce/clipping).  ``grad_stype='row_sparse'`` (reference:
+        Embedding ``sparse_grad=True``) takes effect in
+        ``Trainer._update``, which converts the reduced grad once per
+        step and runs the reference's row-lazy optimizer update."""
+        return self._grad_stype
+
+    @grad_stype.setter
+    def grad_stype(self, v):
+        if v not in ("default", "row_sparse"):
+            raise MXNetError("grad_stype must be default/row_sparse")
+        self._grad_stype = v
 
     def grad(self, ctx=None) -> NDArray:
         if self._grad is None:
@@ -314,6 +334,13 @@ class ParameterDict:
             self._params[name] = param
         else:
             for k, v in kwargs.items():
+                if k == "grad_stype":
+                    # like a fresh Parameter: the requesting layer's
+                    # declaration wins (reference asserts consistency;
+                    # 'default' is the unset value here)
+                    if v != "default" and param.grad_stype != v:
+                        param.grad_stype = v
+                    continue
                 if hasattr(param, k) and getattr(param, k) is not None:
                     existing = getattr(param, k)
                     if k == "shape" and v is not None:

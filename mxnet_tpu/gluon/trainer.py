@@ -151,11 +151,17 @@ class Trainer:
                 continue
             datas = param.list_data()
             grads = param.list_grad()
+            g0 = grads[0]
+            if getattr(param, "grad_stype", "default") == "row_sparse":
+                # sparse_grad path: one conversion per step, after the
+                # allreduce, feeding the optimizer's row-lazy update
+                from ..ndarray import sparse as _sparse
+                g0 = _sparse.cast_storage(g0, "row_sparse")
             if len(datas) == 1:
-                self._updaters[0](i, grads[0], datas[0])
+                self._updaters[0](i, g0, datas[0])
             else:
                 # multi-context: update replica 0, broadcast
-                self._updaters[0](i, grads[0], datas[0])
+                self._updaters[0](i, g0, datas[0])
                 for d in datas[1:]:
                     datas[0].copyto(d)
 

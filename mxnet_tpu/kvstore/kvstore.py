@@ -118,10 +118,14 @@ class KVStore:
             src = self._data[k]
             for o in olist:
                 if src.stype != "default":
-                    src.copyto(o)  # densifies when o is dense
-                    if o.context != src.context:
-                        o._set_data(_jax().device_put(
-                            o._data, o.context.jax_device))
+                    dst_ctx = o.context  # before copyto swaps o's buffers
+                    src.copyto(o)        # densifies when o is dense
+                    if dst_ctx != src.context:
+                        dev = dst_ctx.jax_device
+                        o._set_data(_jax().device_put(o._data, dev))
+                        if hasattr(o, "_aux"):
+                            o._aux = {k: _jax().device_put(v, dev)
+                                      for k, v in o._aux.items()}
                 else:
                     o._set_data(src.as_in_context(o.context)._data)
 

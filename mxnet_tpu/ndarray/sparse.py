@@ -384,15 +384,18 @@ def cast_storage(arr, stype):
         return arr.todense()
     if cur != "default":
         return cast_storage(arr.todense(), stype)
-    dense_np = arr.asnumpy()
     if stype == "row_sparse":
-        reduce_axes = tuple(range(1, dense_np.ndim))
-        nz = _np.nonzero(_np.abs(dense_np).sum(axis=reduce_axes)
-                         if reduce_axes else dense_np)[0]
-        data = jnp.asarray(dense_np[nz])
+        # row mask reduces on device; only the (nrows,) bool vector
+        # crosses to host, the row gather stays on device
+        d = arr._data
+        mask = jnp.any(d != 0, axis=tuple(range(1, d.ndim))) \
+            if d.ndim > 1 else d != 0
+        nz = _np.nonzero(_np.asarray(mask))[0]
+        data = d[jnp.asarray(nz)]
         return RowSparseNDArray(data,
                                 {"indices": jnp.asarray(nz, jnp.int32)},
-                                dense_np.shape)
+                                tuple(d.shape))
+    dense_np = arr.asnumpy()
     if stype == "csr":
         if dense_np.ndim != 2:
             raise MXNetError("csr requires 2-D")

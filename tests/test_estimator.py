@@ -1,0 +1,228 @@
+"""Estimator + contrib layer tests (reference:
+tests/python/unittest/test_gluon_estimator.py, test_gluon_contrib.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as contrib_nn
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, EpochEnd, Estimator,
+    LoggingHandler, StoppingHandler)
+
+
+def _dataset(n=256, dim=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim, classes)
+    X = rng.randn(n, dim).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return X, y
+
+
+def _loader(X, y, batch=64):
+    for i in range(0, len(X), batch):
+        yield nd.array(X[i:i + batch]), nd.array(y[i:i + batch])
+
+
+class _ListLoader:
+    """Re-iterable loader (generator exhausts after one epoch)."""
+
+    def __init__(self, X, y, batch=64):
+        self.batches = list(_loader(X, y, batch))
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+def _net(classes=3):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    return net
+
+
+def test_estimator_fit_improves_accuracy():
+    X, y = _dataset()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}),
+                    context=mx.cpu())
+    est.fit(_ListLoader(X, y), epochs=10)
+    name, acc = est.train_metrics[0].get()
+    assert acc > 0.8, (name, acc)
+    # loss metric populated
+    _, lv = est.train_loss_metric.get()
+    assert np.isfinite(lv)
+
+
+def test_estimator_validation_and_early_stopping():
+    X, y = _dataset()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    context=mx.cpu())
+    early = EarlyStoppingHandler(monitor=est.val_metrics[0], patience=2)
+    est.fit(_ListLoader(X, y), val_data=_ListLoader(X, y), epochs=50,
+            event_handlers=[early])
+    # must have stopped long before 50 epochs on a non-improving metric
+    assert early.current_epoch < 50
+
+
+def test_estimator_max_batches():
+    X, y = _dataset()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    context=mx.cpu())
+    stop = StoppingHandler(max_batch=3)
+    est.fit(_ListLoader(X, y), batches=3, event_handlers=[stop])
+    assert stop.current_batch == 3
+
+
+def test_estimator_checkpoint(tmp_path):
+    X, y = _dataset()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    context=mx.cpu())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="m",
+                             monitor=est.train_metrics[0], save_best=True)
+    est.fit(_ListLoader(X, y), epochs=2, event_handlers=[ckpt])
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("m-epoch") and f.endswith(".params")
+               for f in files), files
+    assert "m-best.params" in files
+    # roundtrip: load best params into a fresh net
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "m-best.params"), ctx=mx.cpu())
+    xa = nd.array(X[:4])
+    np.testing.assert_allclose(net(xa).asnumpy(), net2(xa).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_custom_event_handler():
+    X, y = _dataset()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    context=mx.cpu())
+
+    class CountEpochs(EpochEnd):
+        n = 0
+
+        def epoch_end(self, estimator, *a, **kw):
+            CountEpochs.n += 1
+
+    est.fit(_ListLoader(X, y), epochs=3, event_handlers=[CountEpochs()])
+    assert CountEpochs.n == 3
+
+
+# ---------------------------------------------------------------------------
+# contrib layers
+# ---------------------------------------------------------------------------
+
+def test_hybrid_concurrent_and_identity():
+    blk = contrib_nn.HybridConcurrent(axis=1)
+    with blk.name_scope():
+        blk.add(nn.Dense(4))
+        blk.add(nn.Dense(4))
+        blk.add(contrib_nn.Identity())
+    blk.initialize(ctx=mx.cpu())
+    x = nd.ones((2, 4))
+    out = blk(x)
+    assert out.shape == (2, 12)
+    np.testing.assert_allclose(out.asnumpy()[:, 8:], np.ones((2, 4)))
+
+
+def test_concurrent():
+    blk = contrib_nn.Concurrent(axis=1)
+    with blk.name_scope():
+        blk.add(nn.Dense(3), contrib_nn.Identity())
+    blk.initialize(ctx=mx.cpu())
+    out = blk(nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+
+
+def test_pixelshuffle2d():
+    x = nd.array(np.arange(2 * 8 * 3 * 3, dtype=np.float32)
+                 .reshape(2, 8, 3, 3))
+    out = contrib_nn.PixelShuffle2D(2)(x)
+    assert out.shape == (2, 2, 6, 6)
+    # torch-style check: block (0,0) of channel 0 comes from channels 0..3
+    xn = x.asnumpy()
+    on = out.asnumpy()
+    assert on[0, 0, 0, 0] == xn[0, 0, 0, 0]
+    assert on[0, 0, 0, 1] == xn[0, 1, 0, 0]
+    assert on[0, 0, 1, 0] == xn[0, 2, 0, 0]
+    assert on[0, 0, 1, 1] == xn[0, 3, 0, 0]
+
+
+def test_sparse_embedding_lazy_update():
+    """sparse_grad=True routes through the row-lazy optimizer update:
+    with wd > 0 only rows seen in the batch change (reference
+    lazy_update semantics); dense grads would decay every row."""
+    from mxnet_tpu import autograd
+    emb = contrib_nn.SparseEmbedding(10, 4)
+    emb.initialize(mx.initializer.One(), ctx=mx.cpu())
+    params = emb.collect_params()
+    assert list(params.values())[0].grad_stype == "row_sparse"
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "wd": 0.5})
+    x = nd.array(np.array([1, 3], np.float32))
+    with autograd.record():
+        loss = emb(x).sum()
+    loss.backward()
+    trainer.step(2)
+    w = list(params.values())[0].data().asnumpy()
+    assert not np.allclose(w[1], 1.0)  # touched rows updated
+    np.testing.assert_allclose(w[0], 1.0)  # untouched: no decay (lazy)
+    np.testing.assert_allclose(w[5], 1.0)
+
+
+def test_estimator_fit_zero_epochs_returns():
+    X, y = _dataset(n=64)
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    context=mx.cpu())
+    est.fit(_ListLoader(X, y), epochs=0)   # must not hang
+    est.fit(_ListLoader(X, y), batches=0)  # must not hang
+
+
+def test_checkpoint_resume(tmp_path):
+    X, y = _dataset(n=64)
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    context=mx.cpu())
+    est.fit(_ListLoader(X, y), epochs=2, event_handlers=[
+        CheckpointHandler(str(tmp_path), model_prefix="r")])
+    # fresh net resumes from the newest epoch checkpoint
+    net2 = _net()
+    est2 = Estimator(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     context=mx.cpu())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="r",
+                             resume_from_checkpoint=True, verbose=1)
+    est2.fit(_ListLoader(X, y), epochs=1, event_handlers=[ckpt])
+    assert ckpt.current_epoch >= 2  # resumed past the saved epochs
+
+
+def test_val_metrics_preserve_config():
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.TopKAccuracy(top_k=2),
+                    context=mx.cpu())
+    assert est.val_metrics[0].top_k == 2
+
+
+def test_syncbatchnorm_matches_batchnorm():
+    sbn = contrib_nn.SyncBatchNorm(in_channels=4)
+    bn = nn.BatchNorm(in_channels=4)
+    sbn.initialize(ctx=mx.cpu())
+    bn.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0)
+                 .randn(2, 4, 3, 3).astype(np.float32))
+    np.testing.assert_allclose(sbn(x).asnumpy(), bn(x).asnumpy(),
+                               rtol=1e-5, atol=1e-5)
