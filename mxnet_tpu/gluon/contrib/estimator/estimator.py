@@ -99,15 +99,25 @@ class Estimator:
 
     def evaluate(self, val_data, batch_axis=0, event_handlers=None):
         """Run validation, updating ``val_metrics`` +
-        ``val_loss_metric``."""
+        ``val_loss_metric``.  ``event_handlers`` get
+        ``batch_begin``/``batch_end`` per validation batch (reference
+        semantics)."""
+        handlers = list(event_handlers or [])
+        batch_begin = [h for h in handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in handlers if isinstance(h, BatchEnd)]
         for m in self.val_metrics:
             m.reset()
         self.val_loss_metric.reset()
         for batch in val_data:
+            for h in batch_begin:
+                h.batch_begin(self, batch=batch)
             _, label, pred, loss = self.evaluate_batch(batch, batch_axis)
             for m in self.val_metrics:
                 m.update(label, pred)
             self.val_loss_metric.update(0, loss)
+            for h in batch_end:
+                h.batch_end(self, batch=batch, pred=pred, label=label,
+                            loss=loss)
         if hasattr(val_data, "reset"):
             val_data.reset()
 
@@ -146,7 +156,9 @@ class Estimator:
         while not stop:
             for h in epoch_begin:
                 h.epoch_begin(self)
+            n_batches = 0
             for batch in train_data:
+                n_batches += 1
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
                 _, label, pred, loss = self.fit_batch(batch, batch_axis)
@@ -162,6 +174,14 @@ class Estimator:
                 for h in epoch_end:
                     if h.epoch_end(self):
                         stop = True
+            if n_batches == 0 and not stop:
+                # empty/exhausted loader (e.g. a one-shot generator): no
+                # handler can ever fire again — bail instead of spinning.
+                self.logger.warning(
+                    "fit: train_data yielded no batches this epoch and no "
+                    "stop condition fired; stopping to avoid an infinite "
+                    "loop (is train_data a one-shot generator?)")
+                stop = True
 
         for h in train_end:
             h.train_end(self)

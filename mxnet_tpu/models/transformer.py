@@ -23,8 +23,8 @@ import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
-__all__ = ["TransformerConfig", "init_params", "forward", "make_train_step",
-           "bert_base", "bert_tiny"]
+__all__ = ["TransformerConfig", "init_params", "forward",
+           "forward_with_aux", "make_train_step", "bert_base", "bert_tiny"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +45,17 @@ class TransformerConfig:
     # None = let GSPMD handle it; 'ring' = ring attention (ppermute K/V
     # blocks over ICI); 'ulysses' = all-to-all head scatter.
     seq_parallel: Optional[str] = None
+    # Mixture-of-Experts (expert parallel over the mesh's 'ep' axis):
+    # n_experts=0 → all-dense.  Layers with i % moe_every == moe_every-1
+    # swap their FFN for a top-k routed MoE (parallel/moe.py).
+    n_experts: int = 0
+    moe_every: int = 2
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # GPipe microbatch count when the mesh has a 'pp' axis
+    # (parallel/pipeline.py); ignored otherwise.
+    pp_microbatches: int = 2
 
 
 def bert_base(**kw):
@@ -97,15 +108,27 @@ def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
             "bo": jnp.zeros((D,), cfg.param_dtype),
             "ln1": {"g": jnp.ones((D,), cfg.param_dtype),
                     "b": jnp.zeros((D,), cfg.param_dtype)},
-            "w1": dense_init(k[4], (D, F)),
-            "b1": jnp.zeros((F,), cfg.param_dtype),
-            "w2": dense_init(k[5], (F, D)),
-            "b2": jnp.zeros((D,), cfg.param_dtype),
             "ln2": {"g": jnp.ones((D,), cfg.param_dtype),
                     "b": jnp.zeros((D,), cfg.param_dtype)},
         }
+        if _is_moe_layer(cfg, i):
+            from ..parallel.moe import init_moe_ffn
+            layer["moe"] = init_moe_ffn(k[6], D, F, cfg.n_experts,
+                                        param_dtype=cfg.param_dtype)
+        else:
+            layer.update({
+                "w1": dense_init(k[4], (D, F)),
+                "b1": jnp.zeros((F,), cfg.param_dtype),
+                "w2": dense_init(k[5], (F, D)),
+                "b2": jnp.zeros((D,), cfg.param_dtype),
+            })
         params["layers"].append(layer)
     return params
+
+
+def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
+    return (cfg.n_experts > 0
+            and i % cfg.moe_every == cfg.moe_every - 1)
 
 
 def param_shardings(cfg: TransformerConfig, mesh):
@@ -120,15 +143,23 @@ def param_shardings(cfg: TransformerConfig, mesh):
         return NamedSharding(mesh, P(*spec))
 
     rep = ns()
-    layer = {
-        "wq": ns(None, tp), "wk": ns(None, tp), "wv": ns(None, tp),
-        "wo": ns(tp, None),
-        "bq": ns(tp), "bk": ns(tp), "bv": ns(tp), "bo": rep,
-        "ln1": {"g": rep, "b": rep},
-        "w1": ns(None, tp), "b1": ns(tp),
-        "w2": ns(tp, None), "b2": rep,
-        "ln2": {"g": rep, "b": rep},
-    }
+
+    def layer_sharding(i):
+        layer = {
+            "wq": ns(None, tp), "wk": ns(None, tp), "wv": ns(None, tp),
+            "wo": ns(tp, None),
+            "bq": ns(tp), "bk": ns(tp), "bv": ns(tp), "bo": rep,
+            "ln1": {"g": rep, "b": rep},
+            "ln2": {"g": rep, "b": rep},
+        }
+        if _is_moe_layer(cfg, i):
+            from ..parallel.moe import moe_param_shardings
+            layer["moe"] = moe_param_shardings(mesh)
+        else:
+            layer.update({"w1": ns(None, tp), "b1": ns(tp),
+                          "w2": ns(tp, None), "b2": rep})
+        return layer
+
     return {
         "tok_emb": ns(None, tp),
         "pos_emb": ns(None, tp),
@@ -137,7 +168,7 @@ def param_shardings(cfg: TransformerConfig, mesh):
         "mlm_dense": ns(None, tp),
         "mlm_ln": {"g": rep, "b": rep},
         "mlm_bias": rep,
-        "layers": [layer for _ in range(cfg.n_layers)],
+        "layers": [layer_sharding(i) for i in range(cfg.n_layers)],
     }
 
 
@@ -202,23 +233,41 @@ def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key,
         keep = jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape)
         attn = jnp.where(keep, attn / (1 - cfg.dropout), 0).astype(cdt)
     x = _layer_norm(x + attn, dn(layer["ln1"]["g"]), dn(layer["ln1"]["b"]))
-    h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
-                    approximate=True)
-    h = h @ dn(layer["w2"]) + dn(layer["b2"])
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in layer:
+        from ..parallel.moe import moe_ffn
+        h, aux = moe_ffn(x, layer["moe"], n_experts=cfg.n_experts,
+                         top_k=cfg.expert_top_k,
+                         capacity_factor=cfg.capacity_factor,
+                         mesh=mesh, dtype=cdt)
+    else:
+        h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
+                        approximate=True)
+        h = h @ dn(layer["w2"]) + dn(layer["b2"])
     if train and cfg.dropout > 0:
         key, sub = jax.random.split(key)
         keep = jax.random.bernoulli(sub, 1 - cfg.dropout, h.shape)
         h = jnp.where(keep, h / (1 - cfg.dropout), 0).astype(cdt)
     x = _layer_norm(x + h, dn(layer["ln2"]["g"]), dn(layer["ln2"]["b"]))
-    return x
+    return x, aux
 
 
 def forward(params, tokens, cfg: TransformerConfig, *, type_ids=None,
             mask=None, train=False, rng=None, mesh=None):
     """tokens (B, T) int32 -> MLM logits (B, T, V)."""
+    logits, _ = forward_with_aux(params, tokens, cfg, type_ids=type_ids,
+                                 mask=mask, train=train, rng=rng,
+                                 mesh=mesh)
+    return logits
+
+
+def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
+                     type_ids=None, mask=None, train=False, rng=None,
+                     mesh=None):
+    """Like :func:`forward` but also returns the scalar auxiliary loss
+    (MoE load-balancing; 0 for all-dense configs)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
 
     cdt = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
@@ -236,17 +285,27 @@ def forward(params, tokens, cfg: TransformerConfig, *, type_ids=None,
 
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    layer_fn = _encoder_layer
-    if cfg.remat:
-        layer_fn = jax.checkpoint(
-            _encoder_layer, static_argnums=(3, 4, 6),
-            policy=jax.checkpoint_policies.nothing_saveable)
-    for i, layer in enumerate(params["layers"]):
-        rng, sub = jax.random.split(rng)
-        x = layer_fn(x, layer, mask, cfg, train, sub, mesh)
-        if mesh is not None:
-            x = jax.lax.with_sharding_constraint(
-                x, jax.sharding.NamedSharding(mesh, _act_spec(mesh)))
+    aux_total = jnp.zeros((), jnp.float32)
+
+    pp = (mesh.shape.get("pp", 1) if mesh is not None
+          and "pp" in mesh.axis_names else 1)
+    if pp > 1:
+        x, aux = _pipelined_layers(x, params["layers"], mask, cfg, train,
+                                   rng, mesh)
+        aux_total = aux_total + aux
+    else:
+        layer_fn = _encoder_layer
+        if cfg.remat:
+            layer_fn = jax.checkpoint(
+                _encoder_layer, static_argnums=(3, 4, 6),
+                policy=jax.checkpoint_policies.nothing_saveable)
+        for i, layer in enumerate(params["layers"]):
+            rng, sub = jax.random.split(rng)
+            x, aux = layer_fn(x, layer, mask, cfg, train, sub, mesh)
+            aux_total = aux_total + aux
+            if mesh is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, jax.sharding.NamedSharding(mesh, _act_spec(mesh)))
 
     # MLM head (weight-tied to token embedding)
     h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt), approximate=True)
@@ -254,7 +313,51 @@ def forward(params, tokens, cfg: TransformerConfig, *, type_ids=None,
                     params["mlm_ln"]["b"].astype(cdt))
     logits = h @ params["tok_emb"].T.astype(cdt) + \
         params["mlm_bias"].astype(cdt)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux_total
+
+
+def _pipelined_layers(x, layers, mask, cfg, train, rng, mesh):
+    """GPipe the layer stack over the mesh's 'pp' axis
+    (parallel/pipeline.py).  Requires homogeneous layer structure (all
+    dense, or all-MoE via moe_every=1) and no sequence-parallel attention
+    (a nested manual shard_map).  Returns (x, aux_loss)."""
+    import jax
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    from ..parallel.pipeline import pipeline_apply, stack_layer_params
+
+    if cfg.n_experts and 1 < cfg.moe_every <= len(layers):
+        raise MXNetError("pipeline parallelism needs a homogeneous layer "
+                         "stack; mixed dense/MoE (moe_every>1) is "
+                         "unsupported — use moe_every=1 or drop 'pp'")
+    if cfg.seq_parallel:
+        raise MXNetError("seq_parallel attention cannot nest inside the "
+                         "'pp' shard_map; drop one of sp/pp")
+    stacked = stack_layer_params(layers)
+    aux = {"mask": mask} if mask is not None else {}
+
+    layer_fn = _encoder_layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            _encoder_layer, static_argnums=(3, 4, 6),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    def stage_fn(stage_p, xb, auxb, stage_idx, mub_idx):
+        maskb = auxb.get("mask")
+        key = jax.random.fold_in(jax.random.fold_in(rng, stage_idx),
+                                 mub_idx)
+        aux_sum = jnp.zeros((), jnp.float32)
+        per_stage = jax.tree_util.tree_leaves(stage_p)[0].shape[0]
+        for i in range(per_stage):
+            layer_i = jax.tree_util.tree_map(lambda a: a[i], stage_p)
+            key, sub = jax.random.split(key)
+            xb, a = layer_fn(xb, layer_i, maskb, cfg, train, sub, None)
+            aux_sum = aux_sum + a
+        return xb, aux_sum
+
+    return pipeline_apply(stage_fn, stacked, x, aux, mesh=mesh,
+                          axis="pp", n_microbatches=cfg.pp_microbatches,
+                          has_aux=True)
 
 
 def _act_spec(mesh):
@@ -285,10 +388,10 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
                      b1=0.9, b2=0.999, eps=1e-6)
 
     def loss_fn(params, batch, rng):
-        logits = forward(params, batch["tokens"], cfg,
-                         type_ids=batch.get("type_ids"),
-                         mask=batch.get("mask"), train=True, rng=rng,
-                         mesh=mesh)
+        logits, aux = forward_with_aux(
+            params, batch["tokens"], cfg,
+            type_ids=batch.get("type_ids"),
+            mask=batch.get("mask"), train=True, rng=rng, mesh=mesh)
         labels = batch["labels"]
         valid = (labels >= 0)
         safe = jnp.where(valid, labels, 0)
@@ -296,7 +399,8 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
         tok_loss = -jnp.take_along_axis(logp, safe[..., None],
                                         axis=-1)[..., 0]
         tok_loss = jnp.where(valid, tok_loss, 0.0)
-        return tok_loss.sum() / jnp.maximum(valid.sum(), 1)
+        mlm = tok_loss.sum() / jnp.maximum(valid.sum(), 1)
+        return mlm + cfg.moe_aux_weight * aux
 
     def step(state, batch, rng):
         params, opt_state = state

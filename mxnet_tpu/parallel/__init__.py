@@ -7,3 +7,5 @@ from .mesh import make_mesh, default_mesh, current_mesh, mesh_scope
 from .data_parallel import DataParallelTrainer
 from .ring_attention import (ring_attention, ulysses_attention,
                              sequence_parallel_attention)
+from .pipeline import pipeline_apply, stack_layer_params
+from .moe import init_moe_ffn, moe_ffn, moe_param_shardings

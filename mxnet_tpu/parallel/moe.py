@@ -1,0 +1,143 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+No reference counterpart — MXNet 1.x predates MoE (SURVEY.md §2.4 marks
+expert parallel ABSENT); this is a TPU-build extension following the
+GShard/Switch recipe: a learned router picks top-k experts per token,
+tokens are packed into per-expert capacity buffers with dense one-hot
+dispatch/combine einsums (XLA-friendly — no gather/scatter, the MXU does
+the packing), and the expert dimension of both the parameter tensors and
+the dispatched activations is sharded over ``ep`` so GSPMD inserts the
+all-to-alls over ICI.
+
+Gradients flow through the gate probabilities in the combine tensor
+(standard straight-through routing); an auxiliary load-balancing loss
+(Switch eq. 4) keeps the router from collapsing onto few experts.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["init_moe_ffn", "moe_ffn", "moe_param_shardings"]
+
+
+def init_moe_ffn(key, d_model, d_ff, n_experts, param_dtype="float32"):
+    """Router + per-expert FFN params: leaves carry a leading E axis."""
+    import jax
+    import jax.numpy as jnp
+    k = jax.random.split(key, 3)
+    scale = 0.02
+    return {
+        "router": (jax.random.normal(k[0], (d_model, n_experts))
+                   * scale).astype(param_dtype),
+        "w1": (jax.random.normal(k[1], (n_experts, d_model, d_ff))
+               * scale).astype(param_dtype),
+        "b1": jnp.zeros((n_experts, d_ff), param_dtype),
+        "w2": (jax.random.normal(k[2], (n_experts, d_ff, d_model))
+               * scale).astype(param_dtype),
+        "b2": jnp.zeros((n_experts, d_model), param_dtype),
+    }
+
+
+def moe_param_shardings(mesh):
+    """NamedSharding pytree matching init_moe_ffn: experts over ``ep``,
+    FFN hidden dim over ``tp`` when present."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ep = "ep" if "ep" in mesh.axis_names else None
+    tp = "tp" if "tp" in mesh.axis_names else None
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "router": ns(),
+        "w1": ns(ep, None, tp),
+        "b1": ns(ep, tp),
+        "w2": ns(ep, tp, None),
+        "b2": ns(ep, None),
+    }
+
+
+def _top_k_gating(gates, k):
+    """gates (G, S, E) softmax probs → per-slot expert index + gate value,
+    shapes (G, S, k), slot 0 = highest gate."""
+    import jax
+    val, idx = jax.lax.top_k(gates, k)
+    return idx, val
+
+
+def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
+            mesh=None, activation="gelu", dtype=None):
+    """MoE FFN: x (G, S, D) → (y (G, S, D), aux_loss scalar).
+
+    G = token groups (the batch dim), S = tokens per group.  Each group
+    routes independently with expert capacity
+    ``C = ceil(top_k * S * capacity_factor / E)``; overflow tokens fall
+    through the residual (their y contribution is 0).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    G, S, D = x.shape
+    E = n_experts
+    C = max(1, math.ceil(top_k * S * capacity_factor / E))
+    cdt = dtype or x.dtype
+
+    router_logits = (x.astype(jnp.float32)
+                     @ params["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(router_logits, axis=-1)        # (G, S, E)
+
+    # Switch aux loss: E * Σ_e (token-fraction_e · mean-prob_e)
+    top1 = jnp.argmax(gates, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32),
+                    axis=(0, 1))
+    prob = jnp.mean(gates, axis=(0, 1))
+    aux_loss = E * jnp.sum(frac * prob)
+
+    idx, val = _top_k_gating(gates, top_k)                # (G, S, k)
+    # renormalize selected gate values per token
+    val = val / jnp.maximum(jnp.sum(val, -1, keepdims=True), 1e-9)
+
+    # capacity assignment: position of each (token, slot) in its expert's
+    # buffer, counted in slot-major order so slot-0 picks win capacity.
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)      # (G, S, k, E)
+    flat = onehot.transpose(0, 2, 1, 3).reshape(G, top_k * S, E)
+    pos_flat = jnp.cumsum(flat, axis=1) - flat            # (G, kS, E)
+    pos = pos_flat.reshape(G, top_k, S, E).transpose(0, 2, 1, 3)
+    pos = jnp.sum(pos * onehot, axis=-1)                  # (G, S, k)
+    keep = pos < C
+
+    # (G, S, k, E, C) slot one-hot; overflow slots map to the dropped
+    # C-th class.  dispatch sums slots; combine weights them by gate.
+    slot_oh = (jax.nn.one_hot(idx, E, dtype=jnp.float32)[..., None]
+               * jax.nn.one_hot(jnp.where(keep, pos, C), C + 1,
+                                dtype=jnp.float32)[..., None, :-1])
+    disp = jnp.sum(slot_oh, axis=2)                       # (G, S, E, C)
+    combine = jnp.sum(
+        slot_oh * val[..., None, None].astype(jnp.float32),
+        axis=2)                                           # (G, S, E, C)
+
+    xin = jnp.einsum("gsec,gsd->egcd", disp.astype(cdt), x.astype(cdt))
+    if mesh is not None and "ep" in mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        xin = jax.lax.with_sharding_constraint(
+            xin, NamedSharding(mesh, P("ep", None, None, None)))
+
+    h = jnp.einsum("egcd,edf->egcf", xin, params["w1"].astype(cdt))
+    h = h + params["b1"][:, None, None, :].astype(cdt)
+    if activation == "gelu":
+        h = jax.nn.gelu(h, approximate=True)
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        raise MXNetError("unknown activation %r" % activation)
+    y = jnp.einsum("egcf,efd->egcd", h, params["w2"].astype(cdt))
+    y = y + params["b2"][:, None, None, :].astype(cdt)
+    if mesh is not None and "ep" in mesh.axis_names:
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P("ep", None, None, None)))
+
+    out = jnp.einsum("gsec,egcd->gsd", combine.astype(cdt), y)
+    return out.astype(x.dtype), aux_loss
