@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""opperf: per-op micro-benchmark harness over the op registry.
+
+Reference: ``benchmark/python/opperf/`` (SURVEY.md §2.3: "per-op
+micro-benchmark harness over the whole registry").  Walks
+``mxnet_tpu.ops.registry``, synthesizes inputs per op from a profile
+table, and times (a) eager dispatch (the imperative path — dominated by
+per-op Python+trace overhead, the reference's ~µs dispatch metric) and
+(b) the op under ``jax.jit`` (the compiled XLA kernel itself).
+
+Usage::
+
+    python benchmark/opperf.py                       # common op set
+    python benchmark/opperf.py --ops dot,relu,softmax
+    python benchmark/opperf.py --all --json out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# name -> (input shapes, positional attrs, kwargs)
+_PROFILES = {
+    "dot": (((256, 256), (256, 256)), (), {}),
+    "batch_dot": (((8, 128, 128), (8, 128, 128)), (), {}),
+    "FullyConnected": (((64, 256), (128, 256), (128,)), (),
+                        {"num_hidden": 128}),
+    "Convolution": (((8, 16, 32, 32), (32, 16, 3, 3), (32,)), (),
+                    {"kernel": (3, 3), "num_filter": 32,
+                     "pad": (1, 1)}),
+    "softmax": (((64, 1000),), (), {}),
+    "log_softmax": (((64, 1000),), (), {}),
+    "relu": (((256, 256),), (), {}),
+    "sigmoid": (((256, 256),), (), {}),
+    "tanh": (((256, 256),), (), {}),
+    "exp": (((256, 256),), (), {}),
+    "log": (((256, 256),), (), {}),
+    "sqrt": (((256, 256),), (), {}),
+    "broadcast_add": (((256, 256), (256, 1)), (), {}),
+    "broadcast_mul": (((256, 256), (256, 1)), (), {}),
+    "elemwise_add": (((256, 256), (256, 256)), (), {}),
+    "elemwise_mul": (((256, 256), (256, 256)), (), {}),
+    "sum": (((256, 256),), (), {}),
+    "mean": (((256, 256),), (), {}),
+    "max": (((256, 256),), (), {}),
+    "argmax": (((256, 256),), (), {"axis": 1}),
+    "transpose": (((256, 256),), (), {}),
+    "reshape": (((256, 256),), (), {"shape": (128, 512)}),
+    "Concat": (((64, 128), (64, 128)), (), {"dim": 1}),
+    "split": (((64, 128),), (), {"num_outputs": 4, "axis": 1}),
+    "BatchNorm": (((32, 64, 16, 16), (64,), (64,), (64,), (64,)), (),
+                   {}),
+    "LayerNorm": (((64, 256), (256,), (256,)), (), {}),
+    "Pooling": (((8, 16, 32, 32),), (),
+                {"kernel": (2, 2), "pool_type": "max",
+                 "stride": (2, 2)}),
+    "sgd_update": (((256, 256), (256, 256)), (), {"lr": 0.1}),
+    "adam_update": (((256, 256), (256, 256), (256, 256), (256, 256)),
+                    (), {"lr": 0.1}),
+}
+
+_DEFAULT_SHAPE = ((64, 64),)
+
+
+def _bench_one(name, ctx, warmup, runs, use_default=False):
+    import numpy as np
+    import jax
+    from mxnet_tpu import nd
+    from mxnet_tpu.ops import registry
+
+    op = registry.get_op(name)
+    shapes, pos, kw = _PROFILES.get(
+        name, (_DEFAULT_SHAPE, (), {})) if not use_default else \
+        (_DEFAULT_SHAPE, (), {})
+    rng = np.random.RandomState(0)
+    args = [nd.array(rng.uniform(0.5, 1.5, s).astype("float32"),
+                     ctx=ctx) for s in shapes]
+
+    def run_eager():
+        out = getattr(nd, name)(*args, **kw)
+        (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
+
+    try:
+        run_eager()
+    except Exception as e:
+        return {"op": name, "error": str(e).split("\n")[0][:120]}
+
+    for _ in range(warmup):
+        run_eager()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        run_eager()
+    eager_us = (time.perf_counter() - t0) / runs * 1e6
+
+    # jitted kernel time
+    jargs = [a._data for a in args]
+
+    def f(*xs):
+        out = registry.invoke_impl(op, list(xs), tuple(pos), kw)
+        return out
+
+    try:
+        jf = jax.jit(f)
+        jax.block_until_ready(jf(*jargs))
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            r = jf(*jargs)
+        jax.block_until_ready(r)
+        jit_us = (time.perf_counter() - t0) / runs * 1e6
+    except Exception:
+        jit_us = None
+
+    return {"op": name, "eager_us": round(eager_us, 2),
+            "jit_us": round(jit_us, 2) if jit_us is not None else None}
+
+
+def run_op_benchmarks(ops=None, ctx=None, warmup=5, runs=50):
+    """Benchmark ``ops`` (default: the profiled common set); returns a
+    list of result dicts."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops import registry
+
+    if ctx is None:
+        ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    if ops is None:
+        ops = [o for o in _PROFILES if registry.op_exists(o)]
+    results = []
+    for name in ops:
+        if not registry.op_exists(name):
+            results.append({"op": name, "error": "unknown op"})
+            continue
+        results.append(_bench_one(name, ctx, warmup, runs,
+                                  use_default=name not in _PROFILES))
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="per-op micro-benchmarks")
+    p.add_argument("--ops", default=None,
+                   help="comma-separated op names (default: common set)")
+    p.add_argument("--all", action="store_true",
+                   help="every registry op (default-shaped inputs)")
+    p.add_argument("--runs", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--json", default=None, help="write results to file")
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.ops import registry
+    ops = None
+    if args.ops:
+        ops = args.ops.split(",")
+    elif args.all:
+        ops = registry.list_ops()
+    results = run_op_benchmarks(ops, warmup=args.warmup, runs=args.runs)
+    for r in results:
+        if "error" in r:
+            print("%-20s ERROR %s" % (r["op"], r["error"]))
+        else:
+            jit = ("%8.1f" % r["jit_us"]) if r["jit_us"] is not None \
+                else "     n/a"
+            print("%-20s eager %8.1f us   jit %s us"
+                  % (r["op"], r["eager_us"], jit))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print("wrote", args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

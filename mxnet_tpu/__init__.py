@@ -49,6 +49,9 @@ from . import util             # noqa: E402
 from . import numpy as np      # noqa: E402
 from . import numpy_extension as npx  # noqa: E402
 from . import profiler         # noqa: E402
+from . import runtime          # noqa: E402
+from . import library          # noqa: E402
+from . import rtc              # noqa: E402
 from . import monitor          # noqa: E402
 from .monitor import Monitor   # noqa: E402
 from . import test_utils       # noqa: E402

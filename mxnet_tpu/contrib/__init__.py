@@ -2,3 +2,4 @@
 flow).  Reference: ``python/mxnet/contrib/``."""
 from . import amp
 from . import quantization
+from . import onnx

@@ -1,0 +1,20 @@
+"""ONNX interop (reference: ``python/mxnet/contrib/onnx/`` — SURVEY.md
+§2.2 "ONNX" row: per-op export/import converters).
+
+The converters operate on a lightweight dict-based model IR mirroring
+ONNX's ModelProto/GraphProto structure, so conversion logic runs and is
+tested without the ``onnx`` package; serialization to/from real ``.onnx``
+protobuf files engages only when ``onnx`` is importable (it is not baked
+into this environment — see Environment notes).
+
+* ``export_model(sym, params, input_shapes, ...)`` — Symbol + params →
+  ONNX (mx2onnx)
+* ``import_model(path_or_dict)`` — ONNX → (Symbol, arg_params,
+  aux_params) (onnx2mx)
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+from . import mx2onnx
+from . import onnx2mx
+
+__all__ = ["export_model", "import_model", "mx2onnx", "onnx2mx"]

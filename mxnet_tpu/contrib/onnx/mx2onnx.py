@@ -1,0 +1,381 @@
+"""Symbol → ONNX export (reference: ``contrib/onnx/mx2onnx/``).
+
+Each MXNet-named op has a converter producing ONNX node dicts
+``{"op_type", "name", "inputs", "outputs", "attrs"}``; the graph walk is
+the Symbol's topological order.  Target opset: 13 (+LayerNormalization
+from 17 when used).  ``to_onnx_protobuf`` lowers the dict model to a real
+``onnx.ModelProto`` when the package is present.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["export_model", "to_onnx_protobuf", "register_op_converter"]
+
+OPSET = 13
+
+_CONVERTERS = {}
+
+
+def register_op_converter(op_name):
+    """Register an export converter: ``fn(node_name, input_names, attrs,
+    ctx) -> list of onnx-node dicts`` (ctx carries initializers)."""
+    def dec(fn):
+        _CONVERTERS[op_name] = fn
+        return fn
+    return dec
+
+
+def _node(op_type, name, inputs, outputs=None, **attrs):
+    return {"op_type": op_type, "name": name, "inputs": list(inputs),
+            "outputs": outputs or [name], "attrs": attrs}
+
+
+class _Ctx:
+    """Export context: initializer registry for shape/constant inputs."""
+
+    def __init__(self):
+        self.initializers = {}
+
+    def add_const(self, name, arr):
+        self.initializers[name] = _np.asarray(arr)
+        return name
+
+
+def _tuple_attr(attrs, key, default=None):
+    v = attrs.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# converters
+# ---------------------------------------------------------------------------
+
+@register_op_converter("Convolution")
+def _conv(name, ins, attrs, ctx):
+    kernel = _tuple_attr(attrs, "kernel")
+    stride = _tuple_attr(attrs, "stride", (1,) * len(kernel))
+    pad = _tuple_attr(attrs, "pad", (0,) * len(kernel))
+    dilate = _tuple_attr(attrs, "dilate", (1,) * len(kernel))
+    return [_node("Conv", name, ins, kernel_shape=kernel,
+                  strides=stride, pads=pad + pad, dilations=dilate,
+                  group=int(attrs.get("num_group", 1)))]
+
+
+@register_op_converter("FullyConnected")
+def _fc(name, ins, attrs, ctx):
+    nodes = []
+    data = ins[0]
+    if attrs.get("flatten", True):
+        nodes.append(_node("Flatten", name + "_flat", [data], axis=1))
+        data = name + "_flat"
+    gemm_in = [data, ins[1]] + (list(ins[2:3]) if len(ins) > 2 else [])
+    nodes.append(_node("Gemm", name, gemm_in, transB=1, alpha=1.0,
+                       beta=1.0))
+    return nodes
+
+
+@register_op_converter("Activation")
+def _act(name, ins, attrs, ctx):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = attrs.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError("onnx export: unsupported act_type %r" % act)
+    return [_node(table[act], name, ins)]
+
+
+@register_op_converter("LeakyReLU")
+def _leaky(name, ins, attrs, ctx):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return [_node("LeakyRelu", name, ins,
+                      alpha=float(attrs.get("slope", 0.25)))]
+    if act == "elu":
+        return [_node("Elu", name, ins,
+                      alpha=float(attrs.get("slope", 0.25)))]
+    if act == "prelu":
+        return [_node("PRelu", name, ins)]
+    raise MXNetError("onnx export: unsupported LeakyReLU %r" % act)
+
+
+@register_op_converter("BatchNorm")
+def _bn(name, ins, attrs, ctx):
+    return [_node("BatchNormalization", name, ins,
+                  epsilon=float(attrs.get("eps", 1e-3)),
+                  momentum=float(attrs.get("momentum", 0.9)))]
+
+
+@register_op_converter("LayerNorm")
+def _ln(name, ins, attrs, ctx):
+    return [_node("LayerNormalization", name, ins,
+                  axis=int(attrs.get("axis", -1)),
+                  epsilon=float(attrs.get("eps", 1e-5)))]
+
+
+@register_op_converter("Pooling")
+def _pool(name, ins, attrs, ctx):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(
+            ptype)
+        if op is None:
+            raise MXNetError("onnx export: pool_type %r" % ptype)
+        return [_node(op, name, ins)]
+    kernel = _tuple_attr(attrs, "kernel")
+    stride = _tuple_attr(attrs, "stride", (1,) * len(kernel))
+    pad = _tuple_attr(attrs, "pad", (0,) * len(kernel))
+    op = {"max": "MaxPool", "avg": "AveragePool"}.get(ptype)
+    if op is None:
+        raise MXNetError("onnx export: pool_type %r" % ptype)
+    extra = {}
+    if op == "AveragePool":
+        extra["count_include_pad"] = \
+            0 if attrs.get("count_include_pad", True) in (False, "False") \
+            else 1
+    return [_node(op, name, ins, kernel_shape=kernel, strides=stride,
+                  pads=pad + pad, **extra)]
+
+
+@register_op_converter("softmax")
+def _softmax(name, ins, attrs, ctx):
+    return [_node("Softmax", name, ins,
+                  axis=int(attrs.get("axis", -1)))]
+
+
+@register_op_converter("log_softmax")
+def _log_softmax(name, ins, attrs, ctx):
+    return [_node("LogSoftmax", name, ins,
+                  axis=int(attrs.get("axis", -1)))]
+
+
+@register_op_converter("SoftmaxOutput")
+def _softmax_out(name, ins, attrs, ctx):
+    # label input drops at inference export (reference does the same)
+    return [_node("Softmax", name, ins[:1], axis=-1)]
+
+
+def _binop(op_type):
+    def conv(name, ins, attrs, ctx):
+        return [_node(op_type, name, ins)]
+    return conv
+
+
+for _mx, _ox in [("elemwise_add", "Add"), ("elemwise_sub", "Sub"),
+                 ("elemwise_mul", "Mul"), ("elemwise_div", "Div"),
+                 ("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
+                 ("broadcast_mul", "Mul"), ("broadcast_div", "Div"),
+                 ("broadcast_maximum", "Max"), ("broadcast_minimum",
+                                                "Min"),
+                 ("broadcast_power", "Pow"),
+                 ("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                 ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                 ("sqrt", "Sqrt"), ("abs", "Abs"),
+                 ("negative", "Neg"), ("erf", "Erf"),
+                 ("add_n", "Sum"), ("dot", "MatMul"),
+                 ("batch_dot", "MatMul")]:
+    register_op_converter(_mx)(_binop(_ox))
+
+
+@register_op_converter("Flatten")
+def _flatten(name, ins, attrs, ctx):
+    return [_node("Flatten", name, ins, axis=1)]
+
+
+@register_op_converter("reshape")
+def _reshape(name, ins, attrs, ctx):
+    shape = _tuple_attr(attrs, "shape")
+    sname = ctx.add_const(name + "_shape",
+                          _np.asarray(shape, dtype=_np.int64))
+    return [_node("Reshape", name, [ins[0], sname])]
+
+
+@register_op_converter("transpose")
+def _transpose(name, ins, attrs, ctx):
+    axes = _tuple_attr(attrs, "axes")
+    kw = {"perm": axes} if axes else {}
+    return [_node("Transpose", name, ins, **kw)]
+
+
+@register_op_converter("Concat")
+def _concat(name, ins, attrs, ctx):
+    return [_node("Concat", name, ins, axis=int(attrs.get("dim", 1)))]
+
+
+@register_op_converter("Dropout")
+def _dropout(name, ins, attrs, ctx):
+    # inference export: Dropout is identity; keep the node for fidelity
+    return [_node("Dropout", name, ins)]
+
+
+@register_op_converter("clip")
+def _clip(name, ins, attrs, ctx):
+    lo = ctx.add_const(name + "_min",
+                       _np.float32(attrs.get("a_min", 0.0)))
+    hi = ctx.add_const(name + "_max",
+                       _np.float32(attrs.get("a_max", 0.0)))
+    return [_node("Clip", name, [ins[0], lo, hi])]
+
+
+@register_op_converter("sum")
+def _sum(name, ins, attrs, ctx):
+    axes = _tuple_attr(attrs, "axis")
+    inputs = [ins[0]]
+    if axes is not None:
+        inputs.append(ctx.add_const(
+            name + "_axes", _np.asarray(axes, dtype=_np.int64)))
+    return [_node("ReduceSum", name, inputs,
+                  keepdims=1 if attrs.get("keepdims", False) else 0)]
+
+
+@register_op_converter("mean")
+def _mean(name, ins, attrs, ctx):
+    axes = _tuple_attr(attrs, "axis")
+    kw = {"keepdims": 1 if attrs.get("keepdims", False) else 0}
+    if axes is not None:
+        kw["axes"] = axes
+    return [_node("ReduceMean", name, ins, **kw)]
+
+
+@register_op_converter("expand_dims")
+def _expand_dims(name, ins, attrs, ctx):
+    ax = ctx.add_const(name + "_axes",
+                       _np.asarray([int(attrs["axis"])], _np.int64))
+    return [_node("Unsqueeze", name, [ins[0], ax])]
+
+
+@register_op_converter("squeeze")
+def _squeeze(name, ins, attrs, ctx):
+    axes = _tuple_attr(attrs, "axis")
+    inputs = [ins[0]]
+    if axes is not None:
+        inputs.append(ctx.add_const(
+            name + "_axes", _np.asarray(axes, dtype=_np.int64)))
+    return [_node("Squeeze", name, inputs)]
+
+
+@register_op_converter("_copy")
+def _copy(name, ins, attrs, ctx):
+    return [_node("Identity", name, ins)]
+
+
+@register_op_converter("BlockGrad")
+def _block_grad(name, ins, attrs, ctx):
+    return [_node("Identity", name, ins)]
+
+
+# ---------------------------------------------------------------------------
+# graph walk
+# ---------------------------------------------------------------------------
+
+def export_model(sym, params, input_shapes, input_dtype="float32",
+                 onnx_file_path=None, opset_version=OPSET):
+    """Export a Symbol + params to an ONNX model.
+
+    ``params``: dict name→NDArray/ndarray (args + aux merged, reference
+    signature).  ``input_shapes``: list of shapes for the symbol's data
+    inputs (non-param variables, in ``list_arguments`` order).
+
+    Returns the dict-IR model; additionally writes ``onnx_file_path``
+    (serialized via the ``onnx`` package) when a path is given.
+    """
+    from ...symbol.symbol import Symbol
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model needs a Symbol")
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                  _np.asarray(v)) for k, v in (params or {}).items()}
+    # reference accepts 'arg:'/'aux:' prefixed names from save_checkpoint
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+
+    order = sym._nodes()
+    data_names = [n.name for n in order
+                  if n.is_var and n.name not in params]
+    if len(input_shapes) != len(data_names):
+        raise MXNetError(
+            "export_model: %d input_shapes for data inputs %s"
+            % (len(input_shapes), data_names))
+
+    ctx = _Ctx()
+    for k, v in params.items():
+        ctx.initializers[k] = v
+
+    out_names = {}   # (node id, out_idx) -> onnx name
+    nodes = []
+    for n in order:
+        if n.is_var:
+            out_names[(id(n), 0)] = n.name
+            continue
+        ins = [out_names[(id(i), oi)] for (i, oi) in n.inputs]
+        conv = _CONVERTERS.get(n.op.name)
+        if conv is None:
+            raise MXNetError("onnx export: no converter for op %r"
+                             % n.op.name)
+        new_nodes = conv(n.name, ins, dict(n.attrs), ctx)
+        nodes.extend(new_nodes)
+        final_outs = new_nodes[-1]["outputs"]
+        for i, o in enumerate(final_outs):
+            out_names[(id(n), i)] = o
+
+    graph_outputs = []
+    for (n, oi) in sym._outputs:
+        graph_outputs.append(out_names[(id(n), oi)])
+
+    model = {
+        "ir_version": 8,
+        "opset": opset_version,
+        "producer": "mxnet_tpu",
+        "graph": {
+            "name": sym.name or "mxnet_tpu_graph",
+            "nodes": nodes,
+            "inputs": [{"name": dn, "shape": tuple(s),
+                        "dtype": input_dtype}
+                       for dn, s in zip(data_names, input_shapes)],
+            "outputs": graph_outputs,
+            "initializers": ctx.initializers,
+        },
+    }
+    if onnx_file_path:
+        proto = to_onnx_protobuf(model)
+        with open(onnx_file_path, "wb") as f:
+            f.write(proto.SerializeToString())
+    return model
+
+
+def to_onnx_protobuf(model):
+    """Lower the dict model to a real ``onnx.ModelProto`` (requires the
+    ``onnx`` package)."""
+    try:
+        import onnx
+        from onnx import helper, numpy_helper, TensorProto
+    except ImportError:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; "
+            "export_model still returns the dict-IR model")
+
+    g = model["graph"]
+    nodes = [helper.make_node(n["op_type"], n["inputs"], n["outputs"],
+                              name=n["name"], **n["attrs"])
+             for n in g["nodes"]]
+    dtype_map = {"float32": TensorProto.FLOAT,
+                 "float64": TensorProto.DOUBLE,
+                 "int32": TensorProto.INT32, "int64": TensorProto.INT64}
+    inputs = [helper.make_tensor_value_info(
+        i["name"], dtype_map[i["dtype"]], list(i["shape"]))
+        for i in g["inputs"]]
+    inits = [numpy_helper.from_array(v, name=k)
+             for k, v in g["initializers"].items()]
+    outputs = [helper.make_tensor_value_info(
+        o, TensorProto.FLOAT, None) for o in g["outputs"]]
+    graph = helper.make_graph(nodes, g["name"], inputs, outputs,
+                              initializer=inits)
+    m = helper.make_model(
+        graph, producer_name=model["producer"],
+        opset_imports=[helper.make_opsetid("", model["opset"])])
+    onnx.checker.check_model(m)
+    return m

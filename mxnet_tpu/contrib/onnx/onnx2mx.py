@@ -1,0 +1,382 @@
+"""ONNX → Symbol import (reference: ``contrib/onnx/onnx2mx/``).
+
+Accepts either the dict-IR model produced by :mod:`.mx2onnx` or a path
+to a ``.onnx`` file (loaded via the ``onnx`` package when present).
+Returns ``(sym, arg_params, aux_params)`` like the reference's
+``import_model``: BatchNormalization running stats land in
+``aux_params``, every other initializer in ``arg_params``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["import_model", "register_op_importer"]
+
+_IMPORTERS = {}
+
+
+def register_op_importer(op_type):
+    """``fn(node, get_input, attrs, ctx) -> Symbol`` for one ONNX
+    op_type.  ``get_input(i)`` resolves the i-th input to a Symbol;
+    ``ctx.const(i)`` resolves it to a constant ndarray when it is an
+    initializer (shape/axes inputs)."""
+    def dec(fn):
+        _IMPORTERS[op_type] = fn
+        return fn
+    return dec
+
+
+class _ImportCtx:
+    def __init__(self, initializers):
+        self.initializers = initializers
+        self.aux_names = set()
+        self.consumed_consts = set()
+
+    def const(self, name):
+        if name not in self.initializers:
+            raise MXNetError("onnx import: %r is not an initializer"
+                             % name)
+        self.consumed_consts.add(name)
+        return self.initializers[name]
+
+
+def _sym_op(op_name, inputs, attrs=None, name=None):
+    from ...symbol.symbol import _apply_op
+    return _apply_op(op_name, list(inputs), dict(attrs or {}), name=name)
+
+
+def _ints(v):
+    return tuple(int(x) for x in v)
+
+
+@register_op_importer("Conv")
+def _conv(node, get, attrs, ctx):
+    kernel = _ints(attrs["kernel_shape"])
+    pads = _ints(attrs.get("pads", (0,) * (2 * len(kernel))))
+    ins = [get(i) for i in range(len(node["inputs"]))]
+    a = {"kernel": kernel,
+         "stride": _ints(attrs.get("strides", (1,) * len(kernel))),
+         "pad": pads[:len(kernel)],
+         "dilate": _ints(attrs.get("dilations", (1,) * len(kernel))),
+         "num_group": int(attrs.get("group", 1)),
+         "no_bias": len(ins) < 3}
+    # num_filter comes from the weight initializer when available
+    wname = node["inputs"][1]
+    if wname in ctx.initializers:
+        a["num_filter"] = int(ctx.initializers[wname].shape[0])
+    elif "num_filter" in attrs:
+        a["num_filter"] = int(attrs["num_filter"])
+    else:
+        raise MXNetError("onnx import: cannot infer num_filter for %r"
+                         % node["name"])
+    return _sym_op("Convolution", ins, a, name=node["name"])
+
+
+@register_op_importer("Gemm")
+def _gemm(node, get, attrs, ctx):
+    if int(attrs.get("transA", 0)) != 0:
+        raise MXNetError("onnx import: Gemm transA unsupported")
+    ins = [get(i) for i in range(len(node["inputs"]))]
+    wname = node["inputs"][1]
+    if wname not in ctx.initializers:
+        raise MXNetError("onnx import: Gemm needs initializer weight")
+    w = ctx.initializers[wname]
+    if int(attrs.get("transB", 0)) == 0:
+        # FullyConnected stores (num_hidden, in); transpose the stored
+        # initializer instead of inserting a transpose node.
+        ctx.initializers[wname] = _np.ascontiguousarray(w.T)
+        w = ctx.initializers[wname]
+    a = {"num_hidden": int(w.shape[0]), "no_bias": len(ins) < 3,
+         "flatten": False}
+    return _sym_op("FullyConnected", ins, a, name=node["name"])
+
+
+@register_op_importer("BatchNormalization")
+def _bn(node, get, attrs, ctx):
+    ins = [get(i) for i in range(5)]
+    ctx.aux_names.update(node["inputs"][3:5])
+    return _sym_op("BatchNorm", ins,
+                   {"eps": float(attrs.get("epsilon", 1e-5)),
+                    "momentum": float(attrs.get("momentum", 0.9)),
+                    "fix_gamma": False}, name=node["name"])
+
+
+@register_op_importer("LayerNormalization")
+def _ln(node, get, attrs, ctx):
+    ins = [get(i) for i in range(len(node["inputs"]))]
+    return _sym_op("LayerNorm", ins,
+                   {"axis": int(attrs.get("axis", -1)),
+                    "eps": float(attrs.get("epsilon", 1e-5))},
+                   name=node["name"])
+
+
+def _pool(ptype, global_pool):
+    def imp(node, get, attrs, ctx):
+        a = {"pool_type": ptype, "global_pool": global_pool}
+        if not global_pool:
+            kernel = _ints(attrs["kernel_shape"])
+            pads = _ints(attrs.get("pads", (0,) * (2 * len(kernel))))
+            a.update(kernel=kernel,
+                     stride=_ints(attrs.get("strides",
+                                            (1,) * len(kernel))),
+                     pad=pads[:len(kernel)])
+            if ptype == "avg":
+                a["count_include_pad"] = bool(
+                    int(attrs.get("count_include_pad", 1)))
+        return _sym_op("Pooling", [get(0)], a, name=node["name"])
+    return imp
+
+
+register_op_importer("MaxPool")(_pool("max", False))
+register_op_importer("AveragePool")(_pool("avg", False))
+register_op_importer("GlobalMaxPool")(_pool("max", True))
+register_op_importer("GlobalAveragePool")(_pool("avg", True))
+
+
+def _direct(mx_name, **fixed):
+    def imp(node, get, attrs, ctx):
+        ins = [get(i) for i in range(len(node["inputs"]))]
+        return _sym_op(mx_name, ins, fixed, name=node["name"])
+    return imp
+
+
+for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                 ("Tanh", "tanh"), ("Exp", "exp"), ("Log", "log"),
+                 ("Sqrt", "sqrt"), ("Abs", "abs"), ("Neg", "negative"),
+                 ("Erf", "erf"), ("Identity", "_copy"),
+                 ("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                 ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                 ("Pow", "broadcast_power"),
+                 ("Max", "broadcast_maximum"),
+                 ("Min", "broadcast_minimum"),
+                 ("MatMul", "dot"), ("Sum", "add_n"),
+                 ("Softplus", "softrelu_op_placeholder")]:
+    if _mx == "softrelu_op_placeholder":
+        def _softplus(node, get, attrs, ctx):
+            return _sym_op("Activation", [get(0)],
+                           {"act_type": "softrelu"}, name=node["name"])
+        register_op_importer(_ox)(_softplus)
+    else:
+        register_op_importer(_ox)(_direct(_mx))
+
+
+@register_op_importer("Softmax")
+def _softmax(node, get, attrs, ctx):
+    return _sym_op("softmax", [get(0)],
+                   {"axis": int(attrs.get("axis", -1))},
+                   name=node["name"])
+
+
+@register_op_importer("LogSoftmax")
+def _log_softmax(node, get, attrs, ctx):
+    return _sym_op("log_softmax", [get(0)],
+                   {"axis": int(attrs.get("axis", -1))},
+                   name=node["name"])
+
+
+@register_op_importer("LeakyRelu")
+def _leaky(node, get, attrs, ctx):
+    return _sym_op("LeakyReLU", [get(0)],
+                   {"act_type": "leaky",
+                    "slope": float(attrs.get("alpha", 0.01))},
+                   name=node["name"])
+
+
+@register_op_importer("Elu")
+def _elu(node, get, attrs, ctx):
+    return _sym_op("LeakyReLU", [get(0)],
+                   {"act_type": "elu",
+                    "slope": float(attrs.get("alpha", 1.0))},
+                   name=node["name"])
+
+
+@register_op_importer("Flatten")
+def _flatten(node, get, attrs, ctx):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError("onnx import: Flatten axis != 1 unsupported")
+    return _sym_op("Flatten", [get(0)], {}, name=node["name"])
+
+
+@register_op_importer("Reshape")
+def _reshape(node, get, attrs, ctx):
+    shape = _ints(ctx.const(node["inputs"][1]))
+    return _sym_op("reshape", [get(0)], {"shape": shape},
+                   name=node["name"])
+
+
+@register_op_importer("Transpose")
+def _transpose(node, get, attrs, ctx):
+    a = {}
+    if "perm" in attrs:
+        a["axes"] = _ints(attrs["perm"])
+    return _sym_op("transpose", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("Concat")
+def _concat(node, get, attrs, ctx):
+    ins = [get(i) for i in range(len(node["inputs"]))]
+    return _sym_op("Concat", ins, {"dim": int(attrs.get("axis", 1))},
+                   name=node["name"])
+
+
+@register_op_importer("Dropout")
+def _dropout(node, get, attrs, ctx):
+    return _sym_op("Dropout", [get(0)], {"p": 0.5}, name=node["name"])
+
+
+@register_op_importer("Clip")
+def _clip(node, get, attrs, ctx):
+    if len(node["inputs"]) >= 3:
+        lo = float(ctx.const(node["inputs"][1]))
+        hi = float(ctx.const(node["inputs"][2]))
+    else:
+        lo = float(attrs.get("min", -3.4e38))
+        hi = float(attrs.get("max", 3.4e38))
+    return _sym_op("clip", [get(0)], {"a_min": lo, "a_max": hi},
+                   name=node["name"])
+
+
+@register_op_importer("ReduceSum")
+def _reduce_sum(node, get, attrs, ctx):
+    a = {"keepdims": bool(int(attrs.get("keepdims", 1)))}
+    if len(node["inputs"]) > 1:
+        a["axis"] = _ints(ctx.const(node["inputs"][1]))
+    elif "axes" in attrs:
+        a["axis"] = _ints(attrs["axes"])
+    return _sym_op("sum", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("ReduceMean")
+def _reduce_mean(node, get, attrs, ctx):
+    a = {"keepdims": bool(int(attrs.get("keepdims", 1)))}
+    if "axes" in attrs:
+        a["axis"] = _ints(attrs["axes"])
+    return _sym_op("mean", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("Unsqueeze")
+def _unsqueeze(node, get, attrs, ctx):
+    if len(node["inputs"]) > 1:
+        axes = _ints(ctx.const(node["inputs"][1]))
+    else:
+        axes = _ints(attrs["axes"])
+    s = get(0)
+    for ax in axes:
+        s = _sym_op("expand_dims", [s], {"axis": int(ax)})
+    return s
+
+
+@register_op_importer("Squeeze")
+def _squeeze(node, get, attrs, ctx):
+    a = {}
+    if len(node["inputs"]) > 1:
+        a["axis"] = _ints(ctx.const(node["inputs"][1]))
+    elif "axes" in attrs:
+        a["axis"] = _ints(attrs["axes"])
+    return _sym_op("squeeze", [get(0)], a, name=node["name"])
+
+
+# ---------------------------------------------------------------------------
+# model walk
+# ---------------------------------------------------------------------------
+
+def _from_onnx_protobuf(path):
+    """Load a real .onnx file into the dict IR (needs ``onnx``)."""
+    try:
+        import onnx
+        from onnx import numpy_helper
+    except ImportError:
+        raise MXNetError(
+            "the 'onnx' package is not installed; pass the dict-IR "
+            "model produced by mx2onnx.export_model instead")
+    m = onnx.load(path)
+    g = m.graph
+
+    def attr_value(a):
+        import onnx
+        return onnx.helper.get_attribute_value(a)
+
+    inits = {t.name: numpy_helper.to_array(t) for t in g.initializer}
+    return {
+        "ir_version": m.ir_version,
+        "opset": m.opset_import[0].version if m.opset_import else 13,
+        "producer": m.producer_name,
+        "graph": {
+            "name": g.name,
+            "nodes": [{"op_type": n.op_type,
+                       "name": n.name or (n.output[0] + "_node"),
+                       "inputs": list(n.input),
+                       "outputs": list(n.output),
+                       "attrs": {a.name: attr_value(a)
+                                 for a in n.attribute}}
+                      for n in g.node],
+            "inputs": [{"name": i.name,
+                        "shape": tuple(
+                            d.dim_value for d in
+                            i.type.tensor_type.shape.dim),
+                        "dtype": "float32"}
+                       for i in g.input if i.name not in inits],
+            "outputs": [o.name for o in g.output],
+            "initializers": inits,
+        },
+    }
+
+
+def import_model(model):
+    """Import an ONNX model (dict IR or ``.onnx`` path) →
+    ``(sym, arg_params, aux_params)`` (reference: ``import_model``)."""
+    from ...symbol.symbol import Variable, Group
+    from ... import ndarray as nd
+
+    if isinstance(model, str):
+        model = _from_onnx_protobuf(model)
+    g = model["graph"]
+    inits = dict(g["initializers"])
+    ctx = _ImportCtx(inits)
+
+    produced = {}   # onnx tensor name -> Symbol
+    for i in g["inputs"]:
+        produced[i["name"]] = Variable(i["name"])
+
+    def get_input(node):
+        def get(i):
+            name = node["inputs"][i]
+            if name in produced:
+                return produced[name]
+            if name in inits:
+                produced[name] = Variable(name)
+                return produced[name]
+            raise MXNetError("onnx import: undefined tensor %r" % name)
+        return get
+
+    for node in g["nodes"]:
+        imp = _IMPORTERS.get(node["op_type"])
+        if imp is None:
+            raise MXNetError("onnx import: no importer for %r"
+                             % node["op_type"])
+        out_sym = imp(node, get_input(node), dict(node["attrs"]), ctx)
+        outs = node["outputs"]
+        if len(outs) == 1:
+            produced[outs[0]] = out_sym
+        else:
+            for i, o in enumerate(outs):
+                produced[o] = out_sym[i]
+
+    out_syms = [produced[o] for o in g["outputs"]]
+    sym = out_syms[0] if len(out_syms) == 1 else Group(out_syms)
+
+    # initializers consumed as constants (shape/axes vectors) are gone;
+    # the rest become arg/aux params keyed by the variable names used.
+    used_vars = {n.name for n in sym._nodes() if n.is_var}
+    arg_params, aux_params = {}, {}
+    for k, v in inits.items():
+        if k in ctx.consumed_consts or k not in used_vars:
+            continue
+        arr = nd.array(_np.asarray(v))
+        if k in ctx.aux_names:
+            aux_params[k] = arr
+        else:
+            arg_params[k] = arr
+    return sym, arg_params, aux_params

@@ -1,2 +1,4 @@
 """Image API (reference: ``python/mxnet/image/``)."""
 from .image import *
+from .detection import *
+from . import detection

@@ -1,0 +1,92 @@
+"""External extension-library loading.
+
+Reference: ``python/mxnet/library.py`` (``MXLoadLib``) + the versioned
+extension ABI ``include/mxnet/lib_api.h`` (SURVEY.md §2.1
+"Subgraph/accelerator API": register ops/passes from an external ``.so``
+without rebuilding the framework).
+
+The TPU build keeps both halves of that contract:
+
+* a **Python extension** (``.py`` file or importable module) is executed
+  and may call ``mxnet_tpu.ops.registry.register`` / Gluon APIs directly
+  — this is the idiomatic path since op kernels here are jax-traceable
+  Python, not compiled objects;
+* a **native extension** (``.so``) is dlopened and its exported
+  ``MXTPULibInit(void)`` (returning 0 on success) is invoked, mirroring
+  the reference's ``initialize(int version)`` hook.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libs"]
+
+_loaded = {}
+
+LIB_API_VERSION = 1
+
+
+def load(path, verbose=True):
+    """Load an extension library (reference: ``mx.library.load``).
+
+    ``path``: a ``.py`` file, an importable module name, or a native
+    ``.so``.  Returns the module (Python) or ``ctypes.CDLL`` (native).
+    Re-loading the same path returns the cached handle.
+    """
+    if path in _loaded:
+        return _loaded[path]
+
+    if path.endswith(".so"):
+        if not os.path.exists(path):
+            raise MXNetError("extension library not found: %r" % path)
+        try:
+            handle = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        except OSError as e:
+            raise MXNetError("cannot dlopen %r: %s" % (path, e))
+        init = getattr(handle, "MXTPULibInit", None)
+        if init is None:
+            raise MXNetError(
+                "%r exports no MXTPULibInit — not a mxnet_tpu extension"
+                % path)
+        init.restype = ctypes.c_int
+        ret = init()
+        if ret != 0:
+            raise MXNetError("MXTPULibInit(%r) failed with code %d"
+                             % (path, ret))
+    elif path.endswith(".py"):
+        if not os.path.exists(path):
+            raise MXNetError("extension library not found: %r" % path)
+        name = "_mxtpu_ext_" + os.path.splitext(
+            os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        handle = importlib.util.module_from_spec(spec)
+        sys.modules[name] = handle
+        try:
+            spec.loader.exec_module(handle)
+        except Exception as e:
+            sys.modules.pop(name, None)
+            raise MXNetError("error executing extension %r: %s"
+                             % (path, e))
+    else:
+        try:
+            handle = importlib.import_module(path)
+        except ImportError as e:
+            raise MXNetError("cannot import extension module %r: %s"
+                             % (path, e))
+
+    _loaded[path] = handle
+    if verbose:
+        import logging
+        logging.getLogger("mxnet_tpu").info("loaded library %r", path)
+    return handle
+
+
+def loaded_libs():
+    """Paths/names of extensions loaded so far."""
+    return list(_loaded)

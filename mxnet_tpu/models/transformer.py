@@ -294,11 +294,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
                                    rng, mesh)
         aux_total = aux_total + aux
     else:
-        layer_fn = _encoder_layer
-        if cfg.remat:
-            layer_fn = jax.checkpoint(
-                _encoder_layer, static_argnums=(3, 4, 6),
-                policy=jax.checkpoint_policies.nothing_saveable)
+        layer_fn = _make_layer_fn(cfg)
         for i, layer in enumerate(params["layers"]):
             rng, sub = jax.random.split(rng)
             x, aux = layer_fn(x, layer, mask, cfg, train, sub, mesh)
@@ -314,6 +310,17 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
     logits = h @ params["tok_emb"].T.astype(cdt) + \
         params["mlm_bias"].astype(cdt)
     return logits.astype(jnp.float32), aux_total
+
+
+def _make_layer_fn(cfg: TransformerConfig):
+    """Encoder layer, remat-wrapped per cfg — single construction point
+    so the pp and sequential paths cannot drift."""
+    import jax
+    if not cfg.remat:
+        return _encoder_layer
+    return jax.checkpoint(
+        _encoder_layer, static_argnums=(3, 4, 6),
+        policy=jax.checkpoint_policies.nothing_saveable)
 
 
 def _pipelined_layers(x, layers, mask, cfg, train, rng, mesh):
@@ -335,12 +342,7 @@ def _pipelined_layers(x, layers, mask, cfg, train, rng, mesh):
                          "'pp' shard_map; drop one of sp/pp")
     stacked = stack_layer_params(layers)
     aux = {"mask": mask} if mask is not None else {}
-
-    layer_fn = _encoder_layer
-    if cfg.remat:
-        layer_fn = jax.checkpoint(
-            _encoder_layer, static_argnums=(3, 4, 6),
-            policy=jax.checkpoint_policies.nothing_saveable)
+    layer_fn = _make_layer_fn(cfg)
 
     def stage_fn(stage_p, xb, auxb, stage_idx, mub_idx):
         maskb = auxb.get("mask")

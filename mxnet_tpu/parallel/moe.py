@@ -82,6 +82,9 @@ def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
 
     G, S, D = x.shape
     E = n_experts
+    if top_k > E:
+        raise MXNetError("moe_ffn: top_k=%d > n_experts=%d (lower "
+                         "expert_top_k or add experts)" % (top_k, E))
     C = max(1, math.ceil(top_k * S * capacity_factor / E))
     cdt = dtype or x.dtype
 
@@ -122,8 +125,11 @@ def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
     xin = jnp.einsum("gsec,gsd->egcd", disp.astype(cdt), x.astype(cdt))
     if mesh is not None and "ep" in mesh.axis_names:
         from jax.sharding import NamedSharding, PartitionSpec as P
+        # keep the token-group dim dp-sharded — pinning it replicated
+        # would all-gather over dp and fold-duplicate the expert FLOPs
+        dp = "dp" if "dp" in mesh.axis_names else None
         xin = jax.lax.with_sharding_constraint(
-            xin, NamedSharding(mesh, P("ep", None, None, None)))
+            xin, NamedSharding(mesh, P("ep", dp, None, None)))
 
     h = jnp.einsum("egcd,edf->egcf", xin, params["w1"].astype(cdt))
     h = h + params["b1"][:, None, None, :].astype(cdt)
@@ -137,7 +143,7 @@ def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
     y = y + params["b2"][:, None, None, :].astype(cdt)
     if mesh is not None and "ep" in mesh.axis_names:
         y = jax.lax.with_sharding_constraint(
-            y, NamedSharding(mesh, P("ep", None, None, None)))
+            y, NamedSharding(mesh, P("ep", dp, None, None)))
 
     out = jnp.einsum("gsec,egcd->gsd", combine.astype(cdt), y)
     return out.astype(x.dtype), aux_loss

@@ -1,0 +1,94 @@
+"""Runtime feature introspection.
+
+Reference: ``python/mxnet/runtime.py`` + ``src/libinfo.cc`` (SURVEY.md §2.1
+"Init/runtime misc": compiled-feature flags surfaced at runtime via
+``mx.runtime.Features()``).  The reference's flags describe its build
+matrix (CUDA/CUDNN/NCCL/ONEDNN/…); this build's flags describe the TPU
+substrate: which backends jax can reach, whether the native C++ runtime
+library is built, whether Pallas kernels are usable, and which optional
+integrations are importable.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+Feature.__doc__ = "A runtime feature flag (reference: ``LibFeature``)."
+
+
+def _detect():
+    feats = {}
+
+    def add(name, fn):
+        try:
+            feats[name] = bool(fn())
+        except Exception:
+            feats[name] = False
+
+    import jax
+
+    def platform(p):
+        def check():
+            try:
+                return len(jax.devices(p)) > 0
+            except RuntimeError:
+                return False
+        return check
+
+    add("TPU", platform("tpu"))
+    add("CPU", platform("cpu"))
+    add("GPU", platform("gpu"))
+    add("XLA", lambda: True)   # always the substrate
+    add("PALLAS", lambda: __import__(
+        "jax.experimental.pallas", fromlist=["pallas"]) is not None)
+    add("NATIVE_RUNTIME", lambda: __import__(
+        "mxnet_tpu.native", fromlist=["native"]).available())
+    add("RECORDIO", lambda: True)
+    add("IMAGE_AUG", lambda: __import__("PIL") is not None
+        or __import__("cv2") is not None)
+    add("DIST_KVSTORE", lambda: True)   # TCP PS (kvstore/dist)
+    add("INT64_TENSOR_SIZE", lambda: True)
+    add("ONNX", lambda: __import__("onnx") is not None)
+    add("BF16", lambda: True)
+    add("AMP", lambda: True)
+    add("QUANTIZATION", lambda: True)
+    return feats
+
+
+class Features(collections.abc.Mapping):
+    """Mapping of feature name → :class:`Feature`
+    (reference: ``mx.runtime.Features()``).
+
+    >>> mx.runtime.Features()["XLA"].enabled
+    True
+    >>> mx.runtime.Features().is_enabled("TPU")  # False off-TPU
+    """
+
+    def __init__(self):
+        self._feats = {n: Feature(n, e) for n, e in _detect().items()}
+
+    def __getitem__(self, name):
+        return self._feats[name]
+
+    def __iter__(self):
+        return iter(self._feats)
+
+    def __len__(self):
+        return len(self._feats)
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(
+            "%s %s" % ("✔" if f.enabled else "✖", f.name)
+            for f in self._feats.values())
+
+    def is_enabled(self, name: str) -> bool:
+        """True if the named feature is present and on (case-insensitive,
+        reference semantics: raises KeyError for unknown names)."""
+        return self._feats[name.upper()].enabled
+
+
+def feature_list():
+    """List of :class:`Feature` (reference: ``mx.runtime.feature_list``)."""
+    return list(Features().values())

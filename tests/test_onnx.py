@@ -1,0 +1,146 @@
+"""ONNX converters (SURVEY.md §2.2 "ONNX" row).  The ``onnx`` package is
+not installed in this environment, so these tests exercise the dict-IR
+path: export → dict model → import → numerically identical graph."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.onnx import export_model, import_model
+
+
+def _bind_forward(s, params, data, aux=None):
+    arg_names = s.list_arguments()
+    args = {}
+    for n in arg_names:
+        if n in params:
+            args[n] = params[n]
+        elif n == "data":
+            args[n] = data
+        else:
+            raise AssertionError("missing arg %s" % n)
+    ex = s.bind(ctx=mx.cpu(), args=args, aux_states=aux or {})
+    return ex.forward()[0].asnumpy()
+
+
+def _convnet():
+    x = sym.Variable("data")
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv0")
+    b = sym.BatchNorm(c, name="bn0")
+    r = sym.Activation(b, act_type="relu", name="act0")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    f = sym.FullyConnected(p, num_hidden=10, name="fc0")
+    return sym.softmax(f, name="out0")
+
+
+def _init_params(s, data_shape):
+    rng = np.random.RandomState(0)
+    shapes, _, aux_shapes = s.infer_shape(data=data_shape)
+    args = {}
+    for name, shp in zip(s.list_arguments(), shapes):
+        if name == "data":
+            continue
+        if name.endswith("_gamma"):
+            args[name] = nd.array(np.ones(shp, "float32"))
+        elif name.endswith(("_beta", "_bias")):
+            args[name] = nd.array(np.zeros(shp, "float32"))
+        else:
+            args[name] = nd.array(
+                rng.uniform(-0.1, 0.1, shp).astype("float32"))
+    aux = {}
+    for name, shp in zip(s.list_auxiliary_states(), aux_shapes):
+        if name.endswith("_moving_var"):
+            aux[name] = nd.array(np.ones(shp, "float32"))
+        else:
+            aux[name] = nd.array(np.zeros(shp, "float32"))
+    return args, aux
+
+
+def test_export_model_structure():
+    s = _convnet()
+    args, aux = _init_params(s, (2, 3, 16, 16))
+    params = dict(args)
+    params.update(aux)
+    model = export_model(s, params, [(2, 3, 16, 16)])
+    g = model["graph"]
+    ops = [n["op_type"] for n in g["nodes"]]
+    assert "Conv" in ops and "BatchNormalization" in ops
+    assert "Gemm" in ops and "Softmax" in ops
+    assert g["inputs"][0]["name"] == "data"
+    assert "conv0_weight" in g["initializers"]
+    assert len(g["outputs"]) == 1
+
+
+def test_onnx_roundtrip_convnet():
+    s = _convnet()
+    data_shape = (2, 3, 16, 16)
+    args, aux = _init_params(s, data_shape)
+    params = dict(args)
+    params.update(aux)
+    model = export_model(s, params, [data_shape])
+
+    s2, arg2, aux2 = import_model(model)
+    rng = np.random.RandomState(1)
+    data = nd.array(rng.randn(*data_shape).astype("float32"))
+
+    ref = _bind_forward(s, args, data, aux)
+    got = _bind_forward(s2, arg2, data, aux2)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_roundtrip_mlp_ops():
+    """Elementwise/reshape/concat/reduce ops survive the round trip."""
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    h = sym.dot(x, w, name="mm0")
+    h = sym.broadcast_add(h, sym.Variable("b"), name="add0")
+    h = sym.Activation(h, act_type="tanh", name="t0")
+    h2 = sym.reshape(h, shape=(4, 8), name="rs0")
+    h3 = sym.transpose(h2, axes=(1, 0), name="tr0")
+    h4 = sym.reshape(h3, shape=(4, 8), name="rs1")
+    cat = sym.Concat(h2, h4, dim=1, name="cat0")
+    out = sym.mean(cat, axis=1, name="mean0")
+
+    rng = np.random.RandomState(0)
+    params = {"w": nd.array(rng.randn(8, 8).astype("float32")),
+              "b": nd.array(rng.randn(8).astype("float32"))}
+    model = export_model(out, params, [(4, 8)])
+    s2, arg2, aux2 = import_model(model)
+
+    data = nd.array(rng.randn(4, 8).astype("float32"))
+    ref = _bind_forward(out, params, data)
+    got = _bind_forward(s2, arg2, data, aux2)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_export_unsupported_op_raises():
+    x = sym.Variable("data")
+    y = sym.Custom(x, op_type="noop") if hasattr(sym, "Custom") else None
+    s = sym.arccosh(x) if hasattr(sym, "arccosh") else None
+    if s is None:
+        pytest.skip("no unconverted op available")
+    with pytest.raises(mx.MXNetError):
+        export_model(s, {}, [(2, 2)])
+
+
+def test_onnx_protobuf_requires_package():
+    from mxnet_tpu.contrib.onnx.mx2onnx import to_onnx_protobuf
+    s = _convnet()
+    args, aux = _init_params(s, (1, 3, 8, 8))
+    params = dict(args)
+    params.update(aux)
+    model = export_model(s, params, [(1, 3, 8, 8)])
+    try:
+        import onnx  # noqa: F401
+        has_onnx = True
+    except ImportError:
+        has_onnx = False
+    if has_onnx:
+        proto = to_onnx_protobuf(model)
+        assert proto is not None
+    else:
+        with pytest.raises(mx.MXNetError):
+            to_onnx_protobuf(model)

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder (+ optional .lst) into RecordIO shards.
+
+Reference: ``tools/im2rec.py`` (SURVEY.md §2.3 "im2rec" row: folder +
+``.lst`` → sharded ``.rec``/``.idx`` packing CLI).  Two modes, like the
+reference:
+
+* ``--list``: walk ``root``, assign integer class ids per subfolder,
+  write ``prefix.lst`` (``idx \\t label... \\t relpath``) with optional
+  train/test split and shuffling;
+* pack (default): read ``prefix*.lst``, encode/resize each image, write
+  ``prefix.rec`` + ``prefix.idx`` (``--num-thread`` workers,
+  ``--pack-label`` for multi-float detection labels).
+
+Usage::
+
+    python tools/im2rec.py --list --recursive data/imagenet train/
+    python tools/im2rec.py --resize 480 --quality 95 data/imagenet train/
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive):
+    """Yield (relpath, class_id) walking ``root`` (reference:
+    ``list_image``): class id = sorted-subfolder index when recursive,
+    else 0."""
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for f in files:
+                if os.path.splitext(f)[1].lower() in _EXTS:
+                    label_dir = os.path.relpath(path, root).split(
+                        os.sep)[0]
+                    if label_dir not in cat:
+                        cat[label_dir] = len(cat)
+                    yield (os.path.relpath(os.path.join(path, f), root),
+                           cat[label_dir])
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                yield f, 0
+
+
+def write_list(prefix, root, args):
+    entries = list(list_images(root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    n = len(entries)
+    n_test = int(n * args.test_ratio)
+    n_train = int(n * args.train_ratio)
+    chunks = {"": entries}
+    if args.test_ratio > 0 or args.train_ratio < 1:
+        chunks = {"_train": entries[:n_train],
+                  "_test": entries[n_train:n_train + n_test]}
+        if n_train + n_test < n:
+            chunks["_val"] = entries[n_train + n_test:]
+    for suffix, chunk in chunks.items():
+        if not chunk:
+            continue
+        fname = prefix + suffix + ".lst"
+        with open(fname, "w") as f:
+            for i, (rel, label) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, label, rel))
+        print("wrote %s (%d entries)" % (fname, len(chunk)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(float(parts[0])), [float(x) for x in parts[1:-1]], \
+                parts[-1]
+
+
+def _encode(fullpath, args):
+    """Load, optionally resize/re-encode; pass bytes through untouched
+    when no transform is requested (fast path, like the reference's
+    pass-through mode)."""
+    with open(fullpath, "rb") as f:
+        raw = f.read()
+    if args.resize == 0 and args.center_crop == 0:
+        return raw
+    import io as _io
+    from PIL import Image
+    img = Image.open(_io.BytesIO(raw)).convert("RGB")
+    if args.resize:
+        w, h = img.size
+        scale = args.resize / min(w, h)
+        img = img.resize((max(1, int(w * scale)),
+                          max(1, int(h * scale))), Image.BILINEAR)
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w + s) // 2, (h + s) // 2))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=args.quality)
+    return buf.getvalue()
+
+
+def pack(prefix, root, args):
+    from mxnet_tpu import recordio
+
+    lsts = [f for f in sorted(os.listdir(args.working_dir or "."))
+            if f.startswith(os.path.basename(prefix))
+            and f.endswith(".lst")]
+    base_dir = args.working_dir or os.path.dirname(prefix) or "."
+    if not lsts:
+        cand = prefix + ".lst"
+        if not os.path.exists(cand):
+            print("no .lst found for prefix %r; run --list first" % prefix)
+            return 1
+        lsts = [os.path.basename(cand)]
+    for lst in lsts:
+        out_base = os.path.join(base_dir, os.path.splitext(lst)[0])
+        rec = recordio.MXIndexedRecordIO(out_base + ".idx",
+                                         out_base + ".rec", "w")
+        count = 0
+        for idx, labels, rel in read_list(os.path.join(base_dir, lst)):
+            fullpath = os.path.join(root, rel)
+            try:
+                data = _encode(fullpath, args)
+            except Exception as e:
+                print("skipping %s: %s" % (rel, e))
+                continue
+            if args.pack_label and len(labels) > 1:
+                header = recordio.IRHeader(0, labels, idx, 0)
+            else:
+                header = recordio.IRHeader(0, labels[0] if labels else 0.0,
+                                           idx, 0)
+            rec.write_idx(idx, recordio.pack(header, data))
+            count += 1
+            if count % 1000 == 0:
+                print("%s: %d packed" % (lst, count))
+        rec.close()
+        print("wrote %s.rec / .idx (%d records)" % (out_base, count))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Create RecordIO image packs (reference: im2rec)")
+    p.add_argument("prefix", help="output prefix (and .lst prefix)")
+    p.add_argument("root", help="image folder root")
+    p.add_argument("--list", action="store_true",
+                   help="create .lst instead of packing")
+    p.add_argument("--recursive", action="store_true",
+                   help="class ids from subfolders")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side")
+    p.add_argument("--center-crop", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--pack-label", action="store_true",
+                   help="store full float label vector (detection)")
+    p.add_argument("--num-thread", type=int, default=1,
+                   help="accepted for reference-CLI compat")
+    p.add_argument("--working-dir", default=None)
+    args = p.parse_args(argv)
+
+    if args.list:
+        write_list(args.prefix, args.root, args)
+        return 0
+    return pack(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
