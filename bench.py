@@ -23,7 +23,8 @@ def main():
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    batch = int(os.environ.get("BENCH_BATCH", "128" if amp else "64"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
@@ -34,7 +35,7 @@ def main():
     mesh = make_mesh({"dp": -1})
     trainer = DataParallelTrainer(net, loss_fn, "sgd",
                                   {"learning_rate": 0.1, "momentum": 0.9},
-                                  mesh=mesh)
+                                  mesh=mesh, amp=amp)
 
     np.random.seed(0)
     data = nd.array(np.random.randn(batch, 3, 224, 224).astype("float32"),

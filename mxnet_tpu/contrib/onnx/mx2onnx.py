@@ -216,11 +216,18 @@ def _dropout(name, ins, attrs, ctx):
 
 @register_op_converter("clip")
 def _clip(name, ins, attrs, ctx):
-    lo = ctx.add_const(name + "_min",
-                       _np.float32(attrs.get("a_min", 0.0)))
-    hi = ctx.add_const(name + "_max",
-                       _np.float32(attrs.get("a_max", 0.0)))
-    return [_node("Clip", name, [ins[0], lo, hi])]
+    # one-sided clips omit the missing bound ("" = absent optional input
+    # in ONNX), never default it to 0
+    inputs = [ins[0]]
+    if attrs.get("a_min") is not None:
+        inputs.append(ctx.add_const(name + "_min",
+                                    _np.float32(attrs["a_min"])))
+    elif attrs.get("a_max") is not None:
+        inputs.append("")
+    if attrs.get("a_max") is not None:
+        inputs.append(ctx.add_const(name + "_max",
+                                    _np.float32(attrs["a_max"])))
+    return [_node("Clip", name, inputs)]
 
 
 @register_op_converter("sum")

@@ -151,7 +151,7 @@ for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
                  ("Pow", "broadcast_power"),
                  ("Max", "broadcast_maximum"),
                  ("Min", "broadcast_minimum"),
-                 ("MatMul", "dot"), ("Sum", "add_n"),
+                 ("MatMul", "matmul"), ("Sum", "add_n"),
                  ("Softplus", "softrelu_op_placeholder")]:
     if _mx == "softrelu_op_placeholder":
         def _softplus(node, get, attrs, ctx):
@@ -228,12 +228,16 @@ def _dropout(node, get, attrs, ctx):
 
 @register_op_importer("Clip")
 def _clip(node, get, attrs, ctx):
-    if len(node["inputs"]) >= 3:
-        lo = float(ctx.const(node["inputs"][1]))
-        hi = float(ctx.const(node["inputs"][2]))
-    else:
-        lo = float(attrs.get("min", -3.4e38))
-        hi = float(attrs.get("max", 3.4e38))
+    ins = node["inputs"]
+    lo = hi = None
+    if len(ins) > 1 and ins[1]:
+        lo = float(ctx.const(ins[1]))
+    elif "min" in attrs:
+        lo = float(attrs["min"])
+    if len(ins) > 2 and ins[2]:
+        hi = float(ctx.const(ins[2]))
+    elif "max" in attrs:
+        hi = float(attrs["max"])
     return _sym_op("clip", [get(0)], {"a_min": lo, "a_max": hi},
                    name=node["name"])
 

@@ -85,20 +85,6 @@ class DetHorizontalFlipAug(DetAugmenter):
         return src, label
 
 
-def _iou(boxes, patch):
-    """IoU of (N, 4) boxes vs one (4,) patch, all normalized xyxy."""
-    ix = (_np.minimum(boxes[:, 2], patch[2])
-          - _np.maximum(boxes[:, 0], patch[0])).clip(min=0)
-    iy = (_np.minimum(boxes[:, 3], patch[3])
-          - _np.maximum(boxes[:, 1], patch[1])).clip(min=0)
-    inter = ix * iy
-    area_b = ((boxes[:, 2] - boxes[:, 0])
-              * (boxes[:, 3] - boxes[:, 1])).clip(min=0)
-    area_p = (patch[2] - patch[0]) * (patch[3] - patch[1])
-    union = area_b + area_p - inter
-    return _np.where(union > 0, inter / union, 0.0)
-
-
 class DetRandomCropAug(DetAugmenter):
     """SSD-style random crop with IoU constraint
     (reference: ``DetRandomCropAug``): sample a patch of relative area in
@@ -128,8 +114,18 @@ class DetRandomCropAug(DetAugmenter):
             valid = label[:, 0] >= 0
             if not valid.any():
                 return patch
-            iou = _iou(label[valid, 1:5], patch)
-            if (iou >= self.min_object_covered).all():
+            # sample_distorted_bounding_box semantics: accept when the
+            # patch contains >= min_object_covered of SOME object's area
+            # (intersection / box area, not symmetric IoU)
+            boxes = label[valid, 1:5]
+            ix = (_np.minimum(boxes[:, 2], patch[2])
+                  - _np.maximum(boxes[:, 0], patch[0])).clip(min=0)
+            iy = (_np.minimum(boxes[:, 3], patch[3])
+                  - _np.maximum(boxes[:, 1], patch[1])).clip(min=0)
+            box_area = ((boxes[:, 2] - boxes[:, 0])
+                        * (boxes[:, 3] - boxes[:, 1])).clip(min=1e-12)
+            coverage = ix * iy / box_area
+            if (coverage >= self.min_object_covered).any():
                 return patch
         return None
 
@@ -257,12 +253,14 @@ class ImageDetIter(_img.ImageIter):
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root="", path_imgidx=None,
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
-                 imglist=None, object_width=5, data_name="data",
-                 label_name="label", last_batch_handle="pad", **kwargs):
+                 imglist=None, object_width=5, max_objects=None,
+                 data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape)
         self.object_width = object_width
-        self._max_objects = 1
+        self._max_objects = max_objects  # resolved after super().__init__
+        self._overflow_warned = False
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec,
                          path_imglist=path_imglist, path_root=path_root,
@@ -272,6 +270,30 @@ class ImageDetIter(_img.ImageIter):
                          data_name=data_name, label_name=label_name,
                          last_batch_handle=last_batch_handle, **kwargs)
         self.det_aug_list = aug_list
+        if self._max_objects is None:
+            self._max_objects = self._estimate_max_objects()
+
+    def _estimate_max_objects(self, sample=256):
+        """Scan up to ``sample`` labels for the dataset's max object
+        count, so every batch pads to ONE static shape (reference
+        estimates the label shape up front; static shapes keep the
+        consumer jit-cache warm)."""
+        best = 1
+        try:
+            if self.imglist is not None:
+                for k in list(self.imglist)[:sample]:
+                    best = max(best,
+                               self._parse_label(
+                                   self.imglist[k][0]).shape[0])
+            elif self.imgrec is not None and self.seq is not None:
+                from .. import recordio
+                for k in self.seq[:sample]:
+                    hdr, _ = recordio.unpack(self.imgrec.read_idx(k))
+                    best = max(best,
+                               self._parse_label(hdr.label).shape[0])
+        except Exception:
+            pass
+        return best
 
     @property
     def provide_label(self):
@@ -317,14 +339,24 @@ class ImageDetIter(_img.ImageIter):
             raise StopIteration
         while len(samples) < self.batch_size:
             samples.append(samples[-1])
-        max_obj = max(s[1].shape[0] for s in samples)
-        self._max_objects = max(self._max_objects, max_obj)
+        # every batch pads to ONE static (B, max_objects, w) shape;
+        # overflow objects are dropped with a one-time warning
+        max_obj = self._max_objects
+        if any(s[1].shape[0] > max_obj for s in samples) and \
+                not self._overflow_warned:
+            import logging
+            logging.getLogger("mxnet_tpu").warning(
+                "ImageDetIter: batch contains more than max_objects=%d "
+                "boxes; extra objects are dropped (pass a larger "
+                "max_objects=)", max_obj)
+            self._overflow_warned = True
         w = samples[0][1].shape[1]
         lab = _np.full((self.batch_size, max_obj, w), -1.0, _np.float32)
         dat = _np.stack([_np.transpose(
             s[0].asnumpy() if hasattr(s[0], "asnumpy")
             else _np.asarray(s[0]), (2, 0, 1)) for s in samples])
         for i, (_, b) in enumerate(samples):
-            lab[i, :b.shape[0]] = b
+            n = min(b.shape[0], max_obj)
+            lab[i, :n] = b[:n]
         return mxio.DataBatch(data=[nd_array(dat)],
                               label=[nd_array(lab)], pad=pad)

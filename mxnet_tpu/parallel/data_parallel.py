@@ -33,7 +33,8 @@ class DataParallelTrainer:
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd",
-                 optimizer_params=None, mesh=None, grad_clip=None):
+                 optimizer_params=None, mesh=None, grad_clip=None,
+                 amp=False):
         import jax
         import optax
         from .mesh import default_mesh
@@ -41,6 +42,11 @@ class DataParallelTrainer:
 
         self.block = block
         self.loss_fn = loss_fn
+        # amp=True: forward/backward compute in bfloat16 (MXU-native) with
+        # float32 master params and updates — the bf16-first AMP recipe
+        # (contrib/amp); no loss scaler needed, bf16 exponent range
+        # matches f32.
+        self.amp = amp
         self.mesh = mesh if mesh is not None else default_mesh()
         optimizer_params = dict(optimizer_params or {})
         lr = optimizer_params.pop("learning_rate", 0.01)
@@ -106,19 +112,41 @@ class DataParallelTrainer:
         if hasattr(block, "_resolve_deferred"):
             block._resolve_deferred(NDArray(data))
 
+        amp = self.amp
+        # filled during tracing: which params an op mutated in-place
+        # (BatchNorm running stats via the mutate=(3,4) contract); those
+        # carry their forward-computed value instead of an optimizer step.
+        mutated_flags: List[bool] = []
+
         def pure_loss(param_vals, d, l):
+            import jax.numpy as jnp
             from .. import random as mxrand
             mxrand.push_trace_key(jax.random.PRNGKey(0))
             _TRACE_STATE.active = getattr(_TRACE_STATE, "active", 0) + 1
             saved = [(p, dict(p._data)) for p in params]
             try:
-                for p, v in zip(params, param_vals):
+                use_vals = param_vals
+                if amp:
+                    use_vals = [v.astype(jnp.bfloat16)
+                                if jnp.issubdtype(v.dtype, jnp.floating)
+                                else v for v in param_vals]
+                    if jnp.issubdtype(jnp.asarray(d).dtype,
+                                      jnp.floating):
+                        d = d.astype(jnp.bfloat16)
+                wrapped = [NDArray(v) for v in use_vals]
+                for p, w in zip(params, wrapped):
                     c = next(iter(p._data))
-                    p._data = OrderedDict({c: NDArray(v)})
+                    p._data = OrderedDict({c: w})
                 with autograd._scope(False, True):
                     out = block.forward_raw(NDArray(d))
                     loss = loss_fn(out, NDArray(l))
-                return loss._data.mean()
+                # capture in-place mutations (aux states) before restore
+                del mutated_flags[:]
+                new_vals = []
+                for w, orig in zip(wrapped, use_vals):
+                    mutated_flags.append(w._data is not orig)
+                    new_vals.append(w._data)
+                return loss._data.astype(jnp.float32).mean(), new_vals
             finally:
                 for p, old in saved:
                     p._data = OrderedDict(old)
@@ -127,9 +155,15 @@ class DataParallelTrainer:
 
         def step(state, d, l):
             pvals, opt_state = state
-            loss, grads = jax.value_and_grad(pure_loss)(pvals, d, l)
+            (loss, new_vals), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(pvals, d, l)
             updates, opt_state = tx.update(grads, opt_state, pvals)
             pvals = optax.apply_updates(pvals, updates)
+            # mutated aux (e.g. BN moving stats) take their in-forward
+            # value — the reference's engine applies the same write
+            pvals = [nv.astype(pv.dtype) if m else pv
+                     for pv, nv, m in zip(pvals, new_vals,
+                                          mutated_flags)]
             return (pvals, opt_state), loss
 
         pvals = self._gather_params()

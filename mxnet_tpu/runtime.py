@@ -46,8 +46,16 @@ def _detect():
     add("NATIVE_RUNTIME", lambda: __import__(
         "mxnet_tpu.native", fromlist=["native"]).available())
     add("RECORDIO", lambda: True)
-    add("IMAGE_AUG", lambda: __import__("PIL") is not None
-        or __import__("cv2") is not None)
+    def has_image_lib():
+        for lib in ("PIL", "cv2"):
+            try:
+                __import__(lib)
+                return True
+            except ImportError:
+                continue
+        return False
+
+    add("IMAGE_AUG", has_image_lib)
     add("DIST_KVSTORE", lambda: True)   # TCP PS (kvstore/dist)
     add("INT64_TENSOR_SIZE", lambda: True)
     add("ONNX", lambda: __import__("onnx") is not None)

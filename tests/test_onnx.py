@@ -144,3 +144,31 @@ def test_onnx_protobuf_requires_package():
     else:
         with pytest.raises(mx.MXNetError):
             to_onnx_protobuf(model)
+
+
+def test_onnx_clip_one_sided_roundtrip():
+    x = sym.Variable("data")
+    s_min = sym.clip(x, a_min=0.5, name="cmin")   # one-sided lower
+    s_max = sym.clip(x, a_max=0.25, name="cmax")  # one-sided upper
+    data = nd.array(np.linspace(-1, 1, 8).astype("float32").reshape(2, 4))
+    for s in (s_min, s_max):
+        model = export_model(s, {}, [(2, 4)])
+        s2, a2, x2 = import_model(model)
+        ref = _bind_forward(s, {}, data)
+        got = _bind_forward(s2, a2, data, x2)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_onnx_batched_matmul_roundtrip():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    s = sym.batch_dot(x, w, name="bd0")
+    rng = np.random.RandomState(0)
+    params = {"w": nd.array(rng.randn(3, 5, 4).astype("float32"))}
+    model = export_model(s, params, [(3, 2, 5)])
+    s2, a2, x2 = import_model(model)
+    data = nd.array(rng.randn(3, 2, 5).astype("float32"))
+    ref = _bind_forward(s, params, data)
+    got = _bind_forward(s2, a2, data, x2)
+    assert got.shape == (3, 2, 4)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -234,3 +234,67 @@ def test_opperf_smoke():
     for r in res:
         assert "error" not in r, r
         assert r["eager_us"] > 0
+
+
+def test_image_det_iter_static_label_shape(tmp_path):
+    """Every batch pads to one static (B, max_objects, w) shape."""
+    from PIL import Image
+    import io as _io
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image.detection import ImageDetIter, DetBorrowAug
+    from mxnet_tpu import image as mximg
+
+    rec_path = str(tmp_path / "d.rec")
+    idx_path = str(tmp_path / "d.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        buf = _io.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (32, 32, 3),
+                                    dtype=np.uint8)).save(buf, "JPEG")
+        # record 1 has 3 objects, others 1
+        n = 3 if i == 1 else 1
+        label = [2, 5] + sum(
+            ([float(i), .1, .1, .6, .6] for _ in range(n)), [])
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, label, i, 0), buf.getvalue()))
+    rec.close()
+    it = ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                      path_imgrec=rec_path, path_imgidx=idx_path,
+                      aug_list=[DetBorrowAug(
+                          mximg.ForceResizeAug((24, 24)))])
+    assert it.provide_label[0].shape == (2, 3, 5)
+    shapes = set()
+    for batch in [it.next(), it.next()]:
+        shapes.add(tuple(batch.label[0].shape))
+    assert shapes == {(2, 3, 5)}
+
+
+def test_im2rec_split_prefix_dir(tmp_path):
+    """pack() finds split .lst files written next to a directory-prefixed
+    prefix (the documented --train-ratio/--test-ratio flow)."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(4):
+        Image.fromarray(rng.randint(0, 255, (16, 16, 3),
+                                    dtype=np.uint8)).save(
+            str(d / ("%d.jpg" % i)))
+    out = tmp_path / "out"
+    out.mkdir()
+    prefix = str(out / "pk")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r1 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         "--list", "--train-ratio", "0.5", "--test-ratio", "0.5",
+         prefix, str(d)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r1.returncode == 0, r1.stderr
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(d)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r2.returncode == 0, r2.stderr
+    assert os.path.exists(prefix + "_train.rec")
+    assert os.path.exists(prefix + "_test.rec")

@@ -116,16 +116,16 @@ def _encode(fullpath, args):
 def pack(prefix, root, args):
     from mxnet_tpu import recordio
 
-    lsts = [f for f in sorted(os.listdir(args.working_dir or "."))
-            if f.startswith(os.path.basename(prefix))
-            and f.endswith(".lst")]
+    # search .lst files in the directory the prefix (or --working-dir)
+    # points into, matching the prefix basename
     base_dir = args.working_dir or os.path.dirname(prefix) or "."
+    base_name = os.path.basename(prefix)
+    lsts = [f for f in sorted(os.listdir(base_dir))
+            if f.startswith(base_name) and f.endswith(".lst")]
     if not lsts:
-        cand = prefix + ".lst"
-        if not os.path.exists(cand):
-            print("no .lst found for prefix %r; run --list first" % prefix)
-            return 1
-        lsts = [os.path.basename(cand)]
+        print("no .lst found for prefix %r in %s; run --list first"
+              % (prefix, base_dir))
+        return 1
     for lst in lsts:
         out_base = os.path.join(base_dir, os.path.splitext(lst)[0])
         rec = recordio.MXIndexedRecordIO(out_base + ".idx",
