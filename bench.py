@@ -25,8 +25,8 @@ def main():
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     batch = int(os.environ.get("BENCH_BATCH", "128" if amp else "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "40"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
     net = vision.resnet50_v1()
     net.initialize(mx.initializer.Xavier(), ctx=ctx)
@@ -44,12 +44,12 @@ def main():
 
     for _ in range(warmup):
         loss = trainer.step(data, label)
-    loss.wait_to_read()
+    trainer.sync()
 
     t0 = time.time()
     for _ in range(iters):
         loss = trainer.step(data, label)
-    loss.wait_to_read()
+    trainer.sync()
     dt = time.time() - t0
 
     img_s = batch * iters / dt

@@ -1,0 +1,104 @@
+"""Python half of the embeddable C predict API.
+
+Reference: ``src/c_api/c_predict_api.cc`` (SURVEY.md §2.1 "C API" row:
+"c_predict_api = standalone embeddable inference (symbol JSON + params
+bytes → forward)").  The native ``libmxnet_tpu_predict.so`` embeds
+CPython and drives this module; the compute itself still lowers through
+XLA, so an embedding application gets the same jitted TPU/CPU path the
+Python frontend uses.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "create"]
+
+
+class Predictor:
+    """One bound inference executor over (symbol JSON, params bytes)."""
+
+    def __init__(self, symbol_json, param_bytes, dev_type, input_shapes):
+        from . import context as ctx_mod
+        from . import ndarray as nd
+        from .symbol import load_json
+
+        sym = load_json(symbol_json)
+        # params bytes = the NDArray.save container, usually written by
+        # save_checkpoint with "arg:"/"aux:" prefixes
+        fd, tmp = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_bytes)
+            loaded = nd.load(tmp)
+        finally:
+            os.unlink(tmp)
+        if not isinstance(loaded, dict):
+            raise MXNetError("c_predict: params file holds no name map")
+        arg_params, aux_params = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        ctx = ctx_mod.tpu() if dev_type == 2 else ctx_mod.cpu()
+        self._ctx = ctx
+        self._input_names = list(input_shapes)
+
+        args = {}
+        for name in sym.list_arguments():
+            if name in input_shapes:
+                args[name] = nd.zeros(tuple(input_shapes[name]), ctx=ctx)
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                raise MXNetError(
+                    "c_predict: argument %r neither a declared input "
+                    "nor in params" % name)
+        aux = {name: aux_params[name]
+               for name in sym.list_auxiliary_states()
+               if name in aux_params}
+        self._exe = sym.bind(ctx=ctx, args=args, aux_states=aux)
+        self._inputs = {k: args[k] for k in self._input_names}
+        self._outputs = []
+
+    def set_input(self, key, flat_f32):
+        from . import ndarray as nd
+        if key not in self._inputs:
+            raise MXNetError("c_predict: unknown input %r (have %s)"
+                             % (key, self._input_names))
+        shape = self._inputs[key].shape
+        arr = _np.asarray(flat_f32, dtype=_np.float32).reshape(shape)
+        self._inputs[key] = nd.array(arr, ctx=self._ctx)
+
+    def forward(self):
+        outs = self._exe.forward(is_train=False, **self._inputs)
+        self._outputs = [o.asnumpy().astype(_np.float32) for o in outs]
+
+    def num_outputs(self):
+        return len(self._exe.outputs)
+
+    def get_output_shape(self, index):
+        if not self._outputs:
+            self.forward()
+        return list(self._outputs[index].shape)
+
+    def get_output(self, index):
+        if not self._outputs:
+            self.forward()
+        return self._outputs[index].ravel().tobytes()
+
+
+def create(symbol_json, param_bytes, dev_type, keys, shapes):
+    """Entry point called from native code: ``keys`` list of input
+    names, ``shapes`` list of per-input shape lists."""
+    return Predictor(symbol_json, param_bytes, dev_type,
+                     {k: tuple(s) for k, s in zip(keys, shapes)})

@@ -178,9 +178,27 @@ for _mx, _ox in [("elemwise_add", "Add"), ("elemwise_sub", "Sub"),
                  ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
                  ("sqrt", "Sqrt"), ("abs", "Abs"),
                  ("negative", "Neg"), ("erf", "Erf"),
-                 ("add_n", "Sum"), ("dot", "MatMul"),
-                 ("batch_dot", "MatMul")]:
+                 ("add_n", "Sum")]:
     register_op_converter(_mx)(_binop(_ox))
+
+
+@register_op_converter("dot")
+def _dot(name, ins, attrs, ctx):
+    # ONNX MatMul has numpy (batched) semantics; mxnet N-D dot is a
+    # tensordot over (last axis of a, first axis of b), which MatMul
+    # cannot represent.  Ranks of activations are unknown at export, but
+    # an N-D initializer operand proves the mismatch — reject it.
+    for i in ins:
+        if i in ctx.initializers and ctx.initializers[i].ndim > 2:
+            raise MXNetError(
+                "onnx export: N-D 'dot' (tensordot semantics) has no "
+                "MatMul equivalent; reshape to 2-D or use batch_dot")
+    return [_node("MatMul", name, ins)]
+
+
+@register_op_converter("batch_dot")
+def _batch_dot(name, ins, attrs, ctx):
+    return [_node("MatMul", name, ins)]
 
 
 @register_op_converter("Flatten")

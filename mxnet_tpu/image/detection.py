@@ -275,24 +275,28 @@ class ImageDetIter(_img.ImageIter):
 
     def _estimate_max_objects(self, sample=256):
         """Scan up to ``sample`` labels for the dataset's max object
-        count, so every batch pads to ONE static shape (reference
-        estimates the label shape up front; static shapes keep the
-        consumer jit-cache warm)."""
+        count, so batches pad to ONE static shape (reference estimates
+        the label shape up front; static shapes keep the consumer
+        jit-cache warm).  Under-estimates are never lossy — ``next()``
+        grows the pad size when a batch exceeds it."""
+        from .. import recordio
         best = 1
-        try:
-            if self.imglist is not None:
-                for k in list(self.imglist)[:sample]:
-                    best = max(best,
-                               self._parse_label(
-                                   self.imglist[k][0]).shape[0])
-            elif self.imgrec is not None and self.seq is not None:
-                from .. import recordio
-                for k in self.seq[:sample]:
-                    hdr, _ = recordio.unpack(self.imgrec.read_idx(k))
-                    best = max(best,
-                               self._parse_label(hdr.label).shape[0])
-        except Exception:
-            pass
+        if self.imglist is not None:
+            for k in list(self.imglist)[:sample]:
+                best = max(best,
+                           self._parse_label(self.imglist[k][0]).shape[0])
+        elif self.imgrec is not None and self.seq is not None:
+            for k in self.seq[:sample]:
+                hdr, _ = recordio.unpack(self.imgrec.read_idx(k))
+                best = max(best, self._parse_label(hdr.label).shape[0])
+        elif self.imgrec is not None:
+            for _ in range(sample):
+                s = self.imgrec.read()
+                if s is None:
+                    break
+                hdr, _ = recordio.unpack(s)
+                best = max(best, self._parse_label(hdr.label).shape[0])
+            self.imgrec.reset()
         return best
 
     @property
@@ -339,24 +343,27 @@ class ImageDetIter(_img.ImageIter):
             raise StopIteration
         while len(samples) < self.batch_size:
             samples.append(samples[-1])
-        # every batch pads to ONE static (B, max_objects, w) shape;
-        # overflow objects are dropped with a one-time warning
+        # batches pad to one static (B, max_objects, w) shape; an
+        # under-estimate GROWS the pad size (one-time warning — the
+        # label shape changes) rather than dropping ground truth
+        batch_max = max(s[1].shape[0] for s in samples)
+        if batch_max > self._max_objects:
+            if not self._overflow_warned:
+                import logging
+                logging.getLogger("mxnet_tpu").warning(
+                    "ImageDetIter: batch holds %d objects > estimated "
+                    "max_objects=%d; growing the label pad (pass "
+                    "max_objects= to fix the shape up front)",
+                    batch_max, self._max_objects)
+                self._overflow_warned = True
+            self._max_objects = batch_max
         max_obj = self._max_objects
-        if any(s[1].shape[0] > max_obj for s in samples) and \
-                not self._overflow_warned:
-            import logging
-            logging.getLogger("mxnet_tpu").warning(
-                "ImageDetIter: batch contains more than max_objects=%d "
-                "boxes; extra objects are dropped (pass a larger "
-                "max_objects=)", max_obj)
-            self._overflow_warned = True
         w = samples[0][1].shape[1]
         lab = _np.full((self.batch_size, max_obj, w), -1.0, _np.float32)
         dat = _np.stack([_np.transpose(
             s[0].asnumpy() if hasattr(s[0], "asnumpy")
             else _np.asarray(s[0]), (2, 0, 1)) for s in samples])
         for i, (_, b) in enumerate(samples):
-            n = min(b.shape[0], max_obj)
-            lab[i, :n] = b[:n]
+            lab[i, :b.shape[0]] = b
         return mxio.DataBatch(data=[nd_array(dat)],
                               label=[nd_array(lab)], pad=pad)

@@ -476,19 +476,29 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
     g = jnp.ones_like(gamma) if fix_gamma else gamma
 
+    # cuDNN-BN-style mixed precision: low-precision (bf16/f16) I/O is
+    # fine, but statistics and running-stat updates accumulate in f32 —
+    # bf16's 8-bit mantissa rounds away small momentum updates.
+    f32 = jnp.float32
+    stat_data = data if data.dtype == f32 else data.astype(f32)
+
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red_ax)
-        var = jnp.var(data, axis=red_ax)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(stat_data, axis=red_ax)
+        var = jnp.var(stat_data, axis=red_ax)
+        new_mean = (moving_mean.astype(f32) * momentum
+                    + mean * (1 - momentum)).astype(moving_mean.dtype)
+        new_var = (moving_var.astype(f32) * momentum
+                   + var * (1 - momentum)).astype(moving_var.dtype)
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = moving_mean.astype(f32), moving_var.astype(f32)
         new_mean, new_var = moving_mean, moving_var
 
     inv = 1.0 / jnp.sqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + \
-        beta.reshape(bshape)
-    out = out.astype(data.dtype)
+    scale = (g.astype(f32) * inv).reshape(bshape).astype(data.dtype)
+    shift = (beta.astype(f32)
+             - mean * g.astype(f32) * inv).reshape(bshape).astype(
+                 data.dtype)
+    out = data * scale + shift
     import jax
     return (out, jax.lax.stop_gradient(new_mean),
             jax.lax.stop_gradient(new_var))

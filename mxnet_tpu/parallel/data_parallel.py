@@ -42,10 +42,11 @@ class DataParallelTrainer:
 
         self.block = block
         self.loss_fn = loss_fn
-        # amp=True: forward/backward compute in bfloat16 (MXU-native) with
-        # float32 master params and updates — the bf16-first AMP recipe
-        # (contrib/amp); no loss scaler needed, bf16 exponent range
-        # matches f32.
+        # amp=True: the contrib/amp per-op cast hook runs during the
+        # traced forward — MXU-bound ops (conv/FC/matmul) take bfloat16
+        # inputs while the FP32_OPS list (BatchNorm, softmax, reductions,
+        # losses) stays float32; params remain f32 masters.  No loss
+        # scaler needed, bf16 exponent range matches f32.
         self.amp = amp
         self.mesh = mesh if mesh is not None else default_mesh()
         optimizer_params = dict(optimizer_params or {})
@@ -80,6 +81,15 @@ class DataParallelTrainer:
         import jax
         vals = [p.data()._data for p in self._param_objs]
         return [jax.device_put(v, self._rep) for v in vals]
+
+    def sync(self):
+        """Block until every queued step has fully executed (the loss
+        buffer alone can materialize before the tail of the donated-state
+        pipeline — benchmark timing must drain the params too)."""
+        import jax
+        if self._state is not None:
+            jax.block_until_ready(self._state)
+        return self
 
     def sync_back(self):
         """Write trained values back into the Gluon Parameters."""
@@ -121,19 +131,16 @@ class DataParallelTrainer:
         def pure_loss(param_vals, d, l):
             import jax.numpy as jnp
             from .. import random as mxrand
+            from ..ops import registry as _registry
             mxrand.push_trace_key(jax.random.PRNGKey(0))
             _TRACE_STATE.active = getattr(_TRACE_STATE, "active", 0) + 1
             saved = [(p, dict(p._data)) for p in params]
+            prev_hook = _registry._CAST_HOOK
             try:
-                use_vals = param_vals
                 if amp:
-                    use_vals = [v.astype(jnp.bfloat16)
-                                if jnp.issubdtype(v.dtype, jnp.floating)
-                                else v for v in param_vals]
-                    if jnp.issubdtype(jnp.asarray(d).dtype,
-                                      jnp.floating):
-                        d = d.astype(jnp.bfloat16)
-                wrapped = [NDArray(v) for v in use_vals]
+                    from ..contrib.amp.amp import _make_hook
+                    _registry.set_cast_hook(_make_hook("bfloat16"))
+                wrapped = [NDArray(v) for v in param_vals]
                 for p, w in zip(params, wrapped):
                     c = next(iter(p._data))
                     p._data = OrderedDict({c: w})
@@ -143,11 +150,12 @@ class DataParallelTrainer:
                 # capture in-place mutations (aux states) before restore
                 del mutated_flags[:]
                 new_vals = []
-                for w, orig in zip(wrapped, use_vals):
+                for w, orig in zip(wrapped, param_vals):
                     mutated_flags.append(w._data is not orig)
                     new_vals.append(w._data)
                 return loss._data.astype(jnp.float32).mean(), new_vals
             finally:
+                _registry.set_cast_hook(prev_hook)
                 for p, old in saved:
                     p._data = OrderedDict(old)
                 _TRACE_STATE.active -= 1

@@ -1,0 +1,80 @@
+/*!
+ * Embeddable C prediction API.
+ *
+ * Reference: include/mxnet/c_predict_api.h (SURVEY.md §2.1 "C API" row) —
+ * the same flat handle-based surface: create a predictor from a symbol
+ * JSON string + a parameter blob, set named inputs, forward, read
+ * outputs.  Implementation embeds CPython and lowers through the XLA
+ * compute path (src/c_predict_api.cc); link libmxnet_tpu_predict.so.
+ *
+ * All functions return 0 on success, -1 on failure; call
+ * MXPredGetLastError() for the message.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+
+/*! \brief message for the last error on this thread */
+const char* MXPredGetLastError(void);
+
+/*!
+ * \brief create a predictor
+ * \param symbol_json_str symbol graph JSON (Symbol.tojson / -symbol.json)
+ * \param param_bytes parameter container bytes (.params file contents)
+ * \param param_size byte length of param_bytes
+ * \param dev_type 1 = cpu, 2 = tpu
+ * \param dev_id device ordinal
+ * \param num_input_nodes number of declared data inputs
+ * \param input_keys input names, length num_input_nodes
+ * \param input_shape_indptr CSR-style offsets into input_shape_data,
+ *        length num_input_nodes + 1
+ * \param input_shape_data concatenated input shapes
+ * \param out the created predictor
+ */
+int MXPredCreate(const char* symbol_json_str,
+                 const void* param_bytes,
+                 int param_size,
+                 int dev_type, int dev_id,
+                 uint32_t num_input_nodes,
+                 const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const int64_t* input_shape_data,
+                 PredictorHandle* out);
+
+/*! \brief copy a row-major float32 buffer into the named input */
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, uint32_t size);
+
+/*! \brief run the forward pass */
+int MXPredForward(PredictorHandle handle);
+
+/*! \brief number of outputs */
+int MXPredGetNumOutputs(PredictorHandle handle, uint32_t* out);
+
+/*!
+ * \brief shape of output index; *shape_data stays owned by the
+ * predictor until the next MXPred call on this handle
+ */
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+
+/*! \brief copy output index into a float32 buffer of `size` elements */
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size);
+
+/*! \brief free the predictor */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // MXNET_TPU_C_PREDICT_API_H_

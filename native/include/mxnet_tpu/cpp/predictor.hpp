@@ -1,0 +1,115 @@
+/*!
+ * Header-only C++ frontend over the C predict API.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/ (SURVEY.md §2.3 "C++
+ * frontend" row: header-only over the C API).  RAII Predictor with
+ * std::vector I/O; link libmxnet_tpu_predict.so.
+ *
+ *   mxnet_tpu::cpp::Predictor pred(json, params, {{"data", {1,3,224,224}}});
+ *   pred.SetInput("data", img);
+ *   pred.Forward();
+ *   std::vector<float> prob = pred.GetOutput(0);
+ */
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <fstream>
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../c_predict_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline std::string LoadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  return std::string((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+}
+
+class Predictor {
+ public:
+  using ShapeMap = std::map<std::string, std::vector<int64_t>>;
+
+  /*! \brief dev_type 1 = cpu, 2 = tpu */
+  Predictor(const std::string& symbol_json, const std::string& param_blob,
+            const ShapeMap& input_shapes, int dev_type = 1,
+            int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<int64_t> shapes;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      shapes.insert(shapes.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(shapes.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                     static_cast<int>(param_blob.size()), dev_type,
+                     dev_id, static_cast<uint32_t>(keys.size()),
+                     keys.data(), indptr.data(), shapes.data(),
+                     &handle_) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<uint32_t>(data.size())) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+  }
+
+  uint32_t NumOutputs() const {
+    uint32_t n = 0;
+    if (MXPredGetNumOutputs(handle_, &n) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+    return n;
+  }
+
+  std::vector<uint32_t> GetOutputShape(uint32_t index) const {
+    uint32_t* data = nullptr;
+    uint32_t ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, &data, &ndim) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(uint32_t index) const {
+    auto shape = GetOutputShape(index);
+    uint32_t size = std::accumulate(shape.begin(), shape.end(), 1u,
+                                    std::multiplies<uint32_t>());
+    std::vector<float> out(size);
+    if (MXPredGetOutput(handle_, index, out.data(), size) != 0) {
+      throw std::runtime_error(MXPredGetLastError());
+    }
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

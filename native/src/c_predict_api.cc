@@ -1,0 +1,243 @@
+// Embeddable C prediction API — implementation.
+//
+// Reference: src/c_api/c_predict_api.cc.  The reference runs its own
+// C++ graph executor; here the executor IS the Python/XLA stack, so
+// this translation unit embeds CPython (initializing it if the hosting
+// process has not) and drives mxnet_tpu._c_predict.  Every entry point
+// holds the GIL for its duration and converts Python exceptions into
+// the -1/MXPredGetLastError contract.
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_pred_last_error;
+
+struct PredictorState {
+  PyObject* predictor = nullptr;           // mxnet_tpu._c_predict.Predictor
+  std::vector<uint32_t> shape_scratch;     // owns MXPredGetOutputShape data
+};
+
+std::once_flag g_py_init_flag;
+bool g_we_initialized_python = false;
+
+void EnsurePython() {
+  std::call_once(g_py_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);  // no signal handlers: we are a guest
+      g_we_initialized_python = true;
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void CaptureError(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = std::string(where) + ": ";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_pred_last_error = msg;
+}
+
+PyObject* CallHelper(const char* fn_name, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu._c_predict");
+  if (!mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, fn_name);
+  Py_DECREF(mod);
+  if (!fn) return nullptr;
+  PyObject* ret = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return ret;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXPredGetLastError(void) { return g_pred_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const int64_t* input_shape_data, PredictorHandle* out) {
+  (void)dev_id;
+  EnsurePython();
+  GILGuard gil;
+
+  PyObject* keys = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyList_SetItem(shp, j - lo,
+                     PyLong_FromLongLong(input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue(
+      "(sy#iOO)", symbol_json_str, static_cast<const char*>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), dev_type, keys, shapes);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  if (!args) {
+    CaptureError("MXPredCreate");
+    return -1;
+  }
+  PyObject* pred = CallHelper("create", args);
+  Py_DECREF(args);
+  if (!pred) {
+    CaptureError("MXPredCreate");
+    return -1;
+  }
+  auto* st = new PredictorState();
+  st->predictor = pred;
+  *out = st;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, uint32_t size) {
+  auto* st = static_cast<PredictorState*>(handle);
+  GILGuard gil;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* arr = nullptr;
+  if (np && buf) {
+    arr = PyObject_CallMethod(np, "frombuffer", "Os", buf, "float32");
+  }
+  Py_XDECREF(np);
+  Py_XDECREF(buf);
+  if (!arr) {
+    CaptureError("MXPredSetInput");
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(st->predictor, "set_input", "sO",
+                                    key, arr);
+  Py_DECREF(arr);
+  if (!r) {
+    CaptureError("MXPredSetInput");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto* st = static_cast<PredictorState*>(handle);
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(st->predictor, "forward", nullptr);
+  if (!r) {
+    CaptureError("MXPredForward");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetNumOutputs(PredictorHandle handle, uint32_t* out) {
+  auto* st = static_cast<PredictorState*>(handle);
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(st->predictor, "num_outputs",
+                                    nullptr);
+  if (!r) {
+    CaptureError("MXPredGetNumOutputs");
+    return -1;
+  }
+  *out = static_cast<uint32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim) {
+  auto* st = static_cast<PredictorState*>(handle);
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(st->predictor, "get_output_shape",
+                                    "I", index);
+  if (!r) {
+    CaptureError("MXPredGetOutputShape");
+    return -1;
+  }
+  st->shape_scratch.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    st->shape_scratch.push_back(static_cast<uint32_t>(
+        PyLong_AsLong(PyList_GetItem(r, i))));
+  }
+  Py_DECREF(r);
+  *shape_data = st->shape_scratch.data();
+  *shape_ndim = static_cast<uint32_t>(st->shape_scratch.size());
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size) {
+  auto* st = static_cast<PredictorState*>(handle);
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(st->predictor, "get_output", "I",
+                                    index);
+  if (!r) {
+    CaptureError("MXPredGetOutput");
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0 ||
+      static_cast<size_t>(len) != size * sizeof(float)) {
+    Py_DECREF(r);
+    g_pred_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto* st = static_cast<PredictorState*>(handle);
+  if (st) {
+    GILGuard gil;
+    Py_XDECREF(st->predictor);
+    delete st;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -122,6 +122,11 @@ def pack(prefix, root, args):
     base_name = os.path.basename(prefix)
     lsts = [f for f in sorted(os.listdir(base_dir))
             if f.startswith(base_name) and f.endswith(".lst")]
+    if not lsts and os.path.exists(prefix + ".lst"):
+        # --list writes next to the prefix; honor that location even
+        # when --working-dir points elsewhere
+        base_dir = os.path.dirname(prefix) or "."
+        lsts = [base_name + ".lst"]
     if not lsts:
         print("no .lst found for prefix %r in %s; run --list first"
               % (prefix, base_dir))
