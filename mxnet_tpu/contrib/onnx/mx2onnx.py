@@ -193,11 +193,28 @@ def _dot(name, ins, attrs, ctx):
             raise MXNetError(
                 "onnx export: N-D 'dot' (tensordot semantics) has no "
                 "MatMul equivalent; reshape to 2-D or use batch_dot")
-    return [_node("MatMul", name, ins)]
+    nodes = []
+    ins = list(ins)
+    # 2-D transpose flags lower to explicit Transpose nodes
+    for flag, idx in (("transpose_a", 0), ("transpose_b", 1)):
+        if attrs.get(flag):
+            tname = "%s_%s" % (name, flag)
+            nodes.append(_node("Transpose", tname, [ins[idx]],
+                               perm=(1, 0)))
+            ins[idx] = tname
+    nodes.append(_node("MatMul", name, ins))
+    return nodes
 
 
 @register_op_converter("batch_dot")
 def _batch_dot(name, ins, attrs, ctx):
+    if attrs.get("transpose_a") or attrs.get("transpose_b"):
+        # the Transpose perm needs the operand rank, unknown for
+        # activations at export time
+        raise MXNetError(
+            "onnx export: batch_dot with transpose_a/b is unsupported "
+            "(operand rank unknown); transpose explicitly before "
+            "batch_dot")
     return [_node("MatMul", name, ins)]
 
 

@@ -260,6 +260,7 @@ class ImageDetIter(_img.ImageIter):
             aug_list = CreateDetAugmenter(data_shape)
         self.object_width = object_width
         self._max_objects = max_objects  # resolved after super().__init__
+        self._explicit_max = max_objects is not None
         self._overflow_warned = False
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec,
@@ -343,20 +344,32 @@ class ImageDetIter(_img.ImageIter):
             raise StopIteration
         while len(samples) < self.batch_size:
             samples.append(samples[-1])
-        # batches pad to one static (B, max_objects, w) shape; an
-        # under-estimate GROWS the pad size (one-time warning — the
-        # label shape changes) rather than dropping ground truth
+        # batches pad to one static (B, max_objects, w) shape.  An
+        # ESTIMATED pad size grows on under-estimate (shape changes,
+        # one-time warning) rather than dropping ground truth; an
+        # EXPLICIT max_objects= is a shape contract the consumer bound
+        # to, so overflow there clamps with a warning instead.
         batch_max = max(s[1].shape[0] for s in samples)
         if batch_max > self._max_objects:
-            if not self._overflow_warned:
-                import logging
-                logging.getLogger("mxnet_tpu").warning(
-                    "ImageDetIter: batch holds %d objects > estimated "
-                    "max_objects=%d; growing the label pad (pass "
-                    "max_objects= to fix the shape up front)",
-                    batch_max, self._max_objects)
-                self._overflow_warned = True
-            self._max_objects = batch_max
+            import logging
+            log = logging.getLogger("mxnet_tpu")
+            if self._explicit_max:
+                if not self._overflow_warned:
+                    log.warning(
+                        "ImageDetIter: batch holds %d objects > "
+                        "max_objects=%d; extra objects are dropped "
+                        "(raise max_objects=)", batch_max,
+                        self._max_objects)
+                    self._overflow_warned = True
+            else:
+                if not self._overflow_warned:
+                    log.warning(
+                        "ImageDetIter: batch holds %d objects > "
+                        "estimated max_objects=%d; growing the label "
+                        "pad (pass max_objects= to fix the shape up "
+                        "front)", batch_max, self._max_objects)
+                    self._overflow_warned = True
+                self._max_objects = batch_max
         max_obj = self._max_objects
         w = samples[0][1].shape[1]
         lab = _np.full((self.batch_size, max_obj, w), -1.0, _np.float32)
@@ -364,6 +377,7 @@ class ImageDetIter(_img.ImageIter):
             s[0].asnumpy() if hasattr(s[0], "asnumpy")
             else _np.asarray(s[0]), (2, 0, 1)) for s in samples])
         for i, (_, b) in enumerate(samples):
-            lab[i, :b.shape[0]] = b
+            n = min(b.shape[0], max_obj)
+            lab[i, :n] = b[:n]
         return mxio.DataBatch(data=[nd_array(dat)],
                               label=[nd_array(lab)], pad=pad)

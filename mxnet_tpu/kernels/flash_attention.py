@@ -19,7 +19,8 @@ from jax.experimental import pallas as pl
 __all__ = ["flash_attention"]
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale):
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale,
+            causal):
     import jax
     import jax.numpy as jnp
 
@@ -27,6 +28,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale):
     bq, dh = q.shape
     T = k_ref.shape[1]
     nk = T // block_k
+    q_pos = pl.program_id(1) * bq + jnp.arange(bq)
 
     m0 = jnp.full((bq, 1), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((bq, 1), dtype=jnp.float32)
@@ -39,8 +41,12 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
-        msk = mask_ref[0, pl.dslice(i * block_k, block_k)]
-        s = jnp.where(msk[None, :] != 0, s, -1e30)
+        msk = mask_ref[0, 0, pl.dslice(i * block_k, block_k)]
+        valid = msk[None, :] != 0
+        if causal:
+            k_pos = i * block_k + jnp.arange(block_k)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        s = jnp.where(valid, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -54,7 +60,8 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale):
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd_tpu(q, k, v, mask, block_q=128, block_k=128):
+def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
+                   block_k=128):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -74,40 +81,50 @@ def _flash_fwd_tpu(q, k, v, mask, block_q=128, block_k=128):
     block_k = min(block_k, T)
     grid = (B * H, T // block_q)
 
+    # mask as (B, 1, T): the (1, 1, T) block satisfies the (8, 128)
+    # tiling rule (second-to-last block dim equals the array dim) with
+    # static in-kernel indices — a (1, T) block of a (B, T) array does
+    # not, and a dynamic batch index into packed int8 rows is
+    # unprovable for Mosaic.
     out = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, sm_scale=sm_scale),
+        functools.partial(_kernel, block_k=block_k, sm_scale=sm_scale,
+                          causal=causal),
         out_shape=jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, T), lambda bh, qi, H=H: (bh // H, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, qi, H=H: (bh // H, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh),
                                lambda bh, qi: (bh, qi, 0)),
-    )(qt, kt, vt, mask_arr)
+    )(qt, kt, vt, mask_arr[:, None, :])
     return out.reshape(B, H, T, dh).transpose(0, 2, 1, 3)
 
 
-def _reference_attention(q, k, v, mask):
+def _reference_attention(q, k, v, mask, causal=False):
     import jax
     import jax.numpy as jnp
     dh = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    if causal:
+        T = q.shape[1]
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(tri[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _make_flash():
+def _make_flash(causal):
     import jax
 
     @jax.custom_vjp
     def _flash(q, k, v, mask):
-        return _flash_fwd_tpu(q, k, v, mask)
+        return _flash_fwd_tpu(q, k, v, mask, causal=causal)
 
     def fwd(q, k, v, mask):
         return _flash(q, k, v, mask), (q, k, v, mask)
@@ -116,7 +133,8 @@ def _make_flash():
         q, k, v, mask = res
         # reference backward via recompute (fused bwd kernel: future work)
         _, vjp_fn = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, mask),
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, mask,
+                                                    causal=causal),
             q, k, v)
         dq, dk, dv = vjp_fn(g)
         return dq, dk, dv, None
@@ -125,21 +143,21 @@ def _make_flash():
     return _flash
 
 
-_flash_cached = None
+_flash_cached = {}
 
 
-def flash_attention(q, k, v, mask=None):
-    """(B, T, H, dh) attention with a fused online-softmax TPU kernel.
+def flash_attention(q, k, v, mask=None, causal=False):
+    """(B, T, H, dh) attention with a fused online-softmax TPU kernel;
+    ``causal=True`` adds the autoregressive lower-triangular mask.
 
     Falls back to the jnp reference off-TPU (CPU tests) or when shapes
     don't tile (T not divisible by the 128 block, dh not lane-aligned).
     """
     import jax
-    global _flash_cached
     platform = jax.devices()[0].platform
     B, T, H, dh = q.shape
     if platform == "cpu" or T % 128 != 0 or dh not in (64, 128, 256):
-        return _reference_attention(q, k, v, mask)
-    if _flash_cached is None:
-        _flash_cached = _make_flash()
-    return _flash_cached(q, k, v, mask)
+        return _reference_attention(q, k, v, mask, causal=causal)
+    if causal not in _flash_cached:
+        _flash_cached[causal] = _make_flash(causal)
+    return _flash_cached[causal](q, k, v, mask)

@@ -4,3 +4,4 @@
 the transformer/BERT family and future additions.
 """
 from . import transformer
+from . import gpt

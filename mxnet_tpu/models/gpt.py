@@ -1,0 +1,217 @@
+"""Decoder-only (GPT-style) language model family.
+
+No in-tree reference counterpart (MXNet 1.x shipped its LMs via
+GluonNLP scripts); this reuses the flagship transformer core
+(models/transformer.py) with ``causal=True``, a shifted next-token loss,
+and an incremental KV-cache decode loop for generation — the decode
+path is a ``lax.scan`` over positions with per-layer key/value caches,
+so sampling jits into one XLA program.
+
+The same tp/dp/sp/pp/ep mesh machinery applies: ``make_train_step``
+delegates to the transformer's, with labels derived by shifting tokens.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from . import transformer as T
+
+__all__ = ["gpt_config", "gpt_tiny", "init_params", "forward",
+           "make_train_step", "generate"]
+
+
+def gpt_config(**kw):
+    """A TransformerConfig preset for decoder-only LM use."""
+    base = dict(causal=True, type_vocab_size=1)
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+def gpt_tiny(**kw):
+    base = dict(vocab_size=1024, max_len=128, d_model=64, n_heads=4,
+                n_layers=2, d_ff=128, causal=True, type_vocab_size=1)
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+init_params = T.init_params
+forward = T.forward
+
+
+def make_train_step(cfg, mesh=None, learning_rate=1e-4,
+                    weight_decay=0.01):
+    """(init_state, step) for causal-LM training; ``step(state, batch,
+    rng)`` where batch = dict(tokens[, mask]) — labels are the tokens
+    shifted left (next-token prediction), last position ignored."""
+    import jax.numpy as jnp
+
+    if not cfg.causal:
+        cfg = dataclasses.replace(cfg, causal=True)
+    init_state, mlm_step = T.make_train_step(
+        cfg, mesh=mesh, learning_rate=learning_rate,
+        weight_decay=weight_decay)
+
+    def step(state, batch, rng):
+        tokens = batch["tokens"]
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(tokens.shape, bool)
+        labels = jnp.concatenate(
+            [tokens[:, 1:],
+             jnp.full((tokens.shape[0], 1), -100, tokens.dtype)],
+            axis=1)
+        # padded positions (shifted mask 0) must not contribute to the
+        # next-token loss
+        shifted_mask = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros((tokens.shape[0], 1), bool)], axis=1)
+        labels = jnp.where(shifted_mask, labels, -100)
+        lm_batch = {"tokens": tokens, "labels": labels, "mask": mask}
+        return mlm_step(state, lm_batch, rng)
+
+    return init_state, step
+
+
+# ---------------------------------------------------------------------------
+# incremental decoding
+# ---------------------------------------------------------------------------
+
+def _decode_one(params, cfg, token, pos, caches):
+    """One decode step: token (B,) int32 at position pos; caches is a
+    list of per-layer dicts {"k": (B, L, H, dh), "v": ...}.  Returns
+    (logits (B, V), new caches)."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(cfg.dtype)
+    B = token.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    dh = D // H
+
+    x = params["tok_emb"][token].astype(cdt)           # (B, D)
+    x = x + jax.lax.dynamic_index_in_dim(
+        params["pos_emb"], pos, keepdims=False).astype(cdt)
+    x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                      params["emb_ln"]["b"].astype(cdt))
+
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        def dn(w):
+            return w.astype(cdt)
+        q = (x @ dn(layer["wq"]) + dn(layer["bq"])).reshape(B, H, dh)
+        k = (x @ dn(layer["wk"]) + dn(layer["bk"])).reshape(B, H, dh)
+        v = (x @ dn(layer["wv"]) + dn(layer["bv"])).reshape(B, H, dh)
+        ck = jax.lax.dynamic_update_index_in_dim(cache["k"],
+                                                 k[:, None], pos, 1)
+        cv = jax.lax.dynamic_update_index_in_dim(cache["v"],
+                                                 v[:, None], pos, 1)
+        new_caches.append({"k": ck, "v": cv})
+        L = ck.shape[1]
+        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / jnp.sqrt(
+                           jnp.float32(dh))
+        valid = jnp.arange(L)[None, None, :] <= pos
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhl,blhd->bhd", p,
+                          cv.astype(jnp.float32)).astype(cdt)
+        attn = attn.reshape(B, D) @ dn(layer["wo"]) + dn(layer["bo"])
+        x = T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
+                          dn(layer["ln1"]["b"]))
+        if "moe" in layer:
+            from ..parallel.moe import moe_ffn
+            h, _ = moe_ffn(x[:, None, :], layer["moe"],
+                           n_experts=cfg.n_experts,
+                           top_k=cfg.expert_top_k,
+                           capacity_factor=cfg.capacity_factor,
+                           dtype=cdt)
+            h = h[:, 0, :]
+        else:
+            h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
+                            approximate=True)
+            h = h @ dn(layer["w2"]) + dn(layer["b2"])
+        x = T._layer_norm(x + h, dn(layer["ln2"]["g"]),
+                          dn(layer["ln2"]["b"]))
+
+    h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt),
+                    approximate=True)
+    h = T._layer_norm(h, params["mlm_ln"]["g"].astype(cdt),
+                      params["mlm_ln"]["b"].astype(cdt))
+    logits = h @ params["tok_emb"].T.astype(cdt) + \
+        params["mlm_bias"].astype(cdt)
+    return logits.astype(jnp.float32), new_caches
+
+
+def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
+             rng=None):
+    """Autoregressive generation with KV caches.
+
+    prompt: (B, P) int32.  temperature 0 → greedy argmax; otherwise
+    softmax sampling.  Returns (B, P + max_new_tokens) int32.  The whole
+    loop (prefill + decode scan) jits into one program per
+    (P, max_new_tokens) pair.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not cfg.causal:
+        cfg = dataclasses.replace(cfg, causal=True)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError("generate: %d tokens > cfg.max_len=%d"
+                         % (total, cfg.max_len))
+    H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+
+    def empty_caches():
+        return [{"k": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype)),
+                 "v": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype))}
+                for _ in params["layers"]]
+
+    @jax.jit
+    def run(params, prompt, rng):
+        caches = empty_caches()
+
+        # prefill: feed prompt tokens one by one through the cached
+        # decoder (small P; full-sequence prefill is a later fusion)
+        def prefill(carry, t):
+            caches, _ = carry
+            logits, caches = _decode_one(params, cfg, prompt[:, t], t,
+                                         caches)
+            return (caches, logits), ()
+
+        (caches, logits), _ = jax.lax.scan(
+            prefill, (caches, jnp.zeros((B, cfg.vocab_size),
+                                        jnp.float32)),
+            jnp.arange(P))
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits / temperature, axis=-1).astype(jnp.int32)
+
+        def decode(carry, i):
+            caches, logits, key = carry
+            key, sub = jax.random.split(key)
+            tok = sample(logits, sub)
+            new_logits, caches = _decode_one(params, cfg, tok, P + i,
+                                             caches)
+            return (caches, new_logits, key), tok
+
+        # N-1 decode steps produce N-1 tokens plus the logits for the
+        # last one — sampling it outside the scan avoids a wasted
+        # full decoder forward whose logits nothing reads
+        (_, last_logits, key), toks = jax.lax.scan(
+            decode, (caches, logits, rng),
+            jnp.arange(max_new_tokens - 1))
+        key, sub = jax.random.split(key)
+        last = sample(last_logits, sub)
+        toks = jnp.concatenate([toks.T.astype(jnp.int32),
+                                last[:, None].astype(jnp.int32)], axis=1)
+        return jnp.concatenate([prompt, toks], axis=1)
+
+    return run(params, prompt, rng)

@@ -56,6 +56,8 @@ class TransformerConfig:
     # GPipe microbatch count when the mesh has a 'pp' axis
     # (parallel/pipeline.py); ignored otherwise.
     pp_microbatches: int = 2
+    # autoregressive (decoder/GPT) attention masking (models/gpt.py)
+    causal: bool = False
 
 
 def bert_base(**kw):
@@ -195,17 +197,21 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None):
         from ..parallel.ring_attention import sequence_parallel_attention
         return sequence_parallel_attention(
             q, k, v, mask, mesh=mesh, seq_axis="sp",
-            method=cfg.seq_parallel)
+            method=cfg.seq_parallel, causal=cfg.causal)
     if cfg.use_flash:
         try:
             from ..kernels.flash_attention import flash_attention
-            return flash_attention(q, k, v, mask=mask)
+            return flash_attention(q, k, v, mask=mask, causal=cfg.causal)
         except Exception:
             pass
     dh = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+    if cfg.causal:
+        T = q.shape[1]
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(tri[None, None], logits, -1e9)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

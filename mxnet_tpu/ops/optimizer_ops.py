@@ -226,9 +226,28 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01,
 # grouped multi-tensor updates (one dispatch, many params)
 # ---------------------------------------------------------------------------
 
+def _use_fused_group(tensors):
+    # fused path computes in f32 end-to-end; restrict it to f32 groups
+    # so numerics stay bit-identical with the per-tensor loop
+    import os
+    if os.environ.get("MXNET_FUSED_OPTIMIZER", "1") != "1":
+        return False
+    import jax.numpy as jnp
+    return all(getattr(t, "dtype", None) == jnp.float32
+               for t in tensors)
+
+
 @register("multi_sgd_update", variadic=True, num_outputs=-1)
 def multi_sgd_update(data, lrs=None, wds=None, rescale_grad=1.0,
                      clip_gradient=-1.0, num_weights=1, **kw):
+    ws = [data[2 * i] for i in range(num_weights)]
+    if num_weights > 1 and _use_fused_group(data):
+        from ..kernels.fused_optimizer import fused_multi_sgd
+        gs = [data[2 * i + 1] for i in range(num_weights)]
+        outs, _ = fused_multi_sgd(ws, gs, lrs=lrs, wds=wds,
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient)
+        return tuple(outs)
     outs = []
     for i in range(num_weights):
         w, g = data[2 * i], data[2 * i + 1]
@@ -244,6 +263,16 @@ def multi_sgd_update(data, lrs=None, wds=None, rescale_grad=1.0,
 def multi_sgd_mom_update(data, lrs=None, wds=None, momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0,
                          num_weights=1, **kw):
+    ws = [data[3 * i] for i in range(num_weights)]
+    if num_weights > 1 and _use_fused_group(data):
+        from ..kernels.fused_optimizer import fused_multi_sgd
+        gs = [data[3 * i + 1] for i in range(num_weights)]
+        ms = [data[3 * i + 2] for i in range(num_weights)]
+        outs, moms = fused_multi_sgd(ws, gs, ms, lrs=lrs, wds=wds,
+                                     momentum=momentum,
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)
+        return tuple(outs) + tuple(moms)
     outs = []
     moms = []
     for i in range(num_weights):

@@ -297,3 +297,40 @@ def test_sequence_ops():
     assert np.all(o[2, 0] == -1) and np.all(o[2, 1] == x[2, 1])
     last = nd.SequenceLast(nd.array(x), lens, use_sequence_length=True)
     assert np.allclose(last.asnumpy(), np.stack([x[1, 0], x[2, 1]]))
+
+
+def test_fused_multi_sgd_matches_loop():
+    """Pallas grouped optimizer kernel == per-tensor sgd_update loop."""
+    import os
+    import numpy as np
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    shapes = [(7, 5), (33,), (4, 4, 4), (129,)]
+    ws = [nd.array(rng.randn(*s).astype("float32")) for s in shapes]
+    gs = [nd.array(rng.randn(*s).astype("float32")) for s in shapes]
+    ms = [nd.array(np.zeros(s, "float32")) for s in shapes]
+    lrs = [0.1, 0.2, 0.05, 0.3]
+    wds = [0.0, 0.01, 0.1, 0.0]
+
+    def run(fused):
+        os.environ["MXNET_FUSED_OPTIMIZER"] = "1" if fused else "0"
+        try:
+            data = []
+            moms = [m.copy() for m in ms]
+            for w, g, m in zip(ws, gs, moms):
+                data.extend([w.copy(), g, m])
+            outs = nd.multi_sgd_mom_update(
+                *data, lrs=lrs, wds=wds, momentum=0.9,
+                rescale_grad=0.5, clip_gradient=1.0, num_weights=4)
+            return ([o.asnumpy() for o in outs[:4]],
+                    [m.asnumpy() for m in moms])
+        finally:
+            os.environ["MXNET_FUSED_OPTIMIZER"] = "1"
+
+    outs_f, moms_f = run(True)
+    outs_r, moms_r = run(False)
+    for a, b in zip(outs_f, outs_r):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    for a, b in zip(moms_f, moms_r):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
