@@ -1,0 +1,51 @@
+"""Train a tiny GPT on a toy sequence task, then sample from it with the
+KV-cache decoder (models/gpt.py).
+
+    JAX_PLATFORMS=cpu python examples/gpt_generate.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=80)
+    p.add_argument("--period", type=int, default=8,
+                   help="length of the repeating token pattern")
+    p.add_argument("--temperature", type=float, default=0.0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny(vocab_size=32, max_len=64, dropout=0.0,
+                       use_flash=False, dtype="float32")
+    init_state, step = gpt.make_train_step(cfg, learning_rate=1e-2)
+    state = init_state(jax.random.PRNGKey(0))
+
+    pattern = jnp.arange(1, args.period + 1, dtype=jnp.int32)
+    seq = jnp.tile(pattern, 8)[None, :48]
+    batch = {"tokens": jnp.tile(seq, (8, 1))}
+    for i in range(args.steps):
+        state, loss = step(state, batch, jax.random.PRNGKey(i))
+        if i % 20 == 0:
+            print("step %3d loss %.4f" % (i, float(loss)))
+    print("final loss %.4f" % float(loss))
+
+    prompt = pattern[None, :4]
+    out = gpt.generate(state[0], cfg, prompt, 3 * args.period,
+                       temperature=args.temperature,
+                       rng=jax.random.PRNGKey(7))
+    print("prompt      :", np.asarray(prompt[0]).tolist())
+    print("continuation:", np.asarray(out[0, 4:]).tolist())
+
+
+if __name__ == "__main__":
+    main()

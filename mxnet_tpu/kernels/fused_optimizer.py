@@ -111,6 +111,13 @@ def fused_multi_sgd(weights, grads, moms=None, *, lrs, wds,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    if len(lrs) != len(weights) or len(wds) != len(weights):
+        # the per-tensor loop path would IndexError; fail just as loudly
+        # instead of silently zero-padding lr over trailing tensors
+        raise ValueError(
+            "fused_multi_sgd: %d weights need %d lrs / %d wds"
+            % (len(weights), len(lrs), len(wds)))
+
     wflat, meta = group_flatten(weights)
     gflat, _ = group_flatten(grads)
     total = wflat.size

@@ -160,11 +160,18 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
         rng = jax.random.PRNGKey(0)
 
     B, P = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
     total = P + max_new_tokens
     if total > cfg.max_len:
         raise ValueError("generate: %d tokens > cfg.max_len=%d"
                          % (total, cfg.max_len))
     H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+
+    cache_key = (cfg, B, P, max_new_tokens, float(temperature))
+    cached = _generate_cache.get(cache_key)
+    if cached is not None:
+        return cached(params, prompt, rng)
 
     def empty_caches():
         return [{"k": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype)),
@@ -214,4 +221,10 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
                                 last[:, None].astype(jnp.int32)], axis=1)
         return jnp.concatenate([prompt, toks], axis=1)
 
+    # cache the jitted runner so repeated same-shape calls reuse the
+    # compiled program (jax.jit's cache is keyed on the fn object)
+    _generate_cache[cache_key] = run
     return run(params, prompt, rng)
+
+
+_generate_cache: Dict[Any, Any] = {}
