@@ -173,10 +173,14 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
     if cached is not None:
         return cached(params, prompt, rng)
 
+    # close over plain ints only — capturing `params` here would pin the
+    # first call's weights alive inside the cached jit closure
+    n_layers = len(params["layers"])
+
     def empty_caches():
         return [{"k": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype)),
                  "v": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype))}
-                for _ in params["layers"]]
+                for _ in range(n_layers)]
 
     @jax.jit
     def run(params, prompt, rng):
@@ -222,9 +226,13 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
         return jnp.concatenate([prompt, toks], axis=1)
 
     # cache the jitted runner so repeated same-shape calls reuse the
-    # compiled program (jax.jit's cache is keyed on the fn object)
+    # compiled program (jax.jit's cache is keyed on the fn object);
+    # bounded FIFO so shape churn cannot grow memory forever
+    if len(_generate_cache) >= _GENERATE_CACHE_MAX:
+        _generate_cache.pop(next(iter(_generate_cache)))
     _generate_cache[cache_key] = run
     return run(params, prompt, rng)
 
 
 _generate_cache: Dict[Any, Any] = {}
+_GENERATE_CACHE_MAX = 16

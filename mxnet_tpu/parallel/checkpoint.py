@@ -1,0 +1,88 @@
+"""Sharded checkpoint save/restore for mesh-distributed training state.
+
+Reference: the `.params` container (``NDArray::Save/Load``) is the
+single-host format (SURVEY.md §5.4, kept in ``ndarray.save/load``); the
+survey marks a "sharded multi-host variant" as the TPU extension — this
+is it, built on orbax: each host writes only its shards, restore
+re-shards to the target mesh layout, so checkpoints of tp/dp/pp/ep
+-sharded (params, opt_state) pytrees round-trip without gathering to
+one host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ..base import MXNetError
+
+__all__ = ["save_sharded", "restore_sharded", "latest_step"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_sharded(path, state, step: Optional[int] = None, force=True):
+    """Write ``state`` (a pytree of jax arrays, arbitrary shardings) to
+    ``path`` (or ``path/step_N`` when ``step`` is given)."""
+    import orbax.checkpoint  # noqa: F401 — fail early with ImportError
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, "step_%d" % step)
+    ckpt = _checkpointer()
+    ckpt.save(path, state, force=force)
+    ckpt.wait_until_finished()
+    return path
+
+
+def restore_sharded(path, template, step: Optional[int] = None):
+    """Restore into the structure/shardings of ``template`` — either a
+    live state pytree (its values supply shapes/dtypes/shardings) or a
+    pytree of ``jax.ShapeDtypeStruct`` with shardings attached."""
+    import jax
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, "step_%d" % step)
+    if not os.path.exists(path):
+        raise MXNetError("checkpoint path %r does not exist" % path)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    # the template's mesh (from any NamedSharding leaf): single-device
+    # leaves (e.g. optimizer step counters created eagerly) restore as
+    # mesh-replicated so the whole state shares one device set — a
+    # committed single-device leaf next to mesh-sharded params makes
+    # jit reject the state
+    mesh = None
+    for leaf in jax.tree_util.tree_leaves(template):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, NamedSharding):
+            mesh = s.mesh
+            break
+
+    def as_abstract(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        s = getattr(x, "sharding", None)
+        if mesh is not None and not isinstance(s, NamedSharding):
+            s = NamedSharding(mesh, PartitionSpec())
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+    abstract = jax.tree_util.tree_map(as_abstract, template)
+    return _checkpointer().restore(path, abstract)
+
+
+def latest_step(path):
+    """Largest N among ``path/step_N`` subdirectories, or None."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for d in os.listdir(path):
+        if d.startswith("step_"):
+            try:
+                steps.append(int(d[5:]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None

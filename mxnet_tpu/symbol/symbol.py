@@ -536,6 +536,24 @@ class Symbol:
         with open(fname, "w") as f:
             f.write(self.tojson())
 
+    def optimize_for(self, backend, args=None, aux=None, **kwargs):
+        """Apply a named graph pass or backend pass-list (reference:
+        ``Symbol.optimize_for`` + ``SubgraphProperty`` backends).
+
+        ``backend``: a pass name from ``symbol.passes.list_passes()``
+        or ``"default"`` (CSE + conv/BN folding, the inference recipe).
+        Returns ``(sym, arg_params, aux_params)`` — passes may rewrite
+        params (e.g. ``fold_conv_bn``).
+        """
+        from . import passes
+        names = ([backend] if backend != "default"
+                 else ["eliminate_common_expr", "fold_conv_bn"])
+        sym, args, aux = self, dict(args or {}), dict(aux or {})
+        for name in names:
+            sym, args, aux = passes.apply_pass(sym, name, args, aux,
+                                               **kwargs)
+        return sym, args, aux
+
     # -- binding ----------------------------------------------------------
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

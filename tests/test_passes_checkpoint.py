@@ -1,0 +1,177 @@
+"""Graph passes (fold_conv_bn, CSE, Symbol.optimize_for) and sharded
+orbax checkpointing (SURVEY.md §2.1 subgraph row / §5.4 extension)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _bind_forward(s, params, data, aux=None):
+    args = {}
+    for n in s.list_arguments():
+        if n == "data":
+            args[n] = data
+        else:
+            args[n] = params[n]
+    ex = s.bind(ctx=mx.cpu(), args=args, aux_states=aux or {})
+    return ex.forward()[0].asnumpy()
+
+
+def _conv_bn_net():
+    x = sym.Variable("data")
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                        name="c0")
+    b = sym.BatchNorm(c, fix_gamma=False, name="b0")
+    r = sym.Activation(b, act_type="relu", name="r0")
+    c2 = sym.Convolution(r, kernel=(1, 1), num_filter=4, no_bias=True,
+                         name="c1")
+    b2 = sym.BatchNorm(c2, name="b1")
+    return sym.Pooling(b2, global_pool=True, pool_type="avg", name="p0")
+
+
+def _conv_bn_params(s, shape):
+    rng = np.random.RandomState(0)
+    shapes, _, aux_shapes = s.infer_shape(data=shape)
+    args, aux = {}, {}
+    for name, shp in zip(s.list_arguments(), shapes):
+        if name == "data":
+            continue
+        if name.endswith("_gamma"):
+            args[name] = nd.array(
+                rng.uniform(0.5, 1.5, shp).astype("float32"))
+        else:
+            args[name] = nd.array(
+                rng.uniform(-0.5, 0.5, shp).astype("float32"))
+    for name, shp in zip(s.list_auxiliary_states(), aux_shapes):
+        if name.endswith("_moving_var"):
+            aux[name] = nd.array(
+                rng.uniform(0.5, 2.0, shp).astype("float32"))
+        else:
+            aux[name] = nd.array(
+                rng.uniform(-0.5, 0.5, shp).astype("float32"))
+    return args, aux
+
+
+def test_fold_conv_bn_preserves_outputs():
+    s = _conv_bn_net()
+    shape = (2, 3, 8, 8)
+    args, aux = _conv_bn_params(s, shape)
+    data = nd.array(np.random.RandomState(1).randn(*shape).astype(
+        "float32"))
+    ref = _bind_forward(s, args, data, aux)
+
+    s2, args2, aux2 = s.optimize_for("fold_conv_bn", args, aux)
+    ops = [n.op.name for n in s2._nodes() if not n.is_var]
+    assert "BatchNorm" not in ops
+    assert not aux2  # moving stats consumed
+    got = _bind_forward(s2, args2, data, aux2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_conv_bn_skips_shared_conv():
+    """A conv consumed by two heads must not be folded into one BN."""
+    x = sym.Variable("data")
+    c = sym.Convolution(x, kernel=(1, 1), num_filter=4, name="c0")
+    b = sym.BatchNorm(c, name="b0")
+    out = sym.elemwise_add(b, c, name="add0")
+    args, aux = _conv_bn_params(out, (1, 2, 4, 4))
+    s2, _, _ = out.optimize_for("fold_conv_bn", args, aux)
+    ops = [n.op.name for n in s2._nodes() if not n.is_var]
+    assert "BatchNorm" in ops   # unchanged
+
+
+def test_eliminate_common_expr():
+    x = sym.Variable("data")
+    a = sym.exp(x, name="e1")
+    b = sym.exp(x, name="e2")     # identical subexpression
+    out = sym.elemwise_add(a, b, name="sum")
+    n_before = len([n for n in out._nodes() if not n.is_var])
+    s2, _, _ = out.optimize_for("eliminate_common_expr")
+    n_after = len([n for n in s2._nodes() if not n.is_var])
+    assert n_after == n_before - 1
+    data = nd.array(np.random.randn(3, 4).astype("float32"))
+    np.testing.assert_allclose(
+        _bind_forward(s2, {}, data), _bind_forward(out, {}, data),
+        rtol=1e-6)
+
+
+def test_cse_never_merges_dropout():
+    x = sym.Variable("data")
+    d1 = sym.Dropout(x, p=0.5, name="d1")
+    d2 = sym.Dropout(x, p=0.5, name="d2")
+    out = sym.elemwise_add(d1, d2, name="s")
+    n_before = len([n for n in out._nodes() if not n.is_var])
+    s2, _, _ = out.optimize_for("eliminate_common_expr")
+    assert len([n for n in s2._nodes() if not n.is_var]) == n_before
+
+
+def test_optimize_for_default_pipeline():
+    s = _conv_bn_net()
+    args, aux = _conv_bn_params(s, (1, 3, 8, 8))
+    s2, args2, aux2 = s.optimize_for("default", args, aux)
+    ops = [n.op.name for n in s2._nodes() if not n.is_var]
+    assert "BatchNorm" not in ops
+
+
+def test_unknown_pass_raises():
+    x = sym.Variable("data")
+    with pytest.raises(mx.MXNetError):
+        sym.relu(x).optimize_for("no_such_pass")
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoint
+# ---------------------------------------------------------------------------
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import (make_mesh, save_sharded,
+                                    restore_sharded, latest_step)
+    from mxnet_tpu.models import transformer as T
+
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    cfg = T.bert_tiny(use_flash=False, remat=False, dropout=0.0,
+                      dtype="float32")
+    init_state, step = T.make_train_step(cfg, mesh=mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jnp.arange(4 * 32, dtype=jnp.int32).reshape(4, 32) % 100
+    labels = jnp.where(jnp.arange(32)[None] % 4 == 0, tokens, -100)
+    batch = {"tokens": tokens, "labels": labels,
+             "mask": jnp.ones((4, 32), bool)}
+    state, _ = step(state, batch, jax.random.PRNGKey(1))
+
+    ckdir = str(tmp_path / "ck")
+    save_sharded(ckdir, state, step=3)
+    assert latest_step(ckdir) == 3
+
+    fresh = init_state(jax.random.PRNGKey(9))
+    restored = restore_sharded(ckdir, fresh, step=3)
+
+    orig_leaves = jax.tree_util.tree_leaves(state)
+    tmpl_leaves = jax.tree_util.tree_leaves(fresh)
+    rest_leaves = jax.tree_util.tree_leaves(restored)
+    assert len(orig_leaves) == len(rest_leaves)
+    for a, t, b in zip(orig_leaves, tmpl_leaves, rest_leaves):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-6)
+        # contract: NamedSharding template leaves restore into exactly
+        # that sharding; single-device leaves (eager opt counters) are
+        # promoted to mesh-replicated so the state shares one device set
+        from jax.sharding import NamedSharding, PartitionSpec
+        if isinstance(t.sharding, NamedSharding):
+            assert b.sharding.is_equivalent_to(t.sharding, t.ndim)
+        else:
+            assert b.sharding == NamedSharding(mesh, PartitionSpec())
+
+    # training continues from the restored state
+    state2, loss2 = step(restored, batch, jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss2))
+
+
+def test_restore_missing_raises(tmp_path):
+    from mxnet_tpu.parallel import restore_sharded
+    with pytest.raises(mx.MXNetError):
+        restore_sharded(str(tmp_path / "nope"), {})
