@@ -753,3 +753,60 @@ def ndim(a):
 
 def size(a):
     return asarray(a).size
+
+
+def _scalarize_q(q):
+    # q may be scalar, list, or (nd)array (numpy semantics); attrs skip
+    # the NDArray→jax unwrap, so convert array-likes here
+    if hasattr(q, "asnumpy"):
+        return q.asnumpy()
+    return q
+
+
+def percentile(a, q, axis=None, keepdims=False):
+    return _apply("_np_percentile", a, q=_scalarize_q(q), axis=axis,
+                  keepdims=keepdims)
+
+
+def quantile(a, q, axis=None, keepdims=False):
+    return _apply("_np_quantile", a, q=_scalarize_q(q), axis=axis,
+                  keepdims=keepdims)
+
+
+def cov(m, rowvar=True, bias=False, ddof=None):
+    return _apply("_np_cov", m, rowvar=rowvar, bias=bias, ddof=ddof)
+
+
+def histogram(a, bins=10, range=None):
+    return _apply("_np_histogram", a, bins=bins, range=range)
+
+
+def broadcast_arrays(*args):
+    import jax.numpy as jnp
+
+    def unwrap(a):
+        a = _to_input(a)
+        return a._data if isinstance(a, NDArray) else a
+
+    outs = jnp.broadcast_arrays(*[unwrap(a) for a in args])
+    return [ndarray(o) for o in outs]
+
+
+def column_stack(tup):
+    return _apply("_np_column_stack", *tup)
+
+
+def digitize(x, bins, right=False):
+    return _apply("_np_digitize", x, bins, right=right)
+
+
+def diff(a, n=1, axis=-1):
+    return _apply("_np_diff", a, n=n, axis=axis)
+
+
+def trapz(y, dx=1.0, axis=-1):
+    return _apply("_np_trapz", y, dx=dx, axis=axis)
+
+
+def ediff1d(ary):
+    return _apply("_np_ediff1d", ary)

@@ -394,3 +394,48 @@ def _np_argsort(a, axis=-1, **kw):
 def _np_gradient(a, axis=None, **kw):
     out = _j().gradient(a, axis=axis)
     return tuple(out) if isinstance(out, (list, tuple)) else out
+
+
+@register("_np_percentile", no_grad=True)
+def _np_percentile(a, q=None, axis=None, keepdims=False, **kw):
+    return _j().percentile(a, q, axis=axis, keepdims=keepdims)
+
+
+@register("_np_quantile", no_grad=True)
+def _np_quantile(a, q=None, axis=None, keepdims=False, **kw):
+    return _j().quantile(a, q, axis=axis, keepdims=keepdims)
+
+
+@register("_np_cov")
+def _np_cov(m, rowvar=True, bias=False, ddof=None, **kw):
+    return _j().cov(m, rowvar=rowvar, bias=bias, ddof=ddof)
+
+
+@register("_np_histogram", no_grad=True, num_outputs=2)
+def _np_histogram(a, bins=10, range=None, **kw):
+    return _j().histogram(a, bins=bins, range=range)
+
+
+@register("_np_column_stack", variadic=True)
+def _np_column_stack(seq, **kw):
+    return _j().column_stack(seq)
+
+
+@register("_np_digitize", no_grad=True)
+def _np_digitize(x, bins, right=False, **kw):
+    return _j().digitize(x, bins, right=right)
+
+
+@register("_np_diff")
+def _np_diff(a, n=1, axis=-1, **kw):
+    return _j().diff(a, n=n, axis=axis)
+
+
+@register("_np_trapz")
+def _np_trapz(y, dx=1.0, axis=-1, **kw):
+    return _j().trapezoid(y, dx=dx, axis=axis)
+
+
+@register("_np_ediff1d")
+def _np_ediff1d(ary, **kw):
+    return _j().ediff1d(ary)

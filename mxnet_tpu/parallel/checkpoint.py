@@ -18,9 +18,17 @@ from ..base import MXNetError
 __all__ = ["save_sharded", "restore_sharded", "latest_step"]
 
 
+_CKPT = None
+
+
 def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+    # one process-wide checkpointer: orbax's async machinery owns a
+    # background thread per instance, so per-call construction leaks
+    global _CKPT
+    if _CKPT is None:
+        import orbax.checkpoint as ocp
+        _CKPT = ocp.StandardCheckpointer()
+    return _CKPT
 
 
 def save_sharded(path, state, step: Optional[int] = None, force=True):

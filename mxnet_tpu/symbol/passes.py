@@ -74,7 +74,7 @@ def _rebuild(sym, replace):
 
 
 @register_pass("fold_conv_bn")
-def fold_conv_bn(sym, arg_params, aux_params, eps_default=1e-3):
+def fold_conv_bn(sym, arg_params, aux_params, eps_default=1e-3, **kw):
     """Fold inference-mode BatchNorm into the preceding Convolution's
     weight/bias (reference: the oneDNN/TensorRT subgraph fusers do this
     below the C ABI).  Rewrites BOTH the graph and the params; returns
@@ -94,7 +94,6 @@ def fold_conv_bn(sym, arg_params, aux_params, eps_default=1e-3):
         return None
 
     replace = {}
-    consumed = set()
     from ..ndarray import array as nd_array
     order = sym._nodes()
     conv_consumers: Dict[int, int] = {}
@@ -142,10 +141,6 @@ def fold_conv_bn(sym, arg_params, aux_params, eps_default=1e-3):
         fb_name = data.name + "_bnfold_bias"
         arg_params[fw_name] = nd_array(new_w)
         arg_params[fb_name] = nd_array(new_b)
-        consumed.update(names)
-        consumed.add(wname)
-        if bname:
-            consumed.add(bname)
 
         attrs = dict(data.attrs)
         attrs["no_bias"] = False
@@ -186,7 +181,7 @@ def eliminate_common_expr(sym, arg_params, aux_params, **kw):
         key = (op.name,
                tuple((id(replace.get(id(i), i)), oi)
                      for (i, oi) in node.inputs),
-               node.pos_attrs,
+               repr(node.pos_attrs),
                tuple(sorted((k, repr(v))
                             for k, v in node.attrs.items())))
         if key in canon:

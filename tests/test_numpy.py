@@ -272,3 +272,24 @@ class TestSearchLogic:
         assert not np.array_equal(a, a + 1)
         _assert_close(np.bincount(np.array([0, 1, 1, 3])),
                       onp.bincount([0, 1, 1, 3]))
+
+
+def test_np_statistics_and_misc_extensions():
+    """percentile/quantile/cov/histogram/broadcast_arrays/column_stack/
+    digitize/diff/trapz/ediff1d coverage."""
+    a = np.array([[1., 2., 3.], [4., 5., 6.]])
+    assert abs(float(np.percentile(a, 50)) - 3.5) < 1e-5
+    assert abs(float(np.quantile(a, 0.5)) - 3.5) < 1e-5
+    assert np.cov(a).shape == (2, 2)
+    h, edges = np.histogram(np.array([1., 2., 2., 3.]), bins=3)
+    assert h.asnumpy().sum() == 4 and edges.shape == (4,)
+    b0, b1 = np.broadcast_arrays(np.array([[1.], [2.]]),
+                                 np.array([1., 2., 3.]))
+    assert b0.shape == b1.shape == (2, 3)
+    assert np.column_stack([np.array([1., 2.]),
+                            np.array([3., 4.])]).shape == (2, 2)
+    assert np.digitize(np.array([0.5, 1.5, 2.5]),
+                       np.array([1., 2.])).asnumpy().tolist() == [0, 1, 2]
+    assert np.diff(np.array([1., 4., 9.])).asnumpy().tolist() == [3., 5.]
+    assert abs(float(np.trapz(np.array([1., 2., 3.]))) - 4.0) < 1e-6
+    assert np.ediff1d(a).shape == (5,)

@@ -118,6 +118,7 @@ def test_lm_perplexity_improves():
     ("bert_pretrain.py", ["--steps", "2", "--seq-len", "64",
                           "--batch-size", "4", "--dp", "4", "--tp", "2"]),
     ("gpt_generate.py", ["--steps", "10"]),
+    ("nmt_bucketing.py", ["--batches", "12", "--batch-size", "16"]),
 ])
 def test_example_runs(script, extra):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
