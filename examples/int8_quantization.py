@@ -1,0 +1,101 @@
+"""Post-training INT8 quantization of a trained convnet
+(reference: example/quantization; SURVEY.md §2.2 "Quantization" row).
+
+Flow: train fp32 → calibrate on sample batches (minmax or KL-entropy)
+→ rewrite the graph with int8 ops → compare accuracy.
+
+    JAX_PLATFORMS=cpu python examples/int8_quantization.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.quantization import quantize_model
+
+
+def make_data(n, seed=0):
+    centers = np.random.RandomState(77).randn(4, 1, 8, 8).astype(
+        "float32")
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, n)
+    x = centers[y] + rng.randn(n, 1, 8, 8).astype("float32") * 0.5
+    return x, y
+
+
+def build_symbol():
+    x = sym.Variable("data")
+    h = sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="c0")
+    h = sym.Activation(h, act_type="relu", name="r0")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="p0")
+    h = sym.Flatten(h, name="fl")
+    h = sym.FullyConnected(h, num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def accuracy(s, args, aux, X, Y, batch=64):
+    # bind once, feed per batch — rebinding would recompile each batch
+    assert len(X) % batch == 0
+    ex = s.bind(ctx=mx.cpu(),
+                args=dict(args, data=nd.zeros((batch,) + X.shape[1:]),
+                          softmax_label=nd.zeros((batch,))),
+                aux_states=aux)
+    correct = 0
+    for i in range(0, len(X), batch):
+        out = ex.forward(data=nd.array(X[i:i + batch]))[0].asnumpy()
+        correct += (out.argmax(1) == Y[i:i + batch]).sum()
+    return correct / len(X)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--calib-mode", default="entropy",
+                   choices=["naive", "entropy"])
+    p.add_argument("--epochs", type=int, default=6)
+    args_cli = p.parse_args()
+
+    # train fp32 with Module.fit
+    Xtr, Ytr = make_data(1024)
+    Xte, Yte = make_data(256, seed=9)
+    train_iter = mx.io.NDArrayIter(Xtr, Ytr.astype("float32"), 64,
+                                   shuffle=True,
+                                   label_name="softmax_label")
+    mod = mx.mod.Module(build_symbol(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(train_iter, num_epoch=args_cli.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=None)
+    arg_params, aux_params = mod.get_params()
+
+    s = build_symbol()
+    fp32_acc = accuracy(s, arg_params, aux_params, Xte, Yte)
+    print("fp32 accuracy: %.4f" % fp32_acc)
+
+    calib_iter = mx.io.NDArrayIter(Xtr[:256],
+                                   Ytr[:256].astype("float32"), 64,
+                                   label_name="softmax_label")
+    qsym, qargs, qaux = quantize_model(
+        s, arg_params, aux_params, ctx=mx.cpu(),
+        calib_mode=args_cli.calib_mode, calib_data=calib_iter,
+        excluded_sym_names=("fc",))
+    int8_acc = accuracy(qsym, qargs, qaux, Xte, Yte)
+    print("int8 accuracy (%s calibration): %.4f"
+          % (args_cli.calib_mode, int8_acc))
+    drop = fp32_acc - int8_acc
+    print("accuracy drop: %.4f" % drop)
+    if drop > 0.02:
+        raise SystemExit("quantization accuracy drop too large")
+
+
+if __name__ == "__main__":
+    main()

@@ -366,12 +366,40 @@ def _collect_layer_outputs(sym: Symbol, arg_params, aux_params, ctx,
     internals = sym.get_internals()
     out_nodes = [n for (n, s) in internals._outputs]
     exe = None
+    bound_bs = None
     for feed in _iter_calib_batches(calib_data, data_names,
                                     num_calib_examples):
         args = {k: _nd.array(v) for k, v in feed.items()}
+        feed_bs = next(iter(args.values())).shape[0]
+        if exe is not None and feed_bs != bound_bs:
+            exe = None   # rebind: zero-filled labels are batch-sized
         if exe is None:
+            bound_bs = feed_bs
             for k, v in arg_params.items():
                 args[k] = v
+            # zero-fill remaining args (e.g. SoftmaxOutput labels) —
+            # inference-only calibration has no labels to feed
+            missing = [a for a in internals.list_arguments()
+                       if a not in args]
+            if missing:
+                try:
+                    shapes, _, _ = internals.infer_shape_partial(
+                        **{k: tuple(v.shape) for k, v in args.items()})
+                except Exception as e:
+                    shapes = None
+                if shapes is None:
+                    raise MXNetError(
+                        "calibration: cannot infer shapes for unfed "
+                        "arguments %s — feed them via calib_data or "
+                        "exclude the consuming ops" % missing)
+                for name, shp in zip(internals.list_arguments(),
+                                     shapes):
+                    if name in missing:
+                        if shp is None:
+                            raise MXNetError(
+                                "calibration: shape of unfed argument "
+                                "%r is unresolvable" % name)
+                        args[name] = _nd.zeros(shp)
             exe = internals.bind(ctx=ctx, args=args, args_grad=None,
                                  grad_req="null",
                                  aux_states=dict(aux_params or {}))

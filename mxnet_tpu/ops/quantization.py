@@ -238,8 +238,11 @@ def quantized_pooling(data, min_data, max_data, kernel=None, pool_type="max",
     strides = (1, 1) + stride
     padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pool_type == "max":
-        out = lax.reduce_window(data, jnp.iinfo(jnp.int8).min, lax.max,
-                                dims, strides, padding)
+        # init value must carry the operand dtype (a bare Python int
+        # traces as int32 and reduce_window rejects the mix)
+        out = lax.reduce_window(
+            data, jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype),
+            lax.max, dims, strides, padding)
     elif pool_type == "avg":
         s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
                               dims, strides, padding)

@@ -158,3 +158,16 @@ def test_quantize_model_excluded_layer():
     assert "fc2_weight" in qargs
     assert not any(k.startswith("fc2_weight_quantize") for k in qargs)
     assert any(k.startswith("fc1_weight_quantize") for k in qargs)
+
+
+def test_quantized_max_pooling_int8():
+    """reduce_window init value must carry the int8 operand dtype."""
+    import numpy as np
+    from mxnet_tpu import nd
+    data = nd.array(np.arange(-8, 8, dtype=np.int8).reshape(1, 1, 4, 4)
+                    .astype("int8"))
+    out, mn, mx_ = nd.quantized_pooling(
+        data, nd.array([-1.0]), nd.array([1.0]),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = np.array([[[[ -3, -1], [5, 7]]]], dtype=np.int8)
+    np.testing.assert_array_equal(out.asnumpy(), ref)

@@ -119,6 +119,7 @@ def test_lm_perplexity_improves():
                           "--batch-size", "4", "--dp", "4", "--tp", "2"]),
     ("gpt_generate.py", ["--steps", "10"]),
     ("nmt_bucketing.py", ["--batches", "12", "--batch-size", "16"]),
+    ("int8_quantization.py", ["--epochs", "3", "--calib-mode", "naive"]),
 ])
 def test_example_runs(script, extra):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
