@@ -392,14 +392,21 @@ def _collect_layer_outputs(sym: Symbol, arg_params, aux_params, ctx,
                         "calibration: cannot infer shapes for unfed "
                         "arguments %s — feed them via calib_data or "
                         "exclude the consuming ops" % missing)
-                for name, shp in zip(internals.list_arguments(),
-                                     shapes):
+                try:
+                    dtypes, _, _ = internals.infer_type(
+                        **{k: str(v.dtype) for k, v in args.items()
+                           if hasattr(v, "dtype")})
+                except Exception:
+                    dtypes = [None] * len(internals.list_arguments())
+                for name, shp, dt in zip(internals.list_arguments(),
+                                         shapes, dtypes):
                     if name in missing:
                         if shp is None:
                             raise MXNetError(
                                 "calibration: shape of unfed argument "
                                 "%r is unresolvable" % name)
-                        args[name] = _nd.zeros(shp)
+                        args[name] = _nd.zeros(
+                            shp, dtype=dt or "float32")
             exe = internals.bind(ctx=ctx, args=args, args_grad=None,
                                  grad_req="null",
                                  aux_states=dict(aux_params or {}))

@@ -381,12 +381,18 @@ def _act_spec(mesh):
 # ---------------------------------------------------------------------------
 
 def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
-                    weight_decay=0.01):
+                    weight_decay=0.01, shard_optimizer=False):
     """Build (init_state, step) for MLM pretraining.
 
     ``step(state, batch, rng) -> (state, loss)`` is jitted; with a mesh it
     is jitted with NamedShardings so GSPMD places tp/dp/sp collectives.
     ``batch`` = dict(tokens, labels, weights) — labels -100 ≡ unmasked.
+
+    ``shard_optimizer=True`` shards the Adam moment buffers over the
+    mesh's ``dp`` axis (ZeRO-1; SURVEY.md §2.4 maps the reference's
+    server-side PS optimizer update to exactly this): each dp shard
+    owns 1/dp of the optimizer state, GSPMD inserts the
+    reduce-scatter/all-gather pair around the update.
     """
     import jax
     import jax.numpy as jnp
@@ -423,8 +429,32 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             shardings = param_shardings(cfg, mesh)
             params = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, s), params, shardings)
-        opt_state = tx.init(params)
+        if shard_optimizer and mesh is not None \
+                and "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+            # materialize the moments directly into their shards —
+            # init-then-reshard would peak at full replicated size,
+            # defeating the reason to enable ZeRO-1
+            placements = jax.tree_util.tree_map(
+                lambda l: _zero1_sharding(l, mesh),
+                jax.eval_shape(tx.init, params))
+            opt_state = jax.jit(tx.init,
+                                out_shardings=placements)(params)
+        else:
+            opt_state = tx.init(params)
         return (params, opt_state)
 
     jit_step = jax.jit(step, donate_argnums=(0,))
     return init_state, jit_step
+
+
+def _zero1_sharding(leaf, mesh):
+    """ZeRO-1 placement for one optimizer-state leaf: shard over ``dp``
+    on the leading dim when it divides; small/indivisible leaves
+    replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape["dp"]
+    if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+            and leaf.shape[0] % dp == 0 and leaf.shape[0] > 0:
+        return NamedSharding(mesh, P("dp", *([None] * (leaf.ndim - 1))))
+    return NamedSharding(mesh, P())

@@ -10,3 +10,4 @@ from .ring_attention import (ring_attention, ulysses_attention,
 from .pipeline import pipeline_apply, stack_layer_params
 from .moe import init_moe_ffn, moe_ffn, moe_param_shardings
 from .checkpoint import save_sharded, restore_sharded, latest_step
+from . import multihost

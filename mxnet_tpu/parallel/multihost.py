@@ -1,0 +1,127 @@
+"""Multi-host coordination — the DCN half of the communication story.
+
+Reference: ps-lite's scheduler/rendezvous (``DMLC_PS_ROOT_URI`` env
+rendezvous, SURVEY.md §2.1 "ps-lite" row) and the dmlc tracker that
+``tools/launch.py`` drives.  TPU-native equivalent (§5.8): a
+jax.distributed coordination service — every host runs the SAME program,
+``jax.devices()`` becomes the global device set, meshes span hosts, and
+XLA routes intra-slice collectives over ICI and cross-slice over DCN.
+No parameter server in the data path.
+
+``initialize()`` accepts both its native arguments and the reference's
+``DMLC_*`` environment (as set by ``tools/launch.py``), so a launch
+script written for the reference's tracker drives multi-host TPU
+training unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["initialize", "shutdown", "is_initialized", "rank",
+           "num_hosts", "local_devices", "global_mesh"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Join the multi-host cluster (reference analog: worker start-up
+    against ``DMLC_PS_ROOT_URI``).
+
+    With no arguments, reads ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``
+    (coordinator), ``DMLC_NUM_WORKER`` (process count) and
+    ``DMLC_WORKER_ID`` (this process) — the env contract
+    ``tools/launch.py`` emits — falling back to jax's own TPU-pod
+    auto-detection when neither is present.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return
+    if coordinator_address is None and "DMLC_PS_ROOT_URI" in os.environ:
+        coordinator_address = "%s:%s" % (
+            os.environ["DMLC_PS_ROOT_URI"],
+            os.environ.get("DMLC_PS_ROOT_PORT", "9000"))
+        num_processes = num_processes or int(
+            os.environ.get("DMLC_NUM_WORKER", "1"))
+        process_id = process_id if process_id is not None else int(
+            os.environ.get("DMLC_WORKER_ID", "0"))
+
+    if coordinator_address is None and num_processes is None:
+        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            # jax-native env present: let jax auto-detect the pod
+            _jax_dist_init(jax)
+        # otherwise single host: nothing to coordinate
+        _initialized = True
+        return
+    if coordinator_address is not None and (num_processes is None
+                                            or process_id is None):
+        raise MXNetError(
+            "multihost.initialize(coordinator_address=...) needs "
+            "num_processes and process_id too (or set DMLC_NUM_WORKER/"
+            "DMLC_WORKER_ID like tools/launch.py does)")
+    if num_processes == 1:
+        _initialized = True
+        return
+    _jax_dist_init(jax, coordinator_address=coordinator_address,
+                   num_processes=num_processes, process_id=process_id)
+    _initialized = True
+
+
+def _jax_dist_init(jax, **kw):
+    global _initialized
+    try:
+        jax.distributed.initialize(**kw)
+    except RuntimeError as e:
+        raise MXNetError(
+            "multihost.initialize() must run before the first jax "
+            "computation/device query in the process — call it at the "
+            "top of your training script (launch.py does this for "
+            "you): %s" % e)
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    _initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def rank() -> int:
+    """This host's index (reference: kvstore ``rank``)."""
+    import jax
+    return jax.process_index()
+
+
+def num_hosts() -> int:
+    """Participating host count (reference: ``num_workers``)."""
+    import jax
+    return jax.process_count()
+
+
+def local_devices():
+    import jax
+    return jax.local_devices()
+
+
+def global_mesh(axes):
+    """A mesh over the GLOBAL device set (all hosts).  Same semantics as
+    :func:`mxnet_tpu.parallel.make_mesh` — sized against
+    ``jax.devices()``, which spans hosts after :func:`initialize`."""
+    from .mesh import make_mesh
+    return make_mesh(axes)

@@ -163,3 +163,18 @@ def test_data_parallel_bn_stats_update():
     tr.sync_back()
     after = bn.running_mean.data().asnumpy()
     assert np.abs(after - before).max() > 1e-4
+
+
+def test_multihost_single_process():
+    """Single-process initialize is a no-op that still exposes the
+    rank/num_hosts/global_mesh surface (reference: kvstore rank/size)."""
+    from mxnet_tpu.parallel import multihost
+    multihost.initialize()
+    assert multihost.is_initialized()
+    assert multihost.rank() == 0
+    assert multihost.num_hosts() == 1
+    assert len(multihost.local_devices()) == 8
+    mesh = multihost.global_mesh({"dp": -1})
+    assert mesh.shape["dp"] == 8
+    multihost.shutdown()
+    assert not multihost.is_initialized()
