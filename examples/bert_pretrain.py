@@ -71,8 +71,12 @@ def main():
     batch = {"tokens": tokens, "labels": labels,
              "mask": jnp.ones((B, L), bool)}
 
-    state, loss = step(state, batch, jax.random.PRNGKey(1))  # compile
-    float(loss)
+    # two warmups: the first compiles; the second absorbs the recompile
+    # for the GSPMD-refined state shardings the first step emits
+    # (keys 0/1 — the timed loop uses 2+i, so no key repeats)
+    for w in range(2):
+        state, loss = step(state, batch, jax.random.PRNGKey(w))
+        float(loss)
     t0 = time.time()
     for i in range(args.steps):
         state, loss = step(state, batch, jax.random.PRNGKey(2 + i))

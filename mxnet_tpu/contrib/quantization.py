@@ -397,6 +397,8 @@ def _collect_layer_outputs(sym: Symbol, arg_params, aux_params, ctx,
                         **{k: str(v.dtype) for k, v in args.items()
                            if hasattr(v, "dtype")})
                 except Exception:
+                    dtypes = None
+                if dtypes is None:   # infer_type's failure sentinel
                     dtypes = [None] * len(internals.list_arguments())
                 for name, shp, dt in zip(internals.list_arguments(),
                                          shapes, dtypes):

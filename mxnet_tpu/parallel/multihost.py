@@ -35,8 +35,11 @@ def initialize(coordinator_address: Optional[str] = None,
     With no arguments, reads ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``
     (coordinator), ``DMLC_NUM_WORKER`` (process count) and
     ``DMLC_WORKER_ID`` (this process) — the env contract
-    ``tools/launch.py`` emits — falling back to jax's own TPU-pod
-    auto-detection when neither is present.
+    ``tools/launch.py`` emits.  Without DMLC env, jax's own pod
+    auto-detection runs when a pod marker is present
+    (``JAX_COORDINATOR_ADDRESS``, ``MEGASCALE_COORDINATOR_ADDRESS`` or
+    ``TPU_WORKER_HOSTNAMES``); otherwise the process is treated as
+    single-host.
     """
     global _initialized
     import jax
@@ -53,14 +56,19 @@ def initialize(coordinator_address: Optional[str] = None,
             os.environ.get("DMLC_WORKER_ID", "0"))
 
     if coordinator_address is None and num_processes is None:
-        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-            # jax-native env present: let jax auto-detect the pod
+        # pod-environment markers → let jax auto-detect the cluster;
+        # plain single host otherwise (nothing to coordinate).  A
+        # single-entry TPU_WORKER_HOSTNAMES (e.g. 'localhost' on
+        # one-chip setups) is NOT a pod.
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        if (os.environ.get("JAX_COORDINATOR_ADDRESS")
+                or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+                or len([h for h in hostnames.split(",") if h]) > 1):
             _jax_dist_init(jax)
-        # otherwise single host: nothing to coordinate
         _initialized = True
         return
-    if coordinator_address is not None and (num_processes is None
-                                            or process_id is None):
+    if num_processes is None or (num_processes > 1
+                                 and process_id is None):
         raise MXNetError(
             "multihost.initialize(coordinator_address=...) needs "
             "num_processes and process_id too (or set DMLC_NUM_WORKER/"
@@ -77,12 +85,14 @@ def _jax_dist_init(jax, **kw):
     global _initialized
     try:
         jax.distributed.initialize(**kw)
-    except RuntimeError as e:
-        raise MXNetError(
-            "multihost.initialize() must run before the first jax "
-            "computation/device query in the process — call it at the "
-            "top of your training script (launch.py does this for "
-            "you): %s" % e)
+    except (RuntimeError, ValueError) as e:
+        if "before any JAX calls" in str(e):
+            raise MXNetError(
+                "multihost.initialize() must run before the first jax "
+                "computation/device query in the process — call it at "
+                "the top of your training script (launch.py does this "
+                "for you): %s" % e)
+        raise MXNetError("multihost.initialize() failed: %s" % e)
     _initialized = True
 
 
