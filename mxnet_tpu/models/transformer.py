@@ -434,8 +434,9 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             # materialize the moments directly into their shards —
             # init-then-reshard would peak at full replicated size,
             # defeating the reason to enable ZeRO-1
+            from ..parallel.mesh import zero1_sharding
             placements = jax.tree_util.tree_map(
-                lambda l: _zero1_sharding(l, mesh),
+                lambda l: zero1_sharding(l, mesh),
                 jax.eval_shape(tx.init, params))
             opt_state = jax.jit(tx.init,
                                 out_shardings=placements)(params)
@@ -447,14 +448,4 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
     return init_state, jit_step
 
 
-def _zero1_sharding(leaf, mesh):
-    """ZeRO-1 placement for one optimizer-state leaf: shard over ``dp``
-    on the leading dim when it divides; small/indivisible leaves
-    replicate."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dp = mesh.shape["dp"]
-    if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
-            and leaf.shape[0] % dp == 0 and leaf.shape[0] > 0:
-        return NamedSharding(mesh, P("dp", *([None] * (leaf.ndim - 1))))
-    return NamedSharding(mesh, P())

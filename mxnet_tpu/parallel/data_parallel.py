@@ -34,7 +34,7 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd",
                  optimizer_params=None, mesh=None, grad_clip=None,
-                 amp=False):
+                 amp=False, shard_optimizer=False):
         import jax
         import optax
         from .mesh import default_mesh
@@ -72,6 +72,13 @@ class DataParallelTrainer:
 
         self._param_objs = list(block.collect_params().values())
         self._rep = NamedSharding(self.mesh, P())
+        # ZeRO-1: optimizer state sharded over the data axis — 'dp' if
+        # present, else the mesh's first axis, matching how the batch is
+        # sharded (SURVEY.md §2.4 — the PS server-side optimizer update)
+        self._data_axis = ("dp" if "dp" in self.mesh.axis_names
+                           else self.mesh.axis_names[0])
+        self._shard_opt = (shard_optimizer
+                           and self.mesh.shape[self._data_axis] > 1)
         self._batch_sharding = None
         self._state = None
         self._jit_step = None
@@ -175,8 +182,18 @@ class DataParallelTrainer:
             return (pvals, opt_state), loss
 
         pvals = self._gather_params()
-        opt_state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, self._rep), self.tx.init(pvals))
+        if self._shard_opt:
+            from .mesh import zero1_sharding
+            placements = jax.tree_util.tree_map(
+                lambda l: zero1_sharding(l, self.mesh,
+                                         axis=self._data_axis),
+                jax.eval_shape(self.tx.init, pvals))
+            opt_state = jax.jit(self.tx.init,
+                                out_shardings=placements)(pvals)
+        else:
+            opt_state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._rep),
+                self.tx.init(pvals))
         self._state = (pvals, opt_state)
         self._batch_sharding = NamedSharding(
             self.mesh, P(self.mesh.axis_names[0]))

@@ -80,3 +80,17 @@ class mesh_scope:
 
 def current_mesh():
     return _CURRENT[-1] if _CURRENT else None
+
+
+def zero1_sharding(leaf, mesh, axis="dp"):
+    """ZeRO-1 placement for one optimizer-state leaf: shard over the
+    data axis on the leading dim when it divides; small/indivisible
+    leaves replicate (SURVEY.md §2.4 — the PS server-side optimizer
+    update)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+            and leaf.shape[0] % n == 0 and leaf.shape[0] > 0:
+        return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+    return NamedSharding(mesh, P())

@@ -56,6 +56,12 @@ def initialize(coordinator_address: Optional[str] = None,
             os.environ.get("DMLC_WORKER_ID", "0"))
 
     if coordinator_address is None and num_processes is None:
+        if process_id is not None:
+            raise MXNetError(
+                "multihost.initialize(process_id=%r) without a "
+                "coordinator_address/num_processes — the launcher "
+                "likely failed to export DMLC_PS_ROOT_URI; refusing "
+                "to run as a lone single-host process" % process_id)
         # pod-environment markers → let jax auto-detect the cluster;
         # plain single host otherwise (nothing to coordinate).  A
         # single-entry TPU_WORKER_HOSTNAMES (e.g. 'localhost' on
@@ -81,12 +87,21 @@ def initialize(coordinator_address: Optional[str] = None,
     _initialized = True
 
 
+def _backend_already_up(jax):
+    try:
+        from jax._src import xla_bridge
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
 def _jax_dist_init(jax, **kw):
     global _initialized
+    backend_up = _backend_already_up(jax)
     try:
         jax.distributed.initialize(**kw)
     except (RuntimeError, ValueError) as e:
-        if "before any JAX calls" in str(e):
+        if backend_up or "before any JAX calls" in str(e):
             raise MXNetError(
                 "multihost.initialize() must run before the first jax "
                 "computation/device query in the process — call it at "

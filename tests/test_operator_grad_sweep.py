@@ -1,0 +1,117 @@
+"""Numeric-gradient sweep across the op registry (reference:
+tests/python/unittest/test_operator.py's per-op gradient checks against
+finite differences — SURVEY.md §4.1).  One parametrized harness instead
+of ~10k hand-written lines: every entry pairs an op invocation with the
+shapes it differentiates."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import test_utils as tu
+
+# (name, fn(*NDArrays) -> NDArray, input shapes, kwargs for data gen)
+CASES = [
+    ("relu", lambda a: nd.relu(a), [(3, 4)], {}),
+    ("sigmoid", lambda a: nd.sigmoid(a), [(3, 4)], {}),
+    ("tanh", lambda a: nd.tanh(a), [(3, 4)], {}),
+    ("exp", lambda a: nd.exp(a), [(3, 4)], {}),
+    ("log", lambda a: nd.log(a), [(3, 4)], {"positive": True}),
+    ("sqrt", lambda a: nd.sqrt(a), [(3, 4)], {"positive": True}),
+    ("square", lambda a: nd.square(a), [(3, 4)], {}),
+    ("softrelu", lambda a: nd.Activation(a, act_type="softrelu"),
+     [(3, 4)], {}),
+    ("gelu_erf", lambda a: nd.LeakyReLU(a, act_type="gelu"),
+     [(3, 4)], {}),
+    ("softmax", lambda a: nd.softmax(a), [(3, 5)], {}),
+    ("log_softmax", lambda a: nd.log_softmax(a), [(3, 5)], {}),
+    ("dot", lambda a, b: nd.dot(a, b), [(3, 4), (4, 5)], {}),
+    ("batch_dot", lambda a, b: nd.batch_dot(a, b),
+     [(2, 3, 4), (2, 4, 5)], {}),
+    ("fully_connected",
+     lambda a, w, b: nd.FullyConnected(a, w, b, num_hidden=6),
+     [(3, 5), (6, 5), (6,)], {}),
+    ("convolution",
+     lambda a, w, b: nd.Convolution(a, w, b, kernel=(3, 3),
+                                    num_filter=4, pad=(1, 1)),
+     [(2, 3, 6, 6), (4, 3, 3, 3), (4,)], {}),
+    ("pooling_max",
+     lambda a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max"),
+     [(2, 2, 6, 6)], {}),
+    ("pooling_avg",
+     lambda a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                          pool_type="avg"),
+     [(2, 2, 6, 6)], {}),
+    ("layer_norm",
+     lambda a, g, b: nd.LayerNorm(a, g, b), [(4, 6), (6,), (6,)], {}),
+    ("broadcast_add", lambda a, b: nd.broadcast_add(a, b),
+     [(3, 4), (3, 1)], {}),
+    ("broadcast_mul", lambda a, b: nd.broadcast_mul(a, b),
+     [(3, 4), (1, 4)], {}),
+    ("broadcast_div", lambda a, b: nd.broadcast_div(a, b),
+     [(3, 4), (1, 4)], {"positive": True}),
+    ("elemwise_sub", lambda a, b: nd.elemwise_sub(a, b),
+     [(3, 4), (3, 4)], {}),
+    ("sum_axis", lambda a: nd.sum(a, axis=1), [(3, 4)], {}),
+    ("mean", lambda a: nd.mean(a, axis=0), [(3, 4)], {}),
+    ("max_reduce", lambda a: nd.max(a, axis=1), [(3, 4)],
+     {"spread": True}),
+    ("transpose", lambda a: nd.transpose(a), [(3, 4)], {}),
+    ("reshape", lambda a: nd.reshape(a, shape=(4, 3)), [(3, 4)], {}),
+    ("concat", lambda a, b: nd.Concat(a, b, dim=1),
+     [(3, 2), (3, 3)], {}),
+    ("slice", lambda a: nd.slice(a, begin=(0, 1), end=(3, 4)),
+     [(3, 4)], {}),
+    ("take", lambda a: nd.take(a, nd.array(np.array([0, 2]))),
+     [(4, 5)], {}),
+    ("tile", lambda a: nd.tile(a, reps=(2, 1)), [(3, 4)], {}),
+    ("clip", lambda a: nd.clip(a, a_min=-0.5, a_max=0.5),
+     [(3, 4)], {"spread": True}),
+    ("abs", lambda a: nd.abs(a), [(3, 4)], {"spread": True}),
+    ("where", lambda a, b: nd.where(
+        nd.array((np.arange(12).reshape(3, 4) % 2).astype("float32")),
+        a, b), [(3, 4), (3, 4)], {}),
+    ("embedding",
+     lambda w: nd.Embedding(nd.array(np.array([1., 0., 2.])), w,
+                            input_dim=4, output_dim=3),
+     [(4, 3)], {}),
+    ("smooth_l1", lambda a: nd.smooth_l1(a, scalar=1.0),
+     [(3, 4)], {"spread": True}),
+    ("expand_dims", lambda a: nd.expand_dims(a, axis=1), [(3, 4)], {}),
+    ("flip", lambda a: nd.flip(a, axis=1), [(3, 4)], {}),
+    ("stack", lambda a, b: mx.nd.stack(a, b, axis=0),
+     [(3, 4), (3, 4)], {}),
+    ("linalg_gemm2", lambda a, b: nd.linalg_gemm2(a, b),
+     [(3, 4), (4, 5)], {}),
+    ("norm", lambda a: nd.norm(a, axis=1), [(3, 4)], {"positive": True}),
+]
+
+
+def _gen(shapes, positive=False, spread=False, seed=0):
+    rng = np.random.RandomState(seed)
+    outs = []
+    for s in shapes:
+        a = rng.uniform(0.5, 1.5, s) if positive else \
+            rng.uniform(-2.0, 2.0, s) if spread else \
+            rng.uniform(-0.9, 0.9, s)
+        outs.append(nd.array(a.astype("float32")))
+    return outs
+
+
+@pytest.mark.parametrize(
+    "name,fn,shapes,opts", CASES, ids=[c[0] for c in CASES])
+def test_numeric_gradient(name, fn, shapes, opts):
+    inputs = _gen(shapes, **opts)
+    tu.check_numeric_gradient(fn, inputs, rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize(
+    "name,fn,shapes,opts",
+    [c for c in CASES if c[0] in
+     ("dot", "convolution", "softmax", "layer_norm", "pooling_max")],
+    ids=["dot", "convolution", "softmax", "layer_norm", "pooling_max"])
+def test_eager_vs_hybrid_consistency(name, fn, shapes, opts):
+    """The §4.2 oracle: eager vs compiled must agree fwd + bwd."""
+    inputs = _gen(shapes, **opts)
+    tu.check_consistency(fn, inputs)

@@ -178,3 +178,39 @@ def test_multihost_single_process():
     assert mesh.shape["dp"] == 8
     multihost.shutdown()
     assert not multihost.is_initialized()
+
+
+def test_data_parallel_zero1_matches():
+    """DataParallelTrainer(shard_optimizer=True) trains identically."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    np.random.seed(0)
+    X = np.random.randn(16, 8).astype("float32")
+    Y = np.random.randint(0, 3, (16,))
+
+    def run(shard):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+        net.initialize(mx.initializer.Xavier())
+        tr = DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.05}, mesh=make_mesh({"dp": 8}),
+            shard_optimizer=shard)
+        losses = [float(tr.step(nd.array(X), nd.array(Y)).asnumpy())
+                  for _ in range(5)]
+        if shard:
+            specs = [str(l.sharding.spec) for l in
+                     jax.tree_util.tree_leaves(tr._state[1])
+                     if isinstance(l.sharding, NamedSharding)]
+            assert any("dp" in s for s in specs), specs
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
