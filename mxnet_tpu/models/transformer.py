@@ -434,12 +434,8 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             # materialize the moments directly into their shards —
             # init-then-reshard would peak at full replicated size,
             # defeating the reason to enable ZeRO-1
-            from ..parallel.mesh import zero1_sharding
-            placements = jax.tree_util.tree_map(
-                lambda l: zero1_sharding(l, mesh),
-                jax.eval_shape(tx.init, params))
-            opt_state = jax.jit(tx.init,
-                                out_shardings=placements)(params)
+            from ..parallel.mesh import init_sharded_opt_state
+            opt_state = init_sharded_opt_state(tx, params, mesh)
         else:
             opt_state = tx.init(params)
         return (params, opt_state)

@@ -480,11 +480,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     # fine, but statistics and running-stat updates accumulate in f32 —
     # bf16's 8-bit mantissa rounds away small momentum updates.
     f32 = jnp.float32
-    stat_data = data if data.dtype == f32 else data.astype(f32)
 
     if _training and not use_global_stats:
-        mean = jnp.mean(stat_data, axis=red_ax)
-        var = jnp.var(stat_data, axis=red_ax)
+        if data.dtype == f32:
+            mean = jnp.mean(data, axis=red_ax)
+            var = jnp.var(data, axis=red_ax)
+        else:
+            # Single-pass f32 moments with the cast fused into each
+            # reduction (a shared materialized f32 copy of the
+            # activations costs ~10% ResNet-50 train throughput).
+            # Squares are computed in f32 — bf16 squares lose the
+            # mantissa and f16 squares overflow — and the E[x²]−E[x]²
+            # form is clamped: its f32 cancellation only becomes
+            # visible for |mean|/std ≳ 300 (pathological for BN
+            # inputs), degrading variance accuracy there, never NaN.
+            mean = jnp.mean(data, axis=red_ax, dtype=f32)
+            ex2 = jnp.mean(jnp.square(data.astype(f32)), axis=red_ax)
+            var = jnp.maximum(ex2 - mean * mean, 0.0)
         new_mean = (moving_mean.astype(f32) * momentum
                     + mean * (1 - momentum)).astype(moving_mean.dtype)
         new_var = (moving_var.astype(f32) * momentum

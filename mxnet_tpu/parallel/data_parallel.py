@@ -183,20 +183,16 @@ class DataParallelTrainer:
 
         pvals = self._gather_params()
         if self._shard_opt:
-            from .mesh import zero1_sharding
-            placements = jax.tree_util.tree_map(
-                lambda l: zero1_sharding(l, self.mesh,
-                                         axis=self._data_axis),
-                jax.eval_shape(self.tx.init, pvals))
-            opt_state = jax.jit(self.tx.init,
-                                out_shardings=placements)(pvals)
+            from .mesh import init_sharded_opt_state
+            opt_state = init_sharded_opt_state(
+                self.tx, pvals, self.mesh, axis=self._data_axis)
         else:
             opt_state = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, self._rep),
                 self.tx.init(pvals))
         self._state = (pvals, opt_state)
         self._batch_sharding = NamedSharding(
-            self.mesh, P(self.mesh.axis_names[0]))
+            self.mesh, P(self._data_axis))
         self._jit_step = jax.jit(step, donate_argnums=(0,))
 
     def step(self, data, label):

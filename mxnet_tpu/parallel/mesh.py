@@ -94,3 +94,14 @@ def zero1_sharding(leaf, mesh, axis="dp"):
             and leaf.shape[0] % n == 0 and leaf.shape[0] > 0:
         return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
     return NamedSharding(mesh, P())
+
+
+def init_sharded_opt_state(tx, params, mesh, axis="dp"):
+    """Initialize an optax state directly INTO its ZeRO-1 shards —
+    init-then-reshard would peak at full replicated size, defeating the
+    reason to shard."""
+    import jax
+    placements = jax.tree_util.tree_map(
+        lambda l: zero1_sharding(l, mesh, axis=axis),
+        jax.eval_shape(tx.init, params))
+    return jax.jit(tx.init, out_shardings=placements)(params)
