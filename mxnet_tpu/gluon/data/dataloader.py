@@ -133,6 +133,20 @@ class DataLoader:
                     initializer=_worker_initializer,
                     initargs=(self._dataset,))
 
+    def close(self):
+        """Terminate worker processes (reference: DataLoader relies on
+        GC; explicit close avoids noisy interpreter-exit teardown)."""
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def __iter__(self):
         if self._pool is None:
             def same_process_iter():
