@@ -518,6 +518,9 @@ class HybridBlock(Block):
         return self.hybrid_forward(nd, *args, **params)
 
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+        if args and isinstance(args[0], Symbol):
+            return self._symbolic_forward(*args)
         if self._active and not _in_trace():
             return self._call_cached(*args)
         return self.forward_raw(*args)
@@ -560,21 +563,37 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """Serialize params (+ a JSON graph descriptor) for serving.
+    def _symbolic_forward(self, *args):
+        """Run hybrid_forward with the ``sym`` namespace as F — Symbol
+        inputs flow through the same hybrid_forward chain, so the whole
+        subtree composes into one lazy graph (reference:
+        ``HybridBlock._get_graph`` tracing with Symbol proxies)."""
+        from .. import symbol as sym_ns
+        params = {k: v.var() for k, v in self._reg_params.items()}
+        return self.hybrid_forward(sym_ns, *args, **params)
 
-        Reference: ``HybridBlock.export`` writing ``-symbol.json`` +
-        ``.params``.  The JSON here describes the block tree rather than an
-        nnvm graph (documented divergence; the mount was empty)."""
-        import json
-        params = self._collect_params_with_prefix()
-        arg_dict = {"arg:" + k: v.data() for k, v in params.items()
-                    if v._data is not None}
-        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
-        desc = {"mxnet_tpu_version": 1, "block": type(self).__name__,
-                "name": self.name}
-        with open("%s-symbol.json" % path, "w") as f:
-            json.dump(desc, f)
+    def export(self, path, epoch=0, input_names=("data",)):
+        """Serialize for serving: a REAL symbol graph (``-symbol.json``,
+        loadable by SymbolBlock / Module / the C predict API) plus the
+        ``.params`` container with ``arg:``/``aux:`` prefixes
+        (reference: ``HybridBlock.export``).  Parameters must be
+        initialized (run one forward first)."""
+        from .. import symbol as sym_ns
+
+        inputs = [sym_ns.Variable(n) for n in input_names]
+        out = self(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_ns.Group(list(out))
+        out.save("%s-symbol.json" % path)
+
+        aux_names = set(out.list_auxiliary_states())
+        save_dict = {}
+        for p in self.collect_params().values():
+            if p._data is None:
+                continue
+            tag = "aux:" if p.name in aux_names else "arg:"
+            save_dict[tag + p.name] = p.data()
+        nd.save("%s-%04d.params" % (path, epoch), save_dict)
 
 
 def _tree_sig(tree):
@@ -590,9 +609,90 @@ def _tree_sig(tree):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a Block from a Symbol (reference: ``gluon.SymbolBlock``).
-    Implemented once the Symbol API lands; placeholder that raises."""
+    """Wrap a Symbol graph as a Gluon block (reference:
+    ``gluon.SymbolBlock``): free variables that are not inputs become
+    Parameters; forward binds a cached executor.
+
+    ``outputs``: a Symbol (or list).  ``inputs``: Variable symbol(s) or
+    input name(s).  ``params``: dict of name → NDArray seeding the
+    Parameters (e.g. from ``nd.load``; ``arg:``/``aux:`` prefixes are
+    stripped).
+    """
 
     def __init__(self, outputs, inputs, params=None):
-        raise MXNetError("SymbolBlock arrives with the Symbol API "
-                         "(see symbol/symbol.py)")
+        from ..symbol.symbol import Symbol, Group
+        super().__init__(prefix="", params=None)
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if not isinstance(outputs, Symbol):
+            raise MXNetError("SymbolBlock: outputs must be Symbol(s)")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._input_names = [i.name if isinstance(i, Symbol) else str(i)
+                             for i in inputs]
+        self._sym = outputs
+        self._aux_names = set(outputs.list_auxiliary_states())
+
+        seed = {}
+        for k, v in (params or {}).items():
+            seed[k.split(":", 1)[-1]] = v
+        names = [n for n in outputs.list_arguments()
+                 if n not in self._input_names]
+        names += [n for n in outputs.list_auxiliary_states()]
+        for name in names:
+            p = self.params.get(name, grad_req="write"
+                                if name not in self._aux_names
+                                else "null")
+            if name in seed:
+                value = seed[name]
+                p.shape = tuple(value.shape)
+                if p._data is None:
+                    p.initialize()
+                p.set_data(value)
+            self._reg_params[name] = p
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load an exported model (reference: ``SymbolBlock.imports``)."""
+        from .. import symbol as sym_ns
+        sym = sym_ns.load(symbol_file)
+        params = nd.load(param_file) if param_file else {}
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        return SymbolBlock(sym, list(input_names), params=params)
+
+    def forward_raw(self, *args):
+        """Evaluate the symbol graph node-by-node through the op
+        registry's ``invoke`` — every op lands on the autograd tape (so
+        fine-tuning a loaded SymbolBlock works), parameter values are
+        read fresh each call, aux mutations (BN running stats) flow
+        through the standard mutate contract, and ``hybridize()``
+        compiles the whole walk into one cached XLA program like any
+        other HybridBlock."""
+        from .. import autograd
+        from ..ops.registry import invoke
+
+        env = {n: a for n, a in zip(self._input_names, args)}
+        for name, p in self._reg_params.items():
+            env[name] = p.data()
+
+        vals = {}
+        for node in self._sym._nodes():
+            if node.is_var:
+                if node.name not in env:
+                    raise MXNetError(
+                        "SymbolBlock: no value for variable %r"
+                        % node.name)
+                vals[id(node)] = [env[node.name]]
+                continue
+            ins = [vals[id(i)][oi] for (i, oi) in node.inputs]
+            out = invoke(node.op, ins, node.pos_attrs,
+                         dict(node.attrs))
+            vals[id(node)] = (list(out) if isinstance(out, (list, tuple))
+                              else [out])
+        outs = [vals[id(n)][i] for (n, i) in self._sym._outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def _symbolic_forward(self, *args):
+        return self._sym(**{n: a for n, a in
+                            zip(self._input_names, args)})

@@ -1,0 +1,144 @@
+"""The deployment chain (reference workflow): HybridBlock.export writes
+a REAL symbol graph + params, SymbolBlock.imports serves it, graph
+passes optimize it, and the C predict API embeds it."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.gluon import nn
+
+
+def _net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(),
+                nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    return net
+
+
+def test_export_writes_real_symbol(tmp_path):
+    net = _net()
+    x = nd.array(np.random.RandomState(0).randn(2, 2, 8, 8)
+                 .astype("float32"))
+    net(x)  # materialize params
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+
+    s = sym.load(prefix + "-symbol.json")
+    ops = [n.op.name for n in s._nodes() if not n.is_var]
+    assert "Convolution" in ops and "BatchNorm" in ops
+    assert "FullyConnected" in ops
+    loaded = nd.load(prefix + "-0000.params")
+    assert any(k.startswith("arg:") for k in loaded)
+    assert any(k.startswith("aux:") for k in loaded)  # BN running stats
+
+
+def test_symbolblock_imports_matches_block(tmp_path):
+    net = _net()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 2, 8, 8).astype("float32"))
+    # train-mode forwards to move BN stats off init values
+    from mxnet_tpu import autograd
+    with autograd.record():
+        net(x)
+    ref = net(x).asnumpy()
+
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    served = SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                 prefix + "-0000.params")
+    got = served(x).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # new batch size → executor rebinds transparently
+    x2 = nd.array(rng.randn(5, 2, 8, 8).astype("float32"))
+    assert served(x2).shape == (5, 3)
+
+
+def test_exported_graph_optimizes(tmp_path):
+    """conv+BN folding applies to gluon-exported graphs."""
+    net = _net()
+    x = nd.array(np.random.RandomState(1).randn(2, 2, 8, 8)
+                 .astype("float32"))
+    net(x)
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+
+    s = sym.load(prefix + "-symbol.json")
+    loaded = nd.load(prefix + "-0000.params")
+    args = {k[4:]: v for k, v in loaded.items() if k.startswith("arg:")}
+    aux = {k[4:]: v for k, v in loaded.items() if k.startswith("aux:")}
+    s2, args2, aux2 = s.optimize_for("fold_conv_bn", args, aux)
+    ops = [n.op.name for n in s2._nodes() if not n.is_var]
+    assert "BatchNorm" not in ops
+
+    ex = s2.bind(ctx=mx.cpu(), args=dict(args2, data=x), aux_states=aux2)
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_symbolblock_in_hybrid_parent(tmp_path):
+    """A SymbolBlock composes inside another block's symbolic trace."""
+    net = _net()
+    x = nd.array(np.random.RandomState(2).randn(2, 2, 8, 8)
+                 .astype("float32"))
+    net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    served = SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                 prefix + "-0000.params")
+    out_sym = served(sym.Variable("data"))
+    assert "FullyConnected" in [n.op.name for n in out_sym._nodes()
+                                if not n.is_var]
+
+
+def test_symbolblock_inputs_not_mutated(tmp_path):
+    """Serving must never write into the caller's input arrays."""
+    net = _net()
+    rng = np.random.RandomState(3)
+    x1 = nd.array(rng.randn(2, 2, 8, 8).astype("float32"))
+    x2 = nd.array(rng.randn(2, 2, 8, 8).astype("float32"))
+    net(x1)
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    served = SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                 prefix + "-0000.params")
+    x1_copy = x1.asnumpy().copy()
+    served(x1)
+    served(x2)
+    np.testing.assert_array_equal(x1.asnumpy(), x1_copy)
+
+
+def test_symbolblock_fine_tunes(tmp_path):
+    """Gradients flow through a loaded SymbolBlock (reference parity)."""
+    from mxnet_tpu import autograd, gluon
+    net = _net()
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.randn(8, 2, 8, 8).astype("float32"))
+    y = nd.array(rng.randint(0, 3, (8,)).astype("float32"))
+    net(x)
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    served = SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                 prefix + "-0000.params")
+    trainer = gluon.Trainer(served.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            L = loss_fn(served(x), y)
+        L.backward()
+        trainer.step(8)
+        losses.append(float(L.asnumpy().mean()))
+    assert losses[-1] < losses[0], losses
