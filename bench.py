@@ -42,13 +42,17 @@ def main():
                     ctx=ctx)
     label = nd.array(np.random.randint(0, 1000, (batch,)), ctx=ctx)
 
-    for _ in range(warmup):
-        loss = trainer.step(data, label)
+    # Device-side training loop: all `iters` steps run inside ONE jitted
+    # lax.scan dispatch (DataParallelTrainer.run_steps), so per-dispatch
+    # RPC latency is excluded and timing reflects device execution.
+    # trainer.sync() performs a hard sync (device_get of a state element),
+    # not just block_until_ready — see docs/perf.md "Methodology".
+    for _ in range(max(warmup // iters, 1)):  # compile + warm
+        trainer.run_steps(data, label, steps=iters)
     trainer.sync()
 
     t0 = time.time()
-    for _ in range(iters):
-        loss = trainer.step(data, label)
+    trainer.run_steps(data, label, steps=iters)
     trainer.sync()
     dt = time.time() - t0
 

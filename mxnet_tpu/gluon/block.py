@@ -260,12 +260,9 @@ class Block:
                 if name in full:
                     p = full[name]
                     if p._data is None:
-                        p.shape = tuple(value.shape)
-                        if p._deferred_init:
-                            p._finish_deferred_init()
-                        else:
-                            p.initialize(ctx=ctx)
-                    p.set_data(value)
+                        p._init_from_value(value, ctx=ctx)
+                    else:
+                        p.set_data(value)
                 elif not ignore_extra:
                     raise MXNetError("Parameter %s not found in Block"
                                      % name)
@@ -590,7 +587,10 @@ class HybridBlock(Block):
         save_dict = {}
         for p in self.collect_params().values():
             if p._data is None:
-                continue
+                raise MXNetError(
+                    "export: parameter %r is not initialized — run a "
+                    "forward pass (or initialize()) before export so "
+                    "the .params file is complete" % p.name)
             tag = "aux:" if p.name in aux_names else "arg:"
             save_dict[tag + p.name] = p.data()
         nd.save("%s-%04d.params" % (path, epoch), save_dict)
@@ -645,10 +645,10 @@ class SymbolBlock(HybridBlock):
                                 else "null")
             if name in seed:
                 value = seed[name]
-                p.shape = tuple(value.shape)
                 if p._data is None:
-                    p.initialize()
-                p.set_data(value)
+                    p._init_from_value(value)
+                else:
+                    p.set_data(value)
             self._reg_params[name] = p
 
     @staticmethod
@@ -669,7 +669,6 @@ class SymbolBlock(HybridBlock):
         through the standard mutate contract, and ``hybridize()``
         compiles the whole walk into one cached XLA program like any
         other HybridBlock."""
-        from .. import autograd
         from ..ops.registry import invoke
 
         env = {n: a for n, a in zip(self._input_names, args)}

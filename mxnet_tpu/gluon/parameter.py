@@ -135,6 +135,23 @@ class Parameter:
             self._init_grad()
         self._deferred_init = ()
 
+    def _init_from_value(self, value, ctx=None):
+        """Seed the buffers directly from a concrete value — one device
+        copy, instead of ``initialize()``'s zeros+initializer pass
+        followed by a ``set_data`` overwrite (model-load fast path)."""
+        value = value if isinstance(value, NDArray) else nd.array(value)
+        self.shape = tuple(value.shape)
+        if ctx is None:
+            ctx = (self._deferred_init[1] if self._deferred_init
+                   else self._ctx_list) or [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        self._data = OrderedDict((c, value.copyto(c)) for c in ctx)
+        if self._grad_req != "null":
+            self._init_grad()
+        self._deferred_init = ()
+
     def _finish_deferred_init(self):
         if not self._deferred_init:
             return

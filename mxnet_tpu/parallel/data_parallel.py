@@ -82,6 +82,7 @@ class DataParallelTrainer:
         self._batch_sharding = None
         self._state = None
         self._jit_step = None
+        self._multi_jit = {}
 
     # -- param pytree <-> gluon Parameters --------------------------------
     def _gather_params(self):
@@ -92,10 +93,19 @@ class DataParallelTrainer:
     def sync(self):
         """Block until every queued step has fully executed (the loss
         buffer alone can materialize before the tail of the donated-state
-        pipeline — benchmark timing must drain the params too)."""
+        pipeline — benchmark timing must drain the params too).
+
+        ``block_until_ready`` alone is not trusted: some PjRt transports
+        (e.g. the tunneled axon plugin in this environment) report buffers
+        ready while the execution queue is still draining.  Fetching one
+        element of the newest state output forces the last program to
+        actually retire — the analog of the reference engine's
+        ``WaitForAll`` (SURVEY.md §3.1 sync points)."""
         import jax
         if self._state is not None:
             jax.block_until_ready(self._state)
+            leaf = jax.tree_util.tree_leaves(self._state)[0]
+            jax.device_get(leaf.ravel()[:1])
         return self
 
     def sync_back(self):
@@ -193,7 +203,9 @@ class DataParallelTrainer:
         self._state = (pvals, opt_state)
         self._batch_sharding = NamedSharding(
             self.mesh, P(self._data_axis))
+        self._step_fn = step
         self._jit_step = jax.jit(step, donate_argnums=(0,))
+        self._multi_jit = {}
 
     def step(self, data, label):
         """One data-parallel training step; returns scalar loss."""
@@ -207,3 +219,64 @@ class DataParallelTrainer:
         l = jax.device_put(l, self._batch_sharding)
         self._state, loss = self._jit_step(self._state, d, l)
         return _wrap(loss)
+
+    def run_steps(self, data, label, steps=None):
+        """Run many training steps inside ONE jitted device loop.
+
+        Per-dispatch latency (host→device RPC, graph launch) caps the
+        step rate of :meth:`step` long before the MXU saturates — on the
+        tunneled chip in this environment a single dispatch round-trip
+        costs tens of milliseconds.  The TPU-native cure is the device
+        loop: ``lax.scan`` over the train step, one dispatch for K steps
+        (the same shape as the reference's engine-level op bulking,
+        ``MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN`` — SURVEY.md §3.3 — and
+        classic TPU infeed training loops).
+
+        Two data modes:
+
+        * ``steps=None`` — *superbatch*: ``data``/``label`` carry a
+          leading ``K`` axis (``(K, batch, ...)``); step ``i`` trains on
+          slice ``i``.
+        * ``steps=K`` — *reuse*: the single batch is reused for every
+          step (synthetic benchmarking).
+
+        Returns the per-step losses as an NDArray of shape ``(K,)``.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ndarray.ndarray import NDArray, _wrap
+        d = data._data if isinstance(data, NDArray) else data
+        l = label._data if isinstance(label, NDArray) else label
+        superbatch = steps is None
+        if superbatch:
+            if d.shape[0] != l.shape[0]:
+                raise MXNetError("run_steps: superbatch leading dims "
+                                 "disagree: %r vs %r"
+                                 % (d.shape, l.shape))
+            steps = int(d.shape[0])
+        if self._jit_step is None:
+            self._build(d[0] if superbatch else d,
+                        l[0] if superbatch else l)
+        key = (steps, superbatch)
+        if key not in self._multi_jit:
+            step_fn = self._step_fn
+
+            def multi(state, d, l):
+                def body(st, xs):
+                    dd, ll = (d, l) if xs is None else xs
+                    return step_fn(st, dd, ll)
+                return jax.lax.scan(
+                    body, state,
+                    (d, l) if superbatch else None, length=steps)
+
+            self._multi_jit[key] = jax.jit(multi, donate_argnums=(0,))
+        if superbatch:
+            sb = NamedSharding(
+                self.mesh, P(None, self._data_axis))
+            d = jax.device_put(d, sb)
+            l = jax.device_put(l, sb)
+        else:
+            d = jax.device_put(d, self._batch_sharding)
+            l = jax.device_put(l, self._batch_sharding)
+        self._state, losses = self._multi_jit[key](self._state, d, l)
+        return _wrap(losses)
