@@ -277,12 +277,9 @@ class Block:
             p = params[name]
             value = loaded[name]
             if p._data is None:
-                p.shape = tuple(value.shape)
-                if p._deferred_init:
-                    p._finish_deferred_init()
-                else:
-                    p.initialize(ctx=ctx)
-            p.set_data(value)
+                p._init_from_value(value, ctx=ctx)
+            else:
+                p.set_data(value)
         if not allow_missing:
             for name, p in params.items():
                 if name not in loaded and p._data is None and \
@@ -584,8 +581,13 @@ class HybridBlock(Block):
         out.save("%s-symbol.json" % path)
 
         aux_names = set(out.list_auxiliary_states())
+        graph_names = aux_names | set(out.list_arguments())
         save_dict = {}
         for p in self.collect_params().values():
+            if p.name not in graph_names:
+                # not referenced by the exported graph (e.g. an unused
+                # auxiliary head) — the serving symbol never reads it
+                continue
             if p._data is None:
                 raise MXNetError(
                     "export: parameter %r is not initialized — run a "

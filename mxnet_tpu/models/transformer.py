@@ -285,9 +285,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
                     params["emb_ln"]["b"].astype(cdt))
 
     if mesh is not None:
-        spec = _act_spec(mesh)
-        x = jax.lax.with_sharding_constraint(
-            x, jax.sharding.NamedSharding(mesh, spec))
+        x = _constrain_act(x, mesh)
 
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -306,8 +304,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
             x, aux = layer_fn(x, layer, mask, cfg, train, sub, mesh)
             aux_total = aux_total + aux
             if mesh is not None:
-                x = jax.lax.with_sharding_constraint(
-                    x, jax.sharding.NamedSharding(mesh, _act_spec(mesh)))
+                x = _constrain_act(x, mesh)
 
     # MLM head (weight-tied to token embedding)
     h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt), approximate=True)
@@ -370,10 +367,22 @@ def _pipelined_layers(x, layers, mask, cfg, train, rng, mesh):
 
 def _act_spec(mesh):
     from jax.sharding import PartitionSpec as P
-    names = mesh.axis_names
-    batch_ax = "dp" if "dp" in names else None
-    seq_ax = "sp" if "sp" in names else None
-    return P(batch_ax, seq_ax, None)
+    from ..parallel.mesh import live_axis
+    # constrain only along axes that actually partition — a trivial-axis
+    # constraint materializes a copy per constraint on some PjRt
+    # backends, measured 10-15x on the scanned BERT train step here
+    # (docs/perf.md "Methodology")
+    return P(live_axis(mesh, "dp"), live_axis(mesh, "sp"), None)
+
+
+def _constrain_act(x, mesh):
+    """Apply the activation sharding constraint, skipping trivial ones."""
+    import jax
+    spec = _act_spec(mesh)
+    if all(a is None for a in spec):
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
 
 
 # ---------------------------------------------------------------------------
@@ -381,12 +390,21 @@ def _act_spec(mesh):
 # ---------------------------------------------------------------------------
 
 def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
-                    weight_decay=0.01, shard_optimizer=False):
+                    weight_decay=0.01, shard_optimizer=False,
+                    scan_steps=None, scan_superbatch=False):
     """Build (init_state, step) for MLM pretraining.
 
     ``step(state, batch, rng) -> (state, loss)`` is jitted; with a mesh it
     is jitted with NamedShardings so GSPMD places tp/dp/sp collectives.
     ``batch`` = dict(tokens, labels, weights) — labels -100 ≡ unmasked.
+
+    ``scan_steps=K`` returns a device-side training loop instead: one
+    jitted ``lax.scan`` dispatch runs K steps and returns the K per-step
+    losses (per-dispatch RPC latency is tens of ms on tunneled PjRt —
+    see docs/perf.md "Methodology"). With ``scan_superbatch=True`` every
+    batch leaf carries a leading K axis and step ``i`` consumes slice
+    ``i``; otherwise the same batch is reused each step (synthetic
+    benchmarking). The step rng is folded per step either way.
 
     ``shard_optimizer=True`` shards the Adam moment buffers over the
     mesh's ``dp`` axis (ZeRO-1; SURVEY.md §2.4 maps the reference's
@@ -425,7 +443,11 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
 
     def init_state(key):
         params = init_params(key, cfg)
-        if mesh is not None:
+        # commit shardings only on a real multi-device mesh: arrays
+        # committed to a trivial (1-device) mesh route execution through
+        # the SPMD-partitioned path, which measured 130x slower on the
+        # tunneled chip here (docs/perf.md "Methodology")
+        if mesh is not None and mesh.size > 1:
             shardings = param_shardings(cfg, mesh)
             params = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, s), params, shardings)
@@ -440,8 +462,17 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             opt_state = tx.init(params)
         return (params, opt_state)
 
-    jit_step = jax.jit(step, donate_argnums=(0,))
-    return init_state, jit_step
+    if scan_steps is None:
+        return init_state, jax.jit(step, donate_argnums=(0,))
+
+    def multi(state, batch, rng):
+        def body(st, i):
+            b = (jax.tree_util.tree_map(lambda x: x[i], batch)
+                 if scan_superbatch else batch)
+            return step(st, b, jax.random.fold_in(rng, i))
+        return jax.lax.scan(body, state, jnp.arange(scan_steps))
+
+    return init_state, jax.jit(multi, donate_argnums=(0,))
 
 
 

@@ -71,7 +71,12 @@ class DataParallelTrainer:
         self.tx = tx
 
         self._param_objs = list(block.collect_params().values())
-        self._rep = NamedSharding(self.mesh, P())
+        # on a trivial (1-device) mesh, committing arrays to a
+        # NamedSharding routes execution through the SPMD-partitioned
+        # path — measured 130x slower on the tunneled chip here
+        # (docs/perf.md "Methodology") — so skip all sharding commits
+        self._trivial = self.mesh.size == 1
+        self._rep = None if self._trivial else NamedSharding(self.mesh, P())
         # ZeRO-1: optimizer state sharded over the data axis — 'dp' if
         # present, else the mesh's first axis, matching how the batch is
         # sharded (SURVEY.md §2.4 — the PS server-side optimizer update)
@@ -88,6 +93,8 @@ class DataParallelTrainer:
     def _gather_params(self):
         import jax
         vals = [p.data()._data for p in self._param_objs]
+        if self._trivial:
+            return vals
         return [jax.device_put(v, self._rep) for v in vals]
 
     def sync(self):
@@ -196,12 +203,14 @@ class DataParallelTrainer:
             from .mesh import init_sharded_opt_state
             opt_state = init_sharded_opt_state(
                 self.tx, pvals, self.mesh, axis=self._data_axis)
+        elif self._trivial:
+            opt_state = self.tx.init(pvals)
         else:
             opt_state = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, self._rep),
                 self.tx.init(pvals))
         self._state = (pvals, opt_state)
-        self._batch_sharding = NamedSharding(
+        self._batch_sharding = None if self._trivial else NamedSharding(
             self.mesh, P(self._data_axis))
         self._step_fn = step
         self._jit_step = jax.jit(step, donate_argnums=(0,))
@@ -215,8 +224,9 @@ class DataParallelTrainer:
         l = label._data if isinstance(label, NDArray) else label
         if self._jit_step is None:
             self._build(d, l)
-        d = jax.device_put(d, self._batch_sharding)
-        l = jax.device_put(l, self._batch_sharding)
+        if not self._trivial:
+            d = jax.device_put(d, self._batch_sharding)
+            l = jax.device_put(l, self._batch_sharding)
         self._state, loss = self._jit_step(self._state, d, l)
         return _wrap(loss)
 
@@ -270,7 +280,9 @@ class DataParallelTrainer:
                     (d, l) if superbatch else None, length=steps)
 
             self._multi_jit[key] = jax.jit(multi, donate_argnums=(0,))
-        if superbatch:
+        if self._trivial:
+            pass
+        elif superbatch:
             sb = NamedSharding(
                 self.mesh, P(None, self._data_axis))
             d = jax.device_put(d, sb)

@@ -18,7 +18,8 @@ from typing import Optional, Sequence, Tuple
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "default_mesh", "current_mesh", "mesh_scope"]
+__all__ = ["make_mesh", "default_mesh", "current_mesh", "mesh_scope",
+           "live_axis"]
 
 _CURRENT = []
 
@@ -80,6 +81,18 @@ class mesh_scope:
 
 def current_mesh():
     return _CURRENT[-1] if _CURRENT else None
+
+
+def live_axis(mesh, name):
+    """``name`` if the mesh has that axis AND it actually partitions
+    (size > 1), else None.  Sharding constraints over trivial axes are
+    semantically no-ops but not free on every backend — on the tunneled
+    chip here they materialize a copy per constraint (docs/perf.md
+    "Methodology") — so constraint sites build specs from live axes
+    only."""
+    if mesh is None or name not in mesh.axis_names:
+        return None
+    return name if mesh.shape[name] > 1 else None
 
 
 def zero1_sharding(leaf, mesh, axis="dp"):

@@ -123,13 +123,18 @@ def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
         axis=2)                                           # (G, S, E, C)
 
     xin = jnp.einsum("gsec,gsd->egcd", disp.astype(cdt), x.astype(cdt))
-    if mesh is not None and "ep" in mesh.axis_names:
+    # constraints only along axes that actually partition — a trivial
+    # (size-1) constraint is not free on every backend (docs/perf.md);
+    # gate per-axis so dp stays constrained even when ep is trivial
+    from .mesh import live_axis
+    ep = live_axis(mesh, "ep")
+    dp = live_axis(mesh, "dp")
+    if ep or dp:
         from jax.sharding import NamedSharding, PartitionSpec as P
         # keep the token-group dim dp-sharded — pinning it replicated
         # would all-gather over dp and fold-duplicate the expert FLOPs
-        dp = "dp" if "dp" in mesh.axis_names else None
         xin = jax.lax.with_sharding_constraint(
-            xin, NamedSharding(mesh, P("ep", dp, None, None)))
+            xin, NamedSharding(mesh, P(ep, dp, None, None)))
 
     h = jnp.einsum("egcd,edf->egcf", xin, params["w1"].astype(cdt))
     h = h + params["b1"][:, None, None, :].astype(cdt)
@@ -141,9 +146,9 @@ def moe_ffn(x, params, *, n_experts, top_k=2, capacity_factor=1.25,
         raise MXNetError("unknown activation %r" % activation)
     y = jnp.einsum("egcf,efd->egcd", h, params["w2"].astype(cdt))
     y = y + params["b2"][:, None, None, :].astype(cdt)
-    if mesh is not None and "ep" in mesh.axis_names:
+    if ep or dp:
         y = jax.lax.with_sharding_constraint(
-            y, NamedSharding(mesh, P("ep", dp, None, None)))
+            y, NamedSharding(mesh, P(ep, dp, None, None)))
 
     out = jnp.einsum("gsec,egcd->gsd", combine.astype(cdt), y)
     return out.astype(x.dtype), aux_loss
