@@ -208,3 +208,21 @@ def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
     if not meta["was_list"]:
         return rlist[0]
     return rlist
+
+
+# ---------------------------------------------------------------------------
+# Surface every ``_contrib_*`` registry op as ``nd.contrib.<short name>``
+# (reference: the generated ``python/mxnet/ndarray/contrib.py`` namespace).
+# ---------------------------------------------------------------------------
+
+def _populate_contrib():
+    from ..ops import registry as _registry
+    from .register import _make_stub
+    for _name in _registry.list_ops():
+        if _name.startswith("_contrib_"):
+            _short = _name[len("_contrib_"):]
+            if _short not in globals():
+                globals()[_short] = _make_stub(_registry.get_op(_name))
+
+
+_populate_contrib()

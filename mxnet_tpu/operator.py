@@ -1,0 +1,182 @@
+"""``mx.operator`` — user-defined operators in Python (CustomOp).
+
+Reference: ``python/mxnet/operator.py`` + ``src/operator/custom/custom.cc``
+(SURVEY.md §2.1 "Operator library" row, ``custom/custom.cc``): users
+subclass ``CustomOpProp`` (declares arguments/outputs/shape inference and
+creates the runtime op) and ``CustomOp`` (imperative ``forward`` /
+``backward`` writing results through ``assign``), register the prop under
+a name, and call ``nd.Custom(..., op_type=name)`` / ``sym.Custom(...)``.
+
+TPU-native design: the user's ``forward``/``backward`` receive NDArrays
+and compute with ``mx.nd`` ops, so a CustomOp is *traceable* — under
+``hybridize()``/``jit`` it lowers into the surrounding XLA program
+instead of breaking the graph the way the reference's C++ custom-op
+bridge breaks engine bulking.  The custom ``backward`` is honored by
+wrapping the registry impl in ``jax.custom_vjp`` (the reference routes
+this through the nnvm ``FGradient`` of the Custom node)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register",
+           "get_all_registered_operators"]
+
+_PROPS: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """Base class for the runtime operator (reference:
+    ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write request."""
+        if req == "null":
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:  # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Operator properties: names, shapes, types, and op creation
+    (reference: ``mx.operator.CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a ``CustomOpProp`` under ``reg_name``
+    (reference: ``mx.operator.register``)."""
+    def _wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register(%r): expected a CustomOpProp "
+                             "subclass" % reg_name)
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+    return _wrap
+
+
+def get_all_registered_operators() -> List[str]:
+    return sorted(_PROPS)
+
+
+def _get_prop(op_type: str, attrs) -> CustomOpProp:
+    if op_type not in _PROPS:
+        raise MXNetError(
+            "Custom: op_type %r is not registered (have: %s)"
+            % (op_type, ", ".join(sorted(_PROPS)) or "<none>"))
+    return _PROPS[op_type](**attrs)
+
+
+def _custom_impl(*arrays, op_type=None, **attrs):
+    """Registry impl behind ``nd.Custom`` / ``sym.Custom``."""
+    import jax
+    from .ndarray.ndarray import NDArray
+    from . import autograd
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = _get_prop(op_type, attrs)
+    n_args = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    if len(arrays) != n_args + n_aux:
+        raise MXNetError(
+            "Custom(%s): expected %d arguments + %d aux states, got %d "
+            "inputs" % (op_type, n_args, n_aux, len(arrays)))
+
+    in_shapes = [tuple(a.shape) for a in arrays[:n_args]]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [a.dtype for a in arrays[:n_args]]
+    _, out_types, _ = prop.infer_type(list(in_types))
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    def _run_forward(raw):
+        from . import nd
+        in_nd = [NDArray(a) for a in raw[:n_args]]
+        aux_nd = [NDArray(a) for a in raw[n_args:]]
+        out_nd = [nd.zeros(s, dtype=str(jax.numpy.dtype(t)))
+                  for s, t in zip(out_shapes, out_types)]
+        with autograd.pause():
+            op.forward(is_train=autograd.is_training(),
+                       req=["write"] * n_out, in_data=in_nd,
+                       out_data=out_nd, aux=aux_nd)
+        return tuple(o._data for o in out_nd)
+
+    @jax.custom_vjp
+    def fn(*raw):
+        outs = _run_forward(raw)
+        return outs[0] if n_out == 1 else outs
+
+    def fwd(*raw):
+        outs = _run_forward(raw)
+        return (outs[0] if n_out == 1 else outs), (raw, outs)
+
+    def bwd(res, gs):
+        raw, outs = res
+        gs = (gs,) if n_out == 1 else tuple(gs)
+        in_nd = [NDArray(a) for a in raw[:n_args]]
+        aux_nd = [NDArray(a) for a in raw[n_args:]]
+        out_nd = [NDArray(o) for o in outs]
+        grad_nd = [NDArray(g) for g in gs]
+        from . import nd
+        in_grad = [nd.zeros(x.shape, dtype=str(x.dtype)) for x in in_nd]
+        with autograd.pause():
+            op.backward(req=["write"] * n_args, out_grad=grad_nd,
+                        in_data=in_nd, out_data=out_nd,
+                        in_grad=in_grad, aux=aux_nd)
+        zero_aux = tuple(jax.numpy.zeros_like(a) for a in raw[n_args:])
+        return tuple(g._data for g in in_grad) + zero_aux
+
+    fn.defvjp(fwd, bwd)
+    return fn(*arrays)
+
+
+def _register_custom_op():
+    from .ops.registry import register as _reg
+
+    @_reg("Custom", num_outputs=-1)
+    def Custom(*arrays, op_type=None, **attrs):  # noqa: N802
+        """User-defined Python operator (reference:
+        ``src/operator/custom/custom.cc``).  See ``mx.operator``."""
+        return _custom_impl(*arrays, op_type=op_type, **attrs)
+
+
+_register_custom_op()

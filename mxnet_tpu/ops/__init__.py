@@ -18,3 +18,5 @@ from . import linalg        # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_op        # noqa: F401
 from . import quantization  # noqa: F401
+from . import vision        # noqa: F401
+from .. import operator     # noqa: F401  (registers the "Custom" op)

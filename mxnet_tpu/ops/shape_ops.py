@@ -397,3 +397,99 @@ def depth_to_space(data, block_size=1, **kw):
     x = data.reshape(n, b, b, c // (b * b), h, w)
     x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
     return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("batch_take")
+def batch_take(a, indices, **kw):
+    """Per-row element pick: out[i] = a[i, indices[i]] (reference:
+    ``indexing_op.cc`` batch_take)."""
+    jnp = _j()
+    idx = indices.astype("int32")
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("unravel_index", aliases=("_unravel_index",), no_grad=True)
+def unravel_index(data, shape=None, **kw):
+    """Flat indices → coordinate matrix (D, N) (reference:
+    ``ravel.cc``)."""
+    jnp = _j()
+    coords = jnp.unravel_index(data.astype("int32").reshape(-1),
+                               tuple(shape))
+    out = jnp.stack(coords, axis=0)
+    return out.reshape((len(shape),) + data.shape)
+
+
+@register("ravel_multi_index", aliases=("_ravel_multi_index",),
+          no_grad=True)
+def ravel_multi_index(data, shape=None, **kw):
+    """Coordinate matrix (D, N) → flat indices (reference:
+    ``ravel.cc``)."""
+    jnp = _j()
+    strides = _np.concatenate(
+        [_np.cumprod(list(shape)[::-1])[::-1][1:], [1]]).astype("int32")
+    return jnp.sum(data.astype("int32") *
+                   jnp.asarray(strides)[:, None], axis=0)
+
+
+@register("_contrib_arange_like", aliases=("arange_like",), no_grad=True)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    """arange shaped like (an axis of) the input — shape-polymorphic
+    graphs without host round-trips (reference:
+    ``contrib/arange_like``)."""
+    jnp = _j()
+    repeat = int(repeat)
+
+    def ramp(n):
+        # each value repeated `repeat` times within the n elements
+        vals = start + step * jnp.arange(-(-n // repeat), dtype="float32")
+        return jnp.repeat(vals, repeat)[:n] if repeat != 1 else vals
+
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        return ramp(n).reshape(data.shape)
+    return ramp(data.shape[axis])
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new, **kw):
+    """out = old with rows at ``index`` replaced by ``new`` (reference:
+    ``contrib/index_copy.cc``)."""
+    return old.at[index.astype("int32")].set(new.astype(old.dtype))
+
+
+@register("_contrib_index_array", aliases=("index_array",), no_grad=True)
+def index_array(data, axes=None, **kw):
+    """Index-coordinate tensor of the input's shape (reference:
+    ``contrib/index_array.cc``): out[..., k] = coordinate along axes[k]."""
+    jnp = _j()
+    nd_ = data.ndim
+    sel = tuple(axes) if axes is not None else tuple(range(nd_))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in data.shape],
+                         indexing="ij")
+    return jnp.stack([grids[a] for a in sel], axis=-1).astype("int32")
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",))
+def boolean_mask(data, index, axis=0, **kw):
+    """Rows of ``data`` where ``index`` is nonzero (reference:
+    ``contrib/boolean_mask.cc``).
+
+    TPU note: the output length is data-dependent — a dynamic shape XLA
+    cannot compile.  Eager mode materializes the compacted result on
+    host (matching the reference's output exactly); under jit/hybridize
+    use masking (``where``) or ``np.nonzero``-free formulations instead
+    (SURVEY.md §7 hard-part #5: dynamic shapes are the documented
+    TPU-hostile corner)."""
+    import jax
+    jnp = _j()
+    try:
+        idx = _np.asarray(jax.device_get(index)).astype(bool)
+    except jax.errors.TracerArrayConversionError:
+        raise MXNetError(
+            "boolean_mask has a data-dependent output shape and cannot "
+            "run under jit/hybridize on TPU; restructure with nd.where "
+            "masking (see op docstring)")
+    keep = _np.nonzero(idx)[0]
+    return jnp.take(data, jnp.asarray(keep), axis=axis)

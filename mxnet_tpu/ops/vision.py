@@ -1,0 +1,654 @@
+"""Vision / detection operator kernels.
+
+Reference: ``src/operator/contrib/`` (``bounding_box.cc`` box_iou/box_nms,
+``multibox_prior.cc`` / ``multibox_target.cc`` / ``multibox_detection.cc``
+SSD ops, ``roi_align.cc``, ``bilinear_resize.cc``,
+``adaptive_avg_pooling.cc``), ``src/operator/roi_pooling.cc``,
+``src/operator/spatial_transformer.cc`` / ``bilinear_sampler.cc`` /
+``grid_generator.cc``, ``src/operator/correlation.cc``,
+``src/operator/svm_output.cc`` (SURVEY.md §2.1 "Operator library").
+
+TPU-native design: every op here is static-shape and branch-free so it
+jits cleanly — NMS keeps the input rank and marks suppressed entries
+instead of compacting (which is also the reference's output contract),
+ROIAlign samples fixed per-bin grids via vectorized bilinear gathers
+(no dynamic slicing), and adaptive pooling reduces via an integral
+image so arbitrary output sizes stay one fused XLA computation.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _to_corner(box, fmt):
+    """(..., 4) boxes → corner (xmin, ymin, xmax, ymax)."""
+    jnp = _j()
+    if fmt == "corner":
+        return box
+    # center: (cx, cy, w, h)
+    cx, cy, w, h = (box[..., 0], box[..., 1], box[..., 2], box[..., 3])
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _pairwise_iou(lhs, rhs):
+    """IoU between (..., A, 4) and (..., B, 4) corner boxes → (..., A, B)."""
+    jnp = _j()
+    lt = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    rb = jnp.minimum(lhs[..., :, None, 2:], rhs[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = jnp.maximum(lhs[..., 2] - lhs[..., 0], 0.0) * \
+        jnp.maximum(lhs[..., 3] - lhs[..., 1], 0.0)
+    area_r = jnp.maximum(rhs[..., 2] - rhs[..., 0], 0.0) * \
+        jnp.maximum(rhs[..., 3] - rhs[..., 1], 0.0)
+    union = area_l[..., :, None] + area_r[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU over the outer product of the two boxes' leading dims
+    (reference: ``bounding_box.cc`` BoxOverlap)."""
+    jnp = _j()
+    lf = _to_corner(lhs, format).reshape((-1, 4))
+    rf = _to_corner(rhs, format).reshape((-1, 4))
+    out = _pairwise_iou(lf, rf)
+    return out.reshape(lhs.shape[:-1] + rhs.shape[:-1])
+
+
+def _nms_keep(boxes, scores, valid, thresh, force_suppress, ids):
+    """Greedy NMS over score-descending boxes.  Returns the keep mask in
+    the SORTED order.  O(N²) data-parallel formulation: a box is kept iff
+    no higher-scoring *kept* box overlaps it — computed with a scan over
+    rows of the pairwise-IoU matrix (static shapes, jit-safe)."""
+    jax = _jax()
+    jnp = _j()
+    n = boxes.shape[0]
+    iou = _pairwise_iou(boxes, boxes)
+    same_class = (ids[:, None] == ids[None, :]) if not force_suppress \
+        else jnp.ones((n, n), bool)
+    suppress = (iou > thresh) & same_class
+
+    def body(keep, i):
+        # i suppressed by any kept higher-scoring j < i
+        sup = jnp.any(keep & (jnp.arange(n) < i) & suppress[:, i])
+        k = valid[i] & ~sup
+        keep = keep.at[i].set(k)
+        return keep, ()
+
+    keep0 = jnp.zeros((n,), bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    return keep
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner",
+            **kw):
+    """Non-maximum suppression (reference: ``bounding_box.cc`` BoxNMS).
+
+    Output keeps the input shape; suppressed/invalid entries have their
+    score set to -1 (the reference's contract).  Entries are re-ordered
+    score-descending within each batch."""
+    jnp = _j()
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])  # (B, N, K)
+    B, N, K = flat.shape
+
+    def one(rec):
+        scores = rec[:, score_index]
+        valid = scores > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid = valid & (rec[:, id_index] != background_id)
+        order = jnp.argsort(-scores)
+        rec_s = rec[order]
+        valid_s = valid[order]
+        if topk > 0:
+            valid_s = valid_s & (jnp.arange(N) < topk)
+        boxes = _to_corner(
+            rec_s[:, coord_start:coord_start + 4], in_format)
+        ids_s = rec_s[:, id_index] if id_index >= 0 \
+            else jnp.zeros((N,), rec.dtype)
+        keep = _nms_keep(boxes, rec_s[:, score_index], valid_s,
+                         overlap_thresh, force_suppress, ids_s)
+        out = rec_s
+        if out_format != in_format:
+            if out_format == "corner":
+                conv = boxes
+            else:
+                x0, y0, x1, y1 = (boxes[..., 0], boxes[..., 1],
+                                  boxes[..., 2], boxes[..., 3])
+                conv = jnp.stack([(x0 + x1) / 2, (y0 + y1) / 2,
+                                  x1 - x0, y1 - y0], axis=-1)
+            out = out.at[:, coord_start:coord_start + 4].set(
+                conv.astype(out.dtype))
+        out = out.at[:, score_index].set(
+            jnp.where(keep, out[:, score_index], -1.0))
+        return out
+
+    out = _jax().vmap(one)(flat)
+    return out.reshape(shape)
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """SSD anchor generation (reference: ``multibox_prior.cc``).  For an
+    (N, C, H, W) feature map emits (1, H*W*(S+R-1), 4) corner anchors."""
+    jnp = _j()
+    sizes = tuple(float(s) for s in (sizes if not isinstance(sizes, (int, float)) else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if not isinstance(ratios, (int, float)) else (ratios,)))
+    H, W = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype="float32") + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype="float32") + offsets[1]) * step_x
+    cxg, cyg = jnp.meshgrid(cx, cy)  # (H, W)
+
+    ws, hs = [], []
+    # anchors: (size_i, ratio_0) for all sizes, then (size_0, ratio_j>0)
+    for s in sizes:
+        ws.append(s * _np.sqrt(ratios[0]))
+        hs.append(s / _np.sqrt(ratios[0]))
+    for r in ratios[1:]:
+        ws.append(sizes[0] * _np.sqrt(r))
+        hs.append(sizes[0] / _np.sqrt(r))
+    ws = jnp.asarray(ws, "float32")  # (A,)
+    hs = jnp.asarray(hs, "float32")
+    cxg = cxg[..., None]  # (H, W, 1)
+    cyg = cyg[..., None]
+    anchors = jnp.stack([cxg - ws / 2, cyg - hs / 2,
+                         cxg + ws / 2, cyg + hs / 2], axis=-1)
+    anchors = anchors.reshape((1, -1, 4))
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _encode_loc(anchor, gt, variances):
+    """Corner anchor + matched corner gt → SSD regression target."""
+    jnp = _j()
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    acx = (anchor[..., 0] + anchor[..., 2]) / 2
+    acy = (anchor[..., 1] + anchor[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-12)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-12)
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    return jnp.stack([
+        (gcx - acx) / jnp.maximum(aw, 1e-12) / variances[0],
+        (gcy - acy) / jnp.maximum(ah, 1e-12) / variances[1],
+        jnp.log(gw / jnp.maximum(aw, 1e-12)) / variances[2],
+        jnp.log(gh / jnp.maximum(ah, 1e-12)) / variances[3],
+    ], axis=-1)
+
+
+@register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
+          num_outputs=3, no_grad=True)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """SSD training-target assignment (reference: ``multibox_target.cc``).
+
+    anchor (1, A, 4) corner; label (B, M, 5) rows [cls, x0, y0, x1, y1]
+    padded with cls = -1; cls_pred (B, C+1, A) (used for hard-negative
+    mining when ``negative_mining_ratio`` > 0).  Outputs: loc_target
+    (B, A*4), loc_mask (B, A*4), cls_target (B, A) where class 0 is
+    background and gt class c maps to c+1."""
+    jax = _jax()
+    jnp = _j()
+    A = anchor.shape[1]
+    anc = anchor.reshape((A, 4))
+
+    def one(lab, cpred):
+        M = lab.shape[0]
+        gt_valid = lab[:, 0] >= 0                      # (M,)
+        gt_box = lab[:, 1:5]
+        iou = _pairwise_iou(anc, gt_box)               # (A, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)              # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # bipartite stage: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)          # (M,)
+        forced = jnp.zeros((A,), bool)
+        forced = forced.at[best_anchor].set(gt_valid | forced[best_anchor])
+        forced_gt = jnp.zeros((A,), "int32")
+        forced_gt = forced_gt.at[best_anchor].set(
+            jnp.where(gt_valid, jnp.arange(M), forced_gt[best_anchor])
+            .astype("int32"))
+        use_gt = jnp.where(forced, forced_gt, best_gt.astype("int32"))
+        pos = matched | forced
+        gt_for_anchor = gt_box[use_gt]                 # (A, 4)
+        cls_for_anchor = lab[use_gt, 0].astype("int32") + 1
+        cls_target = jnp.where(pos, cls_for_anchor, 0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining: keep the highest-background-loss
+            # negatives up to ratio * npos, rest -> ignore_label
+            bg_prob = jax.nn.softmax(cpred, axis=0)[0]  # (A,)
+            neg_score = jnp.where(pos | (best_iou >= negative_mining_thresh),
+                                  jnp.inf, bg_prob)
+            order = jnp.argsort(neg_score)             # hardest first
+            rank = jnp.zeros((A,), "int32").at[order].set(
+                jnp.arange(A, dtype="int32"))
+            n_neg = jnp.maximum(
+                (negative_mining_ratio * jnp.sum(pos)).astype("int32"),
+                minimum_negative_samples)
+            keep_neg = rank < n_neg
+            cls_target = jnp.where(pos, cls_target,
+                                   jnp.where(keep_neg, 0,
+                                             int(ignore_label)))
+        loc_t = _encode_loc(anc, gt_for_anchor, variances)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+        loc_m = jnp.where(pos[:, None], 1.0, 0.0) * jnp.ones((A, 4))
+        return (loc_t.reshape(-1), loc_m.reshape(-1),
+                cls_target.astype("float32"))
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+@register("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",),
+          no_grad=True)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """SSD detection decode + per-class NMS (reference:
+    ``multibox_detection.cc``).  cls_prob (B, C+1, A), loc_pred (B, A*4),
+    anchor (1, A, 4) → (B, A, 6) rows [class_id, score, x0, y0, x1, y1],
+    suppressed rows get class_id -1."""
+    jax = _jax()
+    jnp = _j()
+    B, C1, A = cls_prob.shape
+    anc = anchor.reshape((A, 4))
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def one(cp, lp):
+        loc = lp.reshape((A, 4))
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        box = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2, cy + h / 2], axis=-1)
+        if clip:
+            box = jnp.clip(box, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([cp[:background_id],
+                              cp[background_id + 1:]], axis=0)  # (C, A)
+        best = jnp.argmax(fg, axis=0)                            # (A,)
+        score = jnp.max(fg, axis=0)
+        # the fg row index IS the original 0-based gt class (reference
+        # emits channel-1 for background_id 0: gt class c trains channel
+        # c+1 in MultiBoxTarget, detection undoes the shift)
+        cls_id = jnp.where(score > threshold, best.astype("float32"),
+                           -1.0)
+        score = jnp.where(score > threshold, score, -1.0)
+        rec = jnp.concatenate([cls_id[:, None], score[:, None], box],
+                              axis=-1)                           # (A, 6)
+        out = box_nms(rec[None], overlap_thresh=nms_threshold,
+                      valid_thresh=0.0, topk=nms_topk, coord_start=2,
+                      score_index=1, id_index=0, background_id=-1,
+                      force_suppress=force_suppress)[0]
+        # reference marks suppressed rows via class_id = -1
+        return out.at[:, 0].set(
+            jnp.where(out[:, 1] < 0, -1.0, out[:, 0]))
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
+    """Max pooling over quantized ROI bins (reference:
+    ``roi_pooling.cc``).  data (N, C, H, W); rois (R, 5) rows
+    [batch_index, x0, y0, x1, y1] in image coords."""
+    jax = _jax()
+    jnp = _j()
+    PH, PW = pooled_size
+    N, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype="float32")
+    xs = jnp.arange(W, dtype="float32")
+
+    def one(roi):
+        b = roi[0].astype("int32")
+        x0 = jnp.round(roi[1] * spatial_scale)
+        y0 = jnp.round(roi[2] * spatial_scale)
+        x1 = jnp.round(roi[3] * spatial_scale)
+        y1 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        img = data[b]                                   # (C, H, W)
+        ph = jnp.arange(PH, dtype="float32")
+        pw = jnp.arange(PW, dtype="float32")
+        hstart = jnp.floor(ph * bin_h) + y0             # (PH,)
+        hend = jnp.ceil((ph + 1) * bin_h) + y0
+        wstart = jnp.floor(pw * bin_w) + x0             # (PW,)
+        wend = jnp.ceil((pw + 1) * bin_w) + x0
+        ymask = (ys[None, :] >= hstart[:, None]) & \
+            (ys[None, :] < hend[:, None])               # (PH, H)
+        xmask = (xs[None, :] >= wstart[:, None]) & \
+            (xs[None, :] < wend[:, None])               # (PW, W)
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]
+        neg = jnp.asarray(-_np.inf, data.dtype)
+        masked = jnp.where(m[None], img[:, None, None, :, :], neg)
+        out = jnp.max(masked, axis=(3, 4))              # (C, PH, PW)
+        return jnp.where(jnp.isneginf(out), 0.0, out).astype(data.dtype)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False,
+              **kw):
+    """ROIAlign with fixed per-bin bilinear sample grids (reference:
+    ``contrib/roi_align.cc``; Mask R-CNN).  Static shapes: every
+    (roi, bin) samples ``sample_ratio²`` points (default 2²) via
+    vectorized bilinear gathers — no dynamic slicing."""
+    jax = _jax()
+    jnp = _j()
+    if position_sensitive:
+        raise MXNetError(
+            "_contrib_ROIAlign: position_sensitive=True (PS-ROIAlign) "
+            "is not implemented")
+    PH, PW = pooled_size
+    S = sample_ratio if sample_ratio > 0 else 2
+    N, C, H, W = data.shape
+    offset = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        """img (C, H, W); y/x (...,) → (C, ...)."""
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype("int32")
+        x0 = jnp.floor(x).astype("int32")
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one(roi):
+        b = roi[0].astype("int32")
+        x0 = roi[1] * spatial_scale - offset
+        y0 = roi[2] * spatial_scale - offset
+        x1 = roi[3] * spatial_scale - offset
+        y1 = roi[4] * spatial_scale - offset
+        rw = x1 - x0
+        rh = y1 - y0
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        ph = jnp.arange(PH, dtype="float32")
+        pw = jnp.arange(PW, dtype="float32")
+        sy = (jnp.arange(S, dtype="float32") + 0.5) / S
+        sx = (jnp.arange(S, dtype="float32") + 0.5) / S
+        yy = y0 + ph[:, None] * bin_h + sy[None, :] * bin_h  # (PH, S)
+        xx = x0 + pw[:, None] * bin_w + sx[None, :] * bin_w  # (PW, S)
+        Y = yy[:, None, :, None]                        # (PH, 1, S, 1)
+        X = xx[None, :, None, :]                        # (1, PW, 1, S)
+        Yb = jnp.broadcast_to(Y, (PH, PW, S, S))
+        Xb = jnp.broadcast_to(X, (PH, PW, S, S))
+        vals = bilinear(data[b], Yb, Xb)                # (C, PH, PW, S, S)
+        return jnp.mean(vals, axis=(3, 4)).astype(data.dtype)
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Spatial transformer family
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_nchw(data, grid_x, grid_y):
+    """data (C, H, W); normalized grid in [-1, 1]; outside → 0
+    (reference: ``bilinear_sampler.cc`` border handling = zero pad)."""
+    jnp = _j()
+    C, H, W = data.shape
+    x = (grid_x + 1.0) * (W - 1) / 2.0
+    y = (grid_y + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(yi, xi):
+        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype("int32")
+        xc = jnp.clip(xi, 0, W - 1).astype("int32")
+        v = data[:, yc, xc]
+        return jnp.where(inside, v, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, **kw):
+    """Sample data at grid locations (reference:
+    ``bilinear_sampler.cc``).  data (B, C, H, W); grid (B, 2, Ho, Wo)
+    with grid[:, 0] = x, grid[:, 1] = y in [-1, 1]."""
+    jax = _jax()
+
+    def one(img, g):
+        return _bilinear_sample_nchw(img, g[0], g[1]).astype(img.dtype)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0),
+                   **kw):
+    """Generate a sampling grid (reference: ``grid_generator.cc``).
+
+    affine: data (B, 6) row-major 2x3 θ → grid (B, 2, H, W);
+    warp: data (B, 2, H, W) pixel flow → normalized grid."""
+    jnp = _j()
+    if transform_type == "affine":
+        H, W = target_shape
+        B = data.shape[0]
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        xg, yg = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(xg)
+        src = jnp.stack([xg, yg, ones], axis=0).reshape((3, -1))  # (3, HW)
+        theta = data.reshape((B, 2, 3))
+        out = theta @ src                                         # (B,2,HW)
+        return out.reshape((B, 2, H, W)).astype(data.dtype)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        ys = jnp.arange(H, dtype="float32")
+        xs = jnp.arange(W, dtype="float32")
+        xg, yg = jnp.meshgrid(xs, ys)
+        x = (data[:, 0] + xg) * 2.0 / max(W - 1, 1) - 1.0
+        y = (data[:, 1] + yg) * 2.0 / max(H - 1, 1) - 1.0
+        return jnp.stack([x, y], axis=1).astype(data.dtype)
+    raise MXNetError("GridGenerator: unknown transform_type %r"
+                     % transform_type)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        **kw):
+    """Affine spatial transformer network layer (reference:
+    ``spatial_transformer.cc`` — GridGenerator + BilinearSampler)."""
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=tuple(target_shape))
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Resize / adaptive pooling
+# ---------------------------------------------------------------------------
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize_2d(data, like=None, height=1, width=1,
+                       scale_height=None, scale_width=None,
+                       mode="size", **kw):
+    """Bilinear up/downsampling with align_corners=True semantics
+    (reference: ``contrib/bilinear_resize.cc``)."""
+    jnp = _j()
+    B, C, H, W = data.shape
+    if mode == "like" and like is not None:
+        Ho, Wo = like.shape[-2], like.shape[-1]
+    elif mode == "scale" or (scale_height is not None
+                             and scale_width is not None):
+        Ho, Wo = int(H * scale_height), int(W * scale_width)
+    elif mode == "size":
+        Ho, Wo = int(height), int(width)
+    else:
+        raise MXNetError(
+            "_contrib_BilinearResize2D: unsupported mode %r "
+            "(supported: size, scale, like)" % mode)
+    ys = jnp.linspace(0.0, H - 1.0, Ho)
+    xs = jnp.linspace(0.0, W - 1.0, Wo)
+    y0 = jnp.floor(ys).astype("int32")
+    x0 = jnp.floor(xs).astype("int32")
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    v00 = data[:, :, y0][:, :, :, x0]
+    v01 = data[:, :, y0][:, :, :, x1]
+    v10 = data[:, :, y1][:, :, :, x0]
+    v11 = data[:, :, y1][:, :, :, x1]
+    out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+           v10 * wy * (1 - wx) + v11 * wy * wx)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=(1, 1), **kw):
+    """Adaptive average pooling to an arbitrary output size (reference:
+    ``contrib/adaptive_avg_pooling.cc``).  Exact bin averaging via an
+    integral image — one fused XLA computation for any size."""
+    jnp = _j()
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    OH, OW = output_size
+    B, C, H, W = data.shape
+    # integral image with a leading zero row/col
+    ii = jnp.cumsum(jnp.cumsum(data.astype("float32"), axis=2), axis=3)
+    ii = jnp.pad(ii, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    hs = (jnp.arange(OH) * H) // OH
+    he = ((jnp.arange(OH) + 1) * H + OH - 1) // OH
+    ws = (jnp.arange(OW) * W) // OW
+    we = ((jnp.arange(OW) + 1) * W + OW - 1) // OW
+    s = (ii[:, :, he][:, :, :, we] - ii[:, :, hs][:, :, :, we]
+         - ii[:, :, he][:, :, :, ws] + ii[:, :, hs][:, :, :, ws])
+    area = ((he - hs)[:, None] * (we - ws)[None, :]).astype("float32")
+    return (s / area).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (optical flow) and SVMOutput
+# ---------------------------------------------------------------------------
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True, **kw):
+    """Cost-volume correlation between two feature maps (reference:
+    ``correlation.cc``; FlowNet).  Output (B, D², Ho, Wo) where
+    D = 2*(max_displacement/stride2)+1 — computed as D² shifted
+    patch products averaged over channels and the K×K kernel window
+    (static unrolled shifts; XLA fuses the stack)."""
+    jnp = _j()
+    B, C, H, W = data1.shape
+    d = max_displacement // stride2
+    K = kernel_size
+    pad = pad_size
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    bord = max_displacement + (K - 1) // 2
+    ys = _np.arange(bord, Hp - bord, stride1)
+    xs = _np.arange(bord, Wp - bord, stride1)
+    kr = _np.arange(K) - (K - 1) // 2  # kernel window offsets
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            oy, ox = dy * stride2, dx * stride2
+            acc = 0.0
+            for ky in kr:
+                for kx in kr:
+                    a = p1[:, :, ys + ky][:, :, :, xs + kx]
+                    b = p2[:, :, ys + oy + ky][:, :, :, xs + ox + kx]
+                    if is_multiply:
+                        acc = acc + jnp.sum(a * b, axis=1)
+                    else:
+                        acc = acc + jnp.sum(jnp.abs(a - b), axis=1)
+            outs.append(acc / (C * K * K))
+    return jnp.stack(outs, axis=1).astype(data1.dtype)
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, **kw):
+    """SVM output head (reference: ``svm_output.cc``): forward is
+    identity; backward is the (squared-)hinge-loss gradient."""
+    jax = _jax()
+    jnp = _j()
+
+    @jax.custom_vjp
+    def _svm(x, lab):
+        return x
+
+    def _fwd(x, lab):
+        return x, (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        k = x.shape[-1]
+        oh = jax.nn.one_hot(lab.astype("int32"), k, dtype=x.dtype)
+        sgn = 2 * oh - 1                       # +1 for target, -1 rest
+        viol = (margin - sgn * x) > 0
+        if use_linear:
+            grad = jnp.where(viol, -sgn, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * (margin - sgn * x) * sgn, 0.0)
+        grad = grad * regularization_coefficient
+        return (grad.astype(x.dtype), jnp.zeros_like(lab))
+
+    _svm.defvjp(_fwd, _bwd)
+    return _svm(data, label)

@@ -112,6 +112,11 @@ def _resolve_num_outputs(op, n_inputs: int, pos_attrs, attrs) -> int:
         return 2 if attrs.get("ret_typ", "indices") == "both" else 1
     if name == "amp_multicast":
         return n_inputs
+    if name == "Custom":
+        from ..operator import _get_prop
+        a = dict(attrs)
+        op_type = a.pop("op_type", None)
+        return len(_get_prop(op_type, a).list_outputs())
     raise MXNetError(
         "Cannot statically resolve output count for op %r in symbolic "
         "mode" % name)

@@ -1,0 +1,117 @@
+"""mx.operator.CustomOp tests (reference model:
+``tests/python/unittest/test_operator.py::test_custom_op``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+@mx.operator.register("sq")
+class SquareProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Square()
+
+
+class Square(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("split2")
+class Split2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["top", "bottom"]
+
+    def infer_shape(self, in_shape):
+        n = in_shape[0][0] // 2
+        rest = list(in_shape[0][1:])
+        return in_shape, [[n] + rest, [n] + rest], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Split2()
+
+
+class Split2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        n = in_data[0].shape[0] // 2
+        self.assign(out_data[0], req[0], in_data[0][:n])
+        self.assign(out_data[1], req[1], in_data[0][n:])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    nd.concat(out_grad[0], out_grad[1], dim=0))
+
+
+def test_custom_forward():
+    x = np.array([[1.0, -2.0], [3.0, 0.5]], dtype="float32")
+    y = nd.Custom(nd.array(x), op_type="sq").asnumpy()
+    assert np.allclose(y, x * x)
+
+
+def test_custom_backward_is_custom():
+    x = np.array([[1.0, -2.0], [3.0, 0.5]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.Custom(a, op_type="sq")
+        L = y.sum()
+    L.backward()
+    assert np.allclose(a.grad.asnumpy(), 2 * x)
+
+
+def test_custom_multi_output():
+    x = np.arange(8, dtype="float32").reshape(4, 2)
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        top, bot = nd.Custom(a, op_type="split2")
+        L = (top * 2).sum() + (bot * 3).sum()
+    assert top.shape == (2, 2)
+    L.backward()
+    expect = np.concatenate([np.full((2, 2), 2.0), np.full((2, 2), 3.0)])
+    assert np.allclose(a.grad.asnumpy(), expect)
+
+
+def test_custom_inside_hybridize():
+    from mxnet_tpu.gluon import nn, HybridBlock
+
+    class Net(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.dense = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.dense(x), op_type="sq")
+
+    net = Net()
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(3, 5).astype("float32"))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    out = net(x).asnumpy()
+    out2 = net(x).asnumpy()  # cached path
+    assert np.allclose(ref, out, rtol=1e-5, atol=1e-6)
+    assert np.allclose(ref, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_registry_listing():
+    names = mx.operator.get_all_registered_operators()
+    assert "sq" in names and "split2" in names
+
+
+def test_custom_unknown_type_errors():
+    try:
+        nd.Custom(nd.zeros((2, 2)), op_type="definitely_missing")
+        raise SystemExit("should have raised")
+    except mx.base.MXNetError as e:
+        assert "not registered" in str(e)

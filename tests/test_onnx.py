@@ -118,7 +118,6 @@ def test_onnx_roundtrip_mlp_ops():
 
 def test_onnx_export_unsupported_op_raises():
     x = sym.Variable("data")
-    y = sym.Custom(x, op_type="noop") if hasattr(sym, "Custom") else None
     s = sym.arccosh(x) if hasattr(sym, "arccosh") else None
     if s is None:
         pytest.skip("no unconverted op available")

@@ -1,0 +1,288 @@
+"""Detection / spatial-transform op tests with numpy oracles
+(reference model: ``tests/python/unittest/test_operator.py`` sections for
+box_nms, MultiBox*, ROIPooling, SpatialTransformer, Correlation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    ab = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+def test_box_iou():
+    rng = np.random.RandomState(0)
+    a = rng.uniform(0, 1, (5, 4)).astype("float32")
+    b = rng.uniform(0, 1, (7, 4)).astype("float32")
+    a[:, 2:] += a[:, :2]
+    b[:, 2:] += b[:, :2]
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    assert out.shape == (5, 7)
+    assert np.allclose(out, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # three boxes: 2nd overlaps 1st heavily (lower score -> suppressed),
+    # 3rd is disjoint (kept)
+    data = np.array([[
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],
+    ]], dtype="float32")
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()[0]
+    scores = out[:, 1]
+    assert (scores > 0).sum() == 2           # one suppressed
+    assert np.isclose(scores[0], 0.9)        # sorted desc
+    kept_boxes = out[scores > 0][:, 2:]
+    assert any(np.allclose(b, [2, 2, 3, 3]) for b in kept_boxes)
+
+
+def test_box_nms_per_class_vs_force():
+    # overlapping boxes of DIFFERENT classes survive per-class nms but
+    # not force_suppress
+    data = np.array([[
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [1, 0.8, 0.0, 0.0, 1.0, 1.0],
+    ]], dtype="float32")
+    keep = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                              coord_start=2, score_index=1,
+                              id_index=0).asnumpy()[0]
+    assert (keep[:, 1] > 0).sum() == 2
+    sup = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             coord_start=2, score_index=1, id_index=0,
+                             force_suppress=True).asnumpy()[0]
+    assert (sup[:, 1] > 0).sum() == 1
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 6))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                               ratios=(1.0, 2.0)).asnumpy()
+    # (S + R - 1) anchors per cell
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    # first cell center is ((0.5/6), (0.5/4)); first anchor is size .5
+    a0 = anchors[0, 0]
+    cx, cy = (a0[0] + a0[2]) / 2, (a0[1] + a0[3]) / 2
+    assert np.isclose(cx, 0.5 / 6, atol=1e-6)
+    assert np.isclose(cy, 0.5 / 4, atol=1e-6)
+    assert np.isclose(a0[2] - a0[0], 0.5, atol=1e-6)
+
+
+def test_multibox_target_assigns():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], dtype="float32")
+    # one gt box matching anchor 0 exactly; class 2
+    label = np.array([[[2, 0.0, 0.0, 0.5, 0.5],
+                       [-1, 0, 0, 0, 0]]], dtype="float32")
+    cls_pred = np.zeros((1, 4, 3), dtype="float32")
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 3          # gt class 2 -> target 3 (0 = bg)
+    assert cls_t[1] == 0
+    loc_m = loc_m.asnumpy()[0].reshape(3, 4)
+    assert loc_m[0].all() and not loc_m[1].any()
+    # exact match -> zero regression target
+    assert np.allclose(loc_t.asnumpy()[0].reshape(3, 4)[0], 0, atol=1e-5)
+
+
+def test_multibox_detection_roundtrip():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], dtype="float32")
+    # class 1 at anchor 0, class 2 at anchor 1, zero loc offsets
+    cls_prob = np.array([[[0.1, 0.2],      # background
+                          [0.8, 0.1],      # class 1
+                          [0.1, 0.7]]],    # class 2
+                        dtype="float32")
+    loc_pred = np.zeros((1, 8), dtype="float32")
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors)).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    # detection ids are original 0-based gt classes (channel - 1):
+    # channel 1 -> class 0 at anchor 0, channel 2 -> class 1 at anchor 1
+    ids = sorted(kept[:, 0])
+    assert ids == [0.0, 1.0]
+    row0 = kept[kept[:, 0] == 0][0]
+    assert np.allclose(row0[2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_roi_pooling_matches_manual():
+    x = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 3, 3]], dtype="float32")  # 4x4 region
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    # region rows 0..3 cols 0..3, 2x2 max pool
+    region = x[0, 0, :4, :4]
+    expect = np.array([[region[:2, :2].max(), region[:2, 2:].max()],
+                       [region[2:, :2].max(), region[2:, 2:].max()]])
+    assert np.allclose(out[0, 0], expect)
+
+
+def test_roi_align_constant_field():
+    # on a constant image every bilinear sample returns the constant
+    x = np.full((1, 2, 10, 10), 3.5, dtype="float32")
+    rois = np.array([[0, 1.0, 1.0, 7.0, 5.0]], dtype="float32")
+    out = nd.contrib.ROIAlign(nd.array(x), nd.array(rois),
+                              pooled_size=(3, 3),
+                              spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+    assert np.allclose(out, 3.5, atol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    x = np.random.RandomState(0).rand(1, 1, 6, 6).astype("float32")
+    rois = np.array([[0, 0.5, 0.5, 4.5, 4.5]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.contrib.ROIAlign(a, nd.array(rois), pooled_size=(2, 2),
+                                spatial_scale=1.0)
+    y.backward()
+    g = a.grad.asnumpy()
+    assert g.sum() > 0            # bilinear weights sum to out count
+    assert np.isclose(g.sum(), 4.0, atol=1e-4)
+
+
+def test_bilinear_sampler_identity_grid():
+    x = np.random.RandomState(1).rand(2, 3, 5, 7).astype("float32")
+    ys = np.linspace(-1, 1, 5)
+    xs = np.linspace(-1, 1, 7)
+    xg, yg = np.meshgrid(xs, ys)
+    grid = np.stack([xg, yg])[None].repeat(2, 0).astype("float32")
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    assert np.allclose(out, x, atol=1e-5)
+
+
+def test_spatial_transformer_identity_affine():
+    x = np.random.RandomState(2).rand(1, 2, 6, 6).astype("float32")
+    theta = np.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(6, 6)).asnumpy()
+    assert np.allclose(out, x, atol=1e-5)
+    # shifted affine moves content
+    theta2 = np.array([[1, 0, 0.5, 0, 1, 0]], dtype="float32")
+    out2 = nd.SpatialTransformer(nd.array(x), nd.array(theta2),
+                                 target_shape=(6, 6)).asnumpy()
+    assert not np.allclose(out2, x)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 4, 5), dtype="float32")
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    xs = np.linspace(-1, 1, 5)
+    assert np.allclose(grid[0, 0, 0], xs, atol=1e-6)
+
+
+def test_bilinear_resize_2d():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = nd.contrib.BilinearResize2D(nd.array(x), height=7,
+                                      width=7).asnumpy()
+    assert out.shape == (1, 1, 7, 7)
+    # align_corners: corners preserved
+    assert np.isclose(out[0, 0, 0, 0], 0.0)
+    assert np.isclose(out[0, 0, -1, -1], 15.0)
+    assert np.isclose(out[0, 0, 3, 3], 7.5)  # center bilinear
+
+
+def test_adaptive_avg_pooling():
+    x = np.random.RandomState(3).rand(2, 3, 7, 5).astype("float32")
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x),
+                                          output_size=(3, 2)).asnumpy()
+    assert out.shape == (2, 3, 3, 2)
+    import torch
+    ref = torch.nn.functional.adaptive_avg_pool2d(
+        torch.from_numpy(x), (3, 2)).numpy()
+    assert np.allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # divisible case equals reshape-mean
+    x2 = np.random.RandomState(4).rand(1, 1, 6, 6).astype("float32")
+    out2 = nd.contrib.AdaptiveAvgPooling2D(nd.array(x2),
+                                           output_size=(3, 3)).asnumpy()
+    expect = x2.reshape(1, 1, 3, 2, 3, 2).mean((3, 5))
+    assert np.allclose(out2, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_self_is_mean_square():
+    x = np.random.RandomState(5).rand(1, 4, 6, 6).astype("float32")
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    assert out.shape[1] == 9      # 3x3 displacement grid
+    # zero-displacement channel (index 4) == mean over C of x*x
+    center = out[0, 4]
+    expect = (x[0] ** 2).mean(0)
+    assert np.allclose(center, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_svm_output_grad():
+    x = np.array([[0.5, -0.2, 0.1]], dtype="float32")
+    lab = np.array([0], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(a, nd.array(lab), margin=1.0, use_linear=True)
+    assert np.allclose(y.asnumpy(), x)
+    y.backward()
+    g = a.grad.asnumpy()[0]
+    # target class 0: margin violated (0.5 < 1) -> grad -1;
+    # others: -x > -1 -> margin violated -> grad +1
+    assert np.allclose(g, [-1.0, 1.0, 1.0])
+
+
+def test_batch_take_and_ravel():
+    a = np.arange(12, dtype="float32").reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], dtype="float32")
+    out = nd.batch_take(nd.array(a), nd.array(idx)).asnumpy()
+    assert np.allclose(out, a[np.arange(4), idx.astype(int)])
+
+    flat = np.array([0, 5, 11], dtype="float32")
+    coords = nd.unravel_index(nd.array(flat), shape=(4, 3)).asnumpy()
+    assert np.allclose(coords, np.stack(np.unravel_index([0, 5, 11],
+                                                         (4, 3))))
+    back = nd.ravel_multi_index(nd.array(coords.astype("float32")),
+                                shape=(4, 3)).asnumpy()
+    assert np.allclose(back, [0, 5, 11])
+
+
+def test_index_ops_and_boolean_mask():
+    old = np.zeros((4, 3), dtype="float32")
+    new = np.ones((2, 3), dtype="float32")
+    out = nd.contrib.index_copy(nd.array(old),
+                                nd.array(np.array([1, 3], "float32")),
+                                nd.array(new)).asnumpy()
+    assert out[1].all() and out[3].all() and not out[0].any()
+
+    data = np.arange(12, dtype="float32").reshape(4, 3)
+    mask = np.array([1, 0, 1, 0], dtype="float32")
+    got = nd.contrib.boolean_mask(nd.array(data), nd.array(mask)).asnumpy()
+    assert np.allclose(got, data[[0, 2]])
+
+    x = nd.array(np.zeros((2, 3), "float32"))
+    ia = nd.contrib.index_array(x).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    assert ia[1, 2, 0] == 1 and ia[1, 2, 1] == 2
+
+    al = nd.contrib.arange_like(x, axis=1).asnumpy()
+    assert np.allclose(al, [0, 1, 2])
+
+
+def test_detection_ops_in_symbol_graph():
+    """Detection ops compose in the symbolic path too."""
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    anchors = sym.MultiBoxPrior(data, sizes=(0.3,), ratios=(1.0,))
+    ex = anchors.bind(mx.cpu(), {"data": nd.zeros((1, 3, 2, 2))})
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 4, 4)
