@@ -156,3 +156,25 @@ def moments(data, axes=None, keepdims=False, **kw):
     ax = tuple(axes) if axes is not None else None
     return (jnp.mean(data, axis=ax, keepdims=keepdims),
             jnp.var(data, axis=ax, keepdims=keepdims))
+
+
+@register("_contrib_fft", aliases=("fft",))
+def contrib_fft(data, compute_size=128, **kw):
+    """1-D FFT over the last axis; complex output packed as interleaved
+    re/im (the reference's memory layout, ``contrib/fft.cc``)."""
+    jnp = _j()
+    out = jnp.fft.fft(data.astype("float32"), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype("float32")
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def contrib_ifft(data, compute_size=128, **kw):
+    """Inverse of ``_contrib_fft`` — consumes interleaved re/im, emits
+    the real part scaled by N (the reference's convention)."""
+    jnp = _j()
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2)).astype("float32")
+    comp = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(comp, axis=-1).real * n).astype("float32")

@@ -629,3 +629,145 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     loss = optax.ctc_loss(logits, logitpad, labels, labpad,
                           blank_id=blank_id)
     return loss
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """Local response normalization across channels (reference:
+    ``src/operator/nn/lrn.cc``; AlexNet).  NCHW."""
+    jax = _jax()
+    jnp = _j()
+    sq = jnp.square(data.astype("float32"))
+    half = nsize // 2
+    # sum over a channel window via padded cumulative trick
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    win = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    norm = jnp.power(knorm + alpha / nsize * win, beta)
+    return (data.astype("float32") / norm).astype(data.dtype)
+
+
+@register("log_sigmoid")
+def log_sigmoid(data, **kw):
+    """log(sigmoid(x)) (reference: ``mshadow_op.h`` log_sigmoid)."""
+    return _jax().nn.log_sigmoid(data)
+
+
+@register("mish")
+def mish(data, **kw):
+    """x * tanh(softplus(x)) (reference: ``mshadow_op.h`` mish)."""
+    jax = _jax()
+    jnp = _j()
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+@register("masked_softmax")
+def masked_softmax(data, mask, axis=-1, temperature=1.0,
+                   normalize=True, **kw):
+    """Softmax over positions where ``mask`` is true; masked positions
+    output 0 (reference: ``src/operator/nn/softmax.cc``
+    masked_softmax)."""
+    jax = _jax()
+    jnp = _j()
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    neg = jnp.asarray(-_np.inf, x.dtype)
+    masked = jnp.where(mask.astype(bool), x, neg)
+    out = jax.nn.softmax(masked, axis=axis)
+    return jnp.where(mask.astype(bool), out, 0.0).astype(data.dtype)
+
+
+@register("softmax_activation")
+def softmax_activation(data, mode="instance", **kw):
+    """Deprecated alias of softmax (reference:
+    ``softmax_activation.cc``): mode='instance' softmaxes the trailing
+    dim, mode='channel' softmaxes dim 1."""
+    jax = _jax()
+    return jax.nn.softmax(data, axis=1 if mode == "channel" else -1)
+
+
+@register("im2col")
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None, **kw):
+    """Rearrange conv patches into a matrix (reference:
+    ``src/operator/tensor/im2col.cc``): (N, C, *spatial) →
+    (N, C*prod(kernel), prod(out_spatial))."""
+    jax = _jax()
+    nd_ = _conv_dims(kernel)
+    kernel = _tup(kernel, nd_)
+    stride = _tup(stride or 1, nd_)
+    dilate = _tup(dilate or 1, nd_)
+    pad = _tup(pad or 0, nd_)
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    n = patches.shape[0]
+    return patches.reshape((n, patches.shape[1], -1))
+
+
+@register("col2im")
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None, **kw):
+    """Scatter-add inverse of im2col (reference: ``im2col.cc``) —
+    implemented as the transpose (vjp) of ``im2col``, which is exactly
+    its mathematical definition."""
+    jax = _jax()
+    jnp = _j()
+    nd_ = _conv_dims(kernel)
+    out_sp = tuple(int(s) for s in output_size)[-nd_:]
+    C = data.shape[1] // int(_np.prod(_tup(kernel, nd_)))
+    ref_shape = (data.shape[0], C) + out_sp
+    ref = jnp.zeros(ref_shape, data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: im2col(x, kernel=kernel, stride=stride, dilate=dilate,
+                         pad=pad), ref)
+    return vjp(data)[0]
+
+
+@register("Crop")
+def crop_v1(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+            num_args=None, **kw):
+    """Legacy spatial Crop (reference: ``src/operator/crop.cc``):
+    crop inputs[0] to ``h_w`` (or to inputs[1]'s spatial shape) at
+    ``offset`` or centered."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9, **kw):
+    """Identity forward; backward adds the KL-sparsity penalty gradient
+    computed from the batch mean activation (reference:
+    ``src/operator/identity_attach_KL_sparse_reg.cc``, which expects
+    post-sigmoid inputs in (0, 1) and adds
+    ``penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))`` to the gradient).
+    Divergence: the reference keeps ``rho_hat`` as a ``momentum``
+    moving-average aux state; this functional op uses the current batch
+    mean (momentum accepted for signature parity, unused)."""
+    jax = _jax()
+    jnp = _j()
+    rho = sparseness_target
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, x
+
+    def _bwd(x, g):
+        rho_hat = jnp.clip(jnp.mean(x), 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + kl_grad,)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)

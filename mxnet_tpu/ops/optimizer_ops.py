@@ -284,3 +284,78 @@ def multi_sgd_mom_update(data, lrs=None, wds=None, momentum=0.0,
         moms.append(nm)
     # momenta appended after outputs; written back via the mutate contract
     return tuple(outs) + tuple(moms)
+
+
+@register("mp_lamb_update_phase1")
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0, **kw):
+    """Mixed-precision LAMB phase 1: math on the f32 master weight
+    (reference: ``optimizer_op.cc`` mp_lamb_update_phase1)."""
+    return lamb_update_phase1(weight32, grad.astype("float32"), mean, var,
+                              beta1=beta1, beta2=beta2, epsilon=epsilon,
+                              t=t, bias_correction=bias_correction, wd=wd,
+                              rescale_grad=rescale_grad,
+                              clip_gradient=clip_gradient)
+
+
+@register("mp_lamb_update_phase2", mutate=(4,))
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, lr=0.01,
+                          lower_bound=-1.0, upper_bound=-1.0, **kw):
+    """Mixed-precision LAMB phase 2: updates the f32 master, emits the
+    low-precision weight (reference: mp_lamb_update_phase2)."""
+    new32 = lamb_update_phase2(weight32, g_update, r1, r2, lr=lr,
+                               lower_bound=lower_bound,
+                               upper_bound=upper_bound)
+    return new32.astype(weight.dtype), new32
+
+
+@register("multi_lars")
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0, **kw):
+    """LARS layerwise-rate computation over stacked per-layer norms
+    (reference: ``optimizer_op.cc`` multi_lars): out lr_i = lr_i *
+    eta * ||w_i|| / (||g_i|| * rescale + wd_i * ||w_i|| + eps)."""
+    jnp = _j()
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * w_norm / (g_norm + wds * w_norm + eps)
+    # the lars ratio applies only when BOTH norms are positive
+    # (reference: a zero-grad layer passes its lr through unchanged,
+    # not lr*eta*||w||/eps)
+    return jnp.where((w_norm > 0) & (g_norm > 0), lrs * ratio, lrs)
+
+
+@register("preloaded_multi_sgd_update", variadic=True, num_outputs=-1)
+def preloaded_multi_sgd_update(data, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=1, **kw):
+    """multi_sgd_update with per-layer lrs/wds passed as ARRAYS (the
+    last two inputs) instead of attrs — avoids re-jitting when LARS
+    recomputes rates every step (reference: preloaded_multi_sgd)."""
+    lrs, wds = data[-2], data[-1]
+    outs = []
+    for i in range(num_weights):
+        w, g = data[2 * i], data[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", variadic=True, num_outputs=-1,
+          mutate=lambda attrs: tuple(
+              3 * i + 2 for i in range(attrs.get("num_weights", 1))))
+def preloaded_multi_sgd_mom_update(data, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1,
+                                   **kw):
+    lrs, wds = data[-2], data[-1]
+    outs, moms = [], []
+    for i in range(num_weights):
+        w, g, m = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        nw, nm = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.append(nw)
+        moms.append(nm)
+    return tuple(outs) + tuple(moms)

@@ -44,6 +44,41 @@ def _to_corner(box, fmt):
                      axis=-1)
 
 
+def _bilinear_gather(img, y, x, border="clamp"):
+    """Bilinear interpolation of ``img`` (C, H, W) at sample coords
+    ``y``/``x`` (any matching shape, in pixel units) → (C, *y.shape).
+
+    ``border='clamp'``: coordinates clamp to the edge (ROIAlign
+    convention); ``border='zero'``: samples outside the image read 0
+    (BilinearSampler convention).  The single blend implementation
+    backing ROIAlign, BilinearSampler and BilinearResize2D."""
+    jnp = _j()
+    C, H, W = img.shape
+    if border == "clamp":
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def g(yi, xi):
+        yc = jnp.clip(yi, 0, H - 1).astype("int32")
+        xc = jnp.clip(xi, 0, W - 1).astype("int32")
+        v = img[:, yc, xc]
+        if border == "zero":
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = jnp.where(inside, v, 0.0)
+        return v
+
+    v00 = g(y0, x0)
+    v01 = g(y0, x0 + 1)
+    v10 = g(y0 + 1, x0)
+    v11 = g(y0 + 1, x0 + 1)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
 def _pairwise_iou(lhs, rhs):
     """IoU between (..., A, 4) and (..., B, 4) corner boxes → (..., A, B)."""
     jnp = _j()
@@ -119,7 +154,10 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
         rec_s = rec[order]
         valid_s = valid[order]
         if topk > 0:
-            valid_s = valid_s & (jnp.arange(N) < topk)
+            # top-k among VALID boxes only (reference: invalid/background
+            # rows don't consume k slots)
+            valid_rank = jnp.cumsum(valid_s.astype("int32")) - 1
+            valid_s = valid_s & (valid_rank < topk)
         boxes = _to_corner(
             rec_s[:, coord_start:coord_start + 4], in_format)
         ids_s = rec_s[:, id_index] if id_index >= 0 \
@@ -229,12 +267,16 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         matched = best_iou >= overlap_threshold
         # bipartite stage: each valid gt claims its best anchor
         best_anchor = jnp.argmax(iou, axis=0)          # (M,)
+        # padded (cls = -1) rows must not participate in the scatter at
+        # all — at[].set with duplicate indices is order-undefined, so an
+        # invalid gt aliasing a valid gt's anchor could clobber it.
+        # Route invalid gts to out-of-range index A with mode='drop'.
+        scatter_idx = jnp.where(gt_valid, best_anchor, A)
         forced = jnp.zeros((A,), bool)
-        forced = forced.at[best_anchor].set(gt_valid | forced[best_anchor])
+        forced = forced.at[scatter_idx].set(True, mode="drop")
         forced_gt = jnp.zeros((A,), "int32")
-        forced_gt = forced_gt.at[best_anchor].set(
-            jnp.where(gt_valid, jnp.arange(M), forced_gt[best_anchor])
-            .astype("int32"))
+        forced_gt = forced_gt.at[scatter_idx].set(
+            jnp.arange(M, dtype="int32"), mode="drop")
         use_gt = jnp.where(forced, forced_gt, best_gt.astype("int32"))
         pos = matched | forced
         gt_for_anchor = gt_box[use_gt]                 # (A, 4)
@@ -385,23 +427,6 @@ def roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
     N, C, H, W = data.shape
     offset = 0.5 if aligned else 0.0
 
-    def bilinear(img, y, x):
-        """img (C, H, W); y/x (...,) → (C, ...)."""
-        y = jnp.clip(y, 0.0, H - 1.0)
-        x = jnp.clip(x, 0.0, W - 1.0)
-        y0 = jnp.floor(y).astype("int32")
-        x0 = jnp.floor(x).astype("int32")
-        y1 = jnp.minimum(y0 + 1, H - 1)
-        x1 = jnp.minimum(x0 + 1, W - 1)
-        wy = y - y0
-        wx = x - x0
-        v00 = img[:, y0, x0]
-        v01 = img[:, y0, x1]
-        v10 = img[:, y1, x0]
-        v11 = img[:, y1, x1]
-        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
-                v10 * wy * (1 - wx) + v11 * wy * wx)
-
     def one(roi):
         b = roi[0].astype("int32")
         x0 = roi[1] * spatial_scale - offset
@@ -425,7 +450,7 @@ def roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
         X = xx[None, :, None, :]                        # (1, PW, 1, S)
         Yb = jnp.broadcast_to(Y, (PH, PW, S, S))
         Xb = jnp.broadcast_to(X, (PH, PW, S, S))
-        vals = bilinear(data[b], Yb, Xb)                # (C, PH, PW, S, S)
+        vals = _bilinear_gather(data[b], Yb, Xb)        # (C, PH, PW, S, S)
         return jnp.mean(vals, axis=(3, 4)).astype(data.dtype)
 
     return jax.vmap(one)(rois)
@@ -438,28 +463,10 @@ def roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
 def _bilinear_sample_nchw(data, grid_x, grid_y):
     """data (C, H, W); normalized grid in [-1, 1]; outside → 0
     (reference: ``bilinear_sampler.cc`` border handling = zero pad)."""
-    jnp = _j()
     C, H, W = data.shape
     x = (grid_x + 1.0) * (W - 1) / 2.0
     y = (grid_y + 1.0) * (H - 1) / 2.0
-    x0 = jnp.floor(x)
-    y0 = jnp.floor(y)
-    wx = x - x0
-    wy = y - y0
-
-    def gather(yi, xi):
-        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
-        yc = jnp.clip(yi, 0, H - 1).astype("int32")
-        xc = jnp.clip(xi, 0, W - 1).astype("int32")
-        v = data[:, yc, xc]
-        return jnp.where(inside, v, 0.0)
-
-    v00 = gather(y0, x0)
-    v01 = gather(y0, x0 + 1)
-    v10 = gather(y0 + 1, x0)
-    v11 = gather(y0 + 1, x0 + 1)
-    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
-            v10 * wy * (1 - wx) + v11 * wy * wx)
+    return _bilinear_gather(data, y, x, border="zero")
 
 
 @register("BilinearSampler")
@@ -542,18 +549,9 @@ def bilinear_resize_2d(data, like=None, height=1, width=1,
             "(supported: size, scale, like)" % mode)
     ys = jnp.linspace(0.0, H - 1.0, Ho)
     xs = jnp.linspace(0.0, W - 1.0, Wo)
-    y0 = jnp.floor(ys).astype("int32")
-    x0 = jnp.floor(xs).astype("int32")
-    y1 = jnp.minimum(y0 + 1, H - 1)
-    x1 = jnp.minimum(x0 + 1, W - 1)
-    wy = (ys - y0)[None, None, :, None]
-    wx = (xs - x0)[None, None, None, :]
-    v00 = data[:, :, y0][:, :, :, x0]
-    v01 = data[:, :, y0][:, :, :, x1]
-    v10 = data[:, :, y1][:, :, :, x0]
-    v11 = data[:, :, y1][:, :, :, x1]
-    out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
-           v10 * wy * (1 - wx) + v11 * wy * wx)
+    yg = jnp.broadcast_to(ys[:, None], (Ho, Wo))
+    xg = jnp.broadcast_to(xs[None, :], (Ho, Wo))
+    out = _jax().vmap(lambda img: _bilinear_gather(img, yg, xg))(data)
     return out.astype(data.dtype)
 
 

@@ -1,0 +1,153 @@
+"""Long-tail op tests: LRN, im2col/col2im, masked_softmax, fft,
+LARS/mp-LAMB multi-tensor ops, legacy Crop (reference model:
+``tests/python/unittest/test_operator.py`` sections)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_lrn_matches_torch():
+    import torch
+    x = np.random.RandomState(0).rand(2, 8, 5, 5).astype("float32")
+    out = nd.LRN(nd.array(x), alpha=1e-3, beta=0.75, knorm=2.0,
+                 nsize=5).asnumpy()
+    ref = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), size=5, alpha=1e-3, beta=0.75, k=2.0).numpy()
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_im2col_col2im_roundtrip():
+    x = np.random.RandomState(1).rand(2, 3, 6, 6).astype("float32")
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    assert cols.shape == (2, 27, 36)
+    # conv via im2col == Convolution op
+    w = np.random.RandomState(2).rand(4, 3, 3, 3).astype("float32")
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=4, no_bias=True).asnumpy()
+    via = (w.reshape(4, -1) @ cols.asnumpy()).reshape(2, 4, 6, 6)
+    # note: im2col feature order is C-major k-minor, matching OIHW flatten
+    assert np.allclose(via, ref, rtol=1e-4, atol=1e-4)
+    # col2im is the adjoint: <im2col(x), y> == <x, col2im(y)>
+    y = np.random.RandomState(3).rand(*cols.shape).astype("float32")
+    back = nd.col2im(nd.array(y), output_size=(6, 6), kernel=(3, 3),
+                     stride=(1, 1), pad=(1, 1)).asnumpy()
+    lhs = (cols.asnumpy() * y).sum()
+    rhs = (x * back).sum()
+    assert np.isclose(lhs, rhs, rtol=1e-4)
+
+
+def test_masked_softmax():
+    x = np.random.RandomState(4).randn(2, 5).astype("float32")
+    m = np.array([[1, 1, 0, 1, 0], [1, 1, 1, 1, 1]], dtype="float32")
+    out = nd.masked_softmax(nd.array(x), nd.array(m)).asnumpy()
+    assert np.allclose(out[0, [2, 4]], 0)
+    assert np.isclose(out[0].sum(), 1.0, atol=1e-6)
+    e = np.exp(x[0, [0, 1, 3]] - x[0, [0, 1, 3]].max())
+    assert np.allclose(out[0, [0, 1, 3]], e / e.sum(), rtol=1e-5)
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.RandomState(5).rand(3, 8).astype("float32")
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    back = nd.contrib.ifft(f).asnumpy() / 8  # reference scales by N
+    assert np.allclose(back, x, rtol=1e-4, atol=1e-5)
+    ref = np.fft.fft(x, axis=-1)
+    packed = f.asnumpy().reshape(3, 8, 2)
+    assert np.allclose(packed[..., 0], ref.real, rtol=1e-4, atol=1e-4)
+    assert np.allclose(packed[..., 1], ref.imag, rtol=1e-4, atol=1e-4)
+
+
+def test_multi_lars_and_preloaded_sgd():
+    w = [np.random.RandomState(i).rand(4, 3).astype("float32")
+         for i in range(2)]
+    g = [np.random.RandomState(10 + i).rand(4, 3).astype("float32")
+         for i in range(2)]
+    lrs = np.array([0.1, 0.2], dtype="float32")
+    wds = np.array([0.01, 0.0], dtype="float32")
+    wss = np.array([(x * x).sum() for x in w], dtype="float32")
+    gss = np.array([(x * x).sum() for x in g], dtype="float32")
+    new_lrs = nd.multi_lars(nd.array(lrs), nd.array(wss), nd.array(gss),
+                            nd.array(wds), eta=0.01).asnumpy()
+    wn, gn = np.sqrt(wss), np.sqrt(gss)
+    expect = lrs * 0.01 * wn / (gn + wds * wn + 1e-8)
+    assert np.allclose(new_lrs, expect, rtol=1e-5)
+
+    arrs = [nd.array(w[0]), nd.array(g[0]), nd.array(w[1]), nd.array(g[1]),
+            nd.array(new_lrs), nd.array(wds)]
+    o = nd.preloaded_multi_sgd_update(*arrs, num_weights=2)
+    for i in range(2):
+        expect_w = w[i] - new_lrs[i] * (g[i] + wds[i] * w[i])
+        assert np.allclose(o[i].asnumpy(), expect_w, rtol=1e-5, atol=1e-6)
+
+
+def test_mp_lamb_phases():
+    w32 = np.random.RandomState(6).rand(5).astype("float32")
+    w16 = w32.astype("float16")
+    g = np.random.RandomState(7).rand(5).astype("float16")
+    mean = np.zeros(5, "float32")
+    var = np.zeros(5, "float32")
+    upd = nd.mp_lamb_update_phase1(
+        nd.array(w16), nd.array(g), nd.array(mean), nd.array(var),
+        nd.array(w32), t=1, wd=0.01)
+    r1 = np.linalg.norm(w32)
+    r2 = np.linalg.norm(upd.asnumpy())
+    out = nd.mp_lamb_update_phase2(
+        nd.array(w16), upd, nd.array(np.array(r1, "float32")),
+        nd.array(np.array(r2, "float32")), nd.array(w32), lr=0.1)
+    assert out.dtype == np.float16
+    expect32 = w32 - 0.1 * (r1 / r2) * upd.asnumpy()
+    assert np.allclose(out.asnumpy(), expect32.astype("float16"),
+                       rtol=1e-3, atol=1e-3)
+
+
+def test_crop_legacy():
+    x = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    like = np.zeros((1, 1, 2, 2), dtype="float32")
+    out = nd.Crop(nd.array(x), nd.array(like), center_crop=True).asnumpy()
+    assert np.allclose(out[0, 0], x[0, 0, 2:4, 2:4])
+    out2 = nd.Crop(nd.array(x), h_w=(3, 2), offset=(1, 4)).asnumpy()
+    assert out2.shape == (1, 1, 3, 2)
+    assert np.allclose(out2[0, 0], x[0, 0, 1:4, 4:6])
+
+
+def test_log_sigmoid_mish_grads():
+    x = np.random.RandomState(8).randn(4, 3).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.log_sigmoid(a)
+    y.backward()
+    assert np.allclose(a.grad.asnumpy(), 1 / (1 + np.exp(x)), rtol=1e-4)
+    out = nd.mish(nd.array(x)).asnumpy()
+    sp = np.log1p(np.exp(x))
+    assert np.allclose(out, x * np.tanh(sp), rtol=1e-4, atol=1e-5)
+
+
+def test_kl_sparse_reg_identity_forward():
+    x = np.random.RandomState(9).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(a, sparseness_target=0.2,
+                                         penalty=0.1)
+        L = y.sum()
+    assert np.allclose(y.asnumpy(), x)
+    L.backward()
+    g = a.grad.asnumpy()
+    assert g.shape == x.shape and not np.allclose(g, 1.0)  # reg added
+
+
+def test_multi_lars_zero_grad_passthrough():
+    """Regression: a zero-gradient layer keeps its lr unchanged instead
+    of exploding to eta*||w||/eps."""
+    lrs = np.array([0.1, 0.1], dtype="float32")
+    wss = np.array([4.0, 4.0], dtype="float32")
+    gss = np.array([0.0, 1.0], dtype="float32")
+    wds = np.array([0.0, 0.0], dtype="float32")
+    out = nd.multi_lars(nd.array(lrs), nd.array(wss), nd.array(gss),
+                        nd.array(wds), eta=0.01).asnumpy()
+    assert np.isclose(out[0], 0.1)
+    assert np.isclose(out[1], 0.1 * 0.01 * 2.0 / 1.0, rtol=1e-4)

@@ -286,3 +286,32 @@ def test_detection_ops_in_symbol_graph():
     ex = anchors.bind(mx.cpu(), {"data": nd.zeros((1, 3, 2, 2))})
     out = ex.forward()[0].asnumpy()
     assert out.shape == (1, 4, 4)
+
+
+def test_multibox_target_padded_rows_dont_clobber():
+    """Regression: a padded (cls=-1) label row argmaxes to anchor 0 and
+    must not clobber a valid gt's forced bipartite match there."""
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.6, 0.6, 1.0, 1.0]]], dtype="float32")
+    # gt IoU with anchor0 = 0.25 < threshold -> only the forced
+    # bipartite stage assigns it
+    label = np.array([[[1, 0.0, 0.0, 0.2, 0.2],
+                       [-1, 0, 0, 0, 0]]], dtype="float32")
+    cls_pred = np.zeros((1, 3, 2), dtype="float32")
+    _, _, cls_t = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                    nd.array(cls_pred),
+                                    overlap_threshold=0.5)
+    assert cls_t.asnumpy()[0, 0] == 2  # gt class 1 -> target 2
+
+
+def test_box_nms_topk_counts_valid_only():
+    """Regression: background rows must not consume topk slots."""
+    data = np.array([[
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],     # background (id 0)
+        [1, 0.8, 2.0, 2.0, 3.0, 3.0],     # valid class-1 box
+    ]], dtype="float32")
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5, topk=1,
+                             coord_start=2, score_index=1, id_index=0,
+                             background_id=0).asnumpy()[0]
+    assert (out[:, 1] > 0).sum() == 1
+    assert out[out[:, 1] > 0][0][0] == 1  # the class-1 box survived
