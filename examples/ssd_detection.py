@@ -1,0 +1,132 @@
+"""SSD-style single-shot detection on synthetic shapes — the reference's
+``example/ssd`` flow on the TPU-native detection op family:
+``MultiBoxPrior`` (anchors) → ``MultiBoxTarget`` (training targets) →
+``MultiBoxDetection`` + ``box_nms`` (decode), all static-shape and
+jit-compatible (SURVEY.md §2.1 ``src/operator/contrib/multibox_*``).
+
+    JAX_PLATFORMS=cpu python examples/ssd_detection.py --epochs 40
+
+Draws images containing one colored rectangle (class = color) on a
+noisy background, trains a tiny conv SSD head, then decodes and reports
+mean IoU of the top detection against the ground truth.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+N_CLASSES = 2  # red box, green box
+
+
+def make_batch(rng, n, size=32):
+    """Images with one axis-aligned rectangle; returns (x, labels)."""
+    x = rng.uniform(0, 0.15, (n, 3, size, size)).astype("float32")
+    labels = np.zeros((n, 1, 5), dtype="float32")
+    for i in range(n):
+        cls = rng.randint(0, N_CLASSES)
+        w, h = rng.randint(10, 18, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        x[i, cls, y0:y0 + h, x0:x0 + w] += 0.8
+        labels[i, 0] = [cls, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + h) / size]
+    return nd.array(x), nd.array(labels)
+
+
+class SSDHead(gluon.HybridBlock):
+    """Conv backbone + per-anchor class/box predictors."""
+
+    def __init__(self, n_anchors):
+        super().__init__()
+        self.n_anchors = n_anchors
+        with self.name_scope():
+            self.backbone = nn.HybridSequential()
+            for ch in (16, 32, 32):
+                self.backbone.add(
+                    nn.Conv2D(ch, 3, padding=1, use_bias=False),
+                    nn.BatchNorm(), nn.Activation("relu"),
+                    nn.MaxPool2D(2))
+            self.cls = nn.Dense(n_anchors * (N_CLASSES + 1))
+            self.loc = nn.Dense(n_anchors * 4)
+
+    def hybrid_forward(self, F, x):
+        h = self.backbone(x)
+        return (self.cls(h).reshape((0, N_CLASSES + 1, self.n_anchors)),
+                self.loc(h))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--hybridize", action="store_true")
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    feat = nd.zeros((1, 1, 4, 4))  # backbone output spatial shape
+    anchors = nd.MultiBoxPrior(feat, sizes=(0.55, 0.4, 0.3),
+                               ratios=(1.0, 1.6), clip=True)
+    A = anchors.shape[1]
+    print("anchors:", A)
+
+    net = SSDHead(A)
+    net.initialize(mx.initializer.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    for epoch in range(args.epochs):
+        x, labels = make_batch(rng, args.batch_size)
+        with autograd.record():
+            cls_pred, loc_pred = net(x)
+            loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, labels,
+                                                    cls_pred)
+            cls_loss = ce(cls_pred, cls_t).mean()
+            loc_loss = nd.smooth_l1((loc_pred - loc_t) * loc_m,
+                                    scalar=1.0).sum() / args.batch_size
+            L = cls_loss + loc_loss
+        L.backward()
+        trainer.step(args.batch_size)
+        if epoch % 10 == 0 or epoch == args.epochs - 1:
+            print("epoch %3d  cls %.4f  loc %.4f"
+                  % (epoch, float(cls_loss.asnumpy()),
+                     float(loc_loss.asnumpy())))
+
+    # decode: MultiBoxDetection applies per-class NMS
+    x, labels = make_batch(rng, 16)
+    cls_pred, loc_pred = net(x)
+    dets = nd.MultiBoxDetection(nd.softmax(cls_pred, axis=1), loc_pred,
+                                anchors, nms_threshold=0.45).asnumpy()
+    gts = labels.asnumpy()
+    ious, hits = [], 0
+    for i in range(len(dets)):
+        kept = dets[i][dets[i][:, 0] >= 0]
+        if not len(kept):
+            ious.append(0.0)
+            continue
+        best = kept[np.argmax(kept[:, 1])]
+        gt = gts[i, 0]
+        x0 = max(best[2], gt[1]); y0 = max(best[3], gt[2])
+        x1 = min(best[4], gt[3]); y1 = min(best[5], gt[4])
+        inter = max(x1 - x0, 0) * max(y1 - y0, 0)
+        union = ((best[4] - best[2]) * (best[5] - best[3])
+                 + (gt[3] - gt[1]) * (gt[4] - gt[2]) - inter)
+        ious.append(inter / union if union > 0 else 0.0)
+        hits += int(best[0] == gt[0])
+    print("eval: mean IoU %.3f  class acc %.2f"
+          % (float(np.mean(ious)), hits / len(dets)))
+    return float(np.mean(ious))
+
+
+if __name__ == "__main__":
+    main()

@@ -750,9 +750,11 @@ def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
     ``src/operator/identity_attach_KL_sparse_reg.cc``, which expects
     post-sigmoid inputs in (0, 1) and adds
     ``penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))`` to the gradient).
-    Divergence: the reference keeps ``rho_hat`` as a ``momentum``
-    moving-average aux state; this functional op uses the current batch
-    mean (momentum accepted for signature parity, unused)."""
+    ``rho_hat`` is the PER-UNIT mean over the batch axis (axis 0), as
+    in the reference.  Divergence: the reference keeps ``rho_hat`` as a
+    ``momentum`` moving-average aux state; this functional op uses the
+    current batch mean (momentum accepted for signature parity,
+    unused)."""
     jax = _jax()
     jnp = _j()
     rho = sparseness_target
@@ -765,9 +767,10 @@ def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
         return x, x
 
     def _bwd(x, g):
-        rho_hat = jnp.clip(jnp.mean(x), 1e-6, 1 - 1e-6)
+        rho_hat = jnp.clip(jnp.mean(x, axis=0, keepdims=True),
+                           1e-6, 1 - 1e-6)
         kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
-        return (g + kl_grad,)
+        return (g + jnp.broadcast_to(kl_grad, x.shape),)
 
     _f.defvjp(_fwd, _bwd)
     return _f(data)

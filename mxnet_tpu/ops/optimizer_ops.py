@@ -286,18 +286,26 @@ def multi_sgd_mom_update(data, lrs=None, wds=None, momentum=0.0,
     return tuple(outs) + tuple(moms)
 
 
-@register("mp_lamb_update_phase1")
+@register("mp_lamb_update_phase1", mutate=(2, 3))
 def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
                           beta2=0.999, epsilon=1e-6, t=1,
                           bias_correction=True, wd=0.0, rescale_grad=1.0,
                           clip_gradient=-1.0, **kw):
-    """Mixed-precision LAMB phase 1: math on the f32 master weight
-    (reference: ``optimizer_op.cc`` mp_lamb_update_phase1)."""
-    return lamb_update_phase1(weight32, grad.astype("float32"), mean, var,
-                              beta1=beta1, beta2=beta2, epsilon=epsilon,
-                              t=t, bias_correction=bias_correction, wd=wd,
-                              rescale_grad=rescale_grad,
-                              clip_gradient=clip_gradient)
+    """Mixed-precision LAMB phase 1: math on the f32 master weight;
+    mean/var moments are mutated in place like the reference's
+    FMutateInputs contract (``optimizer_op.cc`` mp_lamb_update_phase1)."""
+    jnp = _j()
+    g = grad.astype("float32") * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    out = m / (jnp.sqrt(v) + epsilon) + wd * weight32
+    return out, new_mean, new_var
 
 
 @register("mp_lamb_update_phase2", mutate=(4,))
@@ -333,14 +341,12 @@ def preloaded_multi_sgd_update(data, rescale_grad=1.0, clip_gradient=-1.0,
     """multi_sgd_update with per-layer lrs/wds passed as ARRAYS (the
     last two inputs) instead of attrs — avoids re-jitting when LARS
     recomputes rates every step (reference: preloaded_multi_sgd)."""
-    lrs, wds = data[-2], data[-1]
-    outs = []
-    for i in range(num_weights):
-        w, g = data[2 * i], data[2 * i + 1]
-        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
-                               rescale_grad=rescale_grad,
-                               clip_gradient=clip_gradient))
-    return tuple(outs)
+    # delegate: array lrs/wds index identically to attr lists, and the
+    # fused-group fast path applies unchanged
+    return multi_sgd_update(data[:-2], lrs=data[-2], wds=data[-1],
+                            rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient,
+                            num_weights=num_weights)
 
 
 @register("preloaded_multi_sgd_mom_update", variadic=True, num_outputs=-1,
@@ -349,13 +355,8 @@ def preloaded_multi_sgd_update(data, rescale_grad=1.0, clip_gradient=-1.0,
 def preloaded_multi_sgd_mom_update(data, momentum=0.0, rescale_grad=1.0,
                                    clip_gradient=-1.0, num_weights=1,
                                    **kw):
-    lrs, wds = data[-2], data[-1]
-    outs, moms = [], []
-    for i in range(num_weights):
-        w, g, m = data[3 * i], data[3 * i + 1], data[3 * i + 2]
-        nw, nm = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
-                                wd=wds[i], rescale_grad=rescale_grad,
-                                clip_gradient=clip_gradient)
-        outs.append(nw)
-        moms.append(nm)
-    return tuple(outs) + tuple(moms)
+    return multi_sgd_mom_update(data[:-2], lrs=data[-2], wds=data[-1],
+                                momentum=momentum,
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient,
+                                num_weights=num_weights)

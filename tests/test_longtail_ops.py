@@ -87,11 +87,15 @@ def test_mp_lamb_phases():
     w32 = np.random.RandomState(6).rand(5).astype("float32")
     w16 = w32.astype("float16")
     g = np.random.RandomState(7).rand(5).astype("float16")
-    mean = np.zeros(5, "float32")
-    var = np.zeros(5, "float32")
+    mean = nd.array(np.zeros(5, "float32"))
+    var = nd.array(np.zeros(5, "float32"))
     upd = nd.mp_lamb_update_phase1(
-        nd.array(w16), nd.array(g), nd.array(mean), nd.array(var),
+        nd.array(w16), nd.array(g), mean, var,
         nd.array(w32), t=1, wd=0.01)
+    # moments are mutated in place (FMutateInputs contract)
+    assert np.allclose(mean.asnumpy(), 0.1 * g.astype("float32"),
+                       rtol=1e-3)
+    assert (var.asnumpy() > 0).all()
     r1 = np.linalg.norm(w32)
     r2 = np.linalg.norm(upd.asnumpy())
     out = nd.mp_lamb_update_phase2(

@@ -120,6 +120,7 @@ def test_lm_perplexity_improves():
     ("gpt_generate.py", ["--steps", "10"]),
     ("nmt_bucketing.py", ["--batches", "12", "--batch-size", "16"]),
     ("int8_quantization.py", ["--epochs", "3", "--calib-mode", "naive"]),
+    ("ssd_detection.py", ["--epochs", "3", "--batch-size", "8"]),
 ])
 def test_example_runs(script, extra):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
