@@ -45,6 +45,12 @@ from . import model            # noqa: E402
 from . import module           # noqa: E402
 from . import module as mod    # noqa: E402
 from . import contrib          # noqa: E402
+from . import operator         # noqa: E402
+from . import name             # noqa: E402
+from . import attribute       # noqa: E402
+from .attribute import AttrScope  # noqa: E402
+from . import visualization    # noqa: E402
+from . import visualization as viz  # noqa: E402
 from . import util             # noqa: E402
 from . import numpy as np      # noqa: E402
 from . import numpy_extension as npx  # noqa: E402

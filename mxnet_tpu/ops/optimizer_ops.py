@@ -190,10 +190,12 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return new_w, new_mom
 
 
-@register("lamb_update_phase1")
+@register("lamb_update_phase1", mutate=(2, 3))
 def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """LAMB phase 1; mean/var moments are mutated in place (reference
+    FMutateInputs contract, ``optimizer_op.cc``)."""
     jnp = _j()
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
@@ -204,7 +206,8 @@ def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
     if bias_correction:
         m = m / (1 - beta1 ** t)
         v = v / (1 - beta2 ** t)
-    return m / (jnp.sqrt(v) + epsilon) + wd * weight
+    out = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return out, new_mean, new_var
 
 
 @register("lamb_update_phase2")
@@ -226,6 +229,17 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01,
 # grouped multi-tensor updates (one dispatch, many params)
 # ---------------------------------------------------------------------------
 
+def _concrete_rates(lrs, wds):
+    """True when per-tensor rates are host numbers.  Array-valued rates
+    (the preloaded_* ops — LARS recomputes them on device every step)
+    must stay on the traced per-tensor path: the fused kernel bakes
+    rates in as floats, which would force a host sync per step eagerly
+    and break under jit."""
+    import numbers
+    return all(isinstance(v, numbers.Number)
+               for seq in (lrs, wds) for v in list(seq))
+
+
 def _use_fused_group(tensors):
     # fused path computes in f32 end-to-end; restrict it to f32 groups
     # so numerics stay bit-identical with the per-tensor loop
@@ -241,7 +255,8 @@ def _use_fused_group(tensors):
 def multi_sgd_update(data, lrs=None, wds=None, rescale_grad=1.0,
                      clip_gradient=-1.0, num_weights=1, **kw):
     ws = [data[2 * i] for i in range(num_weights)]
-    if num_weights > 1 and _use_fused_group(data):
+    if num_weights > 1 and _use_fused_group(data) \
+            and _concrete_rates(lrs, wds):
         from ..kernels.fused_optimizer import fused_multi_sgd
         gs = [data[2 * i + 1] for i in range(num_weights)]
         outs, _ = fused_multi_sgd(ws, gs, lrs=lrs, wds=wds,
@@ -264,7 +279,8 @@ def multi_sgd_mom_update(data, lrs=None, wds=None, momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0,
                          num_weights=1, **kw):
     ws = [data[3 * i] for i in range(num_weights)]
-    if num_weights > 1 and _use_fused_group(data):
+    if num_weights > 1 and _use_fused_group(data) \
+            and _concrete_rates(lrs, wds):
         from ..kernels.fused_optimizer import fused_multi_sgd
         gs = [data[3 * i + 1] for i in range(num_weights)]
         ms = [data[3 * i + 2] for i in range(num_weights)]
@@ -291,21 +307,13 @@ def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
                           beta2=0.999, epsilon=1e-6, t=1,
                           bias_correction=True, wd=0.0, rescale_grad=1.0,
                           clip_gradient=-1.0, **kw):
-    """Mixed-precision LAMB phase 1: math on the f32 master weight;
-    mean/var moments are mutated in place like the reference's
-    FMutateInputs contract (``optimizer_op.cc`` mp_lamb_update_phase1)."""
-    jnp = _j()
-    g = grad.astype("float32") * rescale_grad
-    if clip_gradient is not None and clip_gradient >= 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
-    new_mean = beta1 * mean + (1 - beta1) * g
-    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
-    m, v = new_mean, new_var
-    if bias_correction:
-        m = m / (1 - beta1 ** t)
-        v = v / (1 - beta2 ** t)
-    out = m / (jnp.sqrt(v) + epsilon) + wd * weight32
-    return out, new_mean, new_var
+    """Mixed-precision LAMB phase 1: the phase-1 math on the f32 master
+    weight (reference: mp_lamb_update_phase1)."""
+    return lamb_update_phase1(weight32, grad.astype("float32"), mean, var,
+                              beta1=beta1, beta2=beta2, epsilon=epsilon,
+                              t=t, bias_correction=bias_correction, wd=wd,
+                              rescale_grad=rescale_grad,
+                              clip_gradient=clip_gradient)
 
 
 @register("mp_lamb_update_phase2", mutate=(4,))

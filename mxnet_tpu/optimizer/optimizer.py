@@ -391,17 +391,11 @@ class LAMB(Optimizer):
         t = self._index_update_count[index]
         mean, var = state
         kw = _common_kwargs(self, index)
+        # phase1 mutates mean/var in place (FMutateInputs contract)
         g = nd.lamb_update_phase1(weight, grad, mean, var, beta1=self.beta1,
                                   beta2=self.beta2, epsilon=self.epsilon,
                                   t=t, bias_correction=self.bias_correction,
                                   wd=wd, **kw)
-        # phase1's new mean/var must persist: recompute & swap
-        beta1, beta2 = self.beta1, self.beta2
-        gr = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            gr = nd.clip(gr, -self.clip_gradient, self.clip_gradient)
-        mean._set_data((beta1 * mean + (1 - beta1) * gr)._data)
-        var._set_data((beta2 * var + (1 - beta2) * (gr * gr))._data)
         r1 = nd.norm(weight)
         r2 = nd.norm(g)
         kw2 = {}

@@ -37,16 +37,13 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 # ---------------------------------------------------------------------------
 
 class _SymNameManager:
-    def __init__(self):
-        self._counter = {}
+    """Delegates to the active ``mx.name`` scope (reference:
+    ``NameManager.current``) so ``with mx.name.Prefix('p_'):`` affects
+    symbol auto-naming."""
 
     def get(self, name, hint):
-        if name:
-            return name
-        hint = hint.lower()
-        n = self._counter.get(hint, 0)
-        self._counter[hint] = n + 1
-        return "%s%d" % (hint, n)
+        from .. import name as _name
+        return _name.current().get(name, hint)
 
 
 _NM = _SymNameManager()
@@ -694,8 +691,10 @@ def eval_graph(heads: Sequence[Tuple[_Node, int]],
 
 def Variable(name, attr=None, shape=None, dtype=None, init=None,
              lr_mult=None, wd_mult=None, **kwargs):
-    """Create a variable (graph input) symbol."""
-    user = dict(attr or {})
+    """Create a variable (graph input) symbol.  Attributes from active
+    ``mx.AttrScope``s are attached (reference: ``attribute.py``)."""
+    from .. import attribute as _attribute
+    user = _attribute.current().get(attr)
     if shape is not None:
         user["__shape__"] = json.dumps(list(shape))
     if dtype is not None:
@@ -732,9 +731,12 @@ def _impl_slot_names(op) -> List[str]:
 
 def _apply_op(op_name: str, sym_inputs: List[Symbol],
               attrs: Dict[str, Any], pos_attrs: Tuple = (),
-              name: Optional[str] = None) -> Symbol:
+              name: Optional[str] = None,
+              user_attr: Optional[Dict[str, str]] = None) -> Symbol:
+    from .. import attribute as _attribute
     op = _registry.get_op(op_name)
     node_name = _NM.get(name, op.name)
+    user_attrs = _attribute.current().get(user_attr)
 
     inputs = [s._outputs[0] for s in sym_inputs]
 
@@ -751,7 +753,8 @@ def _apply_op(op_name: str, sym_inputs: List[Symbol],
                 vname = "%s_%s" % (node_name, slot)
             inputs.append(Variable(vname)._outputs[0])
 
-    node = _Node(op, node_name, inputs, pos_attrs, attrs)
+    node = _Node(op, node_name, inputs, pos_attrs, attrs,
+                 user_attrs=user_attrs)
     return Symbol([(node, i) for i in range(node.num_outputs)]
                   if node.num_outputs > 1 else [(node, 0)])
 
@@ -759,7 +762,7 @@ def _apply_op(op_name: str, sym_inputs: List[Symbol],
 def _make_sym_stub(op):
     def stub(*args, **kwargs):
         name = kwargs.pop("name", None)
-        kwargs.pop("attr", None)
+        user_attr = kwargs.pop("attr", None)
         sym_inputs: List[Symbol] = []
         pos_attrs: List[Any] = []
         flat = []
@@ -796,7 +799,8 @@ def _make_sym_stub(op):
                     % (op.name, idxs))
             sym_inputs = [slotted[i] for i in idxs]
         return _apply_op(op.name, sym_inputs, kwargs,
-                         pos_attrs=tuple(pos_attrs), name=name)
+                         pos_attrs=tuple(pos_attrs), name=name,
+                         user_attr=user_attr)
 
     stub.__name__ = op.name
     stub.__doc__ = op.doc

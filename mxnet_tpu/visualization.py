@@ -1,0 +1,139 @@
+"""Network visualization (reference: ``python/mxnet/visualization.py``).
+
+``print_summary`` renders the layer table with parameter counts;
+``plot_network`` emits a graphviz digraph when the optional ``graphviz``
+package is installed (gated import — not baked into this image)."""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_shape_map(symbol, shape=None):
+    """Output shape per node name via infer_shape (best effort)."""
+    if not shape:
+        return {}
+    try:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        return dict(zip(internals.list_outputs(), out_shapes))
+    except Exception:
+        return {}
+
+
+def print_summary(symbol, shape: Optional[Dict[str, Tuple]] = None,
+                  line_length: int = 120, positions=(.44, .64, .74, 1.)):
+    """Print a Keras-style layer summary table (reference:
+    ``visualization.print_summary``): layer name/type, output shape,
+    parameter count, previous layers; totals at the bottom."""
+    shape_map = _node_shape_map(symbol, shape)
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(cols):
+        line = ""
+        for i, c in enumerate(cols):
+            line += str(c)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+
+    # parameter sizes: arg shapes from infer_shape, minus the data args
+    # the caller provided in ``shape``
+    arg_sizes = {}
+    if shape:
+        try:
+            arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+            names = symbol.list_arguments()
+            sizes = {n: s for n, s in zip(names, arg_shapes)}
+            sizes.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+            for n, s in sizes.items():
+                # data args come from the caller; auto-created label
+                # variables are inputs, not parameters
+                if n not in shape and s and not n.endswith("_label"):
+                    p = 1
+                    for d in s:
+                        p *= int(d)
+                    arg_sizes[n] = p
+        except Exception:
+            pass
+
+    total = 0
+    for node in symbol._nodes():
+        if node.is_var:
+            continue
+        n_params = 0
+        prevs = []
+        for inp, _ in node.inputs:
+            if inp.is_var:
+                n_params += arg_sizes.get(inp.name, 0)
+            else:
+                prevs.append(inp.name)
+        total += n_params
+        out_shape = shape_map.get(node.name + "_output", "")
+        print_row(["%s (%s)" % (node.name, node.op.name),
+                   str(out_shape), n_params, ",".join(prevs)])
+        print("_" * line_length)
+    print("Total params: %d" % total)
+    print("_" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Build a ``graphviz.Digraph`` of the symbol graph (reference:
+    ``visualization.plot_network``).  Requires the optional ``graphviz``
+    package; raises a clear error when it is unavailable."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the optional 'graphviz' package, "
+            "which is not installed in this environment; use "
+            "print_summary for a text rendering") from e
+
+    node_attrs = dict(node_attrs or {})
+    node_attr = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+
+    fill = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+            "BatchNorm": "#bebada", "Activation": "#ffffb3",
+            "Pooling": "#80b1d3", "Concat": "#fdb462",
+            "Softmax": "#fccde5", "SoftmaxOutput": "#fccde5"}
+
+    def is_weight(n):
+        return n.is_var and (n.name.endswith("_weight")
+                             or n.name.endswith("_bias")
+                             or n.name.endswith("_gamma")
+                             or n.name.endswith("_beta")
+                             or n.name.endswith("_moving_mean")
+                             or n.name.endswith("_moving_var"))
+
+    nodes = symbol._nodes()
+    for n in nodes:
+        if hide_weights and is_weight(n):
+            continue
+        if n.is_var:
+            dot.node(n.name, n.name, **dict(node_attr,
+                                            fillcolor="#8dd3c7"))
+        else:
+            color = fill.get(n.op.name, "#b3de69")
+            dot.node(n.name, "%s\n%s" % (n.name, n.op.name),
+                     **dict(node_attr, fillcolor=color))
+    for n in nodes:
+        if n.is_var:
+            continue
+        for inp, _ in n.inputs:
+            if hide_weights and is_weight(inp):
+                continue
+            dot.edge(inp.name, n.name)
+    return dot

@@ -1,0 +1,83 @@
+"""mx.name / mx.attribute / mx.visualization tests (reference model:
+``tests/python/unittest/test_symbol.py`` and ``test_viz.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("enc_"):
+        a = sym.Variable("data")
+        b = sym.FullyConnected(a, num_hidden=4)
+    assert b.list_outputs()[0].startswith("enc_fullyconnected")
+    # nested prefixes compose left-to-right innermost wins on prepend
+    with mx.name.Prefix("outer_"):
+        c = sym.relu(sym.Variable("x"))
+    assert c.list_outputs()[0].startswith("outer_relu")
+
+
+def test_name_manager_counters_isolated():
+    with mx.name.NameManager():
+        s1 = sym.relu(sym.Variable("x"))
+        s2 = sym.relu(sym.Variable("y"))
+    n1, n2 = s1.list_outputs()[0], s2.list_outputs()[0]
+    assert n1 != n2
+    assert n1.startswith("relu") and n2.startswith("relu")
+
+
+def test_attr_scope_attaches_and_nests():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        with mx.AttrScope(ctx_group="dev2", stage="p1"):
+            b = sym.FullyConnected(a, num_hidden=2, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev2"
+    assert b.attr("stage") == "p1"
+    # explicit attr= overrides scope
+    with mx.AttrScope(tag="scope"):
+        c = sym.Variable("c", attr={"tag": "explicit"})
+    assert c.attr("tag") == "explicit"
+    # outside scopes nothing is attached
+    d = sym.Variable("d")
+    assert d.attr("ctx_group") is None
+
+
+def test_attr_scope_survives_json_roundtrip(tmp_path):
+    with mx.AttrScope(ctx_group="dev3"):
+        s = sym.relu(sym.Variable("x"), name="act")
+    path = str(tmp_path / "g.json")
+    s.save(path)
+    loaded = sym.load(path)
+    assert loaded.attr("ctx_group") == "dev3"
+
+
+def test_print_summary_counts_params(capsys):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    out = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=2,
+                                               name="fc2"), name="sm")
+    total = mx.viz.print_summary(out, shape={"data": (1, 4)})
+    text = capsys.readouterr().out
+    # fc1: 4*8+8 = 40; fc2: 8*2+2 = 18
+    assert total == 58
+    assert "fc1" in text and "fc2" in text and "Total params: 58" in text
+
+
+def test_plot_network_gated():
+    s = sym.relu(sym.Variable("x"))
+    try:
+        import graphviz  # noqa: F401
+        has = True
+    except ImportError:
+        has = False
+    if has:
+        dot = mx.viz.plot_network(s)
+        assert "relu" in dot.source
+    else:
+        try:
+            mx.viz.plot_network(s)
+            raise SystemExit("should raise without graphviz")
+        except mx.base.MXNetError as e:
+            assert "graphviz" in str(e)
