@@ -21,7 +21,7 @@ __all__ = ["Executor"]
 class Executor:
     def __init__(self, symbol: Symbol, ctx, args, args_grad=None,
                  grad_req: Union[str, Dict[str, str]] = "write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         from .. import ndarray as nd
 
         self._sym = symbol
@@ -60,6 +60,7 @@ class Executor:
             if self.grad_req[n] != "null" and n not in self.grad_dict:
                 self.grad_dict[n] = nd.zeros_like(self.arg_dict[n])
 
+        self._group2ctx = dict(group2ctx or {})
         self._diff_names = [n for n in self.arg_names
                             if self.grad_req[n] != "null"]
         self._outputs: Optional[List[NDArray]] = None
@@ -91,8 +92,11 @@ class Executor:
         diff_names = tuple(self._diff_names)
         nodiff_names = tuple(n for n in arg_names if n not in diff_names)
 
+        group2ctx = self._group2ctx
+
         def run(var_values, is_train, key):
-            outs, auxu = eval_graph(heads, var_values, is_train, key)
+            outs, auxu = eval_graph(heads, var_values, is_train, key,
+                                    group2ctx=group2ctx)
             aux_new = [auxu.get(n, var_values[n]) for n in aux_names]
             return outs, aux_new
 
@@ -119,9 +123,18 @@ class Executor:
             grads, = vjp((list(out_grads), cot_aux))
             return outs, aux_new, grads
 
-        self._jit_fwd_infer = jax.jit(fwd_infer)
-        self._jit_fwd_train = jax.jit(fwd_train)
-        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        if group2ctx:
+            # per-node device placement with cross-device copies cannot
+            # live inside one single-device jit program — run the graph
+            # walk eagerly, like the reference's GraphExecutor executes
+            # placed nodes op-by-op
+            self._jit_fwd_infer = fwd_infer
+            self._jit_fwd_train = fwd_train
+            self._jit_fwd_bwd = fwd_bwd
+        else:
+            self._jit_fwd_infer = jax.jit(fwd_infer)
+            self._jit_fwd_train = jax.jit(fwd_train)
+            self._jit_fwd_bwd = jax.jit(fwd_bwd)
 
     # ------------------------------------------------------------------
 
@@ -178,7 +191,8 @@ class Executor:
             # cotangent; ones is the identity seed for true losses
             import jax
             out_structs = jax.eval_shape(
-                lambda a, x, k: self._jit_fwd_train.__wrapped__(a, x, k)[0],
+                lambda a, x, k: getattr(self._jit_fwd_train, '__wrapped__',
+                          self._jit_fwd_train)(a, x, k)[0],
                 arg_vals, aux_vals, key)
             og = [jnp.ones(s.shape, s.dtype) for s in out_structs]
         else:

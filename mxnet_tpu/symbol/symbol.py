@@ -559,18 +559,19 @@ class Symbol:
     # -- binding ----------------------------------------------------------
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, **kwargs):
+             aux_states=None, group2ctx=None, **kwargs):
         return self._bind(ctx, args, args_grad=args_grad, grad_req=grad_req,
-                          aux_states=aux_states)
+                          aux_states=aux_states, group2ctx=group2ctx)
 
     def _bind(self, ctx, args, args_grad=None, grad_req="write",
-              aux_states=None):
+              aux_states=None, group2ctx=None):
         from .executor import Executor
         return Executor(self, ctx, args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    **shapes):
+                    group2ctx=None, **shapes):
         """Infer all shapes from the given input shapes and allocate
         argument/gradient/aux arrays (zeros — initialization is the
         caller's job, as in the reference)."""
@@ -592,7 +593,7 @@ class Symbol:
             grads = {n: nd.zeros(s, ctx=ctx)
                      for n, s in zip(arg_names, arg_shapes)}
         return Executor(self, ctx, args, args_grad=grads, grad_req=grad_req,
-                        aux_states=aux)
+                        aux_states=aux, group2ctx=group2ctx)
 
     # -- eval (imperative convenience) ------------------------------------
 
@@ -639,11 +640,17 @@ def _eval_node_abstract(node: _Node, in_structs):
 
 def eval_graph(heads: Sequence[Tuple[_Node, int]],
                var_values: Dict[str, Any], is_train: bool,
-               rng_key=None):
+               rng_key=None, group2ctx=None):
     """Evaluate the graph with concrete (or tracer) jax arrays.
 
     Returns (outputs, aux_updates) where aux_updates maps mutated variable
-    names to their new values (BatchNorm running stats etc.)."""
+    names to their new values (BatchNorm running stats etc.).
+
+    ``group2ctx`` maps ``ctx_group`` attribute values (attached via
+    ``mx.AttrScope``) to Contexts: each op node whose group is mapped
+    runs on that device, with inputs transferred as needed — the
+    reference's ``place_device`` pass + cross-device copy insertion
+    (SURVEY.md §2.4 "Model parallel (manual)")."""
     import jax
 
     order = _topo_order(heads)
@@ -664,11 +671,22 @@ def eval_graph(heads: Sequence[Tuple[_Node, int]],
             if inp.is_var and inp.name in aux_updates:
                 v = aux_updates[inp.name]
             arrays.append(v)
+        dev = None
+        if group2ctx:
+            grp = n.user_attrs.get("ctx_group")
+            if grp is not None and grp in group2ctx:
+                dev = group2ctx[grp].jax_device
+                arrays = [jax.device_put(a, dev) for a in arrays]
         key = None
         if n.op.needs_rng and rng_key is not None:
             key = jax.random.fold_in(rng_key, counter)
         counter += 1
-        res = _call_impl(n, arrays, rng_key=key, is_train=is_train)
+        if dev is not None:
+            with jax.default_device(dev):
+                res = _call_impl(n, arrays, rng_key=key,
+                                 is_train=is_train)
+        else:
+            res = _call_impl(n, arrays, rng_key=key, is_train=is_train)
         multi = isinstance(res, (tuple, list))
         rlist = list(res) if multi else [res]
         mut = n.mutate_indices()

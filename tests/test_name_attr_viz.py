@@ -81,3 +81,68 @@ def test_plot_network_gated():
             raise SystemExit("should raise without graphviz")
         except mx.base.MXNetError as e:
             assert "graphviz" in str(e)
+
+
+def test_group2ctx_model_parallel():
+    """group2ctx places tagged subgraphs on their devices with automatic
+    cross-device transfers (reference: place_device pass)."""
+    import jax
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs >= 2 devices")
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        h = sym.FullyConnected(a, num_hidden=4, no_bias=True, name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = sym.FullyConnected(sym.relu(h), num_hidden=2, no_bias=True,
+                                 name="fc2")
+
+    np.random.seed(0)
+    A = np.random.randn(3, 5).astype("float32")
+    W1 = np.random.randn(4, 5).astype("float32")
+    W2 = np.random.randn(2, 4).astype("float32")
+    exe = out.bind(mx.cpu(0),
+                   {"a": nd.array(A), "fc1_weight": nd.array(W1),
+                    "fc2_weight": nd.array(W2)},
+                   group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    got = exe.forward()[0]
+    ref = np.maximum(A @ W1.T, 0) @ W2.T
+    assert np.allclose(got.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    # the output buffer lives on dev2's device
+    dev = list(got._data.devices())[0]
+    assert dev == jax.devices()[1]
+    # training path works too (eager vjp across devices)
+    g = nd.zeros((3, 2))
+    exe2 = out.bind(mx.cpu(0),
+                    {"a": nd.array(A), "fc1_weight": nd.array(W1),
+                     "fc2_weight": nd.array(W2)},
+                    args_grad={"fc1_weight": nd.zeros_like(nd.array(W1)),
+                               "fc2_weight": nd.zeros_like(nd.array(W2))},
+                    grad_req={"fc1_weight": "write",
+                              "fc2_weight": "write", "a": "null"},
+                    group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    outs = exe2.forward(is_train=True)
+    exe2.backward([nd.ones((3, 2))])
+    gw2 = exe2.grad_dict["fc2_weight"].asnumpy()
+    ref_gw2 = np.ones((3, 2)).T @ np.maximum(A @ W1.T, 0)
+    assert np.allclose(gw2, ref_gw2, rtol=1e-4, atol=1e-5)
+
+
+def test_group2ctx_default_out_grads_and_simple_bind():
+    """Regression: backward() with default out_grads under group2ctx;
+    simple_bind honors group2ctx."""
+    import jax
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs >= 2 devices")
+    with mx.AttrScope(ctx_group="g1"):
+        x = sym.Variable("x")
+        out = sym.sum(sym.square(x))
+    g2c = {"g1": mx.cpu(1)}
+    exe = out.simple_bind(mx.cpu(0), x=(3,), group2ctx=g2c)
+    assert exe._group2ctx == g2c
+    exe.arg_dict["x"]._set_data(nd.array(
+        np.array([1.0, 2.0, 3.0], "float32"))._data)
+    exe.forward(is_train=True)
+    exe.backward()  # default out_grads path
+    assert np.allclose(exe.grad_dict["x"].asnumpy(), [2, 4, 6])
