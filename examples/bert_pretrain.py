@@ -72,16 +72,31 @@ def main():
              "mask": jnp.ones((B, L), bool)}
 
     # two warmups: the first compiles; the second absorbs the recompile
-    # for the GSPMD-refined state shardings the first step emits
-    # (keys 0/1 — the timed loop uses 2+i, so no key repeats)
+    # for the GSPMD-refined state shardings the first step emits (the
+    # scanned loop below folds its own per-step keys from keys 2/3)
     for w in range(2):
         state, loss = step(state, batch, jax.random.PRNGKey(w))
         float(loss)
+
+    # timed: device-side loop (one lax.scan dispatch for all steps) with
+    # a hard sync on the STATE (the loss buffer alone can materialize
+    # before the donated-state pipeline drains) — docs/perf.md
+    # "Methodology"
+    def hard_sync(state):
+        jax.device_get(jax.tree_util.tree_leaves(state)[0].ravel()[:1])
+
+    _, multi = T.make_train_step(cfg, mesh=mesh, learning_rate=1e-4,
+                                 scan_steps=args.steps)
+    # two warm calls again: compile, then absorb any sharding-refinement
+    # recompile of the scanned program
+    for w in (2, 3):
+        state, losses = multi(state, batch, jax.random.PRNGKey(w))
+        hard_sync(state)
     t0 = time.time()
-    for i in range(args.steps):
-        state, loss = step(state, batch, jax.random.PRNGKey(2 + i))
-    jax.block_until_ready(state)
+    state, losses = multi(state, batch, jax.random.PRNGKey(4))
+    hard_sync(state)
     dt = time.time() - t0
+    loss = jax.device_get(losses[-1])
     toks = B * L * args.steps / dt
     print("loss %.4f  |  %.0f tokens/sec" % (float(loss), toks))
 

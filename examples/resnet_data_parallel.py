@@ -51,15 +51,16 @@ def main():
         "float32"), ctx=ctx)
     label = nd.array(rng.randint(0, 1000, (args.batch_size,)), ctx=ctx)
 
-    loss = trainer.step(data, label)   # compile
+    # device-side loop: all iters in ONE jitted lax.scan dispatch, with
+    # trainer.sync() performing a hard sync (docs/perf.md "Methodology")
+    losses = trainer.run_steps(data, label, steps=args.iters)  # compile
     trainer.sync()
     t0 = time.time()
-    for _ in range(args.iters):
-        loss = trainer.step(data, label)
+    losses = trainer.run_steps(data, label, steps=args.iters)
     trainer.sync()
     dt = time.time() - t0
     print("loss %.4f  |  %.1f images/sec"
-          % (float(loss.asnumpy()),
+          % (float(losses[-1].asnumpy()),
              args.batch_size * args.iters / dt))
     trainer.sync_back()   # write trained params into the Gluon block
 
