@@ -15,7 +15,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
-           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+           "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -274,3 +274,33 @@ class CosineEmbeddingLoss(Loss):
                        F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference:
+    ``gluon/loss.py`` SDMLLoss): batch-wise smoothed-CE over pairwise
+    l2 distances between two batches of paired embeddings, treating
+    off-diagonal pairs as negatives."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1., batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def _compute_distances(self, F, x1, x2):
+        # (N, 1, D) - (1, N, D) -> (N, N) squared l2
+        d = F.expand_dims(x1, 1) - F.expand_dims(x2, 0)
+        return F.sum(F.square(d), axis=2)
+
+    def hybrid_forward(self, F, x1, x2):
+        import numpy as _np
+        n = x1.shape[0]
+        dist = self._compute_distances(F, x1, x2)
+        log_probs = F.log_softmax(-dist, axis=1)
+        # smoothed labels: 1-a on the diagonal, a/(n-1) elsewhere
+        gold = _np.eye(n, dtype="float32")
+        labels = (gold * (1 - self.smoothing_parameter)
+                  + (1 - gold) * self.smoothing_parameter / max(n - 1, 1))
+        from .. import ndarray as nd
+        return self.kl_loss(log_probs, nd.array(labels))

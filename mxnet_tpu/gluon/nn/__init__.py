@@ -2,3 +2,6 @@
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *
+
+# the reference re-exports the Block family through gluon.nn as well
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: E402,F401

@@ -14,7 +14,8 @@ from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
            "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
-           "Lambda", "HybridLambda"]
+           "Lambda", "HybridLambda", "Identity", "Concatenate",
+           "HybridConcatenate"]
 
 
 class Sequential(Block):
@@ -361,3 +362,34 @@ class HybridLambda(HybridBlock):
 
 
 from .activations import Activation  # noqa: E402  (circular-safe)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference: ``nn.Identity``) — useful as a
+    placeholder branch in composed architectures."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input and concat outputs along ``axis``
+    (reference: ``nn.HybridConcurrent``/``HybridConcatenate``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def hybrid_forward(self, F, x):
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Concatenate(HybridConcatenate):
+    """Imperative alias of :class:`HybridConcatenate` (reference keeps
+    both names)."""

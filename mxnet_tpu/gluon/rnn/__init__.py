@@ -1,5 +1,6 @@
 """Gluon RNN API (reference: ``python/mxnet/gluon/rnn/``)."""
 from .rnn_layer import RNN, LSTM, GRU
-from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ZoneoutCell,
-                       ResidualCell, BidirectionalCell)
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
+                       ZoneoutCell, ResidualCell, BidirectionalCell,
+                       ModifierCell, VariationalDropoutCell)

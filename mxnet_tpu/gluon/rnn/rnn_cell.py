@@ -11,9 +11,10 @@ from ...base import MXNetError
 from ..block import HybridBlock
 from ... import ndarray as nd
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell", "ModifierCell",
+           "VariationalDropoutCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -417,3 +418,51 @@ class BidirectionalCell(RecurrentCell):
                    for lo, ro in zip(l_out, r_out)]
         out = nd.stack(*outputs, axis=axis)
         return out, l_states + r_states
+
+
+# The reference distinguishes HybridRecurrentCell (hybridizable) from
+# RecurrentCell; here every cell traces through the shared registry, so
+# the hybrid base is the same class under the reference's name.
+HybridRecurrentCell = RecurrentCell
+
+# Public name for the modifier-cell base (reference: ``ModifierCell``).
+ModifierCell = _ModifierCell
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Modifier applying *variational* dropout: one mask per sequence,
+    reused at every step, on inputs/states/outputs (reference:
+    ``gluon/rnn/rnn_cell.py`` VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._mask_cache = {}
+
+    def reset(self):
+        super().reset()
+        self._mask_cache = {}
+
+    def _mask(self, kind, x, rate):
+        key = (kind, tuple(x.shape))
+        if key not in self._mask_cache:
+            keep = 1.0 - rate
+            self._mask_cache[key] = nd.random_uniform(
+                shape=x.shape, ctx=x.context) < keep
+        return self._mask_cache[key].astype(x.dtype) / (1.0 - rate)
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        train = autograd.is_training()
+        if train and self.drop_inputs:
+            inputs = inputs * self._mask("i", inputs, self.drop_inputs)
+        if train and self.drop_states and states:
+            states = [s * self._mask(("s", i), s, self.drop_states)
+                      for i, s in enumerate(states)]
+        output, states = self.base_cell(inputs, states)
+        if train and self.drop_outputs:
+            output = output * self._mask("o", output, self.drop_outputs)
+        return output, states

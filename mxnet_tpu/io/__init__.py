@@ -1,4 +1,4 @@
 """IO API (reference: ``python/mxnet/io/``)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, ImageRecordIter, MXDataIter, register_iter,
-                 list_iters)
+                 PrefetchingIter, ImageRecordIter, MXDataIter, CSVIter,
+                 LibSVMIter, register_iter, list_iters)

@@ -20,7 +20,8 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter",
+           "CSVIter", "LibSVMIter"]
 
 _ITER_REG = Registry("data_iter")
 
@@ -601,3 +602,129 @@ def MXDataIter(name, **kwargs):
 
 def list_iters():
     return _ITER_REG.list()
+
+
+class CSVIter(DataIter):
+    """Iterate rows of CSV files (reference: ``src/io/iter_csv.cc``).
+
+    ``data_csv``/``label_csv`` name CSV files; ``data_shape`` is the
+    per-row shape.  Rows are read eagerly into host memory and served
+    batch-by-batch with ``round_batch`` padding semantics."""
+
+    def __init__(self, data_csv=None, data_shape=None, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype="float32", **kwargs):
+        import numpy as np
+        from .. import ndarray as nd
+        data = np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((len(data),) + tuple(label_shape),
+                             dtype=dtype)
+        # round_batch=True: wrap the final short batch with leading
+        # samples and report pad (the reference BatchLoader contract,
+        # same as ImageRecordIter above); False: drop the short batch
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad"
+                                  if round_batch else "discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """Iterate LibSVM-format sparse records (reference:
+    ``src/io/iter_libsvm.cc``): ``label idx:val idx:val ...`` per line.
+    Batches are served as CSR NDArrays (dense fallback available via
+    ``.tostype('default')``)."""
+
+    @staticmethod
+    def _parse(path, ncol):
+        import numpy as np
+        labels, rows = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = {}
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    row[int(i)] = float(v)
+                rows.append(row)
+        dense = np.zeros((len(rows), ncol), dtype="float32")
+        for r, row in enumerate(rows):
+            for c, v in row.items():
+                dense[r, c] = v
+        return dense, np.asarray(labels, dtype="float32")
+
+    def __init__(self, data_libsvm=None, data_shape=None,
+                 label_libsvm=None, label_shape=None, batch_size=1,
+                 round_batch=True, **kwargs):
+        ncol = int(data_shape[0])
+        self._dense, lead_labels = self._parse(data_libsvm, ncol)
+        if label_libsvm is not None:
+            # separate label file: its sparse rows ARE the labels
+            lcol = int(label_shape[0]) if label_shape else 1
+            self._labels, _ = self._parse(label_libsvm, lcol)
+        else:
+            self._labels = lead_labels.reshape(-1, 1)
+        self._bs = batch_size
+        self._round = round_batch
+        self._pos = 0
+        super().__init__(batch_size)
+        self._provide_data = [DataDesc("data", (batch_size, ncol))]
+        self._provide_label = [DataDesc(
+            "softmax_label", (batch_size,) + tuple(self._labels.shape[1:]))]
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self):
+        import numpy as np
+        from .. import ndarray as nd
+        from ..ndarray import sparse as sp
+        if self._pos >= len(self._dense):
+            raise StopIteration
+        end = self._pos + self._bs
+        d = self._dense[self._pos:end]
+        l = self._labels[self._pos:end]
+        pad = 0
+        if len(d) < self._bs:
+            if not self._round:
+                # round_batch=False: drop the final short batch
+                raise StopIteration
+            pad = self._bs - len(d)
+            d = np.concatenate([d, self._dense[:pad]])
+            l = np.concatenate([l, self._labels[:pad]])
+        self._pos = end
+        data = sp.csr_matrix(d)
+        return DataBatch(data=[data], label=[nd.array(l)], pad=pad)
+
+
+_ITER_REG.register("CSVIter")(CSVIter)
+_ITER_REG.register("LibSVMIter")(LibSVMIter)

@@ -465,3 +465,20 @@ def create(metric, *args, **kwargs):
             composite.add(create(child, *args, **kwargs))
         return composite
     return _REG.create(metric, *args, **kwargs)
+
+
+@register("torch")
+class Torch(Loss):
+    """Legacy alias of :class:`Loss` kept for reference parity
+    (``metric.Torch`` — mean of raw outputs)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+@register("caffe")
+class Caffe(Torch):
+    """Legacy alias of :class:`Loss` (``metric.Caffe``)."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name=name, **kwargs)

@@ -1,0 +1,116 @@
+"""API long-tail tests: CSVIter/LibSVMIter, SDMLLoss, modifier RNN
+cells, Identity/Concatenate layers, metric aliases."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+import mxnet_tpu.io as mio
+from mxnet_tpu.gluon import loss as gloss, nn, rnn
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as d:
+        dpath = os.path.join(d, "x.csv")
+        lpath = os.path.join(d, "y.csv")
+        X = np.arange(12).reshape(6, 2)
+        np.savetxt(dpath, X, delimiter=",")
+        np.savetxt(lpath, np.arange(6), delimiter=",")
+        it = mio.CSVIter(data_csv=dpath, data_shape=(2,),
+                         label_csv=lpath, batch_size=3)
+        b = it.next()
+        assert b.data[0].shape == (3, 2)
+        assert np.allclose(b.data[0].asnumpy(), X[:3])
+        it.reset()
+        assert np.allclose(it.next().data[0].asnumpy(), X[:3])
+
+
+def test_libsvm_iter():
+    with tempfile.TemporaryDirectory() as d:
+        sv = os.path.join(d, "t.svm")
+        with open(sv, "w") as f:
+            f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5\n")
+        it = mio.LibSVMIter(data_libsvm=sv, data_shape=(4,),
+                            batch_size=2)
+        b = it.next()
+        assert b.data[0].stype == "csr"
+        dense = b.data[0].tostype("default").asnumpy()
+        assert dense[0, 0] == 1.5 and dense[0, 3] == 2.0
+        assert dense[1, 1] == 1.0
+        assert np.allclose(b.label[0].asnumpy().ravel(), [1, 0])
+        b2 = it.next()           # padded final batch
+        assert b2.pad == 1
+
+
+def test_sdml_loss_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    N, D = 4, 5
+    a = rng.randn(N, D).astype("float32")
+    b = rng.randn(N, D).astype("float32")
+    sp = 0.3
+    sd = gloss.SDMLLoss(smoothing_parameter=sp)
+    got = sd(nd.array(a), nd.array(b)).asnumpy()
+
+    # reference formula: KL(smoothed eye || log_softmax(-pairwise_l2^2))
+    dist = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    z = -dist
+    logp = z - z.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    gold = np.eye(N, dtype="float32")
+    labels = gold * (1 - sp) + (1 - gold) * sp / (N - 1)
+    kl = labels * (np.log(labels + 1e-12) - logp)
+    expect = kl.mean(axis=1)
+    assert np.allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+    # differentiable
+    x1 = nd.array(a)
+    x1.attach_grad()
+    with autograd.record():
+        L = sd(x1, nd.array(b)).mean()
+    L.backward()
+    assert np.isfinite(x1.grad.asnumpy()).all()
+    # training signal: a gradient step on x1 toward b's pairing lowers
+    # the loss
+    x1b = nd.array(a - 0.05 * x1.grad.asnumpy())
+    assert float(sd(x1b, nd.array(b)).mean().asnumpy()) <         float(L.asnumpy())
+
+
+def test_variational_dropout_cell_mask_is_fixed_per_sequence():
+    cell = rnn.VariationalDropoutCell(rnn.RNNCell(8), drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((4, 6), "float32"))
+    st = cell.begin_state(batch_size=4)
+    with autograd.record():
+        o1, st = cell(x, st)
+        o2, st = cell(x, st)
+    z1 = o1.asnumpy() == 0
+    z2 = o2.asnumpy() == 0
+    assert z1.any()              # dropout active in train mode
+    assert (z1 == z2).all()      # same mask at every step
+    cell.reset()
+    # eval mode: no dropout
+    o3, _ = cell(x, cell.begin_state(batch_size=4))
+    assert not (o3.asnumpy() == 0).all()
+
+
+def test_identity_concatenate_layers():
+    net = nn.HybridConcatenate(axis=1)
+    net.add(nn.Dense(3), nn.Identity(), nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.ones((2, 4), "float32"))
+    ref = net(x)
+    assert ref.shape == (2, 9)
+    net.hybridize()
+    assert np.allclose(net(x).asnumpy(), ref.asnumpy(), rtol=1e-6)
+    assert isinstance(nn.Block, type) and isinstance(nn.SymbolBlock, type)
+
+
+def test_metric_legacy_aliases():
+    m = mx.metric.create("torch")
+    m.update([nd.array([0.0])], [nd.array([2.0, 4.0])])
+    name, val = m.get()
+    assert name == "torch" and np.isclose(val, 3.0)
+    m2 = mx.metric.create("caffe")
+    assert m2.get()[0] == "caffe"
