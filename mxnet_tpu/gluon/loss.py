@@ -294,13 +294,14 @@ class SDMLLoss(Loss):
         return F.sum(F.square(d), axis=2)
 
     def hybrid_forward(self, F, x1, x2):
-        import numpy as _np
+        # like the reference, this loss is batch-shape-dependent
+        # (x1.shape[0]) and therefore not hybridizable
         n = x1.shape[0]
         dist = self._compute_distances(F, x1, x2)
         log_probs = F.log_softmax(-dist, axis=1)
-        # smoothed labels: 1-a on the diagonal, a/(n-1) elsewhere
-        gold = _np.eye(n, dtype="float32")
-        labels = (gold * (1 - self.smoothing_parameter)
-                  + (1 - gold) * self.smoothing_parameter / max(n - 1, 1))
-        from .. import ndarray as nd
-        return self.kl_loss(log_probs, nd.array(labels))
+        # smoothed labels built in-graph (no per-step host transfer;
+        # context follows the computation): 1-a diagonal, a/(n-1) off
+        gold = F._eye(N=n, M=n)
+        a = self.smoothing_parameter
+        labels = gold * (1 - a) + (1 - gold) * a / max(n - 1, 1)
+        return self.kl_loss(log_probs, labels)

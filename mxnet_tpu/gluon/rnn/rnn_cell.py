@@ -460,8 +460,11 @@ class VariationalDropoutCell(_ModifierCell):
         if train and self.drop_inputs:
             inputs = inputs * self._mask("i", inputs, self.drop_inputs)
         if train and self.drop_states and states:
-            states = [s * self._mask(("s", i), s, self.drop_states)
-                      for i, s in enumerate(states)]
+            # the reference masks only states[0] (the hidden state h) —
+            # an LSTM memory cell c passes through unmasked
+            states = ([states[0] * self._mask("s", states[0],
+                                              self.drop_states)]
+                      + list(states[1:]))
         output, states = self.base_cell(inputs, states)
         if train and self.drop_outputs:
             output = output * self._mask("o", output, self.drop_outputs)

@@ -680,9 +680,17 @@ class LibSVMIter(DataIter):
         ncol = int(data_shape[0])
         self._dense, lead_labels = self._parse(data_libsvm, ncol)
         if label_libsvm is not None:
-            # separate label file: its sparse rows ARE the labels
+            # separate label file: dense value(s) per line (the common
+            # scalar-per-line case, or label_shape values per line)
+            import numpy as np
             lcol = int(label_shape[0]) if label_shape else 1
-            self._labels, _ = self._parse(label_libsvm, lcol)
+            vals = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.strip():
+                        vals.append([float(t) for t in line.split()])
+            self._labels = np.asarray(vals, dtype="float32") \
+                .reshape(-1, lcol)
         else:
             self._labels = lead_labels.reshape(-1, 1)
         self._bs = batch_size

@@ -1,13 +1,16 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels (forward AND backward).
 
 No reference counterpart (MXNet 1.x predates flash attention; SURVEY.md
 §5.7 marks sequence-scale attention as a TPU-build extension).  Design per
 /opt/skills/guides/pallas_guide.md: grid over (batch·heads, q-blocks),
 online-softmax accumulation over k-blocks held in VMEM, fp32 accumulators,
-MXU matmuls via ``jnp.dot`` with ``preferred_element_type``.
+MXU matmuls with ``preferred_element_type``.
 
-Backward: ``jax.custom_vjp`` with a jnp reference backward (recompute) —
-correct gradients today; a fused backward kernel is a later optimization.
+Backward is the FlashAttention-2 recipe as two Pallas kernels — the
+forward saves per-row logsumexp; ``delta = rowsum(dO·O)`` is a cheap jnp
+reduction; a dq kernel (grid over q blocks, scanning kv) and a dk/dv
+kernel (grid over kv blocks, scanning q) recompute probabilities
+blockwise so nothing quadratic is ever materialized.
 """
 from __future__ import annotations
 
@@ -18,9 +21,25 @@ from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention"]
 
+# test hook: run the Pallas kernels in interpreter mode (exact f32 math,
+# works on CPU) so kernel correctness is checkable against the jnp
+# reference to tight tolerances without MXU rounding in the way; also
+# forces the kernel path regardless of sequence length
+_INTERPRET = False
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale,
-            causal):
+# below this sequence length the XLA-fused attention wins on this
+# hardware (measured fwd+bwd crossover — docs/perf.md "Long context"):
+# the blockwise backward pays two extra S recomputes that XLA's fused
+# short-sequence backward avoids, while above it the O(T^2)
+# materialization dominates (and OOMs).  Override via
+# MXNET_FLASH_MIN_SEQ (e.g. lower it when activation memory, not step
+# time, is the binding constraint).
+import os as _os
+_MIN_SEQ = int(_os.environ.get("MXNET_FLASH_MIN_SEQ", "4096"))
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
+            sm_scale, causal):
     import jax
     import jax.numpy as jnp
 
@@ -56,8 +75,17 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, sm_scale,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    if causal:
+        # blocks fully above the diagonal contribute nothing — stop at
+        # the diagonal block (the standard FlashAttention-2 bound)
+        nk_eff = (pl.program_id(1) * bq + bq + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk, nk_eff)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # per-row logsumexp, consumed by the backward kernels
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
@@ -86,10 +114,12 @@ def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
     # static in-kernel indices — a (1, T) block of a (B, T) array does
     # not, and a dynamic batch index into packed int8 rows is
     # unprovable for Mosaic.
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, block_k=block_k, sm_scale=sm_scale,
                           causal=causal),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
+        interpret=_INTERPRET,
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
@@ -97,10 +127,179 @@ def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
             pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, 1, T), lambda bh, qi, H=H: (bh // H, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+        ],
+    )(qt, kt, vt, mask_arr[:, None, :])
+    return (out.reshape(B, H, T, dh).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, T))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   mask_ref, dq_ref, *, block_k, sm_scale, causal):
+    import jax
+    import jax.numpy as jnp
+
+    q = q_ref[0]                      # (BQ, dh)
+    do = do_ref[0]                    # (BQ, dh)
+    lse = lse_ref[0, 0]               # (BQ,)
+    delta = delta_ref[0, 0]           # (BQ,)
+    bq, dh = q.shape
+    T = k_ref.shape[1]
+    nk = T // block_k
+    q_pos = pl.program_id(1) * bq + jnp.arange(bq)
+
+    def body(i, acc):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
+        msk = mask_ref[0, 0, pl.dslice(i * block_k, block_k)]
+        valid = msk[None, :] != 0
+        if causal:
+            k_pos = i * block_k + jnp.arange(block_k)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BQ, BK)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = (pl.program_id(1) * bq + bq + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk, nk_eff)
+    else:
+        nk_eff = nk
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+    acc = jax.lax.fori_loop(0, nk_eff, body, acc0)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    mask_ref, dk_ref, dv_ref, *, block_q, sm_scale,
+                    causal):
+    import jax
+    import jax.numpy as jnp
+
+    k = k_ref[0]                      # (BK, dh)
+    v = v_ref[0]
+    bk, dh = k.shape
+    T = q_ref.shape[1]
+    nq = T // block_q
+    k_pos = pl.program_id(1) * bk + jnp.arange(bk)
+    msk = mask_ref[0, 0, pl.dslice(pl.program_id(1) * bk, bk)]
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.dslice(j * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(j * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
+        valid = msk[None, :] != 0
+        if causal:
+            q_pos = j * block_q + jnp.arange(block_q)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        # dV += P^T dO
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (BK, dh)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (BQ, BK)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        # dK += dS^T Q
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    if causal:
+        # q blocks strictly above this kv block's diagonal see none of
+        # these keys — start at the diagonal block
+        j0 = (pl.program_id(1) * bk) // block_q
+    else:
+        j0 = 0
+    z = jnp.zeros((bk, dh), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(j0, nq, body, (z, z))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, mask, out, lse, g, causal=False,
+                   block_q=128, block_k=128):
+    import jax
+    import jax.numpy as jnp
+
+    B, T, H, dh = q.shape
+    sm_scale = 1.0 / math.sqrt(dh)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    dot = g.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    ot = out.transpose(0, 2, 1, 3).reshape(B * H, T, dh)
+    lse_f = lse.reshape(B * H, 1, T)
+    if mask is None:
+        mask_arr = jnp.ones((B, T), dtype=jnp.int8)
+    else:
+        mask_arr = mask.astype(jnp.int8)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # delta_i = sum_d dO_id * O_id — one cheap fused reduction
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)[:, None, :]                      # (B*H, 1, T)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k,
+                          sm_scale=sm_scale, causal=causal),
+        interpret=_INTERPRET,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, T), lambda bh, qi, H=H: (bh // H, 0, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, dh),
                                lambda bh, qi: (bh, qi, 0)),
-    )(qt, kt, vt, mask_arr[:, None, :])
-    return out.reshape(B, H, T, dh).transpose(0, 2, 1, 3)
+    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :])
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                          sm_scale=sm_scale, causal=causal),
+        interpret=_INTERPRET,
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, dh), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, dh), v.dtype)],
+        grid=(B * H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, dh), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, T, dh), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki, H=H: (bh // H, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
+        ],
+    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :])
+
+    unpack = lambda x: x.reshape(B, H, T, dh).transpose(0, 2, 1, 3)
+    return unpack(dq), unpack(dk), unpack(dv)
 
 
 def _reference_attention(q, k, v, mask, causal=False):
@@ -124,19 +323,17 @@ def _make_flash(causal):
 
     @jax.custom_vjp
     def _flash(q, k, v, mask):
-        return _flash_fwd_tpu(q, k, v, mask, causal=causal)
+        out, _ = _flash_fwd_tpu(q, k, v, mask, causal=causal)
+        return out
 
     def fwd(q, k, v, mask):
-        return _flash(q, k, v, mask), (q, k, v, mask)
+        out, lse = _flash_fwd_tpu(q, k, v, mask, causal=causal)
+        return out, (q, k, v, mask, out, lse)
 
     def bwd(res, g):
-        q, k, v, mask = res
-        # reference backward via recompute (fused bwd kernel: future work)
-        _, vjp_fn = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, mask,
-                                                    causal=causal),
-            q, k, v)
-        dq, dk, dv = vjp_fn(g)
+        q, k, v, mask, out, lse = res
+        dq, dk, dv = _flash_bwd_tpu(q, k, v, mask, out, lse, g,
+                                    causal=causal)
         return dq, dk, dv, None
 
     _flash.defvjp(fwd, bwd)
@@ -156,7 +353,9 @@ def flash_attention(q, k, v, mask=None, causal=False):
     import jax
     platform = jax.devices()[0].platform
     B, T, H, dh = q.shape
-    if platform == "cpu" or T % 128 != 0 or dh not in (64, 128, 256):
+    if not _INTERPRET and (platform == "cpu" or T < _MIN_SEQ):
+        return _reference_attention(q, k, v, mask, causal=causal)
+    if T % 128 != 0 or dh not in (64, 128, 256):
         return _reference_attention(q, k, v, mask, causal=causal)
     if causal not in _flash_cached:
         _flash_cached[causal] = _make_flash(causal)

@@ -1,0 +1,55 @@
+"""Flash-attention Pallas backward kernels vs the jnp reference, in
+Pallas interpreter mode (exact f32 math on CPU — no MXU rounding), per
+the FlashAttention-2 blockwise-recompute recipe."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.kernels import flash_attention as FA
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = FA._INTERPRET
+    FA._INTERPRET = True
+    yield
+    FA._INTERPRET = old
+
+
+@pytest.mark.parametrize("B,T,H,D,causal,use_mask", [
+    (2, 256, 4, 64, True, False),
+    (2, 256, 4, 64, False, False),
+    (2, 384, 2, 128, True, True),
+    (1, 128, 8, 64, False, True),
+])
+def test_flash_grads_match_reference(B, T, H, D, causal, use_mask):
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+    mask = None
+    if use_mask:
+        mask = (jax.random.uniform(ks[3], (B, T)) > 0.2).at[:, :8].set(True)
+    g = jax.random.normal(ks[3], (B, T, H, D), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, mask, causal=causal) * g)
+
+    o_f = FA.flash_attention(q, k, v, mask, causal=causal)
+    o_r = FA._reference_attention(q, k, v, mask, causal=causal)
+    assert float(jnp.max(jnp.abs(o_f - o_r))) < 1e-5
+    gf = jax.grad(loss(FA.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(FA._reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_min_seq_heuristic_routes_short_sequences():
+    """Below MXNET_FLASH_MIN_SEQ (and outside interpret mode) the XLA
+    path serves — measured faster fwd+bwd at short seq."""
+    FA._INTERPRET = False
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 256, 2, 64))
+    out = FA.flash_attention(q, q, q, causal=True)   # falls back, runs
+    assert out.shape == q.shape
