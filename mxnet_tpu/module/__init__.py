@@ -5,3 +5,4 @@ Reference: ``python/mxnet/module/`` (SURVEY.md §2.2 "Module (legacy)").
 from .base_module import BaseModule
 from .module import Module
 from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule

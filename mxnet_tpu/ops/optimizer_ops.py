@@ -368,3 +368,23 @@ def preloaded_multi_sgd_mom_update(data, momentum=0.0, rescale_grad=1.0,
                                 rescale_grad=rescale_grad,
                                 clip_gradient=clip_gradient,
                                 num_weights=num_weights)
+
+
+@register("multi_sum_sq", variadic=True, num_outputs=1, no_grad=True)
+def multi_sum_sq(data, num_arrays=1, **kw):
+    """Per-array sum of squares over a group, one fused launch
+    (reference: ``contrib/multi_sum_sq.cc`` — feeds ``multi_lars``)."""
+    jnp = _j()
+    return jnp.stack([jnp.sum(jnp.square(a.astype("float32")))
+                      for a in data[:num_arrays]])
+
+
+@register("reset_arrays", variadic=True, num_outputs=-1,
+          mutate=lambda attrs: tuple(range(attrs.get("num_arrays", 1))),
+          no_grad=True)
+def reset_arrays(data, num_arrays=1, **kw):
+    """Zero a group of arrays in one call (reference:
+    ``contrib/reset_arrays.cc`` — gradient clearing between
+    accumulation windows)."""
+    jnp = _j()
+    return tuple(jnp.zeros_like(a) for a in data[:num_arrays])

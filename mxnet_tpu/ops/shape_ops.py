@@ -399,10 +399,12 @@ def depth_to_space(data, block_size=1, **kw):
     return x.reshape(n, c // (b * b), h * b, w * b)
 
 
-@register("batch_take")
+@register("batch_take", aliases=("choose_element_0index",
+                                 "_choose_element_0index"))
 def batch_take(a, indices, **kw):
     """Per-row element pick: out[i] = a[i, indices[i]] (reference:
-    ``indexing_op.cc`` batch_take)."""
+    ``indexing_op.cc`` batch_take; legacy alias
+    ``choose_element_0index``)."""
     jnp = _j()
     idx = indices.astype("int32")
     return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
@@ -493,3 +495,12 @@ def boolean_mask(data, index, axis=0, **kw):
             "masking (see op docstring)")
     keep = _np.nonzero(idx)[0]
     return jnp.take(data, jnp.asarray(keep), axis=axis)
+
+
+@register("fill_element_0index", aliases=("_fill_element_0index",))
+def fill_element_0index(lhs, mhs, rhs, **kw):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (reference legacy op)."""
+    jnp = _j()
+    idx = rhs.astype("int32")
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.astype(lhs.dtype))

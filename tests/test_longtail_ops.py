@@ -155,3 +155,25 @@ def test_multi_lars_zero_grad_passthrough():
                         nd.array(wds), eta=0.01).asnumpy()
     assert np.isclose(out[0], 0.1)
     assert np.isclose(out[1], 0.1 * 0.01 * 2.0 / 1.0, rtol=1e-4)
+
+
+def test_multi_sum_sq_and_reset_arrays():
+    ws = [np.random.RandomState(i).rand(3, 4).astype("float32")
+          for i in range(3)]
+    out = nd.multi_sum_sq(*[nd.array(x) for x in ws],
+                          num_arrays=3).asnumpy()
+    assert np.allclose(out, [(x * x).sum() for x in ws], rtol=1e-5)
+    arrs = [nd.array(x) for x in ws]
+    nd.reset_arrays(*arrs, num_arrays=3)
+    assert all((a.asnumpy() == 0).all() for a in arrs)
+
+
+def test_legacy_0index_ops():
+    d = nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    i = nd.array(np.array([0, 2, 1, 0], "float32"))
+    assert np.allclose(nd.choose_element_0index(d, i).asnumpy(),
+                       [0, 5, 7, 9])
+    f = nd.fill_element_0index(
+        d, nd.array(np.full(4, -1.0, "float32")), i).asnumpy()
+    assert f[0, 0] == -1 and f[1, 2] == -1 and f[2, 1] == -1
+    assert f[0, 1] == 1  # untouched

@@ -199,3 +199,47 @@ def test_check_symbolic_oracles():
     og = np.ones((3, 3))
     tu.check_symbolic_backward(s, [a_np, b_np], [og],
                                {"a": b_np, "b": a_np})
+
+
+def test_sequential_module_trains():
+    """SequentialModule chains two Modules; grads flow across the
+    boundary (reference: module/sequential_module.py)."""
+    import mxnet_tpu.symbol as sym
+
+    np.random.seed(0)
+    feat = sym.Variable("data")
+    body = sym.Activation(sym.FullyConnected(feat, num_hidden=16,
+                                             name="fc_body"),
+                          act_type="relu", name="act_body")
+    head_in = sym.Variable("data")
+    head = sym.SoftmaxOutput(sym.FullyConnected(head_in, num_hidden=3,
+                                                name="fc_head"),
+                             name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(body, label_names=[]))
+    seq.add(mx.mod.Module(head, label_names=["softmax_label"]),
+            take_labels=True)
+
+    X = np.random.randn(64, 10).astype("float32")
+    Y = X[:, :3].argmax(1).astype("float32")
+    seq.bind(data_shapes=[("data", (16, 10))],
+             label_shapes=[("softmax_label", (16,))])
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.05})
+    from mxnet_tpu.io.io import DataBatch
+    from mxnet_tpu import nd
+    metric = mx.metric.create("acc")
+    for epoch in range(10):
+        metric.reset()
+        for i in range(0, 64, 16):
+            batch = DataBatch(data=[nd.array(X[i:i+16])],
+                              label=[nd.array(Y[i:i+16])])
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.8, metric.get()
+    # outputs come from the tail module
+    assert seq.get_outputs()[0].shape == (16, 3)
