@@ -125,7 +125,9 @@ def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
     return out.astype(_np.dtype(dtype).name)
 
 
-@register("topk", no_grad=True, num_outputs=-1)
+@register("topk", num_outputs=-1,
+          no_grad=lambda attrs: attrs.get("ret_typ",
+                                          "indices") == "indices")
 def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
          dtype="float32", **kw):
     import jax

@@ -334,3 +334,25 @@ def test_fused_multi_sgd_matches_loop():
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
     for a, b in zip(moms_f, moms_r):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_topk_values_differentiable():
+    """topk ret_typ='value' carries gradients (reference: topk backward
+    scatters into the selected positions); indices stay non-recorded."""
+    x = np.array([[3.0, 1.0, 2.0], [0.5, 2.5, 1.5]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        v = nd.topk(a, k=2, ret_typ="value", axis=-1)
+        L = (v * nd.array(np.array([[1, 10], [100, 1000]], "float32"))).sum()
+    L.backward()
+    # row0 top2 = [3, 2] -> grads 1 at col0, 10 at col2
+    # row1 top2 = [2.5, 1.5] -> 100 at col1, 1000 at col2
+    expect = np.array([[1, 0, 10], [0, 100, 1000]], dtype="float32")
+    assert np.allclose(a.grad.asnumpy(), expect)
+    # indices-only stays non-differentiable (not recorded on the tape)
+    with autograd.record():
+        idx = nd.topk(a, k=1)
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        idx.backward()

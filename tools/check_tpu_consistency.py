@@ -1,0 +1,89 @@
+"""CPU-vs-TPU operator consistency sweep on real hardware.
+
+The §4.2 second-backend oracle (reference:
+``tests/python/gpu/test_operator_gpu.py`` imports the whole CPU suite +
+``check_consistency``), run as a standalone CLI because the pytest tier
+pins itself to the 8-device virtual CPU mesh:
+
+    python tools/check_tpu_consistency.py            # needs the chip
+
+Each case runs forward AND input gradients on cpu(0) and tpu(0) and
+cross-compares within per-dtype tolerance.  Exit code 0 = all pass.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_consistency
+
+
+def rand(*shape, scale=1.0, rng=np.random):
+    return (rng.randn(*shape) * scale).astype("float32")
+
+
+def main():
+    if mx.num_tpus() == 0:
+        print("SKIP: no TPU visible")
+        return 0
+    rng = np.random.RandomState(0)
+
+    cases = [
+        ("dense_gelu", lambda x, w: nd.LeakyReLU(
+            nd.FullyConnected(x, w, num_hidden=32, no_bias=True),
+            act_type="gelu"),
+         [rand(8, 16, rng=rng), rand(32, 16, rng=rng)]),
+        ("conv_bn_relu", lambda x, w: nd.relu(
+            nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                           num_filter=8, no_bias=True)),
+         [rand(2, 4, 12, 12, rng=rng), rand(8, 4, 3, 3, rng=rng)]),
+        ("softmax_ce", lambda x: nd.log_softmax(x, axis=-1),
+         [rand(6, 10, rng=rng)]),
+        ("layernorm", lambda x, g, b: nd.LayerNorm(x, g, b),
+         [rand(4, 24, rng=rng), np.ones(24, "float32"),
+          np.zeros(24, "float32")]),
+        ("batch_dot_t", lambda a, b: nd.batch_dot(a, b,
+                                                  transpose_b=True),
+         [rand(3, 5, 7, rng=rng), rand(3, 6, 7, rng=rng)]),
+        ("pool_max", lambda x: nd.Pooling(x, kernel=(2, 2),
+                                          stride=(2, 2),
+                                          pool_type="max"),
+         [rand(2, 3, 8, 8, rng=rng)]),
+        ("reduce_stats", lambda x: nd.sqrt(nd.mean(nd.square(x),
+                                                   axis=(1, 2))),
+         [rand(4, 9, 9, rng=rng)]),
+        ("topk_pick", lambda x: nd.topk(x, k=3, ret_typ="value",
+                                        axis=-1),
+         [rand(5, 12, rng=rng)]),
+        # constants created inside fn must live on the op's context —
+        # mixed-context eager ops raise, matching reference semantics
+        ("roialign", lambda x: nd.contrib.ROIAlign(
+            x, nd.array(np.array([[0, 1.0, 1.0, 7.0, 7.0]], "float32"),
+                        ctx=x.context),
+            pooled_size=(2, 2), spatial_scale=1.0),
+         [rand(1, 3, 10, 10, rng=rng)]),
+        ("take_embed", lambda w: nd.Embedding(
+            nd.array(np.array([[1, 3], [0, 2]], "float32"),
+                     ctx=w.context), w, input_dim=8, output_dim=5),
+         [rand(8, 5, rng=rng)]),
+    ]
+
+    failed = []
+    for name, fn, inputs in cases:
+        try:
+            check_consistency(fn, inputs)
+            print("ok  %s" % name)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            failed.append(name)
+            print("FAIL %s: %s" % (name, str(e)[:200]))
+    print("%d/%d consistent" % (len(cases) - len(failed), len(cases)))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
