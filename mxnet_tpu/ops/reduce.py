@@ -147,8 +147,6 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
     if ret_typ == "both":
         return (vals, idx)
     if ret_typ == "mask":
-        mask = jnp.zeros(moved.shape, dtype=data.dtype)
-        mask = mask.at[..., :].set(0)
         oh = jax.nn.one_hot(idx.astype("int32"), data.shape[axis],
                             dtype=data.dtype)
         m = jnp.sum(jnp.moveaxis(oh, axis, -2), axis=axis)

@@ -356,3 +356,16 @@ def test_topk_values_differentiable():
     import pytest
     with pytest.raises(mx.base.MXNetError):
         idx.backward()
+
+
+def test_topk_positional_ret_typ_grads():
+    """Regression: attr-dependent no_grad must see POSITIONAL attrs too
+    (nd.topk(a, axis, k, ret_typ) binds via the impl signature)."""
+    x = np.array([[3.0, 1.0, 2.0]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        v = nd.topk(a, -1, 2, "value")
+        L = (v * nd.array(np.array([[2.0, 3.0]], "float32"))).sum()
+    L.backward()
+    assert np.allclose(a.grad.asnumpy(), [[2, 0, 3]])
