@@ -810,3 +810,55 @@ def trapz(y, dx=1.0, axis=-1):
 
 def ediff1d(ary):
     return _apply("_np_ediff1d", ary)
+
+
+# ------------------------------------------------------- generated long-tail
+
+def _gen_np_fn(np_name, n_array_args=1):
+    op_name = "_np_" + np_name
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        arrays = args[:n_array_args]
+        rest = args[n_array_args:]
+        if rest:
+            r = _apply(op_name, *arrays, pos_attrs=tuple(rest),
+                       **kwargs)
+        else:
+            r = _apply(op_name, *arrays, **kwargs)
+        if out is not None:
+            out[...] = r
+            return out
+        return r
+    fn.__name__ = np_name
+    return fn
+
+
+for _nm in ["real", "imag", "conj", "angle", "sinc", "i0", "deg2rad",
+            "rad2deg", "positive", "fliplr", "flipud", "unwrap",
+            "nanmax", "nanmin", "nanstd", "nanvar", "ptp", "signbit",
+            "nanargmax", "nanargmin", "count_nonzero", "argwhere",
+            "flatnonzero", "vander", "frexp", "modf", "spacing"]:
+    if _nm not in globals():
+        globals()[_nm] = _gen_np_fn(_nm, 1)
+
+for _nm in ["fmax", "fmin", "float_power", "ldexp", "logaddexp2",
+            "nextafter", "gcd", "lcm", "isin", "in1d", "convolve",
+            "correlate", "polyval", "divmod", "interp"]:
+    if _nm not in globals():
+        globals()[_nm] = _gen_np_fn(_nm, 2)
+
+
+def _gen_creation_fn(np_name):
+    op_name = "_np_" + np_name
+
+    def fn(*args, **kwargs):
+        return _apply(op_name, pos_attrs=tuple(args), **kwargs)
+    fn.__name__ = np_name
+    return fn
+
+
+for _nm in ["bartlett", "blackman", "hamming", "hanning", "kaiser",
+            "tri", "indices"]:
+    if _nm not in globals():
+        globals()[_nm] = _gen_creation_fn(_nm)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-from ..ops.registry import register
+from ..ops.registry import register, op_exists as _op_exists
 
 
 def _j():
@@ -439,3 +439,63 @@ def _np_trapz(y, dx=1.0, axis=-1, **kw):
 @register("_np_ediff1d")
 def _np_ediff1d(ary, **kw):
     return _j().ediff1d(ary)
+
+
+# ---------------------------------------------------------------------------
+# Generated long-tail: functions where jnp already implements NumPy
+# semantics exactly — registered en masse (reference: the bulk of
+# ``src/operator/numpy/*_op.cc`` is the same mechanical fan-out).
+# ---------------------------------------------------------------------------
+
+def _reg_jnp(name, jnp_name=None, n_in=1, no_grad=False, num_outputs=1):
+    jnp_name = jnp_name or name[len("_np_"):]
+
+    def impl(*args, **kw):
+        kw.pop("out", None)
+        fn = getattr(_j(), jnp_name)
+        return fn(*args, **kw)
+
+    impl.__name__ = name
+    impl.__doc__ = ("NumPy-semantics %r (reference: src/operator/numpy/)"
+                    % jnp_name)
+    register(name, no_grad=no_grad, num_outputs=num_outputs)(impl)
+
+
+# differentiable unary/binary where jnp == numpy semantics
+for _n in ["real", "imag", "conj", "angle", "sinc", "i0", "deg2rad",
+           "rad2deg", "positive", "fliplr", "flipud", "fmax", "fmin",
+           "float_power", "ldexp", "logaddexp2", "nextafter",
+           "nanmax", "nanmin", "nanstd", "nanvar", "ptp",
+           "convolve", "correlate", "unwrap", "vander",
+           "trace", "interp"]:
+    if not _op_exists("_np_" + _n):
+        _reg_jnp("_np_" + _n)
+
+# integer/boolean-valued (non-differentiable)
+for _n in ["signbit", "gcd", "lcm", "nanargmax", "nanargmin",
+           "count_nonzero", "isin", "argwhere", "flatnonzero",
+           "tri", "indices", "spacing"]:
+    if not _op_exists("_np_" + _n):
+        _reg_jnp("_np_" + _n, no_grad=True)
+
+# window functions (creation ops: scalar int arg, no array inputs)
+for _n in ["bartlett", "blackman", "hamming", "hanning", "kaiser"]:
+    if not _op_exists("_np_" + _n):
+        _reg_jnp("_np_" + _n, no_grad=True)
+
+# multi-output
+for _n, _k in [("frexp", 2), ("modf", 2), ("divmod", 2)]:
+    if not _op_exists("_np_" + _n):
+        _reg_jnp("_np_" + _n, num_outputs=_k)
+
+
+@register("_np_polyval")
+def _np_polyval(p, x, **kw):
+    return _j().polyval(p, x)
+
+
+@register("_np_in1d", no_grad=True)
+def _np_in1d(ar1, ar2, **kw):
+    # jnp has no in1d (removed upstream); NumPy defines it as the
+    # raveled isin
+    return _j().isin(ar1, ar2, **kw).ravel()

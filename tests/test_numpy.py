@@ -293,3 +293,68 @@ def test_np_statistics_and_misc_extensions():
     assert np.diff(np.array([1., 4., 9.])).asnumpy().tolist() == [3., 5.]
     assert abs(float(np.trapz(np.array([1., 2., 3.]))) - 4.0) < 1e-6
     assert np.ediff1d(a).shape == (5,)
+
+
+class TestLongTail:
+    """Generated jnp-backed long-tail functions vs numpy oracles."""
+
+    def test_unary_family(self):
+        a = np.array(onp.array([[3.0, -1.0], [0.5, 2.0]], "float32"))
+        for name in ["fliplr", "flipud", "positive", "deg2rad",
+                     "rad2deg", "sinc"]:
+            got = getattr(np, name)(a).asnumpy()
+            want = getattr(onp, name)(a.asnumpy())
+            assert onp.allclose(got, want, atol=1e-6), name
+
+    def test_reductions_and_windows(self):
+        a = np.array(onp.array([[3.0, -1.0], [0.5, 2.0]], "float32"))
+        assert onp.isclose(float(np.ptp(a).asnumpy()), 4.0)
+        assert int(np.count_nonzero(a).asnumpy()) == 4
+        assert onp.allclose(np.hanning(8).asnumpy(), onp.hanning(8),
+                            atol=1e-6)
+        assert onp.allclose(np.hamming(5).asnumpy(), onp.hamming(5),
+                            atol=1e-6)
+
+    def test_binary_and_multi_output(self):
+        a = np.array(onp.array([1.0, -2.0, 3.0], "float32"))
+        b = np.array(onp.array([0.5, 0.5, 0.5], "float32"))
+        assert onp.allclose(np.fmax(a, b).asnumpy(),
+                            onp.fmax(a.asnumpy(), b.asnumpy()))
+        assert onp.allclose(np.logaddexp2(a, b).asnumpy(),
+                            onp.logaddexp2(a.asnumpy(), b.asnumpy()),
+                            rtol=1e-5)
+        m, e = np.frexp(a)
+        assert onp.allclose(
+            m.asnumpy() * 2.0 ** e.asnumpy().astype("float32"),
+            a.asnumpy())
+        p = np.array(onp.array([1.0, 2.0, 3.0], "float32"))
+        x = np.array(onp.array([2.0], "float32"))
+        assert onp.allclose(np.polyval(p, x).asnumpy(), [11.0])
+
+    def test_grad_through_generated_fn(self):
+        from mxnet_tpu import autograd
+        a = np.array(onp.array([[3.0, -1.0]], "float32"))
+        a.attach_grad()
+        with autograd.record():
+            L = np.fmax(a, np.zeros_like(a)).sum()
+        L.backward()
+        assert onp.allclose(a.grad.asnumpy(), [[1.0, 0.0]])
+
+    def test_scalar_operand_backward(self):
+        """Regression: python-scalar operands are tape constants, not
+        dropped (replay misalignment crashed backward)."""
+        a = np.array(onp.array([[3.0, -1.0]], "float32"))
+        a.attach_grad()
+        with autograd.record():
+            L = np.fmax(a, 0.5).sum()
+        L.backward()
+        assert onp.allclose(a.grad.asnumpy(), [[1.0, 0.0]])
+
+    def test_in1d_and_out_kwarg(self):
+        r = np.in1d(np.array(onp.array([1., 2., 3.], "float32")),
+                    np.array(onp.array([2., 4.], "float32"))).asnumpy()
+        assert onp.array_equal(r, [False, True, False])
+        a = np.array(onp.array([[3.0, -1.0]], "float32"))
+        c = np.zeros((1, 2))
+        np.fmax(a, np.zeros_like(a), out=c)
+        assert onp.allclose(c.asnumpy(), [[3.0, 0.0]])
