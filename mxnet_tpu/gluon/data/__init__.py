@@ -2,6 +2,6 @@
 from .dataset import (Dataset, SimpleDataset, ArrayDataset,
                       RecordFileDataset)
 from .sampler import (Sampler, SequentialSampler, RandomSampler,
-                      BatchSampler, FilterSampler)
+                      BatchSampler, FilterSampler, IntervalSampler)
 from .dataloader import DataLoader
 from . import vision

@@ -4,6 +4,7 @@ from __future__ import annotations
 import numpy as _np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "IntervalSampler",
            "FilterSampler"]
 
 
@@ -89,3 +90,23 @@ class BatchSampler(Sampler):
                 self._batch_size
         raise ValueError("last_batch must be one of 'keep', 'discard', or "
                          "'rollover', but got %s" % self._last_batch)
+
+
+class IntervalSampler(Sampler):
+    """Samples i, i+interval, i+2*interval, ... for each start i
+    (reference: ``gluon/data/sampler.py`` IntervalSampler)."""
+
+    def __init__(self, length, interval, rollover=True):
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else [0]
+        for i in starts:
+            yield from range(i, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

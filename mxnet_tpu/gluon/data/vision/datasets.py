@@ -18,6 +18,7 @@ from .... import ndarray as nd
 from ..dataset import _DownloadedDataset, Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageListDataset",
            "ImageRecordDataset", "ImageFolderDataset", "SyntheticMNIST"]
 
 
@@ -234,3 +235,39 @@ class ImageFolderDataset(Dataset):
 
 
 SyntheticMNIST = MNIST  # alias used by hermetic convergence tests
+
+
+class ImageListDataset(Dataset):
+    """Images enumerated by a ``.lst`` file (reference:
+    ``vision/datasets.py`` ImageListDataset; the ``im2rec.py`` listing
+    format: ``index\tlabel[\tlabel...]\trelpath``)."""
+
+    def __init__(self, root, imglist, flag=1):
+        import os as _os
+        self._root = root
+        self._flag = flag
+        self._items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    labels = [float(v) for v in parts[1:-1]]
+                    self._items.append(
+                        (_os.path.join(root, parts[-1]),
+                         labels[0] if len(labels) == 1 else labels))
+        else:
+            for entry in imglist:
+                labels = [float(v) for v in entry[1:-1]]
+                self._items.append(
+                    (_os.path.join(root, entry[-1]),
+                     labels[0] if len(labels) == 1 else labels))
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self._items[idx]
+        return imread(path, flag=self._flag), label

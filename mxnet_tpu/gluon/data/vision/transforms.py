@@ -12,6 +12,7 @@ from .... import ndarray as nd
 from ....ndarray.ndarray import NDArray
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "RandomCrop", "RandomGray",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
            "RandomSaturation", "RandomHue", "RandomColorJitter",
@@ -185,3 +186,55 @@ class RandomLighting(Block):
                             [-0.5836, -0.6948, 0.4203]])
         return LightingAug(self._alpha, eigval, eigvec)(
             x.astype("float32"))
+
+
+class RandomCrop(Block):
+    """Random spatial crop to ``size`` with optional ``pad`` (reference:
+    ``transforms.RandomCrop``).  HWC input."""
+
+    def __init__(self, size, pad=None, pad_value=0):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def forward(self, x):
+        from ....image import random_crop
+        if self._pad:
+            import numpy as _np
+            from .... import nd as _nd
+            p = self._pad
+            arr = _np.pad(x.asnumpy(), ((p, p), (p, p), (0, 0)),
+                          constant_values=self._pad_value)
+            x = _nd.array(arr)
+        # random_crop resizes undersized inputs up to `size`, so the
+        # output shape is always (th, tw, C) — batchable downstream
+        out, _ = random_crop(x, (self._size[1], self._size[0]))
+        return out
+
+
+class RandomGray(Block):
+    """Randomly convert to 3-channel grayscale with probability ``p``
+    (reference: ``transforms.RandomGray``)."""
+
+    _RGB_W = None  # per-class cache: {context: weight NDArray}
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if pyrandom.random() >= self._p:
+            return x
+        from .... import nd as _nd
+        import numpy as _np
+        cache = RandomGray._RGB_W or {}
+        w = cache.get(x.context)
+        if w is None:
+            w = _nd.array(_np.array([0.299, 0.587, 0.114], "float32"),
+                          ctx=x.context)
+            cache[x.context] = w
+            RandomGray._RGB_W = cache
+        gray = (x.astype("float32") * w.reshape((1, 1, 3))).sum(
+            axis=2, keepdims=True)
+        return _nd.concat(gray, gray, gray, dim=2).astype(x.dtype)

@@ -310,7 +310,11 @@ def _unary_fn(np_name, op_name):
     def fn(x, out=None, **kw):
         r = _apply(op_name, x)
         if out is not None:
-            out[...] = r
+            if isinstance(r, tuple):
+                for o, v in zip(out, r):
+                    o[...] = v
+            else:
+                out[...] = r
             return out
         return r
     fn.__name__ = np_name
@@ -340,7 +344,11 @@ def _binary_fn(np_name, op_name):
     def fn(a, b, out=None, **kw):
         r = _apply(op_name, a, b)
         if out is not None:
-            out[...] = r
+            if isinstance(r, tuple):
+                for o, v in zip(out, r):
+                    o[...] = v
+            else:
+                out[...] = r
             return out
         return r
     fn.__name__ = np_name
@@ -827,7 +835,11 @@ def _gen_np_fn(np_name, n_array_args=1):
         else:
             r = _apply(op_name, *arrays, **kwargs)
         if out is not None:
-            out[...] = r
+            if isinstance(r, tuple):
+                for o, v in zip(out, r):
+                    o[...] = v
+            else:
+                out[...] = r
             return out
         return r
     fn.__name__ = np_name
@@ -844,7 +856,7 @@ for _nm in ["real", "imag", "conj", "angle", "sinc", "i0", "deg2rad",
 
 for _nm in ["fmax", "fmin", "float_power", "ldexp", "logaddexp2",
             "nextafter", "gcd", "lcm", "isin", "in1d", "convolve",
-            "correlate", "polyval", "divmod", "interp"]:
+            "correlate", "polyval", "divmod"]:
     if _nm not in globals():
         globals()[_nm] = _gen_np_fn(_nm, 2)
 

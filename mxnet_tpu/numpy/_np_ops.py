@@ -467,7 +467,7 @@ for _n in ["real", "imag", "conj", "angle", "sinc", "i0", "deg2rad",
            "float_power", "ldexp", "logaddexp2", "nextafter",
            "nanmax", "nanmin", "nanstd", "nanvar", "ptp",
            "convolve", "correlate", "unwrap", "vander",
-           "trace", "interp"]:
+           "trace"]:
     if not _op_exists("_np_" + _n):
         _reg_jnp("_np_" + _n)
 

@@ -178,3 +178,8 @@ def num_gpus():
 def current_device():
     from ..context import current_context
     return current_context()
+
+
+# module-level conveniences the reference exposes on npx
+from ..ndarray import load, save  # noqa: E402,F401
+from ..context import current_context  # noqa: E402,F401

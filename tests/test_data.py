@@ -208,3 +208,34 @@ def test_sampler():
     bs = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3,
                                  "rollover")
     assert len(list(bs)) == 2
+
+
+def test_interval_sampler_and_new_transforms():
+    from mxnet_tpu.gluon.data import IntervalSampler
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    assert list(IntervalSampler(7, 3)) == [0, 3, 6, 1, 4, 2, 5]
+    assert list(IntervalSampler(6, 2, rollover=False)) == [0, 2, 4]
+
+    x = nd.array(np.random.RandomState(0).rand(8, 8, 3).astype("float32"))
+    out = transforms.RandomCrop(4)(x)
+    assert out.shape == (4, 4, 3)
+    padded = transforms.RandomCrop(8, pad=2)(x)
+    assert padded.shape == (8, 8, 3)
+    g = transforms.RandomGray(p=1.0)(x)
+    assert np.allclose(g.asnumpy()[..., 0], g.asnumpy()[..., 2])
+    same = transforms.RandomGray(p=0.0)(x)
+    assert np.allclose(same.asnumpy(), x.asnumpy())
+
+
+def test_image_list_dataset(tmp_path):
+    import cv2
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+
+    arr = (np.random.RandomState(1).rand(6, 6, 3) * 255).astype("uint8")
+    cv2.imwrite(str(tmp_path / "a.png"), arr)
+    (tmp_path / "list.lst").write_text("0\t1.0\ta.png\n")
+    ds = ImageListDataset(str(tmp_path), str(tmp_path / "list.lst"))
+    assert len(ds) == 1
+    img, label = ds[0]
+    assert label == 1.0 and img.shape == (6, 6, 3)
