@@ -622,8 +622,9 @@ class CSVIter(DataIter):
             label = np.loadtxt(label_csv, delimiter=",", dtype=dtype,
                                ndmin=2).reshape((-1,) + tuple(label_shape))
         else:
-            label = np.zeros((len(data),) + tuple(label_shape),
-                             dtype=dtype)
+            # no label_csv → no label (the reference CSVIter provides
+            # none; fabricating zeros would mis-wire Module.fit labels)
+            label = None
         # round_batch=True: wrap the final short batch with leading
         # samples and report pad (the reference BatchLoader contract,
         # same as ImageRecordIter above); False: drop the short batch

@@ -35,7 +35,12 @@ _INTERPRET = False
 # MXNET_FLASH_MIN_SEQ (e.g. lower it when activation memory, not step
 # time, is the binding constraint).
 import os as _os
-_MIN_SEQ = int(_os.environ.get("MXNET_FLASH_MIN_SEQ", "4096"))
+
+
+def _min_seq():
+    # read at call time: docs/perf.md documents MXNET_FLASH_MIN_SEQ as a
+    # user-tunable knob, so setting it after import must take effect
+    return int(_os.environ.get("MXNET_FLASH_MIN_SEQ", "4096"))
 
 
 def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
@@ -353,7 +358,7 @@ def flash_attention(q, k, v, mask=None, causal=False):
     import jax
     platform = jax.devices()[0].platform
     B, T, H, dh = q.shape
-    if not _INTERPRET and (platform == "cpu" or T < _MIN_SEQ):
+    if not _INTERPRET and (platform == "cpu" or T < _min_seq()):
         return _reference_attention(q, k, v, mask, causal=causal)
     if T % 128 != 0 or dh not in (64, 128, 256):
         return _reference_attention(q, k, v, mask, causal=causal)

@@ -483,10 +483,14 @@ for _n in ["bartlett", "blackman", "hamming", "hanning", "kaiser"]:
     if not _op_exists("_np_" + _n):
         _reg_jnp("_np_" + _n, no_grad=True)
 
-# multi-output
-for _n, _k in [("frexp", 2), ("modf", 2), ("divmod", 2)]:
+# multi-output.  frexp's exponent is int-dtype: recording it would hand
+# jax.vjp a non-float cotangent, so it is no_grad.  divmod/modf outputs
+# are float for float inputs and stay differentiable (divmod's remainder
+# grad matches np.mod; the floor'd quotient contributes zeros).
+for _n, _k, _ng in [("frexp", 2, True), ("modf", 2, False),
+                    ("divmod", 2, False)]:
     if not _op_exists("_np_" + _n):
-        _reg_jnp("_np_" + _n, num_outputs=_k)
+        _reg_jnp("_np_" + _n, num_outputs=_k, no_grad=_ng)
 
 
 @register("_np_polyval")

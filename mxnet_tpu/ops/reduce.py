@@ -125,6 +125,47 @@ def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
     return out.astype(_np.dtype(dtype).name)
 
 
+def _topk_mask_compute(data, k, axis, is_ascend):
+    import jax
+    jnp = _j()
+    neg = data if not is_ascend else -data
+    moved = jnp.moveaxis(neg, axis, -1)               # (..., N)
+    _, idx = jax.lax.top_k(moved, k)                  # (..., k)
+    oh = jax.nn.one_hot(idx, data.shape[axis],
+                        dtype=data.dtype)             # (..., k, N)
+    m = jnp.sum(oh, axis=-2)                          # (..., N)
+    return jnp.moveaxis(m, -1, axis)
+
+
+_TOPK_MASK_VJP = None
+
+
+def _topk_mask(data, k, axis, is_ascend):
+    """topk ret_typ='mask' with the reference scatter backward: out_grad
+    flows to the selected positions (grad = g * mask), matching upstream
+    TopKImpl's backward rather than the all-zero gradient of
+    one_hot(stop_grad(idx))."""
+    global _TOPK_MASK_VJP
+    if _TOPK_MASK_VJP is None:
+        import jax
+        from functools import partial
+
+        @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+        def fn(data, k, axis, is_ascend):
+            return _topk_mask_compute(data, k, axis, is_ascend)
+
+        def fwd(data, k, axis, is_ascend):
+            m = _topk_mask_compute(data, k, axis, is_ascend)
+            return m, m
+
+        def bwd(k, axis, is_ascend, m, g):
+            return (g * m,)
+
+        fn.defvjp(fwd, bwd)
+        _TOPK_MASK_VJP = fn
+    return _TOPK_MASK_VJP(data, k, axis, is_ascend)
+
+
 @register("topk", num_outputs=-1,
           no_grad=lambda attrs: attrs.get("ret_typ",
                                           "indices") == "indices")
@@ -133,6 +174,9 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
     import jax
     jnp = _j()
     axis = axis if axis is not None else -1
+    if ret_typ == "mask":
+        ax = axis if axis >= 0 else data.ndim + axis
+        return _topk_mask(data, k, ax, is_ascend)
     neg = data if not is_ascend else -data
     moved = jnp.moveaxis(neg, axis, -1)
     vals, idx = jax.lax.top_k(moved, k)
@@ -146,11 +190,6 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
         return vals
     if ret_typ == "both":
         return (vals, idx)
-    if ret_typ == "mask":
-        oh = jax.nn.one_hot(idx.astype("int32"), data.shape[axis],
-                            dtype=data.dtype)
-        m = jnp.sum(jnp.moveaxis(oh, axis, -2), axis=axis)
-        return jnp.moveaxis(m, -1, axis)
     raise ValueError("unknown ret_typ %r" % ret_typ)
 
 

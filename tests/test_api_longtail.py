@@ -27,6 +27,20 @@ def test_csv_iter():
         assert np.allclose(it.next().data[0].asnumpy(), X[:3])
 
 
+def test_csv_iter_no_label():
+    """label_csv=None → NO label advertised (the reference CSVIter
+    provides none; fabricated zeros would mis-wire Module.fit)."""
+    with tempfile.TemporaryDirectory() as d:
+        dpath = os.path.join(d, "x.csv")
+        X = np.arange(12).reshape(6, 2)
+        np.savetxt(dpath, X, delimiter=",")
+        it = mio.CSVIter(data_csv=dpath, data_shape=(2,), batch_size=3)
+        assert it.provide_label == []
+        b = it.next()
+        assert b.data[0].shape == (3, 2)
+        assert b.label is None or b.label == []
+
+
 def test_libsvm_iter():
     with tempfile.TemporaryDirectory() as d:
         sv = os.path.join(d, "t.svm")

@@ -53,3 +53,14 @@ def test_min_seq_heuristic_routes_short_sequences():
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 256, 2, 64))
     out = FA.flash_attention(q, q, q, causal=True)   # falls back, runs
     assert out.shape == q.shape
+
+
+def test_min_seq_env_read_at_call_time(monkeypatch):
+    """MXNET_FLASH_MIN_SEQ is documented as tunable after import: the
+    threshold must be read per call, not frozen at module import."""
+    monkeypatch.setenv("MXNET_FLASH_MIN_SEQ", "123")
+    assert FA._min_seq() == 123
+    monkeypatch.setenv("MXNET_FLASH_MIN_SEQ", "999")
+    assert FA._min_seq() == 999
+    monkeypatch.delenv("MXNET_FLASH_MIN_SEQ")
+    assert FA._min_seq() == 4096

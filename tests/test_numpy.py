@@ -331,6 +331,32 @@ class TestLongTail:
         x = np.array(onp.array([2.0], "float32"))
         assert onp.allclose(np.polyval(p, x).asnumpy(), [11.0])
 
+    def test_frexp_divmod_grad_semantics(self):
+        """frexp has an int-dtype exponent: it must not land on the tape
+        (backward would seed a non-float cotangent).  divmod/modf stay
+        differentiable — their outputs are float for float inputs, and
+        divmod's remainder grad matches np.mod."""
+        import pytest
+        from mxnet_tpu import autograd
+        from mxnet_tpu.base import MXNetError
+        a = np.array(onp.array([1.5, -2.25, 3.0], "float32"))
+        a.attach_grad()
+        with autograd.record():
+            m, e = np.frexp(a)
+        for outp in (m, e):
+            with pytest.raises(MXNetError):
+                outp.backward()
+        with autograd.record():
+            q, r = np.divmod(a, np.array(onp.array([1.0, 1.0, 1.0],
+                                                   "float32")))
+        r.backward()
+        assert onp.allclose(a.grad.asnumpy(), [1.0, 1.0, 1.0])
+        with autograd.record():
+            frac, whole = np.modf(a)
+            L = frac.sum()
+        L.backward()
+        assert a.grad is not None
+
     def test_grad_through_generated_fn(self):
         from mxnet_tpu import autograd
         a = np.array(onp.array([[3.0, -1.0]], "float32"))

@@ -369,3 +369,45 @@ def test_topk_positional_ret_typ_grads():
         L = (v * nd.array(np.array([[2.0, 3.0]], "float32"))).sum()
     L.backward()
     assert np.allclose(a.grad.asnumpy(), [[2, 0, 3]])
+
+
+def test_topk_mask_scatter_backward():
+    """ret_typ='mask' backward scatters out_grad into the selected
+    positions (reference TopKImpl backward), not all-zeros."""
+    x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        m = nd.topk(a, k=2, ret_typ="mask", axis=-1)
+        L = (m * nd.array(np.array([[1, 10, 100], [2, 20, 200]],
+                                   "float32"))).sum()
+    L.backward()
+    # row0 top2 = cols 1,2 ; row1 top2 = cols 0,2
+    expect = np.array([[0, 10, 100], [2, 0, 200]], dtype="float32")
+    assert np.allclose(m.asnumpy(),
+                       np.array([[0, 1, 1], [1, 0, 1]], "float32"))
+    assert np.allclose(a.grad.asnumpy(), expect)
+
+
+def test_topk_mask_non_last_axis():
+    """mask shape/values must be correct for axis != -1 (regression:
+    one_hot's appended trailing dim was summed on the wrong axis)."""
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    for ax in (0, 1, 2, -2):
+        m = nd.topk(nd.array(x), k=1, ret_typ="mask", axis=ax).asnumpy()
+        assert m.shape == x.shape, (ax, m.shape)
+        assert np.allclose(m.sum(axis=ax), 1.0), (ax, m)
+        assert np.allclose((m * x).sum(axis=ax), x.max(axis=ax)), ax
+
+
+def test_topk_both_backward():
+    """ret_typ='both' under record: backward through both heads works
+    (idx contributes zero gradient; vals scatter normally)."""
+    x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], dtype="float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        vals, idx = nd.topk(a, k=2, ret_typ="both", axis=-1)
+    autograd.backward([vals, idx])
+    expect = np.array([[0, 1, 1], [1, 0, 1]], dtype="float32")
+    assert np.allclose(a.grad.asnumpy(), expect)
