@@ -53,6 +53,7 @@ class KVStore:
         self._data: Dict = {}
         self._updater = None
         self._optimizer = None
+        self._compressor = None
 
     @property
     def rank(self) -> int:
@@ -100,6 +101,13 @@ class KVStore:
                         "row_sparse targets only)" % stored.stype)
                 continue
             target_ctx = vlist[0].context
+            if self._compressor is not None and len(vlist) > 1:
+                # compress each device's contribution before the
+                # cross-device aggregate (reference: CommDevice applies
+                # GradientCompression to the p2p reduce payloads); the
+                # error-feedback residual is per (key, device slot)
+                vlist = [self._dequant((k, i), v) for i, v in
+                         enumerate(vlist)]
             reduced = vlist[0]
             for v in vlist[1:]:
                 reduced = reduced + v.as_in_context(target_ctx)
@@ -182,9 +190,17 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        import warnings
-        warnings.warn("gradient compression is a no-op in the single "
-                      "process kvstore (bf16 comms cover the use case)")
+        """2-bit compression with error feedback applied to the
+        cross-device reduce payloads (reference:
+        ``KVStoreLocal::SetGradientCompression``)."""
+        from ..parallel.compression import create_compressor
+        self._compressor = create_compressor(compression_params)
+
+    def _dequant(self, slot, v):
+        payload, shape, dtype = self._compressor.compress(
+            slot, v.asnumpy())
+        arr = self._compressor.decompress(payload, shape, dtype)
+        return nd.array(arr, ctx=v.context)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

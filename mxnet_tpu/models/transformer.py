@@ -434,9 +434,23 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
         mlm = tok_loss.sum() / jnp.maximum(valid.sum(), 1)
         return mlm + cfg.moe_aux_weight * aux
 
+    grad_shardings = (param_shardings(cfg, mesh)
+                      if mesh is not None and mesh.size > 1 else None)
+
     def step(state, batch, rng):
         params, opt_state = state
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        if grad_shardings is not None:
+            # pin grads to the params' own sharding before the update.
+            # Without this, grads reach tx.update with whatever partial
+            # sharding GSPMD propagated out of the backward (e.g. a pp
+            # dim from the pipeline shard_map), and the transition to
+            # the ZeRO-1 dp-sharded moments triggers "Involuntary full
+            # rematerialization" (replicate-then-reshard).  An explicit
+            # all-gather here is the same data movement without the
+            # wasted remat.
+            grads = jax.lax.with_sharding_constraint(grads,
+                                                     grad_shardings)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss
@@ -447,17 +461,20 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
         # committed to a trivial (1-device) mesh route execution through
         # the SPMD-partitioned path, which measured 130x slower on the
         # tunneled chip here (docs/perf.md "Methodology")
-        if mesh is not None and mesh.size > 1:
-            shardings = param_shardings(cfg, mesh)
+        shardings = grad_shardings      # same tree, same guard
+        if shardings is not None:
             params = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, s), params, shardings)
         if shard_optimizer and mesh is not None \
                 and "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
             # materialize the moments directly into their shards —
             # init-then-reshard would peak at full replicated size,
-            # defeating the reason to enable ZeRO-1
+            # defeating the reason to enable ZeRO-1.  Pass the param
+            # shardings so dp composes with tp instead of fighting it
+            # (see zero1_sharding).
             from ..parallel.mesh import init_sharded_opt_state
-            opt_state = init_sharded_opt_state(tx, params, mesh)
+            opt_state = init_sharded_opt_state(
+                tx, params, mesh, param_shardings=shardings)
         else:
             opt_state = tx.init(params)
         return (params, opt_state)

@@ -1,0 +1,111 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: ``src/kvstore/gradient_compression.cc`` (SURVEY.md §2.1 KVStore
+row, §2.4 "Gradient compression"): each gradient element is quantized to
+one of {-threshold, 0, +threshold} encoded in 2 bits (16x smaller wire
+payload than f32), and the quantization error is kept in a worker-local
+*residual* that is added to the next round's gradient — so the error
+feeds back instead of being lost, and the long-run sum of decompressed
+gradients tracks the true sum.
+
+TPU-native split: the multi-chip THROUGHPUT path (GSPMD psum over ICI)
+never sees this code — on-chip interconnect does not want host round
+trips.  Compression applies to the *host-side wire paths* that mirror the
+reference's use of it: the TCP parameter server (``parallel/dist.py``)
+and the local kvstore's cross-device aggregate (``kvstore/kvstore.py``),
+where payloads actually traverse host memory / sockets.
+
+Codes: ``0b00`` → 0, ``0b01`` → +threshold, ``0b10`` → -threshold,
+packed four-per-byte little-end-first (matching the reference's
+quantize_2bit kernel layout of 16 values per int32 word).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["TwoBitCompressor", "create_compressor"]
+
+
+class TwoBitCompressor:
+    """Stateful 2-bit quantizer (state = per-key error-feedback residual).
+
+    One instance lives on each *sender* (worker); the receiver only needs
+    the stateless :meth:`decompress`.
+    """
+
+    def __init__(self, threshold: float = 0.5):
+        if threshold <= 0:
+            raise ValueError("threshold must be positive, got %r"
+                             % (threshold,))
+        self.threshold = float(threshold)
+        self._residual: Dict[object, np.ndarray] = {}
+
+    # -- sender -----------------------------------------------------------
+
+    def compress(self, key, grad: np.ndarray) -> Tuple[bytes, tuple, str]:
+        """grad → (packed 2-bit codes, shape, dtype-name).
+
+        Adds the stored residual first, then quantizes and keeps the new
+        residual (reference: ``Quantize2BitKernel`` + the error-feedback
+        buffer held in ``GradientCompression``).
+        """
+        grad = np.asarray(grad)
+        flat = grad.astype(np.float32).ravel()
+        res = self._residual.get(key)
+        if res is None or res.shape != flat.shape:
+            res = np.zeros_like(flat)
+        adj = flat + res
+        t = self.threshold
+        codes = np.zeros(flat.shape, dtype=np.uint8)
+        codes[adj >= t] = 1
+        codes[adj <= -t] = 2
+        deq = np.where(codes == 1, t, 0.0) + np.where(codes == 2, -t, 0.0)
+        self._residual[key] = adj - deq.astype(np.float32)
+        pad = (-len(codes)) % 4
+        if pad:
+            codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+        c = codes.reshape(-1, 4)
+        packed = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4)
+                  | (c[:, 3] << 6)).astype(np.uint8)
+        return packed.tobytes(), grad.shape, str(grad.dtype)
+
+    # -- receiver ---------------------------------------------------------
+
+    def decompress(self, payload: bytes, shape: tuple,
+                   dtype: str = "float32") -> np.ndarray:
+        return decompress(payload, shape, self.threshold, dtype)
+
+
+def decompress(payload: bytes, shape: tuple, threshold: float,
+               dtype: str = "float32") -> np.ndarray:
+    """Stateless unpack — all a receiver needs (no residual lives on the
+    server side)."""
+    packed = np.frombuffer(payload, dtype=np.uint8)
+    n = int(np.prod(shape)) if shape else 1
+    codes = np.empty((len(packed), 4), dtype=np.uint8)
+    codes[:, 0] = packed & 0x3
+    codes[:, 1] = (packed >> 2) & 0x3
+    codes[:, 2] = (packed >> 4) & 0x3
+    codes[:, 3] = (packed >> 6) & 0x3
+    codes = codes.ravel()[:n]
+    t = threshold
+    out = np.where(codes == 1, t, 0.0) + np.where(codes == 2, -t, 0.0)
+    return out.astype(dtype).reshape(shape)
+
+
+def create_compressor(params) -> TwoBitCompressor:
+    """``set_gradient_compression`` params → compressor (reference:
+    ``GradientCompression::SetParams``; only type='2bit' exists there
+    too)."""
+    params = dict(params or {})
+    ctype = params.pop("type", "2bit")
+    if ctype != "2bit":
+        raise ValueError("unsupported gradient compression type %r "
+                         "(the reference supports '2bit' only)" % ctype)
+    threshold = float(params.pop("threshold", 0.5))
+    if params:
+        raise ValueError("unknown gradient compression params %r"
+                         % (sorted(params),))
+    return TwoBitCompressor(threshold)

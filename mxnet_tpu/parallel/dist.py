@@ -17,8 +17,11 @@ TPU-native split of responsibilities:
   distributed scripts and the §4.5-style multi-process tests run
   unchanged.  Like ps-lite it uses ``DMLC_*`` env vars for rendezvous.
 
-Protocol: length-prefixed pickled (cmd, key, payload) messages; one server
-process (the reference shards keys over servers — noted extension).
+Protocol: length-prefixed pickled (cmd, key, payload) messages.  Keys are
+sharded over ``DMLC_NUM_SERVER`` server processes by stable hash (server
+``i`` listens on ``DMLC_PS_ROOT_PORT + i``) — the reference's ps-lite
+key-range partitioning.  Optional 2-bit gradient compression with error
+feedback rides the push wire path (``parallel/compression.py``).
 """
 from __future__ import annotations
 
@@ -83,9 +86,17 @@ class DistServer:
     """
 
     def __init__(self, host="127.0.0.1", port=0, num_workers=1,
-                 sync_mode=True):
+                 sync_mode=True, exit_on_idle=False):
         self.num_workers = num_workers
         self.sync_mode = sync_mode
+        # exit_on_idle: shut down once every worker has connected and all
+        # connections have closed again (worker processes exited).  Used
+        # by run_server under the mpi/slurm launcher, where no tracker
+        # process exists to SIGTERM the server ranks — without it mpirun
+        # would block forever on the immortal servers.
+        self.exit_on_idle = exit_on_idle
+        self._conn_seen = 0
+        self._conn_active = 0
         self.store: Dict[object, np.ndarray] = {}
         self._pending: Dict[object, list] = {}
         self._push_count: Dict[object, int] = {}
@@ -120,6 +131,13 @@ class DistServer:
 
     def shutdown(self):
         self._stop = True
+        # close() alone does not wake a thread blocked in accept() on
+        # Linux — shutdown the listening socket first (wakes accept with
+        # an error), then close
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -144,6 +162,20 @@ class DistServer:
         self._version[key] = self._version.get(key, 0) + 1
 
     def _handle(self, conn):
+        with self._cv:
+            self._conn_seen += 1
+            self._conn_active += 1
+        try:
+            self._handle_loop(conn)
+        finally:
+            with self._cv:
+                self._conn_active -= 1
+                idle = (self.exit_on_idle and self._conn_active == 0
+                        and self._conn_seen >= self.num_workers)
+            if idle:
+                self.shutdown()
+
+    def _handle_loop(self, conn):
         while True:
             msg = _recv(conn)
             if msg is None:
@@ -157,13 +189,19 @@ class DistServer:
                         self._version[key] = 1
                     self._cv.notify_all()
                 _send(conn, ("ok",))
-            elif cmd == "push":
+            elif cmd in ("push", "cpush"):
                 # (cmd, key, value, rank, round): sync aggregation is
                 # per-(key, round) keyed by worker rank, so a fast worker
                 # pushing round N+1 before a slow worker finishes round N
                 # cannot be double-counted into N (reference: ps-lite
                 # timestamps serve the same purpose)
-                _, key, value, rank, rnd = msg
+                if cmd == "cpush":
+                    # 2-bit compressed push: payload is packed codes
+                    _, key, (payload, shape, dtype, thr), rank, rnd = msg
+                    from .compression import decompress
+                    value = decompress(payload, shape, thr, dtype)
+                else:
+                    _, key, value, rank, rnd = msg
                 value = np.asarray(value)
                 with self._cv:
                     if self.sync_mode:
@@ -224,13 +262,16 @@ def run_server():
     Server ``i`` listens on ``DMLC_PS_ROOT_PORT + i`` (all servers co-locate
     with the root URI host; keys are sharded over them by stable hash —
     reference: ps-lite key-range sharding over server nodes)."""
-    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
     nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
-    server = DistServer(host=host, port=port + sid, num_workers=nworkers,
-                        sync_mode=sync)
+    # bind all interfaces: under the mpi launcher the server rank may land
+    # on any node, and workers reach it via DMLC_PS_ROOT_URI — binding the
+    # root URI here would EADDRNOTAVAIL on a different host
+    server = DistServer(host="0.0.0.0", port=port + sid,
+                        num_workers=nworkers, sync_mode=sync,
+                        exit_on_idle=True)
     server.serve_forever()
 
 
@@ -279,6 +320,7 @@ class DistKVStore:
         self._lock = threading.Lock()
         self._pull_version: Dict[object, int] = {}
         self._push_round: Dict[object, int] = {}
+        self._compressor = None
 
     # -- api --------------------------------------------------------------
 
@@ -329,8 +371,16 @@ class DistKVStore:
                 reduced = reduced + v
             rnd = self._push_round.get(k, 0)
             self._push_round[k] = rnd + 1
-            self._rpc("push", k, _to_numpy(reduced), self._rank, rnd,
-                      key=k)
+            if self._compressor is not None:
+                payload, shape, dtype = self._compressor.compress(
+                    k, _to_numpy(reduced))
+                self._rpc("cpush", k,
+                          (payload, shape, dtype,
+                           self._compressor.threshold),
+                          self._rank, rnd, key=k)
+            else:
+                self._rpc("push", k, _to_numpy(reduced), self._rank, rnd,
+                          key=k)
             if self._sync:
                 # one aggregate-update per round of pushes
                 self._pull_version[k] = \
@@ -374,9 +424,11 @@ class DistKVStore:
         self.barrier()
 
     def set_gradient_compression(self, compression_params):
-        import warnings
-        warnings.warn("gradient compression not applied on the TCP "
-                      "parity path (bf16 comms cover the TPU use case)")
+        """Enable 2-bit compression with error feedback on the push wire
+        path (reference: ``KVStore::SetGradientCompression`` →
+        ``gradient_compression.cc``)."""
+        from .compression import create_compressor
+        self._compressor = create_compressor(compression_params)
 
     def barrier(self):
         self._rpc_all("barrier")

@@ -95,26 +95,58 @@ def live_axis(mesh, name):
     return name if mesh.shape[name] > 1 else None
 
 
-def zero1_sharding(leaf, mesh, axis="dp"):
-    """ZeRO-1 placement for one optimizer-state leaf: shard over the
-    data axis on the leading dim when it divides; small/indivisible
-    leaves replicate (SURVEY.md §2.4 — the PS server-side optimizer
-    update)."""
+def zero1_sharding(leaf, mesh, axis="dp", base=None):
+    """ZeRO-1 placement for one optimizer-state leaf: COMPOSE the data
+    axis onto the param's own sharding (SURVEY.md §2.4 — the PS
+    server-side optimizer update).
+
+    ``base`` is the param's PartitionSpec/NamedSharding (tp etc.).  The
+    dp axis is added on the first dimension the base leaves free and
+    that divides — keeping the tp entries intact.  Dropping them (the
+    round-1 design, P(dp, None, ...)) forced GSPMD into "Involuntary
+    full rematerialization" on every gradient all-reduce: the grads
+    arrive tp-sharded and the tp→dp transition has no efficient
+    collective.  With the composed spec the transition is a plain
+    reduce-scatter on the free dim.  Leaves where no dim divides keep
+    the base sharding (replicated over dp — no ZeRO for that leaf, but
+    no reshard either)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if hasattr(base, "spec"):
+        base = base.spec
+    ndim = getattr(leaf, "ndim", 0)
+    entries = list(base) if base is not None else []
+    entries = entries[:ndim] + [None] * (ndim - len(entries))
     n = mesh.shape[axis]
-    if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
-            and leaf.shape[0] % n == 0 and leaf.shape[0] > 0:
-        return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
-    return NamedSharding(mesh, P())
+    for i in range(ndim):
+        if entries[i] is None and leaf.shape[i] > 0 \
+                and leaf.shape[i] % n == 0:
+            entries[i] = axis
+            break
+    return NamedSharding(mesh, P(*entries))
 
 
-def init_sharded_opt_state(tx, params, mesh, axis="dp"):
+def init_sharded_opt_state(tx, params, mesh, axis="dp",
+                           param_shardings=None):
     """Initialize an optax state directly INTO its ZeRO-1 shards —
     init-then-reshard would peak at full replicated size, defeating the
-    reason to shard."""
+    reason to shard.  ``param_shardings`` (a tree aligned with
+    ``params``) lets param-shaped state leaves compose dp with the
+    param's own tp/sp sharding; non-param leaves (step counts)
+    replicate."""
     import jax
-    placements = jax.tree_util.tree_map(
-        lambda l: zero1_sharding(l, mesh, axis=axis),
-        jax.eval_shape(tx.init, params))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+    if param_shardings is None:
+        placements = jax.tree_util.tree_map(
+            lambda l: zero1_sharding(l, mesh, axis=axis), shapes)
+    else:
+        import optax
+        rep = NamedSharding(mesh, P())
+        placements = optax.tree_map_params(
+            tx,
+            lambda l, s: zero1_sharding(l, mesh, axis=axis, base=s),
+            shapes, param_shardings,
+            transform_non_params=lambda l: rep)
     return jax.jit(tx.init, out_shardings=placements)(params)

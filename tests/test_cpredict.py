@@ -68,6 +68,7 @@ def _export_mlp(tmp_path):
     return o, params, json_path, params_path
 
 
+@pytest.mark.slow
 def test_cpp_predictor_matches_python(tmp_path, predict_lib):
     s, params, json_path, params_path = _export_mlp(tmp_path)
 

@@ -45,6 +45,7 @@ def _net(classes=3):
     return net
 
 
+@pytest.mark.slow
 def test_estimator_fit_improves_accuracy():
     X, y = _dataset()
     net = _net()

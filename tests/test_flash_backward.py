@@ -24,6 +24,7 @@ def _interpret_mode():
     (2, 384, 2, 128, True, True),
     (1, 128, 8, 64, False, True),
 ])
+@pytest.mark.slow
 def test_flash_grads_match_reference(B, T, H, D, causal, use_mask):
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
     q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)

@@ -14,6 +14,7 @@ def _cfg(**kw):
     return gpt.gpt_tiny(**base)
 
 
+@pytest.mark.slow
 def test_causal_mask_blocks_future():
     """Changing a future token must not change past logits."""
     import jax
@@ -37,6 +38,7 @@ def test_causal_mask_blocks_future():
     assert np.abs(np.asarray(l1[:, 0]) - np.asarray(l2[:, 0])).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_lm_training_learns():
     """Next-token loss must fall on a deterministic sequence."""
     import jax
@@ -57,6 +59,7 @@ def test_lm_training_learns():
     assert losses[-1] < losses[0] * 0.6, losses
 
 
+@pytest.mark.slow
 def test_generate_matches_full_forward():
     """Greedy KV-cache decoding must pick the same tokens as greedy
     decoding via the full (re-run) forward pass."""
@@ -94,6 +97,7 @@ def test_generate_respects_max_len():
         gpt.generate(params, cfg, prompt, 10)
 
 
+@pytest.mark.slow
 def test_gpt_train_step_sharded():
     """LM train step over a dp x tp mesh."""
     import jax

@@ -274,6 +274,7 @@ class TestSearchLogic:
                       onp.bincount([0, 1, 1, 3]))
 
 
+@pytest.mark.slow
 def test_np_statistics_and_misc_extensions():
     """percentile/quantile/cov/histogram/broadcast_arrays/column_stack/
     digitize/diff/trapz/ediff1d coverage."""

@@ -10,6 +10,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu import test_utils as tu
 
+pytestmark = pytest.mark.slow
+
 # (name, fn(*NDArrays) -> NDArray, input shapes, kwargs for data gen)
 CASES = [
     ("relu", lambda a: nd.relu(a), [(3, 4)], {}),

@@ -1,6 +1,7 @@
 """Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4.5:
 distributed tests without a real cluster)."""
 import numpy as np
+import os
 import pytest
 
 import mxnet_tpu as mx
@@ -60,6 +61,7 @@ def test_data_parallel_trainer_matches_single_device():
         (w_ref, w_dp)
 
 
+@pytest.mark.slow
 def test_transformer_train_step_dp_tp():
     """Full transformer step over dp x tp mesh compiles and decreases
     loss."""
@@ -111,6 +113,7 @@ def test_kvstore_multi_device_contexts():
     assert np.allclose(out.asnumpy(), 1 + 2 + 3 + 4)
 
 
+@pytest.mark.slow
 def test_data_parallel_amp_learns():
     """amp=True (bf16 compute, f32 master) still converges."""
     import numpy as np
@@ -134,6 +137,7 @@ def test_data_parallel_amp_learns():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow
 def test_data_parallel_bn_stats_update():
     """BatchNorm running stats must survive the jitted train step (the
     mutate=(3,4) contract carries through to the trainer state)."""
@@ -180,6 +184,7 @@ def test_multihost_single_process():
     assert not multihost.is_initialized()
 
 
+@pytest.mark.slow
 def test_data_parallel_zero1_matches():
     """DataParallelTrainer(shard_optimizer=True) trains identically."""
     import jax
@@ -272,3 +277,25 @@ def test_run_steps_matches_python_loop():
                        net_r2.weight.data().asnumpy(),
                        rtol=1e-5, atol=1e-6)
     tr2.sync()  # exercises the hard sync path
+
+
+@pytest.mark.slow
+def test_multichip_dryrun_no_involuntary_remat():
+    """The full multi-chip dryrun (dp/sp/tp, pp/dp, dp/ep/tp meshes with
+    ZeRO-1) must compile without SPMD 'Involuntary full
+    rematerialization' — those replicate-then-reshard transitions are
+    what kills scaling on real hardware (round-1 verdict item #2).
+    Subprocess because the warning is emitted by XLA C++ on stderr."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"),
+         "multichip", "8"],
+        capture_output=True, text=True, timeout=500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert r.stdout.count("loss") == 3, r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, \
+        r.stderr[-3000:]

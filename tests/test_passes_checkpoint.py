@@ -124,6 +124,7 @@ def test_unknown_pass_raises():
 # sharded checkpoint
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_sharded_checkpoint_roundtrip(tmp_path):
     import jax
     import jax.numpy as jnp

@@ -7,6 +7,8 @@ import pytest
 
 import mxnet_tpu as mx
 
+pytestmark = pytest.mark.slow
+
 
 def _cfg(**kw):
     from mxnet_tpu.models import transformer as T

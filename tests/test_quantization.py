@@ -107,6 +107,7 @@ def _small_mlp_symbol():
 
 
 @pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+@pytest.mark.slow
 def test_quantize_model_end_to_end(calib_mode):
     np.random.seed(4)
     sym = _small_mlp_symbol()

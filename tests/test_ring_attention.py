@@ -5,6 +5,8 @@ import pytest
 
 import mxnet_tpu  # noqa: F401  (jax config via conftest)
 
+pytestmark = pytest.mark.slow
+
 
 def _ref_attention(q, k, v, mask, causal=False):
     import jax

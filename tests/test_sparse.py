@@ -105,6 +105,7 @@ class TestCSR:
         np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5,
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_dot_csr_T_dense_is_row_sparse(self):
         d = dense_rand((7, 9), seed=6)
         rhs = np.random.RandomState(7).randn(7, 4).astype(np.float32)

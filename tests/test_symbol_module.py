@@ -117,6 +117,7 @@ def test_batchnorm_aux_update():
     tu.assert_almost_equal(before, after)
 
 
+@pytest.mark.slow
 def test_module_fit_convergence():
     """MNIST-scale convergence test (SURVEY.md §4.4): linearly separable
     blobs must reach high train accuracy in a few epochs."""

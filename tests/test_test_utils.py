@@ -32,6 +32,7 @@ def test_check_numeric_gradient_dot():
         [np.random.randn(3, 4), np.random.randn(4, 2)])
 
 
+@pytest.mark.slow
 def test_check_numeric_gradient_catches_wrong_grad():
     # exp's gradient is exp(x); sqrt(x)'s is not — a deliberately wrong
     # pairing must FAIL the oracle.

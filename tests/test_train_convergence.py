@@ -12,6 +12,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
 from mxnet_tpu.gluon import nn
 
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

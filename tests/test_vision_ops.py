@@ -7,6 +7,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd, autograd
 
+pytestmark = pytest.mark.slow
+
 
 def np_iou(a, b):
     lt = np.maximum(a[:, None, :2], b[None, :, :2])
@@ -79,6 +81,7 @@ def test_multibox_prior():
     assert np.isclose(a0[2] - a0[0], 0.5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_multibox_target_assigns():
     anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
                          [0.5, 0.5, 1.0, 1.0],
@@ -98,6 +101,7 @@ def test_multibox_target_assigns():
     assert np.allclose(loc_t.asnumpy()[0].reshape(3, 4)[0], 0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_multibox_detection_roundtrip():
     anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
                          [0.6, 0.6, 0.9, 0.9]]], dtype="float32")

@@ -7,10 +7,13 @@ script.py`` forks scheduler/servers/workers as local processes with
 ``DMLC_*`` env — real transport, fake topology).
 
 Supported launchers: ``local`` (fork all roles on this host — the test
-topology) and ``ssh`` (one worker per host from a hostfile; each host gets
-the same DMLC_* rendezvous env).  On TPU pods the heavy data path is XLA
-collectives over ICI/DCN inside each worker; this launcher only provides
-role/rendezvous plumbing, like the reference's tracker.
+topology), ``ssh`` (one worker per host from a hostfile; each host gets
+the same DMLC_* rendezvous env), ``mpi`` (delegate process placement to
+``mpirun``; ranks derive their DMLC role from ``OMPI_COMM_WORLD_RANK``),
+and ``slurm`` (same via ``srun``/``SLURM_PROCID``).  On TPU pods the
+heavy data path is XLA collectives over ICI/DCN inside each worker; this
+launcher only provides role/rendezvous plumbing, like the reference's
+tracker (``dmlc_tracker/{local,ssh,mpi,slurm}.py``).
 """
 from __future__ import annotations
 
@@ -107,11 +110,63 @@ def launch_ssh(args, command):
     return code
 
 
+_ROLE_SHIM = (
+    "import os,sys,subprocess;"
+    "r=int(os.environ.get('OMPI_COMM_WORLD_RANK',"
+    "os.environ.get('PMI_RANK',os.environ.get('SLURM_PROCID','0'))));"
+    "ns=int(os.environ['DMLC_NUM_SERVER']);"
+    "os.environ.update({'DMLC_ROLE':'server','DMLC_SERVER_ID':str(r)}"
+    " if r<ns else"
+    " {'DMLC_ROLE':'worker','DMLC_WORKER_ID':str(r-ns)});"
+    "sys.exit(subprocess.call(sys.argv[1:])"
+    " if r>=ns else"
+    " __import__('mxnet_tpu.parallel.dist',fromlist=['run_server'])"
+    ".run_server())"
+)
+
+
+def launch_mpi(args, command, runner=None):
+    """mpirun/srun launcher (reference: ``dmlc_tracker/mpi.py`` /
+    ``slurm.py``).  Spawns num_servers + num_workers ranks; each rank
+    derives its DMLC role from its MPI/slurm rank via a tiny shim —
+    ranks [0, ns) are servers, the rest workers.  Caveats for multi-node
+    allocations: server ranks bind 0.0.0.0 (any node), but
+    DMLC_PS_ROOT_URI must name the node where the scheduler places ranks
+    [0, ns) — export it before launching (the default, this node's
+    hostname, is only right when servers land here).  ``-H/--hostfile``
+    is not consulted; placement belongs to mpirun/srun."""
+    nproc = args.num_workers + args.num_servers
+    port = args.port or 9091
+    root = os.environ.get("DMLC_PS_ROOT_URI", socket.gethostname())
+    env = {
+        "DMLC_PS_ROOT_URI": root,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    }
+    if runner is None:
+        runner = "srun" if args.launcher == "slurm" else "mpirun"
+    # env rides subprocess.call(env=...), which mpirun/srun forward to
+    # the ranks — no launcher-specific -x/--export flags (OpenMPI's -x
+    # is fatal to MPICH/Intel mpirun, and the shim supports those via
+    # PMI_RANK)
+    cmd = [runner, "-n", str(nproc), sys.executable, "-c", _ROLE_SHIM] \
+        + list(command)
+    try:
+        return subprocess.call(cmd, env={**os.environ, **env})
+    except FileNotFoundError:
+        sys.stderr.write(
+            "%s not found on PATH; the equivalent command is:\n  %s\n"
+            % (runner, " ".join(cmd)))
+        return 127
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
-    ap.add_argument("--launcher", choices=["local", "ssh"],
+    ap.add_argument("--launcher", choices=["local", "ssh", "mpi",
+                                           "slurm"],
                     default="local")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("-p", "--port", type=int, default=None)
@@ -121,6 +176,8 @@ def main():
         ap.error("no command given")
     if args.launcher == "local":
         sys.exit(launch_local(args, args.command))
+    if args.launcher in ("mpi", "slurm"):
+        sys.exit(launch_mpi(args, args.command))
     sys.exit(launch_ssh(args, args.command))
 
 
