@@ -19,4 +19,6 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn_op        # noqa: F401
 from . import quantization  # noqa: F401
 from . import vision        # noqa: F401
+from . import vision_ext    # noqa: F401
+from . import contrib_misc  # noqa: F401
 from .. import operator     # noqa: F401  (registers the "Custom" op)

@@ -1,0 +1,210 @@
+"""Contrib long-tail operators: interleaved attention matmuls, masked
+softmax variants, count-sketch, and small utility ops.
+
+Reference: ``src/operator/contrib/transformer.cc`` (interleaved_matmul_*
+— the GluonNLP fused-attention entry points), ``krprod.cc``,
+``count_sketch.cc``, ``quadratic_op.cc``, ``gradient_multiplier_op.cc``,
+``allclose_op.cc`` — SURVEY.md §2.1 operator library (contrib rows).
+
+TPU-native notes: the interleaved matmuls exist upstream to hit cuBLAS
+strided-batch gemm; here they are einsum contractions, which XLA maps
+straight onto the MXU — the op surface is kept for GluonNLP script
+parity, while flash attention (``kernels/flash_attention.py``) remains
+the recommended long-sequence path."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _j():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# interleaved attention matmuls (GluonNLP fused transformer ops)
+# ---------------------------------------------------------------------------
+
+def _split_qkv_interleaved(qkv, heads, parts):
+    """(L, B, H*parts*dh) interleaved per head → tuple of (B*H, L, dh)."""
+    jnp = _j()
+    L, B, D = qkv.shape
+    dh = D // (heads * parts)
+    x = qkv.reshape(L, B, heads, parts, dh)
+    outs = []
+    for p in range(parts):
+        t = x[:, :, :, p]                       # (L, B, H, dh)
+        outs.append(t.transpose(1, 2, 0, 3).reshape(B * heads, L, dh))
+    return tuple(outs)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kw):
+    """(L, B, H*3*dh) interleaved qkv → attention scores (B*H, L, L),
+    scaled by 1/sqrt(dh) like the reference gemm alpha."""
+    jnp = _j()
+    q, k, _ = _split_qkv_interleaved(queries_keys_values, int(heads), 3)
+    scale = 1.0 / _np.sqrt(q.shape[-1])
+    return jnp.einsum("nld,nmd->nlm", q * scale, k)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1, **kw):
+    """((L, B, H*3*dh), (B*H, L, L)) → context (L, B, H*dh)."""
+    jnp = _j()
+    heads = int(heads)
+    _, _, v = _split_qkv_interleaved(queries_keys_values, heads, 3)
+    ctx = jnp.einsum("nlm,nmd->nld", attention, v)   # (B*H, L, dh)
+    BH, L, dh = ctx.shape
+    B = BH // heads
+    return ctx.reshape(B, heads, L, dh).transpose(2, 0, 1, 3) \
+        .reshape(L, B, heads * dh)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1, **kw):
+    """q (Lq, B, H*dh) + interleaved kv (Lk, B, H*2*dh) →
+    (B*H, Lq, Lk)."""
+    jnp = _j()
+    heads = int(heads)
+    Lq, B, D = queries.shape
+    dh = D // heads
+    q = queries.reshape(Lq, B, heads, dh).transpose(1, 2, 0, 3) \
+        .reshape(B * heads, Lq, dh)
+    k, _ = _split_qkv_interleaved(keys_values, heads, 2)
+    scale = 1.0 / _np.sqrt(dh)
+    return jnp.einsum("nld,nmd->nlm", q * scale, k)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1,
+                                     **kw):
+    """(interleaved kv (Lk, B, H*2*dh), att (B*H, Lq, Lk)) →
+    (Lq, B, H*dh)."""
+    jnp = _j()
+    heads = int(heads)
+    _, v = _split_qkv_interleaved(keys_values, heads, 2)
+    ctx = jnp.einsum("nlm,nmd->nld", attention, v)
+    BH, Lq, dh = ctx.shape
+    B = BH // heads
+    return ctx.reshape(B, heads, Lq, dh).transpose(2, 0, 1, 3) \
+        .reshape(Lq, B, heads * dh)
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data, **kw):
+    """data / sqrt(last_dim) (reference: transformer.cc DivSqrtDim)."""
+    return data / _np.sqrt(data.shape[-1])
+
+
+@register("masked_log_softmax")
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    """log_softmax over unmasked positions; masked positions get -inf
+    (reference: masked_log_softmax in softmax op family)."""
+    import jax
+    jnp = _j()
+    neg = jnp.finfo(data.dtype).min
+    x = jnp.where(mask.astype(bool), data / temperature, neg)
+    out = jax.nn.log_softmax(x, axis=axis)
+    return jnp.where(mask.astype(bool), out, -jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# small contrib utilities
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """a*x^2 + b*x + c (reference: quadratic_op.cc — the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+def _grad_mult_vjp():
+    import jax
+    from functools import partial
+
+    @partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def fn(data, scalar):
+        return data
+
+    def fwd(data, scalar):
+        return data, None
+
+    def bwd(scalar, _, g):
+        return (g * scalar,)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+_GRAD_MULT = None
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0, **kw):
+    """Identity forward; backward scales the gradient by ``scalar``
+    (reference: gradient_multiplier_op.cc — gradient-reversal trick when
+    scalar < 0)."""
+    global _GRAD_MULT
+    if _GRAD_MULT is None:
+        _GRAD_MULT = _grad_mult_vjp()
+    return _GRAD_MULT(data, float(scalar))
+
+
+@register("_contrib_allclose", aliases=("allclose",), no_grad=True)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False, **kw):
+    jnp = _j()
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype("float32")
+
+
+@register("_contrib_getnnz", aliases=("getnnz",), no_grad=True)
+def getnnz(data, axis=None, **kw):
+    jnp = _j()
+    return jnp.count_nonzero(data, axis=axis).astype("int64")
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",),
+          no_grad=True)
+def count_sketch(data, h, s, out_dim=1, **kw):
+    """Count sketch projection (reference: count_sketch.cc): out[n, h[i]]
+    += s[i] * data[n, i] — a random feature hash, expressed as a
+    segment-sum so XLA lowers it to one scatter-add."""
+    import jax
+    jnp = _j()
+    out_dim = int(out_dim)
+    idx = h.astype("int32").ravel()
+    sign = s.ravel()
+
+    def one(row):
+        return jax.ops.segment_sum(row * sign, idx,
+                                   num_segments=out_dim)
+
+    flat = data.reshape(-1, data.shape[-1])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape[:-1] + (out_dim,))
+
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",),
+          mutate=(3, 4), training_aware=True)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key=None,
+                    _training=False, **kw):
+    """Cross-device synchronized BatchNorm (reference:
+    ``contrib/sync_batch_norm.cc``).
+
+    TPU-native: under pjit with the batch sharded over ``dp``, the mean/
+    var reductions in BatchNorm are GLOBAL-batch reductions already —
+    GSPMD inserts the psum that the reference implemented by hand with
+    a cross-GPU key-value barrier.  So the op is the standard BatchNorm
+    kernel; ``ndev``/``key`` are accepted for script parity."""
+    from .nn import batch_norm
+    return batch_norm(data, gamma, beta, moving_mean, moving_var,
+                      eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                      use_global_stats=use_global_stats,
+                      output_mean_var=output_mean_var, axis=1,
+                      _training=_training)

@@ -388,3 +388,69 @@ def reset_arrays(data, num_arrays=1, **kw):
     accumulation windows)."""
     jnp = _j()
     return tuple(jnp.zeros_like(a) for a in data[:num_arrays])
+
+
+@register("multi_mp_sgd_update", variadic=True, num_outputs=-1,
+          mutate=lambda attrs: tuple(
+              3 * i + 2 for i in range(attrs.get("num_weights", 1))))
+def multi_mp_sgd_update(data, lrs=None, wds=None, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1, **kw):
+    """Grouped multi-precision SGD: per weight the triple is
+    (weight16, grad16, weight32 master) — reference:
+    ``optimizer_op.cc multi_mp_sgd_update``."""
+    outs, masters = [], []
+    for i in range(num_weights):
+        w, g, w32 = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        nw, nw32 = mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        outs.append(nw)
+        masters.append(nw32)
+    return tuple(outs) + tuple(masters)
+
+
+@register("multi_mp_sgd_mom_update", variadic=True, num_outputs=-1,
+          mutate=lambda attrs: tuple(
+              v for i in range(attrs.get("num_weights", 1))
+              for v in (4 * i + 2, 4 * i + 3)))
+def multi_mp_sgd_mom_update(data, lrs=None, wds=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1, **kw):
+    """Grouped multi-precision momentum SGD: quadruples of
+    (weight16, grad16, momentum32, weight32)."""
+    outs, moms, masters = [], [], []
+    for i in range(num_weights):
+        w, g, m, w32 = (data[4 * i], data[4 * i + 1], data[4 * i + 2],
+                        data[4 * i + 3])
+        nw, nm, nw32 = mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.append(nw)
+        moms.append(nm)
+        masters.append(nw32)
+    out = list(outs)
+    for nm, nw32 in zip(moms, masters):
+        out += [nm, nw32]
+    return tuple(out)
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=("group_adagrad_update",), mutate=(2,))
+def group_adagrad_update(weight, grad, history, lr=0.01,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         epsilon=1e-5, **kw):
+    """Group AdaGrad (reference: ``contrib/optimizer_op.cc``): history
+    is per-ROW — mean of squared grads over trailing dims — so the
+    state is a vector, not a full weight copy."""
+    jnp = _j()
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if g.ndim > 1:
+        h_new = history + jnp.mean(jnp.square(g),
+                                   axis=tuple(range(1, g.ndim)),
+                                   keepdims=True)
+    else:
+        h_new = history + jnp.square(g)
+    w_new = weight - lr * g / (jnp.sqrt(h_new) + epsilon)
+    return w_new, h_new

@@ -178,3 +178,43 @@ _param_sample(
     "_sample_poisson",
     lambda jax, key, ps, s: jax.random.poisson(
         key, _expand(ps[0], s), s).astype("float32"))
+
+
+_param_sample(
+    "_sample_negative_binomial",
+    lambda jax, key, ps, s: jax.random.poisson(
+        jax.random.split(key)[1],
+        jax.random.gamma(jax.random.split(key)[0], _expand(ps[0], s), s)
+        * (1 - _expand(ps[1], s)) / _expand(ps[1], s)).astype("float32"))
+
+
+def _gnb_sampler(jax, key, ps, s):
+    # GNB(mu, alpha) = Poisson(Gamma(1/alpha, alpha*mu)); alpha->0 is
+    # plain Poisson(mu) (reference: sample_op.cc GeneralizedNegativeBinomial)
+    jnp = _j()
+    k1, k2 = jax.random.split(key)
+    m, a = _expand(ps[0], s), _expand(ps[1], s)
+    safe_a = jnp.maximum(a, 1e-8)
+    lam = jax.random.gamma(k1, 1.0 / safe_a, s) * safe_a * m
+    return jax.random.poisson(k2, jnp.where(a < 1e-8, m, lam)) \
+        .astype("float32")
+
+
+_param_sample("_sample_generalized_negative_binomial", _gnb_sampler)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",),
+          needs_rng=True, no_grad=True)
+def random_generalized_negative_binomial(key, mu=1.0, alpha=1.0,
+                                         shape=(1,), dtype=None, **kw):
+    import jax
+    jnp = _j()
+    if isinstance(shape, int):
+        shape = (shape,)
+    k1, k2 = jax.random.split(key)
+    if alpha < 1e-8:
+        lam = jnp.full(tuple(shape), mu)
+    else:
+        lam = jax.random.gamma(k1, 1.0 / alpha, tuple(shape)) * alpha * mu
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))

@@ -1,0 +1,281 @@
+"""Contrib long-tail ops added in round 2 (reference:
+src/operator/contrib/ — deformable family, RPN proposals, interleaved
+attention matmuls, box codecs, misc utilities)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 4, 8, 8).astype("float32"))
+    w = nd.array(rng.randn(6, 4, 3, 3).astype("float32"))
+    b = nd.array(np.zeros(6, "float32"))
+    off = nd.array(np.zeros((2, 18, 8, 8), "float32"))
+    y1 = nd.DeformableConvolution(x, off, w, b, kernel=(3, 3),
+                                  pad=(1, 1), num_filter=6)
+    y2 = nd.Convolution(x, w, b, kernel=(3, 3), pad=(1, 1), num_filter=6)
+    assert float(nd.max(nd.abs(y1 - y2)).asnumpy()) < 1e-4
+    # unit mask makes DCNv2 match DCNv1
+    m = nd.array(np.ones((2, 9, 8, 8), "float32"))
+    y3 = nd.ModulatedDeformableConvolution(x, off, m, w, b, kernel=(3, 3),
+                                           pad=(1, 1), num_filter=6)
+    assert float(nd.max(nd.abs(y3 - y2)).asnumpy()) < 1e-4
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """An integer offset of (0, +1) everywhere must equal sampling the
+    input shifted one pixel left (for a 1x1 kernel)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = np.ones((2, 2, 1, 1), "float32")
+    off = np.zeros((1, 2, 6, 6), "float32")
+    off[:, 1] = 1.0      # dx = +1
+    y = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                 nd.array(np.zeros(2, "float32")),
+                                 kernel=(1, 1), num_filter=2).asnumpy()
+    shifted = np.zeros_like(x)
+    shifted[..., :-1] = x[..., 1:]          # zero border
+    expect = shifted.sum(axis=1, keepdims=True).repeat(2, axis=1)
+    assert np.allclose(y, expect, atol=1e-5)
+
+
+def test_deformable_conv_gradient_flows():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(1, 2, 5, 5).astype("float32"))
+    off = nd.array((rng.randn(1, 8, 4, 4) * 0.3).astype("float32"))
+    w = nd.array(rng.randn(3, 2, 2, 2).astype("float32"))
+    b = nd.array(np.zeros(3, "float32"))
+    for t in (x, off, w):
+        t.attach_grad()
+    with autograd.record():
+        y = nd.DeformableConvolution(x, off, w, b, kernel=(2, 2),
+                                     num_filter=3)
+        L = (y * y).sum()
+    L.backward()
+    assert float(nd.norm(x.grad).asnumpy()) > 0
+    assert float(nd.norm(off.grad).asnumpy()) > 0
+    assert float(nd.norm(w.grad).asnumpy()) > 0
+
+
+def test_psroi_pooling_reads_position_sensitive_channels():
+    C_out, P = 2, 3
+    data = nd.array(np.tile(
+        np.arange(C_out * P * P, dtype="float32").reshape(1, -1, 1, 1),
+        (1, 1, 10, 10)))
+    rois = nd.array(np.array([[0, 1, 1, 8, 8]], "float32"))
+    out = nd.PSROIPooling(data, rois, spatial_scale=1.0,
+                          output_dim=C_out, pooled_size=P)
+    expect = np.arange(C_out * P * P, dtype="float32") \
+        .reshape(C_out, P, P)
+    assert np.allclose(out.asnumpy()[0], expect)
+
+
+def test_deformable_psroi_no_trans_matches_psroi():
+    rng = np.random.RandomState(3)
+    C_out, P = 2, 2
+    data = nd.array(rng.randn(1, C_out * P * P, 8, 8).astype("float32"))
+    rois = nd.array(np.array([[0, 1, 1, 6, 6]], "float32"))
+    a = nd.PSROIPooling(data, rois, spatial_scale=1.0, output_dim=C_out,
+                        pooled_size=P)
+    b = nd.DeformablePSROIPooling(data, rois, spatial_scale=1.0,
+                                  output_dim=C_out, pooled_size=P,
+                                  group_size=P, no_trans=True)
+    assert np.allclose(a.asnumpy(), b.asnumpy(), atol=1e-5)
+
+
+def test_proposal_shapes_and_batch_ids():
+    rng = np.random.RandomState(4)
+    cls = nd.array(rng.rand(2, 6, 4, 4).astype("float32"))
+    bb = nd.array((rng.randn(2, 12, 4, 4) * 0.1).astype("float32"))
+    info = nd.array(np.array([[64, 64, 1.0]] * 2, "float32"))
+    rois = nd.MultiProposal(cls, bb, info, rpn_pre_nms_top_n=30,
+                            rpn_post_nms_top_n=10, scales=(8,),
+                            ratios=(0.5, 1, 2))
+    assert rois.shape == (20, 5)
+    r = rois.asnumpy()
+    assert set(np.unique(r[:, 0])) == {0.0, 1.0}
+    # boxes are clipped into the image
+    assert r[:, 1:].min() >= 0 and r[:, [1, 3]].max() <= 63
+
+    one = nd.Proposal(cls[0:1], bb[0:1], info[0:1], rpn_pre_nms_top_n=30,
+                      rpn_post_nms_top_n=10, scales=(8,),
+                      ratios=(0.5, 1, 2), output_score=True)
+    assert one[0].shape == (10, 5) and one[1].shape == (10, 1)
+
+
+def test_rroi_align_zero_angle_matches_grid():
+    rng = np.random.RandomState(5)
+    data = nd.array(rng.randn(1, 3, 16, 16).astype("float32"))
+    rr = nd.array(np.array([[0, 8, 8, 8, 8, 0]], "float32"))
+    out = nd.RROIAlign(data, rr, pooled_size=(4, 4), spatial_scale=1.0)
+    assert out.shape == (1, 3, 4, 4)
+    # 90-degree rotation of a square ROI permutes the pooled grid
+    rr90 = nd.array(np.array([[0, 8, 8, 8, 8, 90]], "float32"))
+    out90 = nd.RROIAlign(data, rr90, pooled_size=(4, 4),
+                         spatial_scale=1.0).asnumpy()
+    assert np.allclose(np.rot90(out.asnumpy()[0], k=1, axes=(1, 2)),
+                       out90[0], atol=1e-4)
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = nd.array(np.array([[[10., 10, 20, 20], [30, 30, 50, 50]]],
+                                "float32"))
+    refs = nd.array(np.array([[[12., 11, 22, 21]]], "float32"))
+    samples = nd.array(np.array([[1., 0]], "float32"))
+    matches = nd.array(np.array([[0, 0]], "float32"))
+    t, msk = nd.contrib.box_encode(samples, matches, anchors, refs)
+    assert np.allclose(msk.asnumpy()[0, 1], 0)       # negative sample
+    dec = nd.contrib.box_decode(t, anchors, 0.1, 0.1, 0.2, 0.2)
+    assert np.allclose(dec.asnumpy()[0, 0], [12, 11, 22, 21], atol=1e-3)
+
+
+def test_bipartite_matching_greedy():
+    sc = nd.array(np.array([[[0.9, 0.1], [0.8, 0.7]]], "float32"))
+    r, c = nd.contrib.bipartite_matching(sc, threshold=0.05)
+    assert r.asnumpy().tolist() == [[0.0, 1.0]]
+    assert c.asnumpy().tolist() == [[0.0, 1.0]]
+    # threshold excludes weak pairs
+    r2, c2 = nd.contrib.bipartite_matching(sc, threshold=0.75)
+    assert r2.asnumpy().tolist() == [[0.0, -1.0]]
+
+
+def test_interleaved_matmul_family():
+    rng = np.random.RandomState(6)
+    L, B, H, dh = 6, 2, 4, 8
+    qkv = rng.randn(L, B, H * 3 * dh).astype("float32")
+    att = nd.contrib.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    x = qkv.reshape(L, B, H, 3, dh)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3).reshape(B * H, L, dh)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3).reshape(B * H, L, dh)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(B * H, L, dh)
+    expect = (q / np.sqrt(dh)) @ k.transpose(0, 2, 1)
+    assert np.allclose(att.asnumpy(), expect, atol=1e-5)
+    w = np.exp(expect)
+    w /= w.sum(-1, keepdims=True)
+    ctx = nd.contrib.interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), nd.array(w.astype("float32")), heads=H)
+    expect_ctx = (w @ v).reshape(B, H, L, dh).transpose(2, 0, 1, 3) \
+        .reshape(L, B, H * dh)
+    assert np.allclose(ctx.asnumpy(), expect_ctx, atol=1e-5)
+
+    Lk = 5
+    qq = rng.randn(L, B, H * dh).astype("float32")
+    kv = rng.randn(Lk, B, H * 2 * dh).astype("float32")
+    s = nd.contrib.interleaved_matmul_encdec_qk(nd.array(qq),
+                                                nd.array(kv), heads=H)
+    assert s.shape == (B * H, L, Lk)
+    w2 = np.ones((B * H, L, Lk), "float32") / Lk
+    c2 = nd.contrib.interleaved_matmul_encdec_valatt(
+        nd.array(kv), nd.array(w2), heads=H)
+    # uniform attention == mean of v over Lk
+    v2 = kv.reshape(Lk, B, H, 2, dh)[:, :, :, 1]
+    expect2 = v2.mean(axis=0).reshape(B, H * dh)
+    assert np.allclose(c2.asnumpy()[0], expect2, atol=1e-5)
+
+
+def test_misc_contrib_utilities():
+    d = nd.contrib.div_sqrt_dim(nd.array(np.ones((2, 16), "float32")))
+    assert np.allclose(d.asnumpy(), 0.25)
+
+    m = nd.masked_log_softmax(
+        nd.array(np.array([[1., 2., 3.]], "float32")),
+        nd.array(np.array([[1, 1, 0]], "float32")))
+    mm = m.asnumpy()
+    assert np.isinf(mm[0, 2]) and mm[0, 2] < 0
+    assert np.allclose(np.exp(mm[0, :2]).sum(), 1.0, atol=1e-5)
+
+    q = nd.contrib.quadratic(nd.array(np.array([2.0], "float32")),
+                             a=1, b=2, c=3)
+    assert q.asnumpy()[0] == 11.0
+
+    x = nd.array(np.array([1.0, 2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.5).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), -0.5)
+
+    ones = nd.array(np.ones(3, "float32"))
+    assert float(nd.contrib.allclose(ones, ones).asnumpy()) == 1.0
+    assert float(nd.contrib.allclose(ones, ones * 2).asnumpy()) == 0.0
+    assert int(nd.contrib.getnnz(
+        nd.array(np.array([0., 1, 2, 0], "float32"))).asnumpy()) == 2
+
+    data = nd.array(np.array([[1., 2., 3., 4.]], "float32"))
+    h = nd.array(np.array([0, 1, 0, 1], "float32"))
+    s = nd.array(np.array([1, -1, 1, 1], "float32"))
+    cs = nd.contrib.count_sketch(data, h, s, out_dim=2)
+    assert np.allclose(cs.asnumpy(), [[4.0, 2.0]])
+
+
+def test_group_adagrad_and_multi_mp_sgd():
+    w = nd.array(np.ones((4, 3), "float32"))
+    g = nd.array(np.full((4, 3), 2.0, "float32"))
+    hist = nd.zeros((4, 1))
+    out = nd.contrib.group_adagrad_update(w, g, hist, lr=0.1)
+    assert np.allclose(hist.asnumpy(), 4.0)          # mutated in place
+    assert np.allclose(out.asnumpy(), 1 - 0.1 * 2 / (2 + 1e-5),
+                       atol=1e-4)
+
+    w16 = nd.array(np.ones((3,), "float16"))
+    g16 = nd.array(np.full((3,), 0.5, "float16"))
+    w32 = nd.array(np.ones((3,), "float32"))
+    nd.multi_mp_sgd_update(*[w16, g16, w32], lrs=(0.1,), wds=(0.0,),
+                           num_weights=1)
+    assert np.allclose(w32.asnumpy(), 0.95)          # master mutated
+
+    w16b = nd.array(np.ones((3,), "float16"))
+    g16b = nd.array(np.full((3,), 0.5, "float16"))
+    m32 = nd.zeros((3,))
+    w32b = nd.array(np.ones((3,), "float32"))
+    nd.multi_mp_sgd_mom_update(*[w16b, g16b, m32, w32b], lrs=(0.1,),
+                               wds=(0.0,), momentum=0.9, num_weights=1)
+    assert np.allclose(m32.asnumpy(), -0.05)
+    assert np.allclose(w32b.asnumpy(), 0.95)
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rng = np.random.RandomState(7)
+    x = nd.array(rng.randn(4, 3, 5, 5).astype("float32"))
+    ga, be = nd.ones((3,)), nd.zeros((3,))
+    with autograd.train_mode():
+        o1 = nd.contrib.SyncBatchNorm(x, ga, be, nd.zeros((3,)),
+                                      nd.ones((3,)))
+        o2 = nd.BatchNorm(x, ga, be, nd.zeros((3,)), nd.ones((3,)))
+    assert np.allclose(o1.asnumpy(), o2.asnumpy(), atol=1e-5)
+
+
+def test_new_sample_distributions():
+    mx.random.seed(0)
+    k = nd.array(np.array([2.0], "float32"))
+    p = nd.array(np.array([0.5], "float32"))
+    s = nd._sample_negative_binomial(k, p, shape=(4000,))
+    assert abs(s.asnumpy().mean() - 2.0) < 0.3
+    s = nd._sample_generalized_negative_binomial(
+        nd.array(np.array([4.0], "float32")),
+        nd.array(np.array([0.25], "float32")), shape=(4000,))
+    assert abs(s.asnumpy().mean() - 4.0) < 0.5
+    s = nd.random_generalized_negative_binomial(mu=3.0, alpha=0.3,
+                                                shape=(4000,))
+    assert abs(s.asnumpy().mean() - 3.0) < 0.5
+
+
+def test_op_coverage_families_complete():
+    """docs/op_coverage.md's family enumeration stays true: every name
+    it claims present must resolve in the registry."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "gen_op_coverage",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "gen_op_coverage.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from mxnet_tpu.ops import registry
+    have = set(registry.list_ops())
+    for fam, names in mod.FAMILIES.items():
+        missing = [n for n in names if n not in have]
+        assert not missing, (fam, missing)

@@ -280,20 +280,22 @@ def test_mpi_launcher_shim():
     """The mpi/slurm launcher's role shim: emulate mpirun by spawning
     ranks with OMPI_COMM_WORLD_RANK set — rank 0 becomes the server,
     ranks 1..2 the workers running the self-checking script."""
-    from tools.launch import _ROLE_SHIM
+    from tools.launch import _role_shim
     script = os.path.join(REPO, "tests", "dist_sync_kvstore.py")
     port = _free_port_pair()
-    env = {**os.environ,
-           "JAX_PLATFORMS": "cpu",
-           "DMLC_PS_ROOT_URI": "127.0.0.1",
-           "DMLC_PS_ROOT_PORT": str(port),
-           "DMLC_NUM_WORKER": "2",
-           "DMLC_NUM_SERVER": "1"}
+    dmlc = {"DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1"}
+    shim = _role_shim(dmlc)
     procs = []
     for rank in range(3):
+        # DMLC_* deliberately NOT in the process env — the shim must
+        # carry it itself (OpenMPI remote ranks get a login-shell env)
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", _ROLE_SHIM, sys.executable, script],
-            env={**env, "OMPI_COMM_WORLD_RANK": str(rank)},
+            [sys.executable, "-c", shim, sys.executable, script],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "OMPI_COMM_WORLD_RANK": str(rank)},
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
     try:

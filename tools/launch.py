@@ -125,6 +125,16 @@ _ROLE_SHIM = (
 )
 
 
+def _role_shim(env):
+    """Bake the rendezvous env into the -c program itself: OpenMPI's
+    orted spawns remote ranks with the login-shell environment, NOT
+    mpirun's, so env-var forwarding cannot be relied on across nodes."""
+    baked = "".join("os.environ.setdefault(%r,%r);" % (k, str(v))
+                    for k, v in env.items())
+    head, rest = _ROLE_SHIM.split(";", 1)
+    return head + ";" + baked + rest
+
+
 def launch_mpi(args, command, runner=None):
     """mpirun/srun launcher (reference: ``dmlc_tracker/mpi.py`` /
     ``slurm.py``).  Spawns num_servers + num_workers ranks; each rank
@@ -146,12 +156,12 @@ def launch_mpi(args, command, runner=None):
     }
     if runner is None:
         runner = "srun" if args.launcher == "slurm" else "mpirun"
-    # env rides subprocess.call(env=...), which mpirun/srun forward to
-    # the ranks — no launcher-specific -x/--export flags (OpenMPI's -x
-    # is fatal to MPICH/Intel mpirun, and the shim supports those via
-    # PMI_RANK)
-    cmd = [runner, "-n", str(nproc), sys.executable, "-c", _ROLE_SHIM] \
-        + list(command)
+    # rendezvous env is baked into the shim program (see _role_shim) —
+    # launcher-specific -x/--export flags are both insufficient
+    # (OpenMPI doesn't forward arbitrary env to remote orted-spawned
+    # ranks) and non-portable (MPICH rejects -x)
+    cmd = [runner, "-n", str(nproc), sys.executable, "-c",
+           _role_shim(env)] + list(command)
     try:
         return subprocess.call(cmd, env={**os.environ, **env})
     except FileNotFoundError:
