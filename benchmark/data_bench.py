@@ -1,0 +1,111 @@
+"""Input-pipeline throughput benchmark (round-2 verdict item #8).
+
+Measures the native threaded ImageRecordIter (reference:
+``iter_image_recordio_2.cc`` — SURVEY.md §7 hard-part 4: feeding
+v5e-8 ResNet needs >10k img/s) on real JPEG data: packs a synthetic
+``.rec`` of JPEG-encoded images, then times decode+augment+batch.
+
+    python benchmark/data_bench.py [--n 512] [--threads 1,2,4]
+
+Environment note: this dev container exposes ONE CPU core
+(os.cpu_count()==1), so the absolute number here is a PER-CORE figure;
+the loader is threaded and scales with cores on a real TPU-VM host
+(v5e-8 hosts have 112 vCPU).  docs/perf.md records the per-core number
+and the implied host throughput.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_rec(path_rec, path_idx, n, hw=256, seed=0):
+    """Pack n random JPEGs (+labels) into an indexed .rec."""
+    from mxnet_tpu import recordio
+    from PIL import Image
+    import io as _io
+
+    rng = np.random.RandomState(seed)
+    w = recordio.MXIndexedRecordIO(path_idx, path_rec, "w")
+    for i in range(n):
+        # structured image so JPEG does real entropy-coding work
+        base = rng.randint(0, 255, (hw // 8, hw // 8, 3), "uint8")
+        img = np.kron(base, np.ones((8, 8, 1), "uint8"))
+        noise = rng.randint(0, 32, (hw, hw, 3), "uint8")
+        img = np.clip(img.astype("int32") + noise, 0, 255).astype("uint8")
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=90)
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        w.write_idx(i, recordio.pack(header, buf.getvalue()))
+    w.close()
+
+
+def bench_iter(path_rec, path_idx, batch_size, threads, epochs=2):
+    from mxnet_tpu import io as mio
+    it = mio.ImageRecordIter(
+        path_imgrec=path_rec, path_imgidx=path_idx,
+        data_shape=(3, 224, 224), batch_size=batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        preprocess_threads=threads, layout="NHWC")
+    # warm epoch (thread spin-up, page cache)
+    n_img = 0
+    for batch in it:
+        n_img += batch.data[0].shape[0]
+    it.reset()
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(epochs):
+        for batch in it:
+            total += batch.data[0].shape[0]
+        it.reset()
+    dt = time.perf_counter() - t0
+    return total / dt, n_img
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--threads", default="1,2,4")
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    from mxnet_tpu import native
+    with tempfile.TemporaryDirectory() as d:
+        rec = os.path.join(d, "data.rec")
+        idx = os.path.join(d, "data.idx")
+        t0 = time.perf_counter()
+        make_rec(rec, idx, args.n)
+        pack_s = time.perf_counter() - t0
+
+        results = {}
+        for th in [int(t) for t in args.threads.split(",")]:
+            ips, n = bench_iter(rec, idx, args.batch_size, th)
+            results["threads_%d" % th] = round(ips, 1)
+            print("threads=%d: %.0f img/s" % (th, ips), flush=True)
+
+        best = max(results.values())
+        ncore = os.cpu_count() or 1
+        out = {
+            "metric": "image_pipeline_throughput",
+            "value": best,
+            "unit": "images/sec",
+            "native": native.available(),
+            "cores_visible": ncore,
+            "per_core": round(best / ncore, 1),
+            "n_images": args.n,
+            "pack_seconds": round(pack_s, 1),
+            "sweep": results,
+        }
+        print(json.dumps(out))
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Python side of the C training ABI (``native/src/c_train_api.cc``).
+
+Reference: the reference keeps ALL training semantics below the C ABI
+(``src/c_api/c_api_ndarray.cc`` MXImperativeInvokeEx +
+``c_api_autograd``); here the execution stack is Python/XLA, so the C
+entry points drive this module through embedded CPython — the same
+architecture as ``_c_predict`` (SURVEY.md §2.1 "C API" row).
+
+Handle model: every NDArray/optimizer lives in ``_HANDLES`` under an
+integer id; the C side only ever sees ids and flat float32 buffers, so
+the ABI stays flat and language-agnostic (a non-C++ binding needs only
+``dlopen``).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Dict, List
+
+import numpy as np
+
+from . import nd, autograd, optimizer as opt_mod
+from .ops import registry
+
+_HANDLES: Dict[int, object] = {}
+_NEXT = itertools.count(1)
+
+
+def _reg(obj) -> int:
+    h = next(_NEXT)
+    _HANDLES[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _HANDLES[int(h)]
+
+
+def free(h: int) -> None:
+    _HANDLES.pop(int(h), None)
+
+
+# -- ndarray ---------------------------------------------------------------
+
+def ndarray_from_bytes(shape: List[int], data: bytes) -> int:
+    a = np.frombuffer(data, dtype="<f4").reshape(tuple(shape)).copy()
+    return _reg(nd.array(a))
+
+
+def ndarray_zeros(shape: List[int]) -> int:
+    return _reg(nd.zeros(tuple(shape)))
+
+
+def ndarray_to_bytes(h: int):
+    a = _get(h).asnumpy().astype("<f4")
+    return list(a.shape), a.tobytes()
+
+
+def ndarray_shape(h: int) -> List[int]:
+    return list(_get(h).shape)
+
+
+def attach_grad(h: int) -> None:
+    _get(h).attach_grad()
+
+
+def grad_of(h: int) -> int:
+    g = _get(h).grad
+    if g is None:
+        raise ValueError("no gradient attached/computed for handle %d"
+                         % h)
+    return _reg(g)
+
+
+# -- imperative op invoke (the MXImperativeInvokeEx analog) ---------------
+
+def op_invoke(name: str, in_handles: List[int], attrs_json: str):
+    attrs = json.loads(attrs_json) if attrs_json else {}
+    # JSON carries lists where MXNet attrs want tuples (kernel=(3,3))
+    attrs = {k: tuple(v) if isinstance(v, list) else v
+             for k, v in attrs.items()}
+    op = registry.get_op(name)
+    inputs = [_get(h) for h in in_handles]
+    out = registry.invoke(op, inputs, (), attrs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    return [_reg(o) for o in outs]
+
+
+# -- autograd --------------------------------------------------------------
+
+_RECORD_CTX = []
+
+
+def record_start() -> None:
+    ctx = autograd.record()
+    ctx.__enter__()
+    _RECORD_CTX.append(ctx)
+
+
+def record_stop() -> None:
+    if _RECORD_CTX:
+        _RECORD_CTX.pop().__exit__(None, None, None)
+
+
+def backward(h: int) -> None:
+    _get(h).backward()
+
+
+# -- optimizer -------------------------------------------------------------
+
+def optimizer_create(name: str, params_json: str) -> int:
+    kwargs = json.loads(params_json) if params_json else {}
+    optimizer = opt_mod.create(name, **kwargs)
+    return _reg({"updater": opt_mod.get_updater(optimizer)})
+
+
+def optimizer_update(opt_h: int, index: int, weight_h: int,
+                     grad_h: int) -> None:
+    _get(opt_h)["updater"](int(index), _get(grad_h), _get(weight_h))
+
+
+# -- scalar convenience ----------------------------------------------------
+
+def ndarray_scalar(h: int) -> float:
+    return float(_get(h).asnumpy().reshape(-1)[0])

@@ -164,7 +164,8 @@ def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False, **kw):
 @register("_contrib_getnnz", aliases=("getnnz",), no_grad=True)
 def getnnz(data, axis=None, **kw):
     jnp = _j()
-    return jnp.count_nonzero(data, axis=axis).astype("int64")
+    # int32: jax truncates int64 (and warns) unless x64 is enabled
+    return jnp.count_nonzero(data, axis=axis).astype("int32")
 
 
 @register("_contrib_count_sketch", aliases=("count_sketch",),

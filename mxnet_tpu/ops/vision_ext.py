@@ -18,7 +18,7 @@ from __future__ import annotations
 import numpy as _np
 
 from .registry import register
-from .vision import _bilinear_gather, _pairwise_iou, _nms_keep
+from .vision import _bilinear_gather, _nms_keep
 
 
 def _j():

@@ -1,0 +1,68 @@
+// Embeddable C TRAINING API.
+//
+// Reference: src/c_api/c_api_ndarray.cc (MXImperativeInvokeEx) +
+// the autograd/optimizer entry points of src/c_api/c_api.cc — the
+// reference's "all semantics below the C ABI" training surface.
+// Here the execution substrate is Python/XLA; this ABI embeds CPython
+// (like c_predict_api) and drives mxnet_tpu._c_train.  Handles are
+// plain int64 ids; every buffer is flat float32 — a binding in any
+// language needs only dlopen.
+//
+// All functions return 0 on success, -1 on failure
+// (MXTrainGetLastError() describes the failure).
+#ifndef MXNET_TPU_C_TRAIN_API_H_
+#define MXNET_TPU_C_TRAIN_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int64_t NDHandle;
+typedef int64_t OptHandle;
+
+const char* MXTrainGetLastError(void);
+
+// -- ndarray ---------------------------------------------------------------
+int MXTrainNDArrayCreate(const int64_t* shape, int ndim,
+                         const float* data /* may be NULL -> zeros */,
+                         NDHandle* out);
+int MXTrainNDArrayFree(NDHandle h);
+int MXTrainNDArrayShape(NDHandle h, int64_t* shape /* >= 8 slots */,
+                        int* ndim);
+// copies the array into `data` (caller allocates size floats)
+int MXTrainNDArrayCopyTo(NDHandle h, float* data, size_t size);
+int MXTrainNDArrayScalar(NDHandle h, float* out);
+
+// -- imperative op invoke --------------------------------------------------
+// attrs_json: JSON object of op attributes ({"num_hidden": 64}).
+// outputs: caller-provided array of max_outputs slots; *num_outputs is
+// set to the real count.
+int MXTrainOpInvoke(const char* op_name, const NDHandle* inputs,
+                    int num_inputs, const char* attrs_json,
+                    NDHandle* outputs, int max_outputs,
+                    int* num_outputs);
+
+// -- autograd --------------------------------------------------------------
+int MXTrainAttachGrad(NDHandle h);
+int MXTrainRecordStart(void);
+int MXTrainRecordStop(void);
+int MXTrainBackward(NDHandle loss);
+int MXTrainGradOf(NDHandle h, NDHandle* out);
+
+// -- optimizer -------------------------------------------------------------
+// name: "sgd", "adam", ... ; params_json: {"learning_rate": 0.1}
+int MXTrainOptimizerCreate(const char* name, const char* params_json,
+                           OptHandle* out);
+int MXTrainOptimizerFree(OptHandle h);
+// applies the update for parameter `index` in place on `weight`
+int MXTrainOptimizerUpdate(OptHandle h, int index, NDHandle weight,
+                           NDHandle grad);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // MXNET_TPU_C_TRAIN_API_H_

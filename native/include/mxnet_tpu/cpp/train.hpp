@@ -1,0 +1,196 @@
+// Header-only C++ TRAINING frontend over the C train ABI.
+//
+// Reference: cpp-package/include/mxnet-cpp/ (SURVEY.md §2.3 "C++
+// frontend") — NDArray + Operator + Optimizer classes over the flat C
+// API.  The reference generates op.h from the registry; here
+// Operator("name") invokes any registered op by name with JSON attrs,
+// which covers the same surface without code generation.
+//
+// Usage (see tests/cpp_train_demo.cc for a full MNIST-style MLP):
+//
+//   namespace mxcpp = mxnet_tpu::cpp;
+//   auto w = mxcpp::NDArray({64, 784}, host_data);
+//   w.AttachGrad();
+//   mxcpp::Autograd::RecordStart();
+//   auto h = mxcpp::Operator("FullyConnected")
+//                .SetAttr("num_hidden", 64)
+//                .Invoke({x, w, b});
+//   ...
+#ifndef MXNET_TPU_CPP_TRAIN_HPP_
+#define MXNET_TPU_CPP_TRAIN_HPP_
+
+#include <cstdint>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <tuple>
+#include <vector>
+
+#include "../c_train_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " +
+                             MXTrainGetLastError());
+  }
+}
+
+class NDArray {
+ public:
+  NDArray() : h_(0) {}
+  explicit NDArray(NDHandle h) : h_(h) {}
+  NDArray(const std::vector<int64_t>& shape,
+          const float* data = nullptr) {
+    Check(MXTrainNDArrayCreate(shape.data(),
+                               static_cast<int>(shape.size()), data,
+                               &h_),
+          "NDArrayCreate");
+  }
+  NDArray(const std::vector<int64_t>& shape,
+          const std::vector<float>& data)
+      : NDArray(shape, data.data()) {}
+
+  // handles are owned by the Python-side registry; copying the wrapper
+  // shares the handle, Free() releases it explicitly (the demo's
+  // arrays live for the whole program, matching the reference
+  // cpp-package's shared-ptr-like NDArray semantics)
+  void Free() {
+    if (h_) MXTrainNDArrayFree(h_);
+    h_ = 0;
+  }
+
+  NDHandle handle() const { return h_; }
+
+  std::vector<int64_t> Shape() const {
+    int64_t shp[8];
+    int nd = 0;
+    Check(MXTrainNDArrayShape(h_, shp, &nd), "NDArrayShape");
+    return std::vector<int64_t>(shp, shp + nd);
+  }
+
+  std::vector<float> CopyToHost() const {
+    size_t n = 1;
+    for (int64_t d : Shape()) n *= static_cast<size_t>(d);
+    std::vector<float> out(n);
+    Check(MXTrainNDArrayCopyTo(h_, out.data(), n), "NDArrayCopyTo");
+    return out;
+  }
+
+  float Scalar() const {
+    float v = 0;
+    Check(MXTrainNDArrayScalar(h_, &v), "NDArrayScalar");
+    return v;
+  }
+
+  void AttachGrad() { Check(MXTrainAttachGrad(h_), "AttachGrad"); }
+
+  NDArray Grad() const {
+    NDHandle g = 0;
+    Check(MXTrainGradOf(h_, &g), "GradOf");
+    return NDArray(g);
+  }
+
+  void Backward() { Check(MXTrainBackward(h_), "Backward"); }
+
+ private:
+  NDHandle h_;
+};
+
+class Operator {
+ public:
+  explicit Operator(const std::string& name) : name_(name) {}
+
+  template <typename T>
+  Operator& SetAttr(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    attrs_.emplace_back(key, os.str(), /*quoted=*/false);
+    return *this;
+  }
+
+  Operator& SetAttr(const std::string& key, const std::string& value) {
+    attrs_.emplace_back(key, value, /*quoted=*/true);
+    return *this;
+  }
+
+  Operator& SetAttr(const std::string& key, const char* value) {
+    return SetAttr(key, std::string(value));
+  }
+
+  std::vector<NDArray> InvokeMulti(const std::vector<NDArray>& inputs,
+                                   int max_outputs = 8) {
+    std::vector<NDHandle> ins;
+    ins.reserve(inputs.size());
+    for (const auto& a : inputs) ins.push_back(a.handle());
+    std::vector<NDHandle> outs(max_outputs);
+    int n = 0;
+    Check(MXTrainOpInvoke(name_.c_str(), ins.data(),
+                          static_cast<int>(ins.size()),
+                          AttrsJson().c_str(), outs.data(), max_outputs,
+                          &n),
+          name_.c_str());
+    std::vector<NDArray> result;
+    result.reserve(n);
+    for (int i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  NDArray Invoke(const std::vector<NDArray>& inputs) {
+    return InvokeMulti(inputs)[0];
+  }
+
+ private:
+  std::string AttrsJson() const {
+    if (attrs_.empty()) return "";
+    std::ostringstream os;
+    os << "{";
+    for (size_t i = 0; i < attrs_.size(); ++i) {
+      const auto& a = attrs_[i];
+      os << (i ? "," : "") << "\"" << std::get<0>(a) << "\":";
+      if (std::get<2>(a)) {
+        os << "\"" << std::get<1>(a) << "\"";
+      } else {
+        os << std::get<1>(a);
+      }
+    }
+    os << "}";
+    return os.str();
+  }
+
+  std::string name_;
+  std::vector<std::tuple<std::string, std::string, bool>> attrs_;
+};
+
+struct Autograd {
+  static void RecordStart() {
+    Check(MXTrainRecordStart(), "RecordStart");
+  }
+  static void RecordStop() { Check(MXTrainRecordStop(), "RecordStop"); }
+};
+
+class Optimizer {
+ public:
+  Optimizer(const std::string& name, const std::string& params_json) {
+    Check(MXTrainOptimizerCreate(name.c_str(), params_json.c_str(),
+                                 &h_),
+          "OptimizerCreate");
+  }
+  ~Optimizer() { MXTrainOptimizerFree(h_); }
+
+  void Update(int index, NDArray* weight, const NDArray& grad) {
+    Check(MXTrainOptimizerUpdate(h_, index, weight->handle(),
+                                 grad.handle()),
+          "OptimizerUpdate");
+  }
+
+ private:
+  OptHandle h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_TRAIN_HPP_

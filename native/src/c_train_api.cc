@@ -1,0 +1,271 @@
+// C training API — implementation.
+//
+// Reference: src/c_api/c_api_ndarray.cc MXImperativeInvokeEx and the
+// autograd/KVStore entry points (SURVEY.md §2.1 "C API": ~400 flat
+// extern "C" fns; §3.1 call stack).  The reference dispatches into its
+// own C++ imperative runtime; the TPU-native runtime is Python/XLA, so
+// this unit embeds CPython and drives mxnet_tpu._c_train — the same
+// embedding architecture as c_predict_api.cc (shared GIL/error
+// plumbing duplicated deliberately: the two .so targets are
+// independently loadable).
+#include "../include/mxnet_tpu/c_train_api.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_train_last_error;
+
+std::once_flag g_py_init_flag;
+
+void EnsurePython() {
+  std::call_once(g_py_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void CaptureError(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = std::string(where) + ": ";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_train_last_error = msg;
+}
+
+// call mxnet_tpu._c_train.<fn>(*args); returns new ref or nullptr
+PyObject* CallHelper(const char* fn_name, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu._c_train");
+  if (!mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, fn_name);
+  Py_DECREF(mod);
+  if (!fn) return nullptr;
+  PyObject* ret = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return ret;
+}
+
+// helper returning an int64 handle from a python int result
+int HandleCall(const char* fn, PyObject* args, int64_t* out) {
+  PyObject* r = CallHelper(fn, args);
+  Py_XDECREF(args);
+  if (!r) {
+    CaptureError(fn);
+    return -1;
+  }
+  *out = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    CaptureError(fn);
+    return -1;
+  }
+  return 0;
+}
+
+// helper for void-returning calls
+int VoidCall(const char* fn, PyObject* args) {
+  PyObject* r = CallHelper(fn, args);
+  Py_XDECREF(args);
+  if (!r) {
+    CaptureError(fn);
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTrainGetLastError(void) {
+  return g_train_last_error.c_str();
+}
+
+int MXTrainNDArrayCreate(const int64_t* shape, int ndim,
+                         const float* data, NDHandle* out) {
+  EnsurePython();
+  GILGuard gil;
+  PyObject* shp = PyList_New(ndim);
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+    n *= static_cast<size_t>(shape[i]);
+  }
+  if (data == nullptr) {
+    PyObject* args = Py_BuildValue("(O)", shp);
+    Py_DECREF(shp);
+    return HandleCall("ndarray_zeros", args, out);
+  }
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(n * sizeof(float)));
+  PyObject* args = Py_BuildValue("(OO)", shp, bytes);
+  Py_DECREF(shp);
+  Py_DECREF(bytes);
+  return HandleCall("ndarray_from_bytes", args, out);
+}
+
+int MXTrainNDArrayFree(NDHandle h) {
+  GILGuard gil;
+  return VoidCall("free", Py_BuildValue("(L)", h));
+}
+
+int MXTrainNDArrayShape(NDHandle h, int64_t* shape, int* ndim) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(L)", h);
+  PyObject* r = CallHelper("ndarray_shape", args);
+  Py_DECREF(args);
+  if (!r) {
+    CaptureError("MXTrainNDArrayShape");
+    return -1;
+  }
+  Py_ssize_t nd = PyList_Size(r);
+  *ndim = static_cast<int>(nd);
+  for (Py_ssize_t i = 0; i < nd && i < 8; ++i) {
+    shape[i] = PyLong_AsLongLong(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainNDArrayCopyTo(NDHandle h, float* data, size_t size) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(L)", h);
+  PyObject* r = CallHelper("ndarray_to_bytes", args);
+  Py_DECREF(args);
+  if (!r) {
+    CaptureError("MXTrainNDArrayCopyTo");
+    return -1;
+  }
+  PyObject* bytes = PyTuple_GetItem(r, 1);
+  char* buf = nullptr;
+  Py_ssize_t blen = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0 ||
+      static_cast<size_t>(blen) != size * sizeof(float)) {
+    Py_DECREF(r);
+    g_train_last_error = "MXTrainNDArrayCopyTo: size mismatch";
+    return -1;
+  }
+  memcpy(data, buf, blen);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainNDArrayScalar(NDHandle h, float* out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(L)", h);
+  PyObject* r = CallHelper("ndarray_scalar", args);
+  Py_DECREF(args);
+  if (!r) {
+    CaptureError("MXTrainNDArrayScalar");
+    return -1;
+  }
+  *out = static_cast<float>(PyFloat_AsDouble(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainOpInvoke(const char* op_name, const NDHandle* inputs,
+                    int num_inputs, const char* attrs_json,
+                    NDHandle* outputs, int max_outputs,
+                    int* num_outputs) {
+  EnsurePython();
+  GILGuard gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(ins, i, PyLong_FromLongLong(inputs[i]));
+  }
+  PyObject* args = Py_BuildValue("(sOs)", op_name, ins,
+                                 attrs_json ? attrs_json : "");
+  Py_DECREF(ins);
+  PyObject* r = CallHelper("op_invoke", args);
+  Py_DECREF(args);
+  if (!r) {
+    CaptureError("MXTrainOpInvoke");
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(r);
+  *num_outputs = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
+    outputs[i] = PyLong_AsLongLong(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainAttachGrad(NDHandle h) {
+  GILGuard gil;
+  return VoidCall("attach_grad", Py_BuildValue("(L)", h));
+}
+
+int MXTrainRecordStart(void) {
+  GILGuard gil;
+  return VoidCall("record_start", PyTuple_New(0));
+}
+
+int MXTrainRecordStop(void) {
+  GILGuard gil;
+  return VoidCall("record_stop", PyTuple_New(0));
+}
+
+int MXTrainBackward(NDHandle loss) {
+  GILGuard gil;
+  return VoidCall("backward", Py_BuildValue("(L)", loss));
+}
+
+int MXTrainGradOf(NDHandle h, NDHandle* out) {
+  GILGuard gil;
+  return HandleCall("grad_of", Py_BuildValue("(L)", h), out);
+}
+
+int MXTrainOptimizerCreate(const char* name, const char* params_json,
+                           OptHandle* out) {
+  EnsurePython();
+  GILGuard gil;
+  return HandleCall("optimizer_create",
+                    Py_BuildValue("(ss)", name,
+                                  params_json ? params_json : ""),
+                    out);
+}
+
+int MXTrainOptimizerFree(OptHandle h) { return MXTrainNDArrayFree(h); }
+
+int MXTrainOptimizerUpdate(OptHandle h, int index, NDHandle weight,
+                           NDHandle grad) {
+  GILGuard gil;
+  return VoidCall("optimizer_update",
+                  Py_BuildValue("(LiLL)", h, index, weight, grad));
+}
+
+}  // extern "C"

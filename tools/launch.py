@@ -129,7 +129,7 @@ def _role_shim(env):
     """Bake the rendezvous env into the -c program itself: OpenMPI's
     orted spawns remote ranks with the login-shell environment, NOT
     mpirun's, so env-var forwarding cannot be relied on across nodes."""
-    baked = "".join("os.environ.setdefault(%r,%r);" % (k, str(v))
+    baked = "".join("os.environ[%r]=%r;" % (k, str(v))
                     for k, v in env.items())
     head, rest = _ROLE_SHIM.split(";", 1)
     return head + ";" + baked + rest
