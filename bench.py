@@ -25,7 +25,11 @@ def main():
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     batch = int(os.environ.get("BENCH_BATCH", "128" if amp else "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "40"))
+    # 150-step device loops: the tunnel's per-dispatch fixed cost was
+    # measured at ~220 ms this session (docs/conv_ceiling_experiment.md
+    # §1) — at K=150 it contributes <1% instead of the ~11% it silently
+    # added to round-1 numbers at K=40
+    iters = int(os.environ.get("BENCH_ITERS", "150"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
     net = vision.resnet50_v1()

@@ -1,0 +1,110 @@
+"""Per-shape conv efficiency probe on the real chip.
+
+Carry-chained scan (docs/perf.md methodology): each iteration feeds the
+previous output back through a tiny perturbation so XLA cannot hoist
+the loop body; hard sync via device_get.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STEPS = 30
+
+
+def time_fn(make_out, x0, steps=STEPS):
+    """make_out(x) -> y with y broadcastable-perturbable back into x."""
+
+    def body(x, _):
+        y = make_out(x)
+        # fold output back into input (shape-preserving perturbation)
+        s = jnp.tanh(jnp.mean(y)) * 1e-6
+        return x * (1.0 + s), None
+
+    f = jax.jit(lambda x: jax.lax.scan(body, x, None, length=steps)[0])
+    r = f(x0)
+    jax.block_until_ready(r)
+    _ = jax.device_get(r.ravel()[:1])  # hard sync
+    t0 = time.perf_counter()
+    r = f(x0)
+    jax.block_until_ready(r)
+    _ = jax.device_get(r.ravel()[:1])
+    dt = time.perf_counter() - t0
+    return dt / steps
+
+
+def conv_case(B, H, W, Cin, Cout, K, stride, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, H, W, Cin), dtype)
+    w = jnp.asarray(rng.randn(K, K, Cin, Cout) * 0.05, dtype)
+
+    def run(x):
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride),
+            "SAME" if stride == 1 else [(K // 2, K // 2)] * 2,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    t = time_fn(run, x)
+    Ho, Wo = H // stride, W // stride
+    flops = 2 * B * Ho * Wo * Cout * Cin * K * K
+    return t, flops / t / 1e12
+
+
+def matmul_case(M, Kdim, N, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(M, Kdim) * 0.05, dtype)
+    b = jnp.asarray(rng.randn(Kdim, N) * 0.05, dtype)
+
+    def run(a):
+        return a @ b
+
+    t = time_fn(run, a)
+    flops = 2 * M * Kdim * N
+    return t, flops / t / 1e12
+
+
+def main():
+    B = 128
+    print("platform:", jax.devices()[0].platform)
+    cases = [
+        ("stem 7x7/2 224->112 3->64", (B, 224, 224, 3, 64, 7, 2)),
+        ("s1 1x1 56 64->64", (B, 56, 56, 64, 64, 1, 1)),
+        ("s1 3x3 56 64->64", (B, 56, 56, 64, 64, 3, 1)),
+        ("s1 1x1 56 64->256", (B, 56, 56, 64, 256, 1, 1)),
+        ("s1 1x1 56 256->64", (B, 56, 56, 256, 64, 1, 1)),
+        ("s2 3x3 28 128->128", (B, 28, 28, 128, 128, 3, 1)),
+        ("s2 1x1 28 128->512", (B, 28, 28, 128, 512, 1, 1)),
+        ("s2 1x1 28 512->128", (B, 28, 28, 512, 128, 1, 1)),
+        ("s3 3x3 14 256->256", (B, 14, 14, 256, 256, 3, 1)),
+        ("s3 1x1 14 256->1024", (B, 14, 14, 256, 1024, 1, 1)),
+        ("s3 1x1 14 1024->256", (B, 14, 14, 1024, 256, 1, 1)),
+        ("s4 3x3 7 512->512", (B, 7, 7, 512, 512, 3, 1)),
+        ("s4 1x1 7 512->2048", (B, 7, 7, 512, 2048, 1, 1)),
+        ("s4 1x1 7 2048->512", (B, 7, 7, 2048, 512, 1, 1)),
+    ]
+    total_t, total_f = 0.0, 0.0
+    for name, (b, h, w, ci, co, k, s) in cases:
+        t, tf = conv_case(b, h, w, ci, co, k, s)
+        ho, wo = h // s, w // s
+        fl = 2 * b * ho * wo * co * ci * k * k
+        total_t += t
+        total_f += fl
+        print("%-28s %7.3f ms  %6.1f TF/s" % (name, t * 1e3, tf),
+              flush=True)
+    print("weighted conv TF/s: %.1f" % (total_f / total_t / 1e12))
+
+    # matmul equivalents of the 1x1 convs (exact same contraction)
+    for name, (M, Kd, N) in [
+        ("mm 56^2*128 x 64->256", (128 * 56 * 56, 64, 256)),
+        ("mm 28^2*128 x 512->128", (128 * 28 * 28, 512, 128)),
+        ("mm 14^2*128 x 1024->256", (128 * 14 * 14, 1024, 256)),
+        ("mm 8192^3", (8192, 8192, 8192)),
+    ]:
+        t, tf = matmul_case(M, Kd, N)
+        print("%-28s %7.3f ms  %6.1f TF/s" % (name, t * 1e3, tf),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

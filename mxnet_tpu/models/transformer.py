@@ -40,6 +40,21 @@ class TransformerConfig:
     param_dtype: str = "float32"  # master params
     use_flash: bool = True        # pallas flash attention on TPU
     remat: bool = True            # jax.checkpoint per layer
+    # remat policy when remat=True: "nothing" recomputes everything
+    # (minimum memory); "dots" saves MXU outputs (attention scores,
+    # FFN matmuls) so the backward recompute is elementwise-only —
+    # measured faster whenever it fits (docs/perf.md).  NOTE: bert-base
+    # bs16/seq512 fits WITHOUT remat on one v5e chip — remat there is
+    # pure cost (13% — round-2 measurement); reach for it at longer
+    # sequences first.
+    remat_policy: str = "nothing"
+    # dropout PRNG: True converts the step rng to the TPU's hardware
+    # RBG generator (counter-based like the reference's GPU Philox
+    # dropout) — threefry bit generation measured 19% of the bert-base
+    # step; RBG removes nearly all of it (97k->134k tok/s with
+    # no-remat, docs/perf.md).  Mask streams differ from threefry but
+    # are deterministic per key.
+    fast_rng: bool = True
     type_vocab_size: int = 2
     # sequence/context parallelism over the mesh's 'sp' axis:
     # None = let GSPMD handle it; 'ring' = ring attention (ppermute K/V
@@ -321,9 +336,16 @@ def _make_layer_fn(cfg: TransformerConfig):
     import jax
     if not cfg.remat:
         return _encoder_layer
+    if cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.dots_saveable
+    elif cfg.remat_policy == "nothing":
+        policy = jax.checkpoint_policies.nothing_saveable
+    else:
+        from ..base import MXNetError
+        raise MXNetError("remat_policy must be 'nothing' or 'dots', "
+                         "got %r" % (cfg.remat_policy,))
     return jax.checkpoint(
-        _encoder_layer, static_argnums=(3, 4, 6),
-        policy=jax.checkpoint_policies.nothing_saveable)
+        _encoder_layer, static_argnums=(3, 4, 6), policy=policy)
 
 
 def _pipelined_layers(x, layers, mask, cfg, train, rng, mesh):
@@ -439,6 +461,12 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
 
     def step(state, batch, rng):
         params, opt_state = state
+        if cfg.fast_rng and cfg.dropout > 0:
+            # hardware RBG for dropout mask bits (see TransformerConfig
+            # .fast_rng); derived from the caller's key so the stream
+            # stays deterministic per (key, step)
+            rng = jax.random.wrap_key_data(
+                jax.random.bits(rng, (4,), "uint32"), impl="rbg")
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         if grad_shardings is not None:
             # pin grads to the params' own sharding before the update.

@@ -179,6 +179,9 @@ class Optimizer {
           "OptimizerCreate");
   }
   ~Optimizer() { MXTrainOptimizerFree(h_); }
+  // owns the handle: copying would double-free it
+  Optimizer(const Optimizer&) = delete;
+  Optimizer& operator=(const Optimizer&) = delete;
 
   void Update(int index, NDArray* weight, const NDArray& grad) {
     Check(MXTrainOptimizerUpdate(h_, index, weight->handle(),

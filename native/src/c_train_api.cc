@@ -137,11 +137,13 @@ int MXTrainNDArrayCreate(const int64_t* shape, int ndim,
 }
 
 int MXTrainNDArrayFree(NDHandle h) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("free", Py_BuildValue("(L)", h));
 }
 
 int MXTrainNDArrayShape(NDHandle h, int64_t* shape, int* ndim) {
+  EnsurePython();
   GILGuard gil;
   PyObject* args = Py_BuildValue("(L)", h);
   PyObject* r = CallHelper("ndarray_shape", args);
@@ -151,8 +153,14 @@ int MXTrainNDArrayShape(NDHandle h, int64_t* shape, int* ndim) {
     return -1;
   }
   Py_ssize_t nd = PyList_Size(r);
+  if (nd > 8) {
+    Py_DECREF(r);
+    g_train_last_error = "MXTrainNDArrayShape: rank > 8 unsupported "
+                         "by the 8-slot shape buffer contract";
+    return -1;
+  }
   *ndim = static_cast<int>(nd);
-  for (Py_ssize_t i = 0; i < nd && i < 8; ++i) {
+  for (Py_ssize_t i = 0; i < nd; ++i) {
     shape[i] = PyLong_AsLongLong(PyList_GetItem(r, i));
   }
   Py_DECREF(r);
@@ -160,6 +168,7 @@ int MXTrainNDArrayShape(NDHandle h, int64_t* shape, int* ndim) {
 }
 
 int MXTrainNDArrayCopyTo(NDHandle h, float* data, size_t size) {
+  EnsurePython();
   GILGuard gil;
   PyObject* args = Py_BuildValue("(L)", h);
   PyObject* r = CallHelper("ndarray_to_bytes", args);
@@ -183,6 +192,7 @@ int MXTrainNDArrayCopyTo(NDHandle h, float* data, size_t size) {
 }
 
 int MXTrainNDArrayScalar(NDHandle h, float* out) {
+  EnsurePython();
   GILGuard gil;
   PyObject* args = Py_BuildValue("(L)", h);
   PyObject* r = CallHelper("ndarray_scalar", args);
@@ -216,8 +226,24 @@ int MXTrainOpInvoke(const char* op_name, const NDHandle* inputs,
     return -1;
   }
   Py_ssize_t n = PyList_Size(r);
+  if (n > max_outputs) {
+    // free every produced handle — returning a truncated list would
+    // leak the rest in the Python-side registry forever
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* a = Py_BuildValue(
+          "(L)", PyLong_AsLongLong(PyList_GetItem(r, i)));
+      PyObject* fr = CallHelper("free", a);
+      Py_XDECREF(a);
+      Py_XDECREF(fr);
+    }
+    Py_DECREF(r);
+    g_train_last_error =
+        std::string("MXTrainOpInvoke: op produced more outputs than "
+                    "max_outputs; pass a larger buffer");
+    return -1;
+  }
   *num_outputs = static_cast<int>(n);
-  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
+  for (Py_ssize_t i = 0; i < n; ++i) {
     outputs[i] = PyLong_AsLongLong(PyList_GetItem(r, i));
   }
   Py_DECREF(r);
@@ -225,26 +251,31 @@ int MXTrainOpInvoke(const char* op_name, const NDHandle* inputs,
 }
 
 int MXTrainAttachGrad(NDHandle h) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("attach_grad", Py_BuildValue("(L)", h));
 }
 
 int MXTrainRecordStart(void) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("record_start", PyTuple_New(0));
 }
 
 int MXTrainRecordStop(void) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("record_stop", PyTuple_New(0));
 }
 
 int MXTrainBackward(NDHandle loss) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("backward", Py_BuildValue("(L)", loss));
 }
 
 int MXTrainGradOf(NDHandle h, NDHandle* out) {
+  EnsurePython();
   GILGuard gil;
   return HandleCall("grad_of", Py_BuildValue("(L)", h), out);
 }
@@ -263,6 +294,7 @@ int MXTrainOptimizerFree(OptHandle h) { return MXTrainNDArrayFree(h); }
 
 int MXTrainOptimizerUpdate(OptHandle h, int index, NDHandle weight,
                            NDHandle grad) {
+  EnsurePython();
   GILGuard gil;
   return VoidCall("optimizer_update",
                   Py_BuildValue("(LiLL)", h, index, weight, grad));
