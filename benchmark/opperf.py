@@ -75,6 +75,11 @@ def _bench_one(name, ctx, warmup, runs, use_default=False):
     from mxnet_tpu.ops import registry
 
     op = registry.get_op(name)
+    if op.variadic:
+        # variadic ops take a LIST operand whose arity is part of the
+        # workload; add a _PROFILES entry to benchmark a specific arity
+        return {"op": name, "error": "variadic op: needs a _PROFILES "
+                                     "entry with an explicit arity"}
     shapes, pos, kw = _PROFILES.get(
         name, (_DEFAULT_SHAPE, (), {})) if not use_default else \
         (_DEFAULT_SHAPE, (), {})
@@ -83,13 +88,25 @@ def _bench_one(name, ctx, warmup, runs, use_default=False):
                      ctx=ctx) for s in shapes]
 
     def run_eager():
-        out = getattr(nd, name)(*args, **kw)
+        # registry.invoke threads the PRNG key for needs_rng samplers
+        out = registry.invoke(op, args, tuple(pos), dict(kw))
         (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
 
     try:
         run_eager()
-    except Exception as e:
-        return {"op": name, "error": str(e).split("\n")[0][:120]}
+    except Exception as first:
+        # registry-walk fallback: many ops are binary — retry with a
+        # second same-shape operand before reporting unprofiled
+        args = args + [nd.array(
+            rng.uniform(0.5, 1.5, _DEFAULT_SHAPE[0]).astype("float32"),
+            ctx=ctx)]
+        try:
+            run_eager()
+        except Exception:
+            # the FIRST error is the informative one (the retry's
+            # arity complaint would mask it for non-binary ops)
+            return {"op": name,
+                    "error": str(first).split("\n")[0][:120]}
 
     for _ in range(warmup):
         run_eager()

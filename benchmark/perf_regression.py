@@ -1,0 +1,184 @@
+"""Perf regression harness (round-2 verdict item #10): runs the four
+headline benchmarks on the real chip and compares against stored
+expected ranges (tunnel-jitter bars included).
+
+    python benchmark/perf_regression.py             # run + compare
+    python benchmark/perf_regression.py --update    # rewrite ranges
+
+Ranges live in benchmark/perf_expected.json.  Bars are deliberately
+wide (±15%) because the axon tunnel adds multi-percent run-to-run
+jitter AND its fixed per-dispatch cost varies by session (25–220 ms
+measured across rounds — docs/conv_ceiling_experiment.md §1).  A
+regression that matters (a 130x sharding-path accident, a lost fusion)
+blows far past these bars; tunnel weather does not.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+EXPECTED = os.path.join(REPO, "benchmark", "perf_expected.json")
+
+
+def bench_resnet():
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=900)
+    line = [l for l in r.stdout.splitlines() if '"metric"' in l][-1]
+    return json.loads(line)["value"]
+
+
+def bench_bert():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.models import transformer as T
+    B, L = 16, 512
+    cfg = T.bert_base(use_flash=False, remat=False, dropout=0.1)
+    init_state, step = T.make_train_step(cfg, learning_rate=1e-4,
+                                         scan_steps=100)
+    state = init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)),
+                         jnp.int32)
+    labels = jnp.where(jnp.asarray(rng.rand(B, L) < 0.15), tokens,
+                       -100)
+    batch = {"tokens": tokens, "labels": labels,
+             "mask": jnp.ones((B, L), dtype=bool)}
+    k = jax.random.PRNGKey(1)
+    state, _ = step(state, batch, k)
+    jax.block_until_ready(state)
+    jax.device_get(jax.tree_util.tree_leaves(state)[0].ravel()[:1])
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time()
+        state, _ = step(state, batch, k)
+        jax.block_until_ready(state)
+        jax.device_get(jax.tree_util.tree_leaves(state)[0].ravel()[:1])
+        best = min(best, time.time() - t0)
+    return B * L * 100 / best
+
+
+def bench_flash():
+    """Flash fwd+bwd at seq 8192 (the regime where the kernel wins)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.kernels import flash_attention as FA
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 8192, 8, 64) * 0.05, jnp.float32)
+
+    def loss(fn):
+        return lambda q: (fn(q, q, q, causal=True) ** 2).sum()
+
+    g = jax.jit(jax.grad(loss(FA.flash_attention)))
+    K = 20
+
+    def loop(q):
+        def body(q, _):
+            gq = g(q)
+            return q + 1e-9 * gq, None
+        return jax.lax.scan(body, q, None, length=K)[0]
+
+    f = jax.jit(loop)
+    r = f(q)
+    jax.block_until_ready(r)
+    jax.device_get(r.ravel()[:1])
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time()
+        r = f(q)
+        jax.block_until_ready(r)
+        jax.device_get(r.ravel()[:1])
+        best = min(best, time.time() - t0)
+    return best / K * 1e3    # ms per fwd+bwd
+
+
+def bench_gpt_decode():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.models import gpt
+    cfg = gpt.gpt_config(vocab_size=32000, max_len=512, d_model=768,
+                         n_heads=12, n_layers=12, d_ff=3072,
+                         dropout=0.0, use_flash=False, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 8)),
+                         jnp.int32)
+    new = 256
+    out = gpt.generate(params, cfg, prompt, max_new_tokens=new)
+    jax.block_until_ready(out)
+    jax.device_get(out.ravel()[:1])
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time()
+        out = gpt.generate(params, cfg, prompt, max_new_tokens=new)
+        jax.block_until_ready(out)
+        jax.device_get(out.ravel()[:1])
+        best = min(best, time.time() - t0)
+    return 8 * new / best
+
+
+BENCHES = {
+    "resnet50_img_s": (bench_resnet, "higher"),
+    "bert_base_tok_s": (bench_bert, "higher"),
+    "flash_8192_fwdbwd_ms": (bench_flash, "lower"),
+    "gpt_decode_tok_s": (bench_gpt_decode, "higher"),
+}
+
+BAR = 0.15
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    ap.add_argument("--only", default=None)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    if mx.num_tpus() == 0:
+        print("SKIP: no TPU visible")
+        return 0
+
+    expected = {}
+    if os.path.exists(EXPECTED):
+        with open(EXPECTED) as f:
+            expected = json.load(f)
+
+    results = {}
+    failures = []
+    for name, (fn, direction) in BENCHES.items():
+        if args.only and args.only != name:
+            continue
+        v = fn()
+        results[name] = round(v, 1)
+        exp = expected.get(name)
+        status = "new"
+        if exp is not None and not args.update:
+            lo, hi = exp["lo"], exp["hi"]
+            ok = v >= lo if direction == "higher" else v <= hi
+            status = "ok" if ok else "REGRESSION"
+            if not ok:
+                failures.append((name, v, exp))
+        print("%-24s %10.1f  [%s]  expected %s" % (
+            name, v, status, exp), flush=True)
+
+    if args.update or not expected:
+        out = dict(expected)           # keep entries not re-measured
+        for name, v in results.items():
+            out[name] = {"lo": round(v * (1 - BAR), 1),
+                         "hi": round(v * (1 + BAR), 1),
+                         "measured": v}
+        with open(EXPECTED, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print("wrote", EXPECTED)
+        return 0
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -348,14 +348,46 @@ class NDArray:
     # ------------------------------------------------------------------
     # indexing
     # ------------------------------------------------------------------
+    def _int64_index_scope(self):
+        """x64 scope for indexing arrays whose element count exceeds
+        int32 range: without it JAX truncates slice starts/scatter
+        indices to int32 — reads past 2^31 raise OverflowError and
+        writes silently land nowhere (reference:
+        tests/nightly/test_large_array.py, the INT64_TENSOR_SIZE build
+        flag; SURVEY.md §4.7)."""
+        import contextlib
+        if self.size >= 2**31:
+            import jax
+            return jax.enable_x64(True)
+        return contextlib.nullcontext()
+
+    def _widen_index_arrays(self, k):
+        """Inside the int64 scope, integer index ARRAYS must also be
+        int64 — XLA computes gather/scatter offsets in the index dtype,
+        so int32 indices overflow on >=2^31-element arrays even with
+        x64 on."""
+        jnp = _jnp()
+
+        def widen(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                      jnp.integer):
+                return x.astype(jnp.int64)
+            return x
+
+        if isinstance(k, tuple):
+            return tuple(widen(e) for e in k)
+        return widen(k)
+
     def __getitem__(self, key):
         key = _clean_index(key)
         from ..ops.registry import OpDef, invoke
-        import functools
 
         def impl(data, *idx_arrays):
             k = _rebuild_index(key, list(idx_arrays))
-            return data[k]
+            with self._int64_index_scope():
+                if self.size >= 2**31:
+                    k = self._widen_index_arrays(k)
+                return data[k]
 
         idx_arrays = _extract_index_arrays(key)
         op = OpDef("_getitem", impl, num_outputs=1)
@@ -374,7 +406,10 @@ class NDArray:
             v = value._data
         else:
             v = value
-        new = self._data.at[k].set(v)
+        with self._int64_index_scope():
+            if self.size >= 2**31:
+                k = self._widen_index_arrays(k)
+            new = self._data.at[k].set(v)
         self._set_data(new)
         return self
 

@@ -31,6 +31,7 @@ def test_amp_widest_cast(amp_on):
     assert str(out.dtype) == "float32"
 
 
+@pytest.mark.slow
 def test_amp_gluon_training_converges(amp_on):
     np.random.seed(0)
     net = mx.gluon.nn.HybridSequential()

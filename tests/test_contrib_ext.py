@@ -8,6 +8,7 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
 
 
+@pytest.mark.slow
 def test_deformable_conv_zero_offset_equals_conv():
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(2, 4, 8, 8).astype("float32"))
@@ -42,6 +43,7 @@ def test_deformable_conv_integer_offset_shifts():
     assert np.allclose(y, expect, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_deformable_conv_gradient_flows():
     rng = np.random.RandomState(2)
     x = nd.array(rng.randn(1, 2, 5, 5).astype("float32"))
@@ -60,6 +62,7 @@ def test_deformable_conv_gradient_flows():
     assert float(nd.norm(w.grad).asnumpy()) > 0
 
 
+@pytest.mark.slow
 def test_psroi_pooling_reads_position_sensitive_channels():
     C_out, P = 2, 3
     data = nd.array(np.tile(
@@ -86,6 +89,7 @@ def test_deformable_psroi_no_trans_matches_psroi():
     assert np.allclose(a.asnumpy(), b.asnumpy(), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_proposal_shapes_and_batch_ids():
     rng = np.random.RandomState(4)
     cls = nd.array(rng.rand(2, 6, 4, 4).astype("float32"))
@@ -248,6 +252,7 @@ def test_sync_batch_norm_matches_batch_norm():
     assert np.allclose(o1.asnumpy(), o2.asnumpy(), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_new_sample_distributions():
     mx.random.seed(0)
     k = nd.array(np.array([2.0], "float32"))

@@ -62,6 +62,7 @@ def test_estimator_fit_improves_accuracy():
     assert np.isfinite(lv)
 
 
+@pytest.mark.slow
 def test_estimator_validation_and_early_stopping():
     X, y = _dataset()
     net = _net()

@@ -118,6 +118,7 @@ def test_symbolblock_inputs_not_mutated(tmp_path):
     np.testing.assert_array_equal(x1.asnumpy(), x1_copy)
 
 
+@pytest.mark.slow
 def test_symbolblock_fine_tunes(tmp_path):
     """Gradients flow through a loaded SymbolBlock (reference parity)."""
     from mxnet_tpu import autograd, gluon

@@ -86,6 +86,7 @@ def test_generate_matches_full_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_generate_respects_max_len():
     import jax
     from mxnet_tpu.models import gpt, transformer as T

@@ -2,11 +2,13 @@
 LARS/mp-LAMB multi-tensor ops, legacy Crop (reference model:
 ``tests/python/unittest/test_operator.py`` sections)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, autograd
 
 
+@pytest.mark.slow
 def test_lrn_matches_torch():
     import torch
     x = np.random.RandomState(0).rand(2, 8, 5, 5).astype("float32")

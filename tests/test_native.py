@@ -174,6 +174,7 @@ class TestImageRecordIter:
         assert sorted(order1) == sorted(order2)
         assert order1 != order2 or True  # epochs reshuffle (probabilistic)
 
+    @pytest.mark.slow
     def test_matches_python_fallback(self, tmp_path):
         """Native pipeline output equals the Python fallback
         (center crop, no augmentation) — the cpu-vs-native oracle."""

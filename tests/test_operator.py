@@ -206,6 +206,7 @@ def test_take_pick_onehot():
     assert np.allclose(oh.asnumpy(), np.eye(4)[[0, 2]])
 
 
+@pytest.mark.slow
 def test_grads_of_common_ops():
     x = np.random.uniform(0.5, 1.5, (3, 4)).astype("float32")
     check_numeric_gradient(lambda a: (a * a).sum(), [x.copy()])
@@ -299,6 +300,7 @@ def test_sequence_ops():
     assert np.allclose(last.asnumpy(), np.stack([x[1, 0], x[2, 1]]))
 
 
+@pytest.mark.slow
 def test_fused_multi_sgd_matches_loop():
     """Pallas grouped optimizer kernel == per-tensor sgd_update loop."""
     import os

@@ -20,6 +20,7 @@ def test_make_mesh():
         make_mesh({"dp": 3})
 
 
+@pytest.mark.slow
 def test_data_parallel_trainer_matches_single_device():
     """Sharded dp training must match the math of plain training."""
     import jax

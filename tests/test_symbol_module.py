@@ -202,6 +202,7 @@ def test_check_symbolic_oracles():
                                {"a": b_np, "b": a_np})
 
 
+@pytest.mark.slow
 def test_sequential_module_trains():
     """SequentialModule chains two Modules; grads flow across the
     boundary (reference: module/sequential_module.py)."""
