@@ -27,8 +27,11 @@ EXPECTED = os.path.join(REPO, "benchmark", "perf_expected.json")
 def bench_resnet():
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True, timeout=900)
-    line = [l for l in r.stdout.splitlines() if '"metric"' in l][-1]
-    return json.loads(line)["value"]
+    lines = [l for l in r.stdout.splitlines() if '"metric"' in l]
+    if r.returncode != 0 or not lines:
+        raise RuntimeError("bench.py failed (rc=%d): %s"
+                           % (r.returncode, r.stderr[-1000:]))
+    return json.loads(lines[-1])["value"]
 
 
 def bench_bert():

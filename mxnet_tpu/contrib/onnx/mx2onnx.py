@@ -360,7 +360,12 @@ def export_model(sym, params, input_shapes, input_dtype="float32",
                              % n.op.name)
         new_nodes = conv(n.name, ins, dict(n.attrs), ctx)
         nodes.extend(new_nodes)
-        final_outs = new_nodes[-1]["outputs"]
+        # a converter whose LAST node carries "_mx_outputs" maps the
+        # mxnet node's outputs to those names positionally (needed when
+        # one mxnet output requires post-processing nodes, e.g. topk
+        # 'both' casting indices to float)
+        final_outs = new_nodes[-1].pop("_mx_outputs",
+                                       new_nodes[-1]["outputs"])
         for i, o in enumerate(final_outs):
             out_names[(id(n), i)] = o
 
@@ -421,3 +426,331 @@ def to_onnx_protobuf(model):
         opset_imports=[helper.make_opsetid("", model["opset"])])
     onnx.checker.check_model(m)
     return m
+
+
+# ---------------------------------------------------------------------------
+# round-2 converter expansion (reference: the ~130-op mx2onnx set)
+# ---------------------------------------------------------------------------
+
+for _mx, _ox in [("sin", "Sin"), ("cos", "Cos"), ("tan", "Tan"),
+                 ("arcsin", "Asin"), ("arccos", "Acos"),
+                 ("arctan", "Atan"), ("sinh", "Sinh"),
+                 ("cosh", "Cosh"), ("arcsinh", "Asinh"),
+                 ("arccosh", "Acosh"), ("arctanh", "Atanh"),
+                 ("ceil", "Ceil"), ("floor", "Floor"),
+                 ("round", "Round"), ("sign", "Sign"),
+                 ("reciprocal", "Reciprocal"),
+                 ("maximum", "Max"), ("minimum", "Min"),
+                 ("broadcast_greater", "Greater"),
+                 ("broadcast_lesser", "Less"),
+                 ("broadcast_equal", "Equal"),
+                 ("broadcast_greater_equal", "GreaterOrEqual"),
+                 ("broadcast_lesser_equal", "LessOrEqual")]:
+    register_op_converter(_mx)(_binop(_ox))
+
+
+@register_op_converter("square")
+def _square(name, ins, attrs, ctx):
+    return [_node("Mul", name, [ins[0], ins[0]])]
+
+
+@register_op_converter("hard_sigmoid")
+def _hard_sigmoid(name, ins, attrs, ctx):
+    return [_node("HardSigmoid", name, ins,
+                  alpha=float(attrs.get("alpha", 0.2)),
+                  beta=float(attrs.get("beta", 0.5)))]
+
+
+def _scalar_binop(op_type, reverse=False):
+    def conv(name, ins, attrs, ctx):
+        c = ctx.add_const(name + "_scalar",
+                          _np.float32(attrs.get("scalar", 0.0)))
+        inputs = [c, ins[0]] if reverse else [ins[0], c]
+        return [_node(op_type, name, inputs)]
+    return conv
+
+
+for _mx, _ox, _rev in [("_plus_scalar", "Add", False),
+                       ("_minus_scalar", "Sub", False),
+                       ("_rminus_scalar", "Sub", True),
+                       ("_mul_scalar", "Mul", False),
+                       ("_div_scalar", "Div", False),
+                       ("_rdiv_scalar", "Div", True),
+                       ("_power_scalar", "Pow", False),
+                       ("_maximum_scalar", "Max", False),
+                       ("_minimum_scalar", "Min", False)]:
+    register_op_converter(_mx)(_scalar_binop(_ox, _rev))
+
+
+def _reduce(op_type):
+    def conv(name, ins, attrs, ctx):
+        axes = _tuple_attr(attrs, "axis")
+        kw = {"keepdims": 1 if attrs.get("keepdims", False) else 0}
+        if axes is not None:
+            kw["axes"] = axes
+        return [_node(op_type, name, ins, **kw)]
+    return conv
+
+
+for _mx, _ox in [("max", "ReduceMax"), ("min", "ReduceMin"),
+                 ("max_axis", "ReduceMax"), ("min_axis", "ReduceMin"),
+                 ("prod", "ReduceProd")]:
+    register_op_converter(_mx)(_reduce(_ox))
+
+
+@register_op_converter("norm")
+def _norm(name, ins, attrs, ctx):
+    if int(attrs.get("ord", 2)) != 2:
+        raise MXNetError("onnx export: norm ord != 2 unsupported")
+    return _reduce("ReduceL2")(name, ins, attrs, ctx)
+
+
+def _arg_reduce(op_type):
+    def conv(name, ins, attrs, ctx):
+        ax = attrs.get("axis")
+        if ax is None:
+            # mxnet axis=None means FLATTENED argmax; ONNX's missing
+            # axis defaults to 0 — silently different numbers
+            raise MXNetError(
+                "onnx export: %s with axis=None (flatten semantics) "
+                "has no ONNX equivalent; reshape to 1-D first"
+                % op_type)
+        kw = {"keepdims": 1 if attrs.get("keepdims", False) else 0,
+              "axis": int(ax)}
+        # mxnet arg* returns float32; ONNX returns int64 — cast back
+        nodes = [_node(op_type, name + "_i64", ins, **kw),
+                 _node("Cast", name, [name + "_i64"], to=1)]  # FLOAT
+        return nodes
+    return conv
+
+
+register_op_converter("argmax")(_arg_reduce("ArgMax"))
+register_op_converter("argmin")(_arg_reduce("ArgMin"))
+
+
+@register_op_converter("slice")
+def _slice(name, ins, attrs, ctx):
+    begin = _tuple_attr(attrs, "begin")
+    end = _tuple_attr(attrs, "end")
+    step = _tuple_attr(attrs, "step")
+    axes = tuple(range(len(begin)))
+    c = lambda suf, v: ctx.add_const(name + suf,
+                                     _np.asarray(v, _np.int64))
+    inputs = [ins[0], c("_starts", begin), c("_ends", end),
+              c("_axes", axes)]
+    if step is not None and any(s not in (1, None) for s in step):
+        inputs.append(c("_steps", [1 if s is None else s
+                                   for s in step]))
+    return [_node("Slice", name, inputs)]
+
+
+@register_op_converter("slice_axis")
+def _slice_axis(name, ins, attrs, ctx):
+    ax = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = int(end) if end is not None else 2**31 - 1
+    c = lambda suf, v: ctx.add_const(name + suf,
+                                     _np.asarray(v, _np.int64))
+    return [_node("Slice", name,
+                  [ins[0], c("_starts", [begin]), c("_ends", [end]),
+                   c("_axes", [ax])])]
+
+
+@register_op_converter("split")
+def _split(name, ins, attrs, ctx):
+    n = int(attrs["num_outputs"])
+    ax = int(attrs.get("axis", 1))
+    outs = ["%s_out%d" % (name, i) for i in range(n)]
+    # opset 13: equal split is inferred from the output count — the
+    # num_outputs ATTRIBUTE only exists from opset 18 and fails the
+    # checker at 13
+    return [_node("Split", name, ins, outputs=outs, axis=ax)]
+
+
+register_op_converter("SliceChannel")(_CONVERTERS["split"])
+
+
+@register_op_converter("tile")
+def _tile(name, ins, attrs, ctx):
+    reps = _tuple_attr(attrs, "reps")
+    c = ctx.add_const(name + "_reps", _np.asarray(reps, _np.int64))
+    return [_node("Tile", name, [ins[0], c])]
+
+
+@register_op_converter("pad")
+def _pad(name, ins, attrs, ctx):
+    mode = attrs.get("mode", "constant")
+    if mode not in ("constant", "edge", "reflect"):
+        raise MXNetError("onnx export: pad mode %r" % mode)
+    pw = _tuple_attr(attrs, "pad_width")
+    # mxnet: (b0, a0, b1, a1, ...); onnx: (b0, b1, ..., a0, a1, ...)
+    begins = pw[0::2]
+    ends = pw[1::2]
+    c = ctx.add_const(name + "_pads",
+                      _np.asarray(begins + ends, _np.int64))
+    onnx_mode = {"constant": "constant", "edge": "edge",
+                 "reflect": "reflect"}[mode]
+    inputs = [ins[0], c]
+    if mode == "constant":
+        inputs.append(ctx.add_const(
+            name + "_value",
+            _np.float32(attrs.get("constant_value", 0.0))))
+    return [_node("Pad", name, inputs, mode=onnx_mode)]
+
+
+@register_op_converter("take")
+def _take(name, ins, attrs, ctx):
+    ax = int(attrs.get("axis", 0))
+    cast = _node("Cast", name + "_idx", [ins[1]], to=7)  # INT64
+    return [cast, _node("Gather", name, [ins[0], name + "_idx"],
+                        axis=ax)]
+
+
+@register_op_converter("Embedding")
+def _embedding(name, ins, attrs, ctx):
+    # Embedding(data=indices, weight) → Gather(weight, indices)
+    cast = _node("Cast", name + "_idx", [ins[0]], to=7)
+    return [cast, _node("Gather", name, [ins[1], name + "_idx"],
+                        axis=0)]
+
+
+@register_op_converter("where")
+def _where(name, ins, attrs, ctx):
+    cast = _node("Cast", name + "_cond", [ins[0]], to=9)  # BOOL
+    return [cast, _node("Where", name,
+                        [name + "_cond", ins[1], ins[2]])]
+
+
+@register_op_converter("one_hot")
+def _one_hot(name, ins, attrs, ctx):
+    depth = ctx.add_const(name + "_depth",
+                          _np.asarray(int(attrs["depth"]), _np.int64))
+    values = ctx.add_const(
+        name + "_values",
+        _np.asarray([attrs.get("off_value", 0.0),
+                     attrs.get("on_value", 1.0)], _np.float32))
+    cast = _node("Cast", name + "_idx", [ins[0]], to=7)
+    return [cast, _node("OneHot", name, [name + "_idx", depth, values],
+                        axis=-1)]
+
+
+@register_op_converter("topk")
+def _topk(name, ins, attrs, ctx):
+    ret = attrs.get("ret_typ", "indices")
+    if ret not in ("value", "indices", "both"):
+        raise MXNetError("onnx export: topk ret_typ %r" % ret)
+    k = ctx.add_const(name + "_k",
+                      _np.asarray([int(attrs.get("k", 1))], _np.int64))
+    ax = int(attrs.get("axis", -1))
+    largest = 0 if attrs.get("is_ascend", False) else 1
+    vals, idxs = name + "_vals", name + "_idxs"
+    nodes = [_node("TopK", name + "_topk", [ins[0], k],
+                   outputs=[vals, idxs], axis=ax, largest=largest,
+                   sorted=1)]
+    if ret == "value":
+        nodes.append(_node("Identity", name, [vals]))
+    elif ret == "indices":
+        nodes.append(_node("Cast", name, [idxs], to=1))
+    else:
+        nodes.append(_node("Cast", name + "_fidx", [idxs], to=1))
+        # declare the mxnet node's two outputs explicitly — the walk
+        # maps them positionally (see export_model)
+        nodes[-1]["_mx_outputs"] = [vals, name + "_fidx"]
+    return nodes
+
+
+@register_op_converter("Cast")
+def _cast(name, ins, attrs, ctx):
+    to = {"float32": 1, "float64": 11, "int32": 6, "int64": 7,
+          "float16": 10, "bool": 9,
+          "uint8": 2, "int8": 3}.get(str(attrs.get("dtype", "float32")))
+    if to is None:
+        raise MXNetError("onnx export: Cast dtype %r"
+                         % attrs.get("dtype"))
+    return [_node("Cast", name, ins, to=to)]
+
+
+register_op_converter("cast")(_CONVERTERS["Cast"])
+
+
+@register_op_converter("Deconvolution")
+def _deconv(name, ins, attrs, ctx):
+    kernel = _tuple_attr(attrs, "kernel")
+    stride = _tuple_attr(attrs, "stride", (1,) * len(kernel))
+    pad = _tuple_attr(attrs, "pad", (0,) * len(kernel))
+    dilate = _tuple_attr(attrs, "dilate")
+    if (dilate and any(d != 1 for d in dilate)) \
+            or attrs.get("adj") or attrs.get("target_shape"):
+        raise MXNetError("onnx export: Deconvolution dilate/adj/"
+                         "target_shape are unsupported")
+    return [_node("ConvTranspose", name, ins, kernel_shape=kernel,
+                  strides=stride, pads=pad + pad,
+                  group=int(attrs.get("num_group", 1)))]
+
+
+@register_op_converter("InstanceNorm")
+def _instance_norm(name, ins, attrs, ctx):
+    return [_node("InstanceNormalization", name, ins,
+                  epsilon=float(attrs.get("eps", 1e-3)))]
+
+
+@register_op_converter("LRN")
+def _lrn(name, ins, attrs, ctx):
+    return [_node("LRN", name, ins,
+                  alpha=float(attrs.get("alpha", 1e-4)),
+                  beta=float(attrs.get("beta", 0.75)),
+                  bias=float(attrs.get("knorm", 2.0)),
+                  size=int(attrs["nsize"]))]
+
+
+@register_op_converter("depth_to_space")
+def _d2s(name, ins, attrs, ctx):
+    return [_node("DepthToSpace", name, ins,
+                  blocksize=int(attrs["block_size"]), mode="DCR")]
+
+
+@register_op_converter("space_to_depth")
+def _s2d(name, ins, attrs, ctx):
+    return [_node("SpaceToDepth", name, ins,
+                  blocksize=int(attrs["block_size"]))]
+
+
+@register_op_converter("UpSampling")
+def _upsampling(name, ins, attrs, ctx):
+    if attrs.get("sample_type", "nearest") != "nearest":
+        raise MXNetError("onnx export: UpSampling bilinear → use "
+                         "_contrib_BilinearResize2D")
+    s = float(attrs["scale"])
+    scales = ctx.add_const(name + "_scales",
+                           _np.asarray([1, 1, s, s], _np.float32))
+    return [_node("Resize", name, [ins[0], "", scales],
+                  mode="nearest")]
+
+
+@register_op_converter("stack")
+def _stack(name, ins, attrs, ctx):
+    ax = int(attrs.get("axis", 0))
+    nodes = []
+    unsq = []
+    for i, x in enumerate(ins):
+        axc = ctx.add_const("%s_ax%d" % (name, i),
+                            _np.asarray([ax], _np.int64))
+        nodes.append(_node("Unsqueeze", "%s_u%d" % (name, i),
+                           [x, axc]))
+        unsq.append("%s_u%d" % (name, i))
+    nodes.append(_node("Concat", name, unsq, axis=ax))
+    return nodes
+
+
+@register_op_converter("flip")
+def _flip(name, ins, attrs, ctx):
+    ax = int(attrs["axis"])
+    c = lambda suf, v, dt: ctx.add_const(name + suf,
+                                         _np.asarray(v, dt))
+    return [_node("Slice", name,
+                  [ins[0], c("_starts", [-1], _np.int64),
+                   c("_ends", [_np.iinfo(_np.int64).min + 1],
+                     _np.int64),
+                   c("_axes", [ax], _np.int64),
+                   c("_steps", [-1], _np.int64)])]

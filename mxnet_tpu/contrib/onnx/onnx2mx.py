@@ -384,3 +384,227 @@ def import_model(model):
         else:
             arg_params[k] = arr
     return sym, arg_params, aux_params
+
+
+# ---------------------------------------------------------------------------
+# round-2 importer expansion (mirrors the mx2onnx converter set)
+# ---------------------------------------------------------------------------
+
+for _ox, _mx in [("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                 ("Asin", "arcsin"), ("Acos", "arccos"),
+                 ("Atan", "arctan"), ("Sinh", "sinh"),
+                 ("Cosh", "cosh"), ("Asinh", "arcsinh"),
+                 ("Acosh", "arccosh"), ("Atanh", "arctanh"),
+                 ("Ceil", "ceil"), ("Floor", "floor"),
+                 ("Round", "round"), ("Sign", "sign"),
+                 ("Reciprocal", "reciprocal"),
+                 ("Greater", "broadcast_greater"),
+                 ("Less", "broadcast_lesser"),
+                 ("Equal", "broadcast_equal"),
+                 ("GreaterOrEqual", "broadcast_greater_equal"),
+                 ("LessOrEqual", "broadcast_lesser_equal"),
+                 ("Softsign", "softsign"),
+                 ("Where", "where")]:
+    register_op_importer(_ox)(_direct(_mx))
+
+
+@register_op_importer("HardSigmoid")
+def _hard_sigmoid(node, get, attrs, ctx):
+    return _sym_op("hard_sigmoid", [get(0)],
+                   {"alpha": float(attrs.get("alpha", 0.2)),
+                    "beta": float(attrs.get("beta", 0.5))},
+                   name=node["name"])
+
+
+def _reduce_imp(mx_name):
+    def imp(node, get, attrs, ctx):
+        a = {"keepdims": bool(int(attrs.get("keepdims", 1)))}
+        if len(node["inputs"]) > 1 and node["inputs"][1]:
+            a["axis"] = _ints(ctx.const(node["inputs"][1]))
+        elif "axes" in attrs:
+            a["axis"] = _ints(attrs["axes"])
+        return _sym_op(mx_name, [get(0)], a, name=node["name"])
+    return imp
+
+
+register_op_importer("ReduceMax")(_reduce_imp("max"))
+register_op_importer("ReduceMin")(_reduce_imp("min"))
+register_op_importer("ReduceProd")(_reduce_imp("prod"))
+register_op_importer("ReduceL2")(_reduce_imp("norm"))
+
+
+def _arg_imp(mx_name):
+    def imp(node, get, attrs, ctx):
+        # ONNX's missing axis defaults to 0 (NOT mxnet's flatten-None)
+        a = {"keepdims": bool(int(attrs.get("keepdims", 1))),
+             "axis": int(attrs.get("axis", 0))}
+        return _sym_op(mx_name, [get(0)], a, name=node["name"])
+    return imp
+
+
+register_op_importer("ArgMax")(_arg_imp("argmax"))
+register_op_importer("ArgMin")(_arg_imp("argmin"))
+
+
+@register_op_importer("Slice")
+def _slice(node, get, attrs, ctx):
+    ins = node["inputs"]
+    starts = _ints(ctx.const(ins[1]))
+    ends = _ints(ctx.const(ins[2]))
+    axes = _ints(ctx.const(ins[3])) if len(ins) > 3 and ins[3] \
+        else tuple(range(len(starts)))
+    steps = _ints(ctx.const(ins[4])) if len(ins) > 4 and ins[4] \
+        else (1,) * len(starts)
+    if (len(axes) == 1 and steps[0] == -1 and starts[0] == -1
+            and ends[0] <= -(2**62)):
+        # the exporter's full-axis flip encoding specifically
+        return _sym_op("flip", [get(0)], {"axis": axes[0]},
+                       name=node["name"])
+    # general case: per-axis begin/end/step, None for untouched axes
+    rank = max(axes) + 1
+    b = [None] * rank
+    e = [None] * rank
+    st = [None] * rank
+    for s0, e0, ax, sp in zip(starts, ends, axes, steps):
+        b[ax] = s0
+        # large ONNX sentinels mean "to the boundary"
+        if sp >= 0:
+            e[ax] = None if e0 >= 2**31 - 1 else e0
+        else:
+            e[ax] = None if e0 <= -(2**31) else e0
+        st[ax] = sp
+    a = {"begin": tuple(b), "end": tuple(e)}
+    if any(s not in (None, 1) for s in st):
+        a["step"] = tuple(st)
+    return _sym_op("slice", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("Split")
+def _split_imp(node, get, attrs, ctx):
+    n = int(attrs.get("num_outputs", len(node["outputs"])))
+    return _sym_op("split", [get(0)],
+                   {"num_outputs": n, "axis": int(attrs.get("axis", 0))},
+                   name=node["name"])
+
+
+@register_op_importer("Tile")
+def _tile_imp(node, get, attrs, ctx):
+    reps = _ints(ctx.const(node["inputs"][1]))
+    return _sym_op("tile", [get(0)], {"reps": reps}, name=node["name"])
+
+
+@register_op_importer("Pad")
+def _pad_imp(node, get, attrs, ctx):
+    pads = _ints(ctx.const(node["inputs"][1]))
+    n = len(pads) // 2
+    pw = []
+    for i in range(n):
+        pw += [pads[i], pads[n + i]]
+    a = {"mode": attrs.get("mode", "constant"), "pad_width": tuple(pw)}
+    if len(node["inputs"]) > 2 and node["inputs"][2]:
+        a["constant_value"] = float(ctx.const(node["inputs"][2]))
+    return _sym_op("pad", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("Gather")
+def _gather_imp(node, get, attrs, ctx):
+    return _sym_op("take", [get(0), get(1)],
+                   {"axis": int(attrs.get("axis", 0))},
+                   name=node["name"])
+
+
+@register_op_importer("Cast")
+def _cast_imp(node, get, attrs, ctx):
+    to = int(attrs["to"])
+    dtype = {1: "float32", 11: "float64", 6: "int32", 7: "int64",
+             10: "float16", 9: "bool", 2: "uint8", 3: "int8"}.get(to)
+    if dtype is None:
+        raise MXNetError("onnx import: Cast to=%d unsupported" % to)
+    if dtype == "bool":
+        # mxnet has no bool dtype; comparisons already produce 0/1
+        return _sym_op("_copy", [get(0)], {}, name=node["name"])
+    return _sym_op("cast", [get(0)], {"dtype": dtype},
+                   name=node["name"])
+
+
+@register_op_importer("OneHot")
+def _one_hot_imp(node, get, attrs, ctx):
+    depth = int(ctx.const(node["inputs"][1]))
+    values = ctx.const(node["inputs"][2])
+    return _sym_op("one_hot", [get(0)],
+                   {"depth": depth, "off_value": float(values[0]),
+                    "on_value": float(values[1])}, name=node["name"])
+
+
+@register_op_importer("TopK")
+def _topk_imp(node, get, attrs, ctx):
+    k = int(ctx.const(node["inputs"][1])[0])
+    a = {"k": k, "axis": int(attrs.get("axis", -1)),
+         "ret_typ": "both",
+         "is_ascend": not bool(int(attrs.get("largest", 1)))}
+    return _sym_op("topk", [get(0)], a, name=node["name"])
+
+
+@register_op_importer("ConvTranspose")
+def _deconv_imp(node, get, attrs, ctx):
+    kernel = _ints(attrs["kernel_shape"])
+    pads = _ints(attrs.get("pads", (0,) * (2 * len(kernel))))
+    ins = [get(i) for i in range(len(node["inputs"]))]
+    wname = node["inputs"][1]
+    if wname not in ctx.initializers:
+        raise MXNetError("onnx import: ConvTranspose needs initializer "
+                         "weight")
+    a = {"kernel": kernel,
+         "stride": _ints(attrs.get("strides", (1,) * len(kernel))),
+         "pad": pads[:len(kernel)],
+         "num_group": int(attrs.get("group", 1)),
+         "no_bias": len(ins) < 3,
+         "num_filter": int(ctx.initializers[wname].shape[1]
+                           * int(attrs.get("group", 1)))}
+    return _sym_op("Deconvolution", ins, a, name=node["name"])
+
+
+@register_op_importer("InstanceNormalization")
+def _in_imp(node, get, attrs, ctx):
+    ins = [get(i) for i in range(3)]
+    return _sym_op("InstanceNorm", ins,
+                   {"eps": float(attrs.get("epsilon", 1e-5))},
+                   name=node["name"])
+
+
+@register_op_importer("LRN")
+def _lrn_imp(node, get, attrs, ctx):
+    return _sym_op("LRN", [get(0)],
+                   {"alpha": float(attrs.get("alpha", 1e-4)),
+                    "beta": float(attrs.get("beta", 0.75)),
+                    "knorm": float(attrs.get("bias", 2.0)),
+                    "nsize": int(attrs["size"])}, name=node["name"])
+
+
+@register_op_importer("DepthToSpace")
+def _d2s_imp(node, get, attrs, ctx):
+    return _sym_op("depth_to_space", [get(0)],
+                   {"block_size": int(attrs["blocksize"])},
+                   name=node["name"])
+
+
+@register_op_importer("SpaceToDepth")
+def _s2d_imp(node, get, attrs, ctx):
+    return _sym_op("space_to_depth", [get(0)],
+                   {"block_size": int(attrs["blocksize"])},
+                   name=node["name"])
+
+
+@register_op_importer("Resize")
+def _resize_imp(node, get, attrs, ctx):
+    mode = attrs.get("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if mode != "nearest":
+        raise MXNetError("onnx import: Resize mode %r unsupported"
+                         % mode)
+    scales = ctx.const(node["inputs"][2])
+    s = float(scales[2])
+    return _sym_op("UpSampling", [get(0)],
+                   {"scale": int(s), "sample_type": "nearest"},
+                   name=node["name"])

@@ -118,7 +118,8 @@ def test_onnx_roundtrip_mlp_ops():
 
 def test_onnx_export_unsupported_op_raises():
     x = sym.Variable("data")
-    s = sym.arccosh(x) if hasattr(sym, "arccosh") else None
+    # erfinv has no ONNX standard op and no converter here
+    s = sym.erfinv(x) if hasattr(sym, "erfinv") else None
     if s is None:
         pytest.skip("no unconverted op available")
     with pytest.raises(mx.MXNetError):
@@ -171,3 +172,145 @@ def test_onnx_batched_matmul_roundtrip():
     got = _bind_forward(s2, a2, data, x2)
     assert got.shape == (3, 2, 4)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def _roundtrip_unary(build, data_shape=(3, 4), positive=False,
+                     rtol=1e-5, atol=1e-6):
+    """export → import → forward equality for a single-op graph."""
+    x = sym.Variable("data")
+    out = build(x)
+    model = export_model(out, {}, [data_shape])
+    s2, arg2, aux2 = import_model(model)
+    rng = np.random.RandomState(0)
+    raw = rng.uniform(0.5, 1.5, data_shape) if positive else \
+        rng.uniform(-0.9, 0.9, data_shape)
+    data = nd.array(raw.astype("float32"))
+    ref = _bind_forward(out, {}, data)
+    got = _bind_forward(s2, arg2, data, aux2)
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("name", [
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "arcsinh", "arctanh", "ceil", "floor", "round", "sign",
+    "reciprocal", "square", "hard_sigmoid"])
+def test_onnx_roundtrip_new_unary(name):
+    positive = name in ("reciprocal", "arccosh")
+    _roundtrip_unary(lambda x: getattr(sym, name)(x),
+                     positive=positive)
+
+
+def test_onnx_roundtrip_scalar_ops():
+    _roundtrip_unary(lambda x: ((x * 2.0 + 1.5 - 0.25) / 4.0) ** 2.0)
+
+
+def test_onnx_roundtrip_reduce_arg_ops():
+    _roundtrip_unary(lambda x: sym.max(x, axis=1))
+    _roundtrip_unary(lambda x: sym.min(x, axis=0, keepdims=True))
+    _roundtrip_unary(lambda x: sym.prod(x, axis=1), positive=True)
+    _roundtrip_unary(lambda x: sym.norm(x, axis=1))
+    _roundtrip_unary(lambda x: sym.argmax(x, axis=1))
+    _roundtrip_unary(lambda x: sym.argmin(x, axis=1))
+
+
+def test_onnx_roundtrip_shape_ops():
+    _roundtrip_unary(lambda x: sym.slice(x, begin=(0, 1), end=(3, 4)))
+    _roundtrip_unary(lambda x: sym.slice_axis(x, axis=1, begin=1,
+                                              end=3))
+    _roundtrip_unary(lambda x: sym.tile(x, reps=(2, 2)))
+    _roundtrip_unary(lambda x: sym.flip(x, axis=1))
+    _roundtrip_unary(
+        lambda x: sym.pad(sym.reshape(x, shape=(1, 1, 3, 4)),
+                          mode="constant",
+                          pad_width=(0, 0, 0, 0, 1, 1, 2, 2)))
+    _roundtrip_unary(lambda x: sym.split(x, num_outputs=2, axis=1)[0])
+    _roundtrip_unary(lambda x: sym.stack(x, x, axis=1))
+    _roundtrip_unary(lambda x: sym.cast(x, dtype="float32"))
+
+
+def test_onnx_roundtrip_comparisons_where():
+    x = sym.Variable("data")
+    y = x * 2.0
+    cond = sym.broadcast_greater(x, y)
+    out = sym.where(cond, x, y)
+    model = export_model(out, {}, [(3, 4)])
+    s2, arg2, aux2 = import_model(model)
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.randn(3, 4).astype("float32"))
+    np.testing.assert_allclose(_bind_forward(s2, arg2, data, aux2),
+                               _bind_forward(out, {}, data), rtol=1e-5)
+
+
+def test_onnx_roundtrip_take_embedding():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.take(w, x, axis=0)
+    rng = np.random.RandomState(0)
+    params = {"w": nd.array(rng.randn(8, 5).astype("float32"))}
+    model = export_model(out, params, [(4,)])
+    s2, arg2, aux2 = import_model(model)
+    idx = nd.array(np.array([0., 3., 7., 1.], "float32"))
+    ref = _bind_forward(out, params, idx)
+    got = _bind_forward(s2, arg2, idx, aux2)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    emb = sym.Embedding(x, w, input_dim=8, output_dim=5, name="emb0")
+    model = export_model(emb, params, [(4,)])
+    s2, arg2, aux2 = import_model(model)
+    got = _bind_forward(s2, arg2, idx, aux2)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_onnx_roundtrip_one_hot_topk():
+    x = sym.Variable("data")
+    out = sym.one_hot(x, depth=5)
+    model = export_model(out, {}, [(4,)])
+    s2, arg2, aux2 = import_model(model)
+    idx = nd.array(np.array([0., 3., 4., 1.], "float32"))
+    np.testing.assert_allclose(_bind_forward(s2, arg2, idx, aux2),
+                               _bind_forward(out, {}, idx))
+
+    out = sym.topk(sym.Variable("data"), k=2, ret_typ="both", axis=-1)
+    model = export_model(out, {}, [(3, 5)])
+    s2, arg2, aux2 = import_model(model)
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.randn(3, 5).astype("float32"))
+    ex = out.bind(ctx=mx.cpu(), args={"data": data})
+    refs = [o.asnumpy() for o in ex.forward()]
+    ex2 = s2.bind(ctx=mx.cpu(), args={"data": data})
+    gots = [o.asnumpy() for o in ex2.forward()]
+    for r, g in zip(refs, gots):
+        np.testing.assert_allclose(g, r, rtol=1e-6)
+
+
+def test_onnx_roundtrip_deconv_instancenorm_lrn():
+    x = sym.Variable("data")
+    d = sym.Deconvolution(x, kernel=(2, 2), stride=(2, 2),
+                          num_filter=4, name="dc0")
+    i = sym.InstanceNorm(d, name="in0")
+    out = sym.LRN(i, nsize=3, name="lrn0")
+    data_shape = (1, 3, 5, 5)
+    args, aux = _init_params(out, data_shape)
+    model = export_model(out, dict(args), [data_shape])
+    s2, arg2, aux2 = import_model(model)
+    rng = np.random.RandomState(1)
+    data = nd.array(rng.randn(*data_shape).astype("float32"))
+    ref = _bind_forward(out, args, data, aux)
+    got = _bind_forward(s2, arg2, data, aux2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_roundtrip_spatial_blocks():
+    _roundtrip_unary(
+        lambda x: sym.depth_to_space(
+            sym.reshape(x, shape=(1, 4, 1, 3)), block_size=2),
+        data_shape=(3, 4))
+    _roundtrip_unary(
+        lambda x: sym.space_to_depth(
+            sym.reshape(x, shape=(1, 1, 2, 6)), block_size=2),
+        data_shape=(3, 4))
+    _roundtrip_unary(
+        lambda x: sym.UpSampling(
+            sym.reshape(x, shape=(1, 1, 3, 4)), scale=2,
+            sample_type="nearest"),
+        data_shape=(3, 4))

@@ -11,7 +11,6 @@ model_backwards_compatibility_check/ — SURVEY.md §4.7).
 """
 import json
 import os
-import struct
 
 import numpy as np
 import pytest
@@ -46,14 +45,32 @@ def test_int64_indexing_beyond_int32_elements():
     assert s == 1 + 2 + 3 + 4
 
 
-def test_size_arithmetic_is_int64():
-    """Shape/size bookkeeping must not wrap at 2^31 even when no giant
-    buffer is allocated (cheap guard that runs in every tier)."""
-    a = nd.zeros((2**16, 4), dtype="int8")
-    big = (2**20, 2**12)               # 2^32 elements, never allocated
-    from mxnet_tpu.ndarray.ndarray import NDArray
-    assert int(np.prod(big, dtype=np.int64)) == 2**32
-    assert a.size == 2**18
+def test_index_widening_machinery():
+    """Cheap every-tier guard for the int64 indexing fix: the widen
+    helper must upcast integer index arrays (XLA computes gather
+    offsets in the index dtype), and the x64 scope must activate
+    exactly at the 2^31-element threshold."""
+    import contextlib
+    import jax
+    import jax.numpy as jnp
+    a = nd.zeros((4, 4))
+    with jax.enable_x64(True):
+        k = a._widen_index_arrays((jnp.array([1, 2], jnp.int32),
+                                   slice(None)))
+        assert k[0].dtype == jnp.int64
+        assert isinstance(k[1], slice)
+    small = nd.zeros((8,))
+    assert isinstance(small._int64_index_scope(),
+                      contextlib.nullcontext().__class__)
+
+    class _Huge(type(a)):
+        @property
+        def size(self):
+            return 2**31
+
+    huge = _Huge(a._data)
+    assert not isinstance(huge._int64_index_scope(),
+                          contextlib.nullcontext().__class__)
 
 
 # ---------------------------------------------------------------------------
