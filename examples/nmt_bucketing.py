@@ -62,33 +62,43 @@ class BucketIter:
                 provide_label=[("softmax_label", (self.batch_size,))])
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--batches", type=int, default=60)
-    p.add_argument("--batch-size", type=int, default=32)
-    args = p.parse_args()
+def train(batches=60, batch_size=32, seed=0, score_after=0,
+          log_every=0):
+    """Train the bucketing module; returns (accuracy, module).
 
+    ``score_after``: only batches past this index count toward the
+    returned accuracy (lets convergence tests score the tail)."""
     bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(BUCKETS),
                                 context=mx.cpu())
-    bm.bind(data_shapes=[("data", (args.batch_size, max(BUCKETS)))],
-            label_shapes=[("softmax_label", (args.batch_size,))])
+    bm.bind(data_shapes=[("data", (batch_size, max(BUCKETS)))],
+            label_shapes=[("softmax_label", (batch_size,))])
     bm.init_params(initializer=mx.initializer.Xavier())
     bm.init_optimizer(optimizer="sgd",
                       optimizer_params={"learning_rate": 0.5})
 
     metric = mx.metric.Accuracy()
-    for i, batch in enumerate(BucketIter(args.batches,
-                                         args.batch_size)):
+    for i, batch in enumerate(BucketIter(batches, batch_size,
+                                         seed=seed)):
         bm.forward(batch, is_train=True)
         bm.backward()
         bm.update()
-        metric.update(batch.label[0], bm.get_outputs()[0])
-        if (i + 1) % 20 == 0:
+        if i >= score_after:
+            metric.update(batch.label[0], bm.get_outputs()[0])
+        if log_every and (i + 1) % log_every == 0:
             print("batch %3d  %s=%.3f  buckets=%s"
                   % (i + 1, *metric.get(), sorted(bm._buckets)))
-    name, acc = metric.get()
-    print("final %s=%.3f over buckets %s" % (name, acc,
-                                             sorted(bm._buckets)))
+    return metric.get()[1], bm
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args()
+    acc, bm = train(batches=args.batches, batch_size=args.batch_size,
+                    log_every=20)
+    print("final accuracy=%.3f over buckets %s"
+          % (acc, sorted(bm._buckets)))
 
 
 if __name__ == "__main__":
