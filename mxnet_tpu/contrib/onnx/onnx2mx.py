@@ -460,7 +460,14 @@ def _slice(node, get, attrs, ctx):
         # the exporter's full-axis flip encoding specifically
         return _sym_op("flip", [get(0)], {"axis": axes[0]},
                        name=node["name"])
-    # general case: per-axis begin/end/step, None for untouched axes
+    # general case: per-axis begin/end/step, None for untouched axes.
+    # ONNX allows negative axes; without the input rank they cannot be
+    # normalized here, so reject rather than silently mis-slicing.
+    if any(ax < 0 for ax in axes):
+        raise MXNetError(
+            "ONNX Slice with negative axes %r is not supported by the "
+            "importer (input rank unknown at import time); normalize "
+            "axes in the producing model" % (list(axes),))
     rank = max(axes) + 1
     b = [None] * rank
     e = [None] * rank
@@ -604,7 +611,18 @@ def _resize_imp(node, get, attrs, ctx):
         raise MXNetError("onnx import: Resize mode %r unsupported"
                          % mode)
     scales = ctx.const(node["inputs"][2])
-    s = float(scales[2])
+    if len(scales) != 4:
+        raise MXNetError("onnx import: Resize supports 4-D NCHW scales "
+                         "only (got %d-element scales; sizes-driven "
+                         "Resize unsupported)" % len(scales))
+    sh, sw = float(scales[2]), float(scales[3])
+    if sh != sw:
+        raise MXNetError("onnx import: Resize with asymmetric H/W "
+                         "scales %r/%r unsupported" % (sh, sw))
+    if sh <= 0 or sh != int(sh):
+        raise MXNetError("onnx import: Resize scale %r is not a "
+                         "positive integer (UpSampling cannot express "
+                         "fractional scales)" % sh)
     return _sym_op("UpSampling", [get(0)],
-                   {"scale": int(s), "sample_type": "nearest"},
+                   {"scale": int(sh), "sample_type": "nearest"},
                    name=node["name"])

@@ -622,9 +622,11 @@ class CSVIter(DataIter):
             label = np.loadtxt(label_csv, delimiter=",", dtype=dtype,
                                ndmin=2).reshape((-1,) + tuple(label_shape))
         else:
-            # no label_csv → no label (the reference CSVIter provides
-            # none; fabricating zeros would mis-wire Module.fit labels)
-            label = None
+            # no label_csv → all-zero dummy label, matching the reference
+            # iter_csv.cc ("if label_csv is not available, all labels
+            # will be returned as 0") so batch.label[0] stays valid
+            label = np.zeros((data.shape[0],) + tuple(label_shape),
+                             dtype=dtype)
         # round_batch=True: wrap the final short batch with leading
         # samples and report pad (the reference BatchLoader contract,
         # same as ImageRecordIter above); False: drop the short batch

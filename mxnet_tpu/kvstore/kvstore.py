@@ -105,9 +105,20 @@ class KVStore:
                 # compress each device's contribution before the
                 # cross-device aggregate (reference: CommDevice applies
                 # GradientCompression to the p2p reduce payloads); the
-                # error-feedback residual is per (key, device slot)
-                vlist = [self._dequant((k, i), v) for i, v in
-                         enumerate(vlist)]
+                # error-feedback residual is per (key, device[, dup#])
+                # so a caller reordering its device list across
+                # iterations cannot cross-apply residuals between
+                # gradient streams; repeated same-device values get a
+                # per-occurrence suffix so they keep distinct residuals
+                seen = {}
+                slots = []
+                for v in vlist:
+                    c = str(v.context)
+                    n = seen.get(c, 0)
+                    seen[c] = n + 1
+                    slots.append((k, c) if n == 0 else (k, c, n))
+                vlist = [self._dequant(s, v)
+                         for s, v in zip(slots, vlist)]
             reduced = vlist[0]
             for v in vlist[1:]:
                 reduced = reduced + v.as_in_context(target_ctx)

@@ -97,6 +97,11 @@ class DistServer:
         self.exit_on_idle = exit_on_idle
         self._conn_seen = 0
         self._conn_active = 0
+        # distinct worker ranks observed (from push messages): the
+        # idle-exit path must not arm until every rank has connected,
+        # or a dropped-and-reconnected worker could inflate a plain
+        # connection count past num_workers and strand late workers
+        self._ranks_seen = set()
         self.store: Dict[object, np.ndarray] = {}
         self._pending: Dict[object, list] = {}
         self._push_count: Dict[object, int] = {}
@@ -171,7 +176,7 @@ class DistServer:
             with self._cv:
                 self._conn_active -= 1
                 idle = (self.exit_on_idle and self._conn_active == 0
-                        and self._conn_seen >= self.num_workers)
+                        and len(self._ranks_seen) >= self.num_workers)
             if idle:
                 self.shutdown()
 
@@ -204,6 +209,7 @@ class DistServer:
                     _, key, value, rank, rnd = msg
                 value = np.asarray(value)
                 with self._cv:
+                    self._ranks_seen.add(rank)
                     if self.sync_mode:
                         bucket = self._pending.setdefault((key, rnd), {})
                         bucket[rank] = value
@@ -245,6 +251,16 @@ class DistServer:
                 from .. import optimizer as opt
                 optimizer = pickle.loads(blob)
                 self._updater = opt.get_updater(optimizer)
+                _send(conn, ("ok",))
+            elif cmd == "hello":
+                # worker announces its rank on connect; the idle-exit
+                # path arms only once every distinct rank has said hello
+                # (a reconnecting worker cannot inflate the count, and
+                # servers that never receive a push — shard-starved or
+                # pull-only workloads — still learn the full roster)
+                _, rank = msg
+                with self._cv:
+                    self._ranks_seen.add(rank)
                 _send(conn, ("ok",))
             elif cmd == "stop":
                 _send(conn, ("ok",))
@@ -318,6 +334,9 @@ class DistKVStore:
                     % (sid, host, port + sid, last_err))
             self._socks.append(sock)
         self._lock = threading.Lock()
+        for sock in self._socks:
+            _send(sock, ("hello", self._rank))
+            _recv(sock)
         self._pull_version: Dict[object, int] = {}
         self._push_round: Dict[object, int] = {}
         self._compressor = None

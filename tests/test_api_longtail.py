@@ -28,17 +28,18 @@ def test_csv_iter():
 
 
 def test_csv_iter_no_label():
-    """label_csv=None → NO label advertised (the reference CSVIter
-    provides none; fabricated zeros would mis-wire Module.fit)."""
+    """label_csv=None → all-zero dummy label (reference iter_csv.cc:
+    'if label_csv is not available, all labels will be returned as
+    0'), so scripts doing batch.label[0] keep working."""
     with tempfile.TemporaryDirectory() as d:
         dpath = os.path.join(d, "x.csv")
         X = np.arange(12).reshape(6, 2)
         np.savetxt(dpath, X, delimiter=",")
         it = mio.CSVIter(data_csv=dpath, data_shape=(2,), batch_size=3)
-        assert it.provide_label == []
         b = it.next()
         assert b.data[0].shape == (3, 2)
-        assert b.label is None or b.label == []
+        assert b.label[0].shape == (3, 1)
+        assert np.allclose(b.label[0].asnumpy(), 0)
 
 
 def test_libsvm_iter():

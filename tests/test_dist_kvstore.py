@@ -115,6 +115,21 @@ def test_two_bit_compressor_unit():
     assert err <= t + 1e-5, err         # bounded by one quantum
 
 
+def test_local_compression_residual_keyed_by_device():
+    """Error-feedback residuals are keyed by (key, device), not by the
+    positional slot, so reordering the device list across pushes keeps
+    each device's residual with its own gradient stream."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((8,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g0 = mx.nd.array(np.full((8,), 0.3, "float32"), ctx=mx.cpu(0))
+    g1 = mx.nd.array(np.full((8,), -0.2, "float32"), ctx=mx.cpu(1))
+    kv.push("w", [g0, g1])
+    kv.push("w", [g1, g0])      # reordered device list
+    keys = set(kv._compressor._residual)
+    assert keys == {("w", "cpu(0)"), ("w", "cpu(1)")}, keys
+
+
 def test_dist_push_compressed_wire():
     """cpush sends the packed payload over the socket — measure the
     actual wire bytes and check the server reconstructs quantized

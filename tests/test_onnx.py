@@ -126,6 +126,51 @@ def test_onnx_export_unsupported_op_raises():
         export_model(s, {}, [(2, 2)])
 
 
+def _min_model(nodes, inits, in_shape=(2, 3, 4, 4)):
+    return {"ir_version": 7, "opset": 13, "producer": "test",
+            "graph": {"name": "g", "nodes": nodes,
+                      "inputs": [{"name": "data", "shape": in_shape,
+                                  "dtype": "float32"}],
+                      "outputs": [nodes[-1]["outputs"][0]],
+                      "initializers": inits}}
+
+
+def test_onnx_import_slice_negative_axes_rejected():
+    """ONNX allows negative axes in Slice; without the input rank they
+    cannot be normalized, so the importer must reject them instead of
+    building a wrong begin/end list (advisor finding, round 2)."""
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model as imp
+    model = _min_model(
+        [{"op_type": "Slice", "name": "sl",
+          "inputs": ["data", "st", "en", "ax"], "outputs": ["out"],
+          "attrs": {}}],
+        {"st": np.array([0]), "en": np.array([2]),
+         "ax": np.array([-1])})
+    with pytest.raises(mx.MXNetError, match="negative axes"):
+        imp(model)
+
+
+def test_onnx_import_resize_bad_scales_rejected():
+    """Fractional or asymmetric H/W Resize scales cannot be expressed by
+    UpSampling — must raise, not silently truncate (advisor finding)."""
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model as imp
+
+    def m(scales):
+        return _min_model(
+            [{"op_type": "Resize", "name": "rs",
+              "inputs": ["data", "roi", "sc"], "outputs": ["out"],
+              "attrs": {"mode": "nearest"}}],
+            {"roi": np.array([], dtype="float32"),
+             "sc": np.array(scales, dtype="float32")})
+    with pytest.raises(mx.MXNetError, match="not a positive integer"):
+        imp(m([1, 1, 1.5, 1.5]))
+    with pytest.raises(mx.MXNetError, match="asymmetric"):
+        imp(m([1, 1, 2, 3]))
+    # integral symmetric scales still import
+    s2, a2, x2 = imp(m([1, 1, 2, 2]))
+    assert s2 is not None
+
+
 def test_onnx_protobuf_requires_package():
     from mxnet_tpu.contrib.onnx.mx2onnx import to_onnx_protobuf
     s = _convnet()
