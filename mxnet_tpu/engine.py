@@ -105,6 +105,24 @@ class Engine:
                         pass
         return result
 
+    def note(self, result):
+        """Record op outputs in the recent ring without the push() hook
+        machinery — the invoke fast lane calls this so ``wait_for_all``
+        stays a true sync point."""
+        import weakref
+        if type(result) in (tuple, list):
+            for r in result:
+                if hasattr(r, "block_until_ready"):
+                    try:
+                        self._recent.append(weakref.ref(r))
+                    except TypeError:
+                        pass
+        elif hasattr(result, "block_until_ready"):
+            try:
+                self._recent.append(weakref.ref(result))
+            except TypeError:
+                pass
+
     def wait_for_all(self):
         """Block until all outstanding device work completes; deferred
         device errors surface here.

@@ -381,15 +381,27 @@ class NDArray:
     def __getitem__(self, key):
         key = _clean_index(key)
         from ..ops.registry import OpDef, invoke
+        idx_arrays = _extract_index_arrays(key)
 
-        def impl(data, *idx_arrays):
-            k = _rebuild_index(key, list(idx_arrays))
+        # cacheable lane: basic/int-fancy indexing on <2^31-element
+        # arrays goes through a stable op with the index as a hashable
+        # attr, so the eager-jit cache applies (slicing is the data
+        # pipeline's hottest imperative op).  Bool masks (data-dependent
+        # output shape) and int64-widening cases use the direct path.
+        if self.size < 2**31:
+            tmpl = _index_template(key)
+            if tmpl is not None and not any(
+                    a.dtype == _np.bool_ for a in idx_arrays):
+                return invoke(_getitem_op(), [self] + idx_arrays,
+                              attrs={"key_tmpl": tmpl})
+
+        def impl(data, *idx_arrs):
+            k = _rebuild_index(key, list(idx_arrs))
             with self._int64_index_scope():
                 if self.size >= 2**31:
                     k = self._widen_index_arrays(k)
                 return data[k]
 
-        idx_arrays = _extract_index_arrays(key)
         op = OpDef("_getitem", impl, num_outputs=1)
         return invoke(op, [self] + idx_arrays)
 
@@ -540,6 +552,74 @@ def _rebuild_index(key, arrays: List[Any]):
     if isinstance(key, tuple):
         return tuple(next(it) if isinstance(k, NDArray) else k for k in key)
     return key
+
+
+# --- cacheable __getitem__ lane -------------------------------------------
+
+class _Arr:
+    """Hashable placeholder marking an index-array position in a key
+    template (the arrays themselves travel as op inputs)."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<ARR>"
+
+
+_ARR = _Arr()
+
+
+def _index_template(key):
+    """Hashable template of a cleaned index, or None if the index uses
+    constructs the cacheable lane does not handle (lists — whose
+    fancy-index semantics a tuple template would corrupt — np arrays,
+    or anything unhashable)."""
+    def one(k):
+        if isinstance(k, NDArray):
+            return _ARR
+        if k is None or k is Ellipsis or type(k) is slice:
+            return k
+        if isinstance(k, (int, _np.integer)) and not isinstance(k, bool):
+            return int(k)
+        return _INVALID
+
+    _INVALID = object()
+    if isinstance(key, tuple):
+        out = tuple(one(k) for k in key)
+        return None if any(o is _INVALID for o in out) else out
+    o = one(key)
+    return None if o is _INVALID else o
+
+
+def _rebuild_index_tmpl(tmpl, arrays: List[Any]):
+    it = iter(arrays)
+    if tmpl is _ARR:
+        return next(it)
+    if isinstance(tmpl, tuple):
+        return tuple(next(it) if k is _ARR else k for k in tmpl)
+    return tmpl
+
+
+def _getitem_cacheable_impl(*args, key_tmpl=None):
+    data = args[0]
+    return data[_rebuild_index_tmpl(key_tmpl, list(args[1:]))]
+
+
+_GETITEM_OP = None
+
+
+def _getitem_op():
+    global _GETITEM_OP
+    if _GETITEM_OP is None:
+        from ..ops.registry import OpDef
+        # module-lifetime OpDef → safe to mark cacheable (id is stable)
+        _GETITEM_OP = OpDef("_getitem", _getitem_cacheable_impl,
+                            num_outputs=1, cacheable=True)
+    return _GETITEM_OP
 
 
 # ---------------------------------------------------------------------------

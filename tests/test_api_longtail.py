@@ -42,6 +42,62 @@ def test_csv_iter_no_label():
         assert np.allclose(b.label[0].asnumpy(), 0)
 
 
+def test_eager_jit_unhashable_pos_attr_falls_back():
+    """A raw numpy array in positional attrs must fall back to the
+    direct eager path, not crash the cache-key lookup (review
+    regression, round 3)."""
+    from mxnet_tpu import nd
+    a = nd.array(np.array([[1., 2.], [3., 4.]], "float32"))
+    out = nd.take(a, np.array([0, 1]))
+    assert out.shape[0] == 2
+
+
+def test_bleu_metric():
+    """metric.BLEU vs the hand-computed Papineni example: hyp 'the cat
+    is on the mat' / ref 'the cat sat on the mat' → smoothed BLEU-4 =
+    (5/6 · 4/6 · 2/5 · 1/4)^(1/4) ≈ 0.48549, BP=1 (equal lengths);
+    unsmoothed is 0 (no 4-gram match)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    vocab = {w: i for i, w in enumerate(
+        "the cat is sat on mat PAD".split())}
+
+    def ids(s, pad_to=None):
+        t = [vocab[w] for w in s.split()]
+        if pad_to:
+            t += [vocab["PAD"]] * (pad_to - len(t))
+        return t
+
+    hyp = ids("the cat is on the mat")
+    ref = ids("the cat sat on the mat")
+    m = mx.metric.create("bleu", smooth=True)
+    m.update(nd.array([ref]), nd.array([hyp]))
+    assert abs(m.get()[1] - 0.485498) < 1e-4, m.get()
+    m0 = mx.metric.BLEU(smooth=False)
+    m0.update(nd.array([ref]), nd.array([hyp]))
+    assert m0.get()[1] == 0.0
+    # perfect hypothesis → 1.0; pad stripping must not change it
+    m1 = mx.metric.BLEU(pad_token=vocab["PAD"])
+    m1.update(nd.array([ids("the cat sat on the mat", pad_to=9)]),
+              nd.array([ids("the cat sat on the mat", pad_to=9)]))
+    assert abs(m1.get()[1] - 1.0) < 1e-9
+    # brevity penalty: hyp strictly shorter than ref is penalized below
+    # its raw precision (here all n-gram precisions are 1)
+    m2 = mx.metric.BLEU(max_n=2)
+    m2.update(nd.array([ids("the cat sat on")]),
+              nd.array([ids("the cat sat")]))
+    import math
+    assert abs(m2.get()[1] - math.exp(1 - 4 / 3)) < 1e-6
+    # scores (batch, len, vocab) are argmax-decoded
+    import numpy as _np
+    sc = _np.zeros((1, len(hyp), len(vocab)), "float32")
+    for i, t in enumerate(hyp):
+        sc[0, i, t] = 1.0
+    m3 = mx.metric.BLEU(smooth=True)
+    m3.update(nd.array([ref]), nd.array(sc))
+    assert abs(m3.get()[1] - 0.485498) < 1e-4
+
+
 def test_libsvm_iter():
     with tempfile.TemporaryDirectory() as d:
         sv = os.path.join(d, "t.svm")
