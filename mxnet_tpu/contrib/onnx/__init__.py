@@ -1,20 +1,25 @@
 """ONNX interop (reference: ``python/mxnet/contrib/onnx/`` — SURVEY.md
 §2.2 "ONNX" row: per-op export/import converters).
 
-The converters operate on a lightweight dict-based model IR mirroring
-ONNX's ModelProto/GraphProto structure, so conversion logic runs and is
-tested without the ``onnx`` package; serialization to/from real ``.onnx``
-protobuf files engages only when ``onnx`` is importable (it is not baked
-into this environment — see Environment notes).
+The converters operate on a dict-based model IR mirroring ONNX's
+ModelProto/GraphProto structure; ``onnx_proto.py`` is a hand-rolled
+protobuf wire codec (no ``onnx``/``protobuf`` dependency) that
+serializes the dict IR to real ``.onnx`` file bytes and parses foreign
+``.onnx`` files back.  The reader is cross-validated against torch's
+independent ONNX writer (tests/test_onnx_rnn.py), and golden ``.onnx``
+byte files pin the format across rounds (tests/golden/onnx_*.onnx).
 
-* ``export_model(sym, params, input_shapes, ...)`` — Symbol + params →
-  ONNX (mx2onnx)
-* ``import_model(path_or_dict)`` — ONNX → (Symbol, arg_params,
-  aux_params) (onnx2mx)
+* ``export_model(sym, params, input_shapes, onnx_file_path=...)`` —
+  Symbol + params → dict model, optionally written as ``.onnx`` bytes
+  (mx2onnx; ``mx2onnx.to_onnx_bytes`` for the raw bytes)
+* ``import_model(path_or_dict)`` — ``.onnx`` file or dict model →
+  (Symbol, arg_params, aux_params) (onnx2mx)
 """
 from .mx2onnx import export_model
 from .onnx2mx import import_model
 from . import mx2onnx
 from . import onnx2mx
+from . import onnx_proto
 
-__all__ = ["export_model", "import_model", "mx2onnx", "onnx2mx"]
+__all__ = ["export_model", "import_model", "mx2onnx", "onnx2mx",
+           "onnx_proto"]

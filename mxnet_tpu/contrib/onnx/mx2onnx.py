@@ -316,6 +316,133 @@ def _block_grad(name, ins, attrs, ctx):
 # graph walk
 # ---------------------------------------------------------------------------
 
+# -- fused RNN family (reference: mx2onnx rnn converters) -------------------
+
+# gate-order block permutations, ours → ONNX (rows of the G·H weight
+# blocks).  Ours follows cuDNN packing (ops/rnn_op.py): LSTM [i,f,g,o],
+# GRU [r,z,n]; ONNX: LSTM W[iofc], GRU W[zrh].
+_LSTM_TO_ONNX = (0, 3, 1, 2)
+_GRU_TO_ONNX = (1, 0, 2)
+
+
+def _gate_reorder(mat, order, H):
+    """Reorder the leading G·H axis of W/R/b blocks by gate."""
+    blocks = [mat[g * H:(g + 1) * H] for g in range(len(order))]
+    return _np.concatenate([blocks[g] for g in order], axis=0)
+
+
+@register_op_converter("RNN")
+def _rnn_conv(name, ins, attrs, ctx):
+    from ...ops.rnn_op import _unpack_params, _GATES
+    mode = attrs["mode"]
+    if mode not in _GATES:
+        raise MXNetError("onnx export: RNN mode %r unsupported" % mode)
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    bi = str(attrs.get("bidirectional", False)) in ("True", "true", "1")
+    D = 2 if bi else 1
+    G = _GATES[mode]
+    if str(attrs.get("use_sequence_length", False)) in ("True", "1"):
+        raise MXNetError("onnx export: RNN use_sequence_length "
+                         "unsupported (ONNX sequence_lens not emitted)")
+
+    pname = ins[1]
+    if pname not in ctx.initializers:
+        raise MXNetError(
+            "onnx export: RNN parameters %r must be a constant "
+            "initializer (pass them in export_model params)" % pname)
+    # read without popping — a second RNN node may share (tie) the same
+    # parameter variable; the unused flat initializer is pruned by the
+    # post-walk cleanup in export_model
+    params = _np.asarray(ctx.initializers[pname])
+    # infer input size from the packed length (rnn_param_size inverse)
+    per_rest = D * (G * H * (H * D) + G * H * H + 2 * G * H)
+    first_fixed = D * (G * H * H + 2 * G * H)
+    I = (params.size - (L - 1) * per_rest - first_fixed) // (D * G * H)
+    weights, biases = _unpack_params(params, mode, L, int(I), H, D)
+
+    order = {"lstm": _LSTM_TO_ONNX, "gru": _GRU_TO_ONNX}.get(
+        mode, (0,))
+    onnx_type = {"lstm": "LSTM", "gru": "GRU",
+                 "rnn_tanh": "RNN", "rnn_relu": "RNN"}[mode]
+    nodes = []
+    x = ins[0]
+    hs, cs = [], []
+    for layer in range(L):
+        Ws, Rs, Bs = [], [], []
+        for d in range(D):
+            W, R = weights[layer][d]
+            bW, bR = biases[layer][d]
+            Ws.append(_gate_reorder(_np.asarray(W), order, H))
+            Rs.append(_gate_reorder(_np.asarray(R), order, H))
+            Bs.append(_np.concatenate(
+                [_gate_reorder(_np.asarray(bW).reshape(-1, 1), order,
+                               H).ravel(),
+                 _gate_reorder(_np.asarray(bR).reshape(-1, 1), order,
+                               H).ravel()]))
+        ln = "%s_l%d" % (name, layer)
+        ctx.add_const(ln + "_W", _np.stack(Ws))
+        ctx.add_const(ln + "_R", _np.stack(Rs))
+        ctx.add_const(ln + "_B", _np.stack(Bs))
+        # initial states: slice this layer's (D, N, H) block out of the
+        # (L*D, N, H) state input
+        if L == 1:
+            h0 = ins[2]
+        else:
+            h0 = ln + "_h0"
+            ctx.add_const(ln + "_h0_b", _np.array([layer * D]))
+            ctx.add_const(ln + "_h0_e", _np.array([(layer + 1) * D]))
+            ctx.add_const(ln + "_h0_a", _np.array([0]))
+            nodes.append(_node("Slice", h0,
+                               [ins[2], ln + "_h0_b", ln + "_h0_e",
+                                ln + "_h0_a"]))
+        node_inputs = [x, ln + "_W", ln + "_R", ln + "_B", "", h0]
+        if mode == "lstm":
+            if L == 1:
+                c0 = ins[3]
+            else:
+                c0 = ln + "_c0"
+                nodes.append(_node("Slice", c0,
+                                   [ins[3], ln + "_h0_b", ln + "_h0_e",
+                                    ln + "_h0_a"]))
+            node_inputs.append(c0)
+        a = {"hidden_size": H,
+             "direction": "bidirectional" if bi else "forward"}
+        if mode == "rnn_relu":
+            a["activations"] = ["Relu"] * D
+        if mode == "gru":
+            a["linear_before_reset"] = 1   # cuDNN/MXNet convention
+        outs = [ln + "_Y", ln + "_Yh"] + \
+            ([ln + "_Yc"] if mode == "lstm" else [])
+        nodes.append(_node(onnx_type, ln, node_inputs, outputs=outs, **a))
+        hs.append(ln + "_Yh")
+        if mode == "lstm":
+            cs.append(ln + "_Yc")
+        # Y is (T, D, N, H) → (T, N, D·H) for the next layer / output
+        nodes.append(_node("Transpose", ln + "_Yt", [ln + "_Y"],
+                           perm=(0, 2, 1, 3)))
+        ctx.add_const(ln + "_Yshape", _np.array([0, 0, D * H],
+                                                dtype="int64"))
+        nodes.append(_node("Reshape", ln + "_Yr",
+                           [ln + "_Yt", ln + "_Yshape"]))
+        x = ln + "_Yr"
+
+    if L == 1:
+        hN = hs[0]
+        cN = cs[0] if cs else None
+    else:
+        hN = name + "_hN"
+        nodes.append(_node("Concat", hN, hs, axis=0))
+        if cs:
+            cN = name + "_cN"
+            nodes.append(_node("Concat", cN, cs, axis=0))
+        else:
+            cN = None
+    outs = [x, hN] + ([cN] if cN else [])
+    nodes[-1]["_mx_outputs"] = outs
+    return nodes
+
+
 def export_model(sym, params, input_shapes, input_dtype="float32",
                  onnx_file_path=None, opset_version=OPSET):
     """Export a Symbol + params to an ONNX model.
@@ -373,6 +500,14 @@ def export_model(sym, params, input_shapes, input_dtype="float32",
     for (n, oi) in sym._outputs:
         graph_outputs.append(out_names[(id(n), oi)])
 
+    # prune initializers no node consumes (e.g. the flat RNN parameter
+    # vector its converter re-packed into per-layer W/R/B tensors)
+    referenced = set(graph_outputs)
+    for node in nodes:
+        referenced.update(node["inputs"])
+    ctx.initializers = {k: v for k, v in ctx.initializers.items()
+                        if k in referenced}
+
     model = {
         "ir_version": 8,
         "opset": opset_version,
@@ -388,10 +523,17 @@ def export_model(sym, params, input_shapes, input_dtype="float32",
         },
     }
     if onnx_file_path:
-        proto = to_onnx_protobuf(model)
         with open(onnx_file_path, "wb") as f:
-            f.write(proto.SerializeToString())
+            f.write(to_onnx_bytes(model))
     return model
+
+
+def to_onnx_bytes(model) -> bytes:
+    """Serialize the dict model to real ``.onnx`` file bytes via the
+    built-in protobuf wire encoder (``onnx_proto.py``) — no external
+    dependency.  ``onnx.load`` on the result yields the same model."""
+    from .onnx_proto import encode_model
+    return encode_model(model)
 
 
 def to_onnx_protobuf(model):

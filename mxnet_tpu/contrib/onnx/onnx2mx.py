@@ -287,7 +287,18 @@ def _squeeze(node, get, attrs, ctx):
 # ---------------------------------------------------------------------------
 
 def _from_onnx_protobuf(path):
-    """Load a real .onnx file into the dict IR (needs ``onnx``)."""
+    """Load a real .onnx file into the dict IR.
+
+    Uses the built-in wire-format reader (``onnx_proto.decode_model``,
+    no dependency); the ``onnx``-package path below is kept only as a
+    cross-check when that package happens to be installed."""
+    from .onnx_proto import decode_model
+    with open(path, "rb") as f:
+        return decode_model(f.read())
+
+
+def _from_onnx_protobuf_pkg(path):
+    """Same, via the ``onnx`` package (cross-validation helper)."""
     try:
         import onnx
         from onnx import numpy_helper
@@ -384,6 +395,134 @@ def import_model(model):
         else:
             arg_params[k] = arr
     return sym, arg_params, aux_params
+
+
+# -- fused RNN family (inverse of the mx2onnx RNN converter) ----------------
+
+# ONNX gate blocks → our cuDNN-packed order (ops/rnn_op.py):
+# LSTM onnx [i,o,f,c] → ours [i,f,g(c),o]; GRU onnx [z,r,h] → ours [r,z,h]
+_LSTM_FROM_ONNX = (0, 2, 3, 1)
+_GRU_FROM_ONNX = (1, 0, 2)
+
+
+def _gate_unorder(mat, order, H):
+    blocks = [mat[g * H:(g + 1) * H] for g in range(len(order))]
+    return _np.concatenate([blocks[g] for g in order], axis=0)
+
+
+def _rnn_importer(mode):
+    def imp(node, get, attrs, ctx):
+        from ...ops.rnn_op import _GATES
+        G = _GATES[mode]
+        H = int(attrs["hidden_size"])
+        direction = attrs.get("direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+        if direction == "reverse":
+            raise MXNetError("onnx import: reverse-only %s unsupported"
+                             % node["op_type"])
+        D = 2 if direction == "bidirectional" else 1
+        if float(attrs.get("clip", 0)) != 0:
+            raise MXNetError("onnx import: RNN clip unsupported")
+        acts = attrs.get("activations")
+        if acts is not None:
+            acts = tuple(a.decode() if isinstance(a, bytes) else a
+                         for a in acts)
+        rnn_mode = mode
+        if mode == "rnn_tanh":
+            if acts and acts[0] == "Relu":
+                rnn_mode = "rnn_relu"
+            elif acts and acts[0] != "Tanh":
+                raise MXNetError("onnx import: RNN activation %r "
+                                 "unsupported" % (acts[0],))
+        elif acts is not None:
+            defaults = {"lstm": ("Sigmoid", "Tanh", "Tanh"),
+                        "gru": ("Sigmoid", "Tanh")}[mode] * D
+            if tuple(acts) != defaults:
+                raise MXNetError("onnx import: custom %s activations %r "
+                                 "unsupported" % (mode, acts))
+        if mode == "gru" and int(attrs.get("linear_before_reset", 0)) != 1:
+            raise MXNetError(
+                "onnx import: GRU linear_before_reset=0 has no "
+                "cuDNN-convention equivalent (reference RNN op is "
+                "linear_before_reset=1)")
+        ins = node["inputs"]
+        if len(ins) > 4 and ins[4]:
+            raise MXNetError("onnx import: RNN sequence_lens unsupported")
+        order = {"lstm": _LSTM_FROM_ONNX, "gru": _GRU_FROM_ONNX}.get(
+            mode, (0,))
+        W = _np.asarray(ctx.const(ins[1]))   # (D, G*H, I)
+        R = _np.asarray(ctx.const(ins[2]))   # (D, G*H, H)
+        if len(ins) > 3 and ins[3]:
+            B = _np.asarray(ctx.const(ins[3]))
+        else:
+            B = _np.zeros((D, 2 * G * H), dtype=W.dtype)
+        flat = []
+        for d in range(D):
+            flat.append(_gate_unorder(W[d], order, H).ravel())
+            flat.append(_gate_unorder(R[d], order, H).ravel())
+        for d in range(D):
+            flat.append(_gate_unorder(B[d][:G * H].reshape(-1, 1), order,
+                                      H).ravel())
+            flat.append(_gate_unorder(B[d][G * H:].reshape(-1, 1), order,
+                                      H).ravel())
+        pname = node["name"] + "_parameters"
+        ctx.initializers[pname] = _np.concatenate(flat)
+        from ...symbol.symbol import Variable
+        params_var = Variable(pname)
+
+        a = {"mode": rnn_mode, "state_size": H, "num_layers": 1,
+             "bidirectional": D == 2, "state_outputs": True}
+        h0 = ins[5] if len(ins) > 5 and ins[5] else None
+        c0 = ins[6] if mode == "lstm" and len(ins) > 6 and ins[6] else None
+        if h0 is None and c0 is None:
+            res = _sym_op("_rnn_nostate", [get(0), params_var], a,
+                          name=node["name"])
+        else:
+            if h0 is None:
+                raise MXNetError("onnx import: LSTM with initial_c but "
+                                 "no initial_h unsupported")
+            inputs = [get(0), params_var, get(5)]
+            if mode == "lstm":
+                if c0 is None:
+                    raise MXNetError("onnx import: LSTM initial_c "
+                                     "required when initial_h given")
+                inputs.append(get(6))
+            res = _sym_op("RNN", inputs, a, name=node["name"])
+        # our Y is (T, N, D*H); ONNX consumers expect (T, D, N, H)
+        y = _sym_op("reshape", [res[0]], {"shape": (0, 0, D, H)},
+                    name=node["name"] + "_yr")
+        y = _sym_op("transpose", [y], {"axes": (0, 2, 1, 3)},
+                    name=node["name"] + "_yt")
+        from ...symbol.symbol import Group
+        outs = [y, res[1]]
+        if mode == "lstm":
+            outs.append(res[2])
+        return Group(outs)
+    return imp
+
+
+@register_op_importer("Constant")
+def _constant_imp(node, get, attrs, ctx):
+    """Constant node → initializer (consumers read it via ctx.const or
+    bind it as a param variable)."""
+    v = attrs.get("value")
+    if v is None:
+        for k in ("value_float", "value_int"):
+            if k in attrs:
+                v = _np.asarray(attrs[k])
+                break
+    if v is None:
+        raise MXNetError("onnx import: Constant without value attr")
+    from ...symbol.symbol import Variable
+    out = node["outputs"][0]
+    ctx.initializers[out] = _np.asarray(v)
+    return Variable(out)
+
+
+register_op_importer("LSTM")(_rnn_importer("lstm"))
+register_op_importer("GRU")(_rnn_importer("gru"))
+register_op_importer("RNN")(_rnn_importer("rnn_tanh"))
 
 
 # ---------------------------------------------------------------------------

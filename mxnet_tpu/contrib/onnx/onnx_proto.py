@@ -1,0 +1,481 @@
+"""Hand-rolled ONNX protobuf wire codec — no ``onnx``/``protobuf``
+dependency.
+
+Reference: ``contrib/onnx/mx2onnx/`` lowers to ``onnx.ModelProto``; this
+module produces/consumes the same *bytes* directly.  The ONNX file format
+is standard protobuf wire encoding of the messages in ``onnx/onnx.proto``
+(ir_version 7 / opset 13 era).  Field numbers below are transcribed from
+that schema:
+
+    ModelProto:    1 ir_version, 2 producer_name, 3 producer_version,
+                   4 domain, 5 model_version, 6 doc_string, 7 graph,
+                   8 opset_import
+    OperatorSetIdProto: 1 domain, 2 version
+    GraphProto:    1 node, 2 name, 5 initializer, 10 doc_string,
+                   11 input, 12 output, 13 value_info
+    NodeProto:     1 input, 2 output, 3 name, 4 op_type, 5 attribute,
+                   6 doc_string, 7 domain
+    AttributeProto: 1 name, 20 type, 2 f, 3 i, 4 s, 5 t, 7 floats,
+                   8 ints, 9 strings   (type enum: FLOAT=1 INT=2 STRING=3
+                   TENSOR=4 FLOATS=6 INTS=7 STRINGS=8)
+    TensorProto:   1 dims, 2 data_type, 8 name, 9 raw_data
+                   (data_type enum: FLOAT=1 UINT8=2 INT8=3 UINT16=4
+                   INT16=5 INT32=6 INT64=7 STRING=8 BOOL=9 FLOAT16=10
+                   DOUBLE=11 UINT32=12 UINT64=13 BFLOAT16=16)
+    ValueInfoProto: 1 name, 2 type
+    TypeProto:     1 tensor_type;  TypeProto.Tensor: 1 elem_type, 2 shape
+    TensorShapeProto: 1 dim;  Dimension: 1 dim_value, 2 dim_param
+
+Wire types: 0 = varint, 1 = fixed64, 2 = length-delimited, 5 = fixed32.
+Repeated numeric fields are emitted packed (proto3 default); the reader
+accepts both packed and unpacked forms.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ...base import MXNetError
+
+# -- low-level writers ------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64          # protobuf negative int64 → 10-byte varint
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _f_string(field: int, s) -> bytes:
+    if isinstance(s, bytes):
+        return _f_bytes(field, s)
+    return _f_bytes(field, str(s).encode("utf-8"))
+
+
+def _f_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _f_packed_varints(field: int, values) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return _f_bytes(field, payload)
+
+
+def _f_packed_floats(field: int, values) -> bytes:
+    payload = struct.pack("<%df" % len(values), *[float(v) for v in values])
+    return _f_bytes(field, payload)
+
+
+# -- ONNX message writers ---------------------------------------------------
+
+_DTYPE_TO_ONNX = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+_ONNX_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ONNX.items()}
+
+
+def encode_tensor(name: str, arr) -> bytes:
+    arr = _np.asarray(arr)
+    if str(arr.dtype) not in _DTYPE_TO_ONNX:
+        raise MXNetError("onnx export: dtype %s has no TensorProto code"
+                         % arr.dtype)
+    out = [_f_packed_varints(1, arr.shape),
+           _f_varint(2, _DTYPE_TO_ONNX[str(arr.dtype)]),
+           _f_string(8, name),
+           _f_bytes(9, _np.ascontiguousarray(arr).tobytes())]
+    return b"".join(out)
+
+
+def encode_attribute(name: str, value) -> bytes:
+    """AttributeProto from a python value; type inferred like
+    ``onnx.helper.make_attribute``."""
+    out = [_f_string(1, name)]
+    if isinstance(value, bool):
+        out += [_f_varint(20, 2), _f_varint(3, int(value))]
+    elif isinstance(value, (int, _np.integer)):
+        out += [_f_varint(20, 2), _f_varint(3, int(value))]
+    elif isinstance(value, (float, _np.floating)):
+        out += [_f_varint(20, 1), _f_float(2, float(value))]
+    elif isinstance(value, (str, bytes)):
+        out += [_f_varint(20, 3), _f_string(4, value)]
+    elif isinstance(value, _np.ndarray):
+        out += [_f_varint(20, 4), _f_bytes(5, encode_tensor("", value))]
+    elif isinstance(value, (list, tuple)):
+        vals = list(value)
+        if all(isinstance(v, (str, bytes)) for v in vals):
+            out.append(_f_varint(20, 8))
+            for v in vals:
+                out.append(_f_string(9, v))
+        elif all(isinstance(v, (int, _np.integer))
+                 and not isinstance(v, bool) for v in vals):
+            out += [_f_varint(20, 7), _f_packed_varints(8, vals)]
+        elif all(isinstance(v, (int, float, _np.integer, _np.floating))
+                 for v in vals):
+            out += [_f_varint(20, 6), _f_packed_floats(7, vals)]
+        else:
+            raise MXNetError("onnx export: cannot encode attribute %s=%r"
+                             % (name, value))
+    else:
+        raise MXNetError("onnx export: cannot encode attribute %s=%r"
+                         % (name, value))
+    return b"".join(out)
+
+
+def encode_node(node: dict) -> bytes:
+    out = []
+    for i in node["inputs"]:
+        out.append(_f_string(1, i))
+    for o in node["outputs"]:
+        out.append(_f_string(2, o))
+    out.append(_f_string(3, node["name"]))
+    out.append(_f_string(4, node["op_type"]))
+    for k in sorted(node.get("attrs", {})):
+        out.append(_f_bytes(5, encode_attribute(k, node["attrs"][k])))
+    return b"".join(out)
+
+
+def encode_value_info(name: str, dtype: str, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        if isinstance(d, str):
+            dims += _f_bytes(1, _f_string(2, d))
+        else:
+            dims += _f_bytes(1, _f_varint(1, int(d)))
+    tensor_type = (_f_varint(1, _DTYPE_TO_ONNX[dtype])
+                   + _f_bytes(2, dims))
+    type_proto = _f_bytes(1, tensor_type)
+    return _f_string(1, name) + _f_bytes(2, type_proto)
+
+
+def encode_model(model: dict) -> bytes:
+    """dict-IR model (see ``export_model``) → ``.onnx`` file bytes."""
+    g = model["graph"]
+    graph = [
+        b"".join(_f_bytes(1, encode_node(n)) for n in g["nodes"]),
+        _f_string(2, g["name"]),
+        b"".join(_f_bytes(5, encode_tensor(k, v))
+                 for k, v in g["initializers"].items()),
+        b"".join(_f_bytes(11, encode_value_info(
+            i["name"], i.get("dtype", "float32"), i.get("shape", ())))
+            for i in g["inputs"]),
+        b"".join(_f_bytes(12, encode_value_info(o, "float32", ()))
+                 if isinstance(o, str) else
+                 _f_bytes(12, encode_value_info(
+                     o["name"], o.get("dtype", "float32"),
+                     o.get("shape", ())))
+                 for o in g["outputs"]),
+    ]
+    opset = _f_string(1, "") + _f_varint(2, model.get("opset", 13))
+    out = [_f_varint(1, model.get("ir_version", 7)),
+           _f_string(2, model.get("producer", "mxnet_tpu")),
+           _f_string(3, model.get("producer_version", "1.0")),
+           _f_bytes(7, b"".join(graph)),
+           _f_bytes(8, opset)]
+    return b"".join(out)
+
+
+# -- low-level reader -------------------------------------------------------
+
+
+class _Reader:
+    __slots__ = ("data", "pos", "end")
+
+    def __init__(self, data: bytes, pos=0, end=None):
+        self.data = data
+        self.pos = pos
+        self.end = len(data) if end is None else end
+
+    def done(self):
+        return self.pos >= self.end
+
+    def varint(self) -> int:
+        n = 0
+        shift = 0
+        while True:
+            if self.pos >= self.end:
+                raise MXNetError("onnx parse: truncated varint")
+            b = self.data[self.pos]
+            self.pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 70:
+                raise MXNetError("onnx parse: varint too long")
+        if n >= 1 << 63:      # negative int64
+            n -= 1 << 64
+        return n
+
+    def field(self):
+        """→ (field_number, wire_type, value) where value is int for
+        varint/fixed and bytes for length-delimited."""
+        tag = self.varint()
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            return field, wire, self.varint()
+        if wire == 2:
+            ln = self.varint()
+            v = self.data[self.pos:self.pos + ln]
+            if len(v) != ln:
+                raise MXNetError("onnx parse: truncated bytes field")
+            self.pos += ln
+            return field, wire, v
+        if wire == 5:
+            v = self.data[self.pos:self.pos + 4]
+            self.pos += 4
+            return field, wire, struct.unpack("<f", v)[0]
+        if wire == 1:
+            v = self.data[self.pos:self.pos + 8]
+            self.pos += 8
+            return field, wire, struct.unpack("<d", v)[0]
+        raise MXNetError("onnx parse: unsupported wire type %d" % wire)
+
+
+def _packed_ints(v) -> list:
+    """bytes (packed) or a single int → list of ints."""
+    if isinstance(v, int):
+        return [v]
+    r = _Reader(v)
+    out = []
+    while not r.done():
+        out.append(r.varint())
+    return out
+
+
+def decode_tensor(data: bytes):
+    """TensorProto bytes → (name, np.ndarray)."""
+    r = _Reader(data)
+    dims, dtype_code, name, raw = [], 1, "", None
+    # typed fields accumulate across chunks: writers may emit multiple
+    # packed chunks per field, or (legal protobuf) unpacked one-element
+    # fields — both concatenate
+    floats, doubles, int32s, int64s = [], [], [], []
+    while not r.done():
+        f, w, v = r.field()
+        if f == 1:
+            dims += _packed_ints(v)
+        elif f == 2:
+            dtype_code = v
+        elif f == 8:
+            name = v.decode("utf-8")
+        elif f == 9:
+            raw = v
+        elif f == 4:        # float_data (packed or unpacked fixed32)
+            if isinstance(v, bytes):
+                floats += list(struct.unpack("<%df" % (len(v) // 4), v))
+            else:
+                floats.append(v)
+        elif f == 5:        # int32_data
+            int32s += _packed_ints(v)
+        elif f == 7:        # int64_data
+            int64s += _packed_ints(v)
+        elif f == 10:       # double_data (packed or unpacked fixed64)
+            if isinstance(v, bytes):
+                doubles += list(struct.unpack("<%dd" % (len(v) // 8), v))
+            else:
+                doubles.append(v)
+    dtype = _ONNX_TO_DTYPE.get(dtype_code)
+    if dtype is None:
+        raise MXNetError("onnx parse: unsupported tensor data_type %d"
+                         % dtype_code)
+    if raw is not None:
+        arr = _np.frombuffer(raw, dtype=dtype).reshape(dims)
+    elif floats or doubles or int32s or int64s:
+        for vals, k in ((floats, "float32"), (doubles, "float64"),
+                        (int32s, "int32"), (int64s, "int64")):
+            if vals:
+                arr = _np.array(vals, dtype=k).reshape(dims)
+                break
+        arr = arr.astype(dtype, copy=False)
+    else:
+        arr = _np.zeros(dims, dtype=dtype)
+    return name, arr
+
+
+def decode_attribute(data: bytes):
+    """AttributeProto bytes → (name, python value)."""
+    r = _Reader(data)
+    name, atype = "", None
+    f_val = i_val = s_val = t_val = None
+    floats, ints, strings = [], [], []
+    while not r.done():
+        f, w, v = r.field()
+        if f == 1:
+            name = v.decode("utf-8")
+        elif f == 20:
+            atype = v
+        elif f == 2:
+            f_val = v
+        elif f == 3:
+            i_val = v
+        elif f == 4:
+            s_val = v
+        elif f == 5:
+            t_val = v
+        elif f == 7:
+            if isinstance(v, bytes):
+                floats += list(struct.unpack("<%df" % (len(v) // 4), v))
+            else:
+                floats.append(v)
+        elif f == 8:
+            ints += _packed_ints(v)
+        elif f == 9:
+            strings.append(v)
+    if atype == 1:
+        return name, f_val
+    if atype == 2:
+        return name, i_val
+    if atype == 3:
+        return name, s_val.decode("utf-8") if s_val is not None else ""
+    if atype == 4:
+        return name, decode_tensor(t_val)[1]
+    if atype == 6:
+        return name, tuple(floats)
+    if atype == 7:
+        return name, tuple(ints)
+    if atype == 8:
+        return name, tuple(s.decode("utf-8") for s in strings)
+    # type field omitted: best-effort by which value is present
+    for v in (i_val, f_val, s_val):
+        if v is not None:
+            return name, v
+    if ints:
+        return name, tuple(ints)
+    if floats:
+        return name, tuple(floats)
+    raise MXNetError("onnx parse: attribute %r has unsupported type %r"
+                     % (name, atype))
+
+
+def decode_node(data: bytes) -> dict:
+    r = _Reader(data)
+    node = {"inputs": [], "outputs": [], "name": "", "op_type": "",
+            "attrs": {}}
+    while not r.done():
+        f, w, v = r.field()
+        if f == 1:
+            node["inputs"].append(v.decode("utf-8"))
+        elif f == 2:
+            node["outputs"].append(v.decode("utf-8"))
+        elif f == 3:
+            node["name"] = v.decode("utf-8")
+        elif f == 4:
+            node["op_type"] = v.decode("utf-8")
+        elif f == 5:
+            k, val = decode_attribute(v)
+            node["attrs"][k] = val
+    if not node["name"]:
+        node["name"] = (node["outputs"][0] + "_node") if node["outputs"] \
+            else node["op_type"]
+    return node
+
+
+def decode_value_info(data: bytes):
+    """ValueInfoProto bytes → (name, dtype str, shape tuple)."""
+    r = _Reader(data)
+    name, dtype, shape = "", "float32", ()
+    while not r.done():
+        f, w, v = r.field()
+        if f == 1:
+            name = v.decode("utf-8")
+        elif f == 2:
+            tr = _Reader(v)
+            while not tr.done():
+                tf, tw, tv = tr.field()
+                if tf == 1:          # tensor_type
+                    tt = _Reader(tv)
+                    while not tt.done():
+                        ttf, ttw, ttv = tt.field()
+                        if ttf == 1:
+                            dtype = _ONNX_TO_DTYPE.get(ttv, "float32")
+                        elif ttf == 2:   # shape
+                            dims = []
+                            sr = _Reader(ttv)
+                            while not sr.done():
+                                sf, sw, sv = sr.field()
+                                if sf == 1:      # dim
+                                    dr = _Reader(sv)
+                                    dim = 0
+                                    while not dr.done():
+                                        df, dw, dv = dr.field()
+                                        if df == 1:
+                                            dim = dv
+                                        elif df == 2:
+                                            dim = dv.decode("utf-8")
+                                    dims.append(dim)
+                            shape = tuple(dims)
+    return name, dtype, shape
+
+
+def decode_model(data: bytes) -> dict:
+    """``.onnx`` file bytes → the dict-IR model ``import_model`` takes."""
+    r = _Reader(data)
+    model = {"ir_version": 7, "opset": 13, "producer": "",
+             "graph": {"name": "", "nodes": [], "inputs": [],
+                       "outputs": [], "initializers": {}}}
+    graph_bytes = None
+    while not r.done():
+        f, w, v = r.field()
+        if f == 1:
+            model["ir_version"] = v
+        elif f == 2:
+            model["producer"] = v.decode("utf-8")
+        elif f == 7:
+            graph_bytes = v
+        elif f == 8:
+            sr = _Reader(v)
+            domain, version = "", 13
+            while not sr.done():
+                sf, sw, sv = sr.field()
+                if sf == 1:
+                    domain = sv.decode("utf-8")
+                elif sf == 2:
+                    version = sv
+            if domain in ("", "ai.onnx"):
+                model["opset"] = version
+    if graph_bytes is None:
+        raise MXNetError("onnx parse: model has no graph")
+    g = model["graph"]
+    inits = g["initializers"]
+    value_inputs = []
+    gr = _Reader(graph_bytes)
+    while not gr.done():
+        f, w, v = gr.field()
+        if f == 1:
+            g["nodes"].append(decode_node(v))
+        elif f == 2:
+            g["name"] = v.decode("utf-8")
+        elif f == 5:
+            name, arr = decode_tensor(v)
+            inits[name] = arr
+        elif f == 11:
+            value_inputs.append(decode_value_info(v))
+        elif f == 12:
+            name, _, _ = decode_value_info(v)
+            g["outputs"].append(name)
+    g["inputs"] = [{"name": n, "dtype": d, "shape": s}
+                   for n, d, s in value_inputs if n not in inits]
+    return model

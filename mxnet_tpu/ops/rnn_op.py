@@ -148,3 +148,21 @@ def rnn(key, data, parameters, state, state_cell=None, state_size=None,
         cN = jnp.stack(cs_out, axis=0)
         return x, hN, cN
     return x, hN
+
+
+@register("_rnn_nostate", num_outputs=-1, needs_rng=True,
+          training_aware=True)
+def rnn_nostate(key, data, parameters, state_size=None, num_layers=1,
+                mode="lstm", bidirectional=False, _training=False, **kw):
+    """RNN with implicit all-zero initial states — the ONNX importer's
+    target for LSTM/GRU/RNN nodes whose optional ``initial_h``/
+    ``initial_c`` inputs are omitted (zero states per the ONNX spec)."""
+    import jax.numpy as jnp
+    D = 2 if bidirectional else 1
+    T, N, I = data.shape
+    z = jnp.zeros((num_layers * D, N, state_size), dtype=data.dtype)
+    kw.pop("state_outputs", None)
+    return rnn(key, data, parameters, z,
+               z if mode == "lstm" else None, state_size=state_size,
+               num_layers=num_layers, mode=mode,
+               bidirectional=bidirectional, _training=_training, **kw)
