@@ -353,6 +353,89 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
+@register("bleu")
+class BLEU(EvalMetric):
+    """Corpus-level BLEU over token-id sequences (reference:
+    Sockeye/GluonNLP evaluation — BASELINE.md "BLEU/F1 parity" row;
+    Papineni et al. 2002: modified n-gram precision, geometric mean,
+    brevity penalty).
+
+    ``update(labels, preds)``: ``labels`` = reference sequences
+    (batch, len) of token ids; ``preds`` = hypothesis token ids
+    (batch, len), or per-token scores (batch, len, vocab) which are
+    argmax-decoded first.  ``pad_token`` (and anything after
+    ``eos_token`` when given) is stripped before matching.
+
+    Counts accumulate corpus-wide (NOT per-sentence averages), so
+    ``get()`` is true corpus BLEU; ``smooth`` adds +1 smoothing to the
+    higher-order precisions (Lin & Och 2004) for short corpora."""
+
+    def __init__(self, max_n=4, pad_token=None, eos_token=None,
+                 smooth=False, name="bleu", output_names=None,
+                 label_names=None):
+        self.max_n = int(max_n)
+        self.pad_token = pad_token
+        self.eos_token = eos_token
+        self.smooth = smooth
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        super().reset()
+        self._match = [0] * getattr(self, "max_n", 4)
+        self._total = [0] * getattr(self, "max_n", 4)
+        self._hyp_len = 0
+        self._ref_len = 0
+
+    def _clean(self, seq):
+        toks = [int(t) for t in seq]
+        if self.eos_token is not None and self.eos_token in toks:
+            toks = toks[:toks.index(self.eos_token)]
+        if self.pad_token is not None:
+            toks = [t for t in toks if t != self.pad_token]
+        return toks
+
+    def update(self, labels, preds):
+        from collections import Counter
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if pred.ndim == label.ndim + 1:
+                pred = pred.argmax(axis=-1)
+            label = label.reshape(-1, label.shape[-1])
+            pred = pred.reshape(-1, pred.shape[-1])
+            for ref_row, hyp_row in zip(label, pred):
+                ref = self._clean(ref_row)
+                hyp = self._clean(hyp_row)
+                self._hyp_len += len(hyp)
+                self._ref_len += len(ref)
+                for n in range(1, self.max_n + 1):
+                    hg = Counter(tuple(hyp[i:i + n])
+                                 for i in range(len(hyp) - n + 1))
+                    rg = Counter(tuple(ref[i:i + n])
+                                 for i in range(len(ref) - n + 1))
+                    self._match[n - 1] += sum(
+                        min(c, rg[g]) for g, c in hg.items())
+                    self._total[n - 1] += max(len(hyp) - n + 1, 0)
+                self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0 or self._hyp_len == 0:
+            return (self.name, float("nan"))
+        logp = 0.0
+        for n in range(self.max_n):
+            m, t = self._match[n], self._total[n]
+            if self.smooth and n > 0:
+                m, t = m + 1, t + 1
+            if m == 0 or t == 0:
+                return (self.name, 0.0)
+            logp += math.log(m / t)
+        logp /= self.max_n
+        bp = 0.0 if self._hyp_len >= self._ref_len else \
+            1.0 - self._ref_len / self._hyp_len
+        return (self.name, float(math.exp(bp + logp)))
+
+
 @register("pearsonr")
 class PearsonCorrelation(EvalMetric):
     def __init__(self, name="pearsonr", output_names=None,

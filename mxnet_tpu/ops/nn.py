@@ -381,8 +381,13 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
         scale = grad_scale
         if normalization == "batch":
             scale = scale / x.shape[0]
-        elif normalization == "valid" and use_ignore:
-            valid = jnp.maximum(jnp.sum(lab != ignore_label), 1)
+        elif normalization == "valid":
+            # reference: valid = count of non-ignored labels under
+            # use_ignore, else every label position counts
+            if use_ignore:
+                valid = jnp.maximum(jnp.sum(lab != ignore_label), 1)
+            else:
+                valid = lab.size
             scale = scale / valid
         grad = grad * scale
         return (grad.astype(x.dtype), jnp.zeros_like(lab))
