@@ -1,0 +1,181 @@
+"""Implicit-GEMM 3x3 convolution with fused BN prologue/stats — Pallas.
+
+SURVEY.md §7 build-order item 3 prescribes a fused conv+bn+relu kernel;
+docs/conv_ceiling_experiment.md (round 2) bounded the attainable win at
+the measured 8.8 ms/step batch-norm statistics term.  The fusion design
+exploits what XLA cannot do across its fusion boundaries:
+
+* **prologue**: the *previous* layer's BN apply + ReLU folded into this
+  conv's input read (x is read once anyway; normalize in-register),
+* **stats epilogue**: per-channel sum / sum-of-squares of the conv
+  output accumulated across grid steps while the output tile is still
+  in VMEM — the next layer's BN statistics come out of this conv for
+  free instead of a separate pass over the activation.
+
+Layout: NHWC bf16, 3x3, stride 1, SAME padding (the ResNet-50 residual
+conv family).  Grid is (K-blocks, B, H-blocks) — K outermost so each
+stats block stays resident across its whole (B, H) sweep; halo rows via
+``pl.Element`` H indexing; fp32 accumulation on the MXU
+(``preferred_element_type``), per /opt/skills/guides/pallas_guide.md.
+
+The timing study against the XLA emitter lives in
+docs/conv_ceiling_experiment.md §6 (round 3); this kernel is the
+committed artifact either way.
+"""
+from __future__ import annotations
+
+import functools
+
+from jax.experimental import pallas as pl
+
+__all__ = ["conv3x3_fused"]
+
+_INTERPRET = False   # test hook (CPU interpreter mode)
+
+
+def _kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, sum_ref, ssq_ref,
+            acc_s, acc_q, *, th, h_total, relu, prologue, stats,
+            out_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    b = pl.program_id(1)
+    h = pl.program_id(2)
+
+    x = x_ref[0]                       # (TH+2, W+2, C) bf16
+    if prologue or relu:
+        xf = x.astype(jnp.float32)
+        if prologue:
+            xf = xf * scale_ref[:] + shift_ref[:]
+        if relu:
+            xf = jnp.maximum(xf, 0.0)
+        # SAME padding is zero AFTER bn/relu (the network pads the conv
+        # input, which is the normalized activation) — re-zero the halo
+        # positions the prologue just mapped to relu(shift).  Masks are
+        # built as full-rank iotas: a 2-D mask broadcast over the lane
+        # dim crashes this Mosaic version (see conv_ceiling §6 notes).
+        if prologue:
+            # relu alone maps padding 0 → 0, so only the affine
+            # prologue needs the re-zeroing mask
+            rows = h * th + jax.lax.broadcasted_iota(
+                jnp.int32, xf.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1)
+            valid = ((rows >= 1) & (rows <= h_total)
+                     & (cols >= 1) & (cols <= xf.shape[1] - 2))
+            xf = jnp.where(valid, xf, 0.0)
+        x = xf.astype(x_ref.dtype)
+
+    wpad = x.shape[1]                  # W + 2
+    w_out = wpad - 2
+    c = x.shape[2]
+    bk = w_ref.shape[3]
+
+    acc = jnp.zeros((th * w_out, bk), dtype=jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xt = x[dy:dy + th, dx:dx + w_out, :].reshape(th * w_out, c)
+            acc = acc + jax.lax.dot_general(
+                xt, w_ref[dy, dx], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    o = acc.reshape(th, w_out, bk)
+    o_ref[0] = o.astype(out_dtype)
+
+    if stats:
+        # accumulate in VMEM scratch across the (B, H) sweep of this
+        # K-block; flush to the output refs on the sweep's last step
+        first = jnp.logical_and(b == 0, h == 0)
+        last = jnp.logical_and(b == pl.num_programs(1) - 1,
+                               h == pl.num_programs(2) - 1)
+        s_tile = jnp.sum(o, axis=(0, 1))[None, :]
+        q_tile = jnp.sum(o * o, axis=(0, 1))[None, :]
+
+        @pl.when(first)
+        def _():
+            acc_s[:] = jnp.zeros_like(acc_s)
+            acc_q[:] = jnp.zeros_like(acc_q)
+
+        acc_s[:] += s_tile
+        acc_q[:] += q_tile
+
+        @pl.when(last)
+        def _():
+            sum_ref[:] = acc_s[:]
+            ssq_ref[:] = acc_q[:]
+
+
+def conv3x3_fused(x, w, scale=None, shift=None, relu=False, stats=False,
+                  th=None, bk=None, out_dtype=None):
+    """3x3 stride-1 SAME conv, NHWC.
+
+    x: (B, H, W, C); w: (3, 3, C, K).
+    ``scale``/``shift``: per-C BN apply folded into the input read
+    (``y = conv(relu(x*scale+shift), w)``); ``stats=True`` additionally
+    returns (sum_k, sumsq_k) over the conv OUTPUT for the next BN.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, W, C = x.shape
+    K = w.shape[3]
+    out_dtype = out_dtype or x.dtype
+    th = th or (H if H <= 28 else 28)
+    bk = bk or min(K, 128)
+    assert H % th == 0 and K % bk == 0, (H, th, K, bk)
+    nh, nk = H // th, K // bk
+
+    prologue = scale is not None
+    if not prologue:
+        scale = jnp.ones((C,), jnp.float32)
+        shift = jnp.zeros((C,), jnp.float32)
+    scale = scale.astype(jnp.float32)
+    shift = shift.astype(jnp.float32)
+
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+    kern = functools.partial(_kernel, th=th, h_total=H, relu=relu,
+                             prologue=prologue, stats=stats,
+                             out_dtype=out_dtype)
+    out_shape = (jax.ShapeDtypeStruct((B, H, W, K), out_dtype),
+                 jax.ShapeDtypeStruct((1, K), jnp.float32),
+                 jax.ShapeDtypeStruct((1, K), jnp.float32))
+    y, s, ss = pl.pallas_call(
+        kern,
+        grid=(nk, B, nh),
+        in_specs=[
+            # Element-indexed (all dims — Mosaic requires uniformity):
+            # the H window starts at h*th ELEMENTS and spans th+2 rows,
+            # so consecutive blocks overlap by the 2-row halo
+            pl.BlockSpec((pl.Element(1), pl.Element(th + 2),
+                          pl.Element(W + 2), pl.Element(C)),
+                         lambda k, b, h: (b, h * th, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, C, bk), lambda k, b, h: (0, 0, 0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C,), lambda k, b, h: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C,), lambda k, b, h: (0,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, th, W, bk), lambda k, b, h: (b, h, 0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda k, b, h: (0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda k, b, h: (0, k),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((1, bk), jnp.float32),
+                        pltpu.VMEM((1, bk), jnp.float32)],
+        interpret=_INTERPRET,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * H * W * C * K * 9,
+            bytes_accessed=(B * (H + 2) * (W + 2) * C * 2 * nk
+                            + w.size * 2 + B * H * W * K * 2),
+            transcendentals=0),
+    )(xp, w, scale, shift)
+    if stats:
+        return y, s[0], ss[0]
+    return y

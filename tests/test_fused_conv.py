@@ -1,0 +1,56 @@
+"""Pallas implicit-GEMM fused conv kernel — interpreter-mode oracle.
+
+The kernel is the committed artifact of the round-3 conv-ceiling
+resolution (docs/conv_ceiling_experiment.md §6: it loses to the XLA
+emitter per-shape and is NOT wired into the model path); this test
+keeps it correct so the negative result stays reproducible."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu.kernels.fused_conv as fc
+
+
+def _ref(x, w, scale=None, shift=None, relu=False):
+    if scale is not None:
+        x = x * scale + shift
+        if relu:
+            x = jnp.maximum(x, 0)
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", [
+    # (B, H, W, C, K, th, bk, prologue, relu, stats)
+    (2, 8, 8, 8, 16, 4, 16, False, False, False),
+    (2, 8, 8, 8, 16, 4, 16, True, True, True),
+    (1, 12, 12, 16, 32, 6, 32, True, False, True),
+])
+def test_fused_conv_interpret(case):
+    B, H, W, C, K, th, bk, prologue, relu, stats = case
+    old = fc._INTERPRET
+    fc._INTERPRET = True
+    try:
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 3, C, K) * 0.1, jnp.float32)
+        scale = jnp.asarray(rng.rand(C) + 0.5, jnp.float32) \
+            if prologue else None
+        shift = jnp.asarray(rng.randn(C) * 0.1, jnp.float32) \
+            if prologue else None
+        out = fc.conv3x3_fused(x, w, scale=scale, shift=shift,
+                               relu=relu, stats=stats, th=th, bk=bk)
+        r = _ref(x, w, scale, shift, relu)
+        if stats:
+            y, s, ss = out
+            np.testing.assert_allclose(s, r.sum((0, 1, 2)), rtol=1e-4)
+            np.testing.assert_allclose(ss, (r * r).sum((0, 1, 2)),
+                                       rtol=1e-4)
+        else:
+            y = out
+        np.testing.assert_allclose(y, r, rtol=1e-5, atol=1e-5)
+    finally:
+        fc._INTERPRET = old
