@@ -66,16 +66,23 @@ class Context:
     def jax_device(self):
         import jax
         dt = self.device_type
+        # a Context addresses THIS process's devices: under multi-host
+        # (jax.distributed) jax.devices() lists the whole cluster, and
+        # placing an eager array on another host's device is an error —
+        # the reference's Context is likewise process-local (each worker
+        # sees its own gpu(0..n)); cross-host placement happens only
+        # through mesh shardings.
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                devs = jax.devices("cpu")
+                devs = [d for d in jax.local_devices()
+                        if d.platform == "cpu"] or jax.devices("cpu")
             except RuntimeError:
                 # CPU backend absent (rare); fall back to default backend.
-                devs = jax.devices()
+                devs = jax.local_devices()
             return devs[self.device_id % len(devs)]
         # tpu/gpu → accelerator backend; under the CPU test harness this is
         # the virtual host-device array.
-        devs = jax.devices()
+        devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "Context %s: device_id %d out of range (%d devices visible)"
@@ -150,9 +157,13 @@ def current_context() -> Context:
 
 
 def num_tpus() -> int:
+    """Process-local accelerator count — matches ``Context.jax_device``
+    semantics so ``[mx.tpu(i) for i in range(mx.num_gpus())]`` stays
+    valid on every worker of a multi-host job (the reference's
+    ``num_gpus()`` is likewise per-worker)."""
     import jax
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except Exception:
         return 0
     return len(devs)

@@ -491,8 +491,12 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
         # tunneled chip here (docs/perf.md "Methodology")
         shardings = grad_shardings      # same tree, same guard
         if shardings is not None:
-            params = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(p, s), params, shardings)
+            # host_staged_put: cross-process shardings need host-numpy
+            # staging (init_params is deterministic per key, so every
+            # process holds identical values)
+            from ..parallel.multihost import host_staged_put
+            params = jax.tree_util.tree_map(host_staged_put, params,
+                                            shardings)
         if shard_optimizer and mesh is not None \
                 and "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
             # materialize the moments directly into their shards —

@@ -95,7 +95,8 @@ class DataParallelTrainer:
         vals = [p.data()._data for p in self._param_objs]
         if self._trivial:
             return vals
-        return [jax.device_put(v, self._rep) for v in vals]
+        from .multihost import host_staged_put
+        return [host_staged_put(v, self._rep) for v in vals]
 
     def sync(self):
         """Block until every queued step has fully executed (the loss

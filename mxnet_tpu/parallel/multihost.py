@@ -111,6 +111,20 @@ def _jax_dist_init(jax, **kw):
     _initialized = True
 
 
+def host_staged_put(value, sharding):
+    """``jax.device_put`` that works for cross-process shardings.
+
+    A sharding spanning processes cannot be fed from a process-local
+    committed array — stage through host numpy (callers must hold
+    identical values on every process, the same synchronized-start
+    contract as the reference's workers)."""
+    import jax
+    if jax.process_count() > 1:
+        import numpy as _np
+        value = _np.asarray(value)
+    return jax.device_put(value, sharding)
+
+
 def shutdown():
     global _initialized
     if not _initialized:

@@ -43,6 +43,38 @@ def test_dist_sync_two_workers_via_launcher():
     assert r.stdout.count("OK") >= 1, r.stdout + r.stderr
 
 
+@pytest.mark.slow
+def test_gspmd_multiprocess_via_launcher():
+    """The multi-chip THROUGHPUT path, multi-process (round-3 verdict
+    #4): launch.py forks 2 jax.distributed processes x 4 CPU devices,
+    whose dp=8 mesh collectives cross the process boundary; final
+    losses (gluon DataParallelTrainer AND the flagship transformer
+    step) must match this process's single-process 8-device run."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "dist_gspmd_worker",
+        os.path.join(REPO, "tests", "dist_gspmd_worker.py"))
+    worker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(worker)
+
+    from mxnet_tpu.parallel import multihost
+    multihost.initialize()       # no-op single-process
+    expect_dp = worker.run_dp_trainer()
+    expect_tf = worker.run_flagship()
+
+    launcher = os.path.join(REPO, "tools", "launch.py")
+    script = os.path.join(REPO, "tests", "dist_gspmd_worker.py")
+    r = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "-s", "0",
+         "--launcher", "local", sys.executable, script,
+         "--expect-dp", repr(expect_dp), "--expect-tf", repr(expect_tf)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert r.stdout.count("GSPMD multi-process OK") == 2, \
+        r.stdout[-2000:] + r.stderr[-2000:]
+
+
 def test_dist_async_applies_immediately():
     server = DistServer(num_workers=1, sync_mode=False)
     server.start()
