@@ -1,0 +1,216 @@
+/* XS glue: Perl <-> the embeddable C training ABI.
+ *
+ * Reference: perl-package/AI-MXNet (SURVEY.md §2.3 "Perl" row) binds
+ * the reference's C ABI; this binds the TPU build's c_train_api
+ * (native/include/mxnet_tpu/c_train_api.h).  Handles are IVs; tensor
+ * payloads travel as packed float32 strings (pack "f*").
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxnet_tpu/c_train_api.h"
+
+MODULE = AI::MXNetTPU::FFI  PACKAGE = AI::MXNetTPU::FFI
+PROTOTYPES: DISABLE
+
+const char *
+last_error()
+  CODE:
+    RETVAL = MXTrainGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+nd_create(shape_ref, data_sv)
+    SV* shape_ref
+    SV* data_sv
+  CODE:
+    AV* av;
+    int ndim, i;
+    int64_t shape[8];
+    size_t need = 1;
+    const float* data = NULL;
+    NDHandle h;
+    if (!SvROK(shape_ref) || SvTYPE(SvRV(shape_ref)) != SVt_PVAV)
+      croak("nd_create: shape must be an array ref");
+    av = (AV*)SvRV(shape_ref);
+    ndim = (int)(av_len(av) + 1);
+    if (ndim < 1 || ndim > 8)
+      croak("nd_create: ndim %d out of range", ndim);
+    for (i = 0; i < ndim; i++) {
+      shape[i] = (int64_t)SvIV(*av_fetch(av, i, 0));
+      need *= (size_t)shape[i];
+    }
+    if (SvOK(data_sv)) {
+      STRLEN len;
+      const char* p = SvPV(data_sv, len);
+      if (len != need * sizeof(float))
+        croak("nd_create: packed data is %lu bytes, shape needs %lu",
+              (unsigned long)len, (unsigned long)(need * sizeof(float)));
+      data = (const float*)p;
+    }
+    if (MXTrainNDArrayCreate(shape, ndim, data, &h) != 0)
+      croak("nd_create: %s", MXTrainGetLastError());
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+int
+nd_free(h)
+    IV h
+  CODE:
+    RETVAL = MXTrainNDArrayFree((NDHandle)h);
+  OUTPUT:
+    RETVAL
+
+SV *
+nd_shape(h)
+    IV h
+  CODE:
+    int64_t shape[8];
+    int ndim, i;
+    AV* av;
+    if (MXTrainNDArrayShape((NDHandle)h, shape, &ndim) != 0)
+      croak("nd_shape: %s", MXTrainGetLastError());
+    av = newAV();
+    for (i = 0; i < ndim; i++)
+      av_push(av, newSViv((IV)shape[i]));
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+SV *
+nd_copyto(h)
+    IV h
+  CODE:
+    int64_t shape[8];
+    int ndim, i;
+    size_t n = 1;
+    if (MXTrainNDArrayShape((NDHandle)h, shape, &ndim) != 0)
+      croak("nd_copyto: %s", MXTrainGetLastError());
+    for (i = 0; i < ndim; i++)
+      n *= (size_t)shape[i];
+    RETVAL = newSV(n * sizeof(float) + 1);
+    SvPOK_only(RETVAL);
+    SvCUR_set(RETVAL, n * sizeof(float));
+    if (MXTrainNDArrayCopyTo((NDHandle)h, (float*)SvPVX(RETVAL), n)
+        != 0)
+      croak("nd_copyto: %s", MXTrainGetLastError());
+  OUTPUT:
+    RETVAL
+
+double
+nd_scalar(h)
+    IV h
+  CODE:
+    float v;
+    if (MXTrainNDArrayScalar((NDHandle)h, &v) != 0)
+      croak("nd_scalar: %s", MXTrainGetLastError());
+    RETVAL = (double)v;
+  OUTPUT:
+    RETVAL
+
+SV *
+op_invoke(name, inputs_ref, attrs_json)
+    const char* name
+    SV* inputs_ref
+    const char* attrs_json
+  CODE:
+    AV* av;
+    int n, i, nout;
+    NDHandle in[64];
+    NDHandle out[16];
+    AV* res;
+    if (!SvROK(inputs_ref) || SvTYPE(SvRV(inputs_ref)) != SVt_PVAV)
+      croak("op_invoke: inputs must be an array ref");
+    av = (AV*)SvRV(inputs_ref);
+    n = (int)(av_len(av) + 1);
+    if (n > 64)
+      croak("op_invoke: too many inputs (%d)", n);
+    for (i = 0; i < n; i++)
+      in[i] = (NDHandle)SvIV(*av_fetch(av, i, 0));
+    if (MXTrainOpInvoke(name, in, n, attrs_json, out, 16, &nout) != 0)
+      croak("op_invoke(%s): %s", name, MXTrainGetLastError());
+    res = newAV();
+    for (i = 0; i < nout; i++)
+      av_push(res, newSViv((IV)out[i]));
+    RETVAL = newRV_noinc((SV*)res);
+  OUTPUT:
+    RETVAL
+
+int
+attach_grad(h)
+    IV h
+  CODE:
+    if (MXTrainAttachGrad((NDHandle)h) != 0)
+      croak("attach_grad: %s", MXTrainGetLastError());
+    RETVAL = 0;
+  OUTPUT:
+    RETVAL
+
+int
+record_start()
+  CODE:
+    if (MXTrainRecordStart() != 0)
+      croak("record_start: %s", MXTrainGetLastError());
+    RETVAL = 0;
+  OUTPUT:
+    RETVAL
+
+int
+record_stop()
+  CODE:
+    if (MXTrainRecordStop() != 0)
+      croak("record_stop: %s", MXTrainGetLastError());
+    RETVAL = 0;
+  OUTPUT:
+    RETVAL
+
+int
+backward(h)
+    IV h
+  CODE:
+    if (MXTrainBackward((NDHandle)h) != 0)
+      croak("backward: %s", MXTrainGetLastError());
+    RETVAL = 0;
+  OUTPUT:
+    RETVAL
+
+IV
+grad_of(h)
+    IV h
+  CODE:
+    NDHandle g;
+    if (MXTrainGradOf((NDHandle)h, &g) != 0)
+      croak("grad_of: %s", MXTrainGetLastError());
+    RETVAL = (IV)g;
+  OUTPUT:
+    RETVAL
+
+IV
+optimizer_create(name, params_json)
+    const char* name
+    const char* params_json
+  CODE:
+    OptHandle h;
+    if (MXTrainOptimizerCreate(name, params_json, &h) != 0)
+      croak("optimizer_create: %s", MXTrainGetLastError());
+    RETVAL = (IV)h;
+  OUTPUT:
+    RETVAL
+
+int
+optimizer_update(h, index, w, g)
+    IV h
+    int index
+    IV w
+    IV g
+  CODE:
+    if (MXTrainOptimizerUpdate((OptHandle)h, index, (NDHandle)w,
+                               (NDHandle)g) != 0)
+      croak("optimizer_update: %s", MXTrainGetLastError());
+    RETVAL = 0;
+  OUTPUT:
+    RETVAL

@@ -1,0 +1,63 @@
+#!/usr/bin/perl
+# MNIST-style MLP training from Perl — the same model/loop as
+# tests/test_ctrain.py's C++ program, gated against the Python loss
+# trajectory by tests/test_perl_binding.py.
+#
+#   perl -Ilib examples/train_mlp.pl <data.bin>
+#
+# data.bin layout (little-endian float32): X(64x16) Y(64) W1(16x16)
+# B1(16) W2(4x16) B2(4).
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use AI::MXNetTPU;
+
+my ($N, $D, $H, $C, $EPOCHS) = (64, 16, 16, 4, 8);
+
+my $path = shift @ARGV or die "usage: train_mlp.pl data.bin\n";
+open my $f, '<:raw', $path or die "open $path: $!";
+
+sub read_floats {
+    my ($n) = @_;
+    my $buf;
+    read($f, $buf, $n * 4) == $n * 4 or die "short read";
+    return $buf;                      # packed float32 string
+}
+
+my $x  = AI::MXNetTPU::NDArray->new([$N, $D], read_floats($N * $D));
+my $y  = AI::MXNetTPU::NDArray->new([$N],     read_floats($N));
+my $w1 = AI::MXNetTPU::NDArray->new([$H, $D], read_floats($H * $D));
+my $b1 = AI::MXNetTPU::NDArray->new([$H],     read_floats($H));
+my $w2 = AI::MXNetTPU::NDArray->new([$C, $H], read_floats($C * $H));
+my $b2 = AI::MXNetTPU::NDArray->new([$C],     read_floats($C));
+close $f;
+
+$_->attach_grad for ($w1, $b1, $w2, $b2);
+
+my $sgd = AI::MXNetTPU::Optimizer->new('sgd', learning_rate => 0.5);
+
+my $op = sub {
+    my ($name, %attrs) = @_;
+    return AI::MXNetTPU::Operator->new($name)->set_attr(%attrs);
+};
+
+for my $epoch (1 .. $EPOCHS) {
+    my $loss = AI::MXNetTPU::AutoGrad->record(sub {
+        my $h  = $op->('FullyConnected', num_hidden => $H)
+                    ->invoke($x, $w1, $b1);
+        my $a  = $op->('Activation', act_type => 'relu')->invoke($h);
+        my $o  = $op->('FullyConnected', num_hidden => $C)
+                    ->invoke($a, $w2, $b2);
+        my $lp = $op->('log_softmax')->invoke($o);
+        my $pk = $op->('pick')->invoke($lp, $y);
+        my $mn = $op->('mean')->invoke($pk);
+        return $op->('negative')->invoke($mn);
+    });
+    $loss->backward;
+    printf "loss %.6f\n", $loss->scalar;
+    my @params = ($w1, $b1, $w2, $b2);
+    for my $i (0 .. $#params) {
+        $sgd->update($i, $params[$i], $params[$i]->grad);
+    }
+}

@@ -1,0 +1,178 @@
+package AI::MXNetTPU;
+# Perl binding for the TPU-native MXNet-parity framework.
+#
+# Reference: perl-package/AI-MXNet (SURVEY.md $2.3 "Perl" row) - the
+# same layering: a thin native glue (AI::MXNetTPU::FFI, XS over
+# native/include/mxnet_tpu/c_train_api.h) and an idiomatic Perl API on
+# top (NDArray / Operator / Optimizer / autograd).  Training runs the
+# identical semantics as the Python frontend: the ABI embeds the
+# framework, so losses match the Python trajectory bit-for-bit gate in
+# tests/test_perl_binding.py.
+use strict;
+use warnings;
+
+our $VERSION = '1.0';
+
+package AI::MXNetTPU::FFI;
+use strict;
+use warnings;
+use DynaLoader;
+use File::Basename qw(dirname);
+our @ISA = ('DynaLoader');
+
+# the train ABI embeds CPython; numpy's C extensions need libpython
+# symbols to be globally visible, so pre-load it RTLD_GLOBAL (0x01)
+sub _preload_python {
+    my $soname = $ENV{MXNET_TPU_LIBPYTHON};
+    if (!$soname) {
+        my $v = `python3 -c "import sys;print('%d.%d'%sys.version_info[:2])"`;
+        chomp $v;
+        $soname = "libpython$v.so";
+    }
+    for my $cand ($soname, "$soname.1.0") {
+        my $ref = DynaLoader::dl_load_file($cand, 0x01);
+        return if $ref;
+    }
+    # non-fatal: the direct link may already satisfy the symbols
+}
+
+sub dl_load_flags { 0x01 }    # RTLD_GLOBAL
+
+_preload_python();
+bootstrap AI::MXNetTPU::FFI;
+
+package AI::MXNetTPU::NDArray;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $shape, $data) = @_;
+    my $packed = !defined $data ? undef
+        : ref $data eq 'ARRAY' ? pack('f*', @$data)
+        : $data;                              # already packed floats
+    my $h = AI::MXNetTPU::FFI::nd_create($shape, $packed);
+    return bless { handle => $h, own => 1 }, $class;
+}
+
+sub _from_handle {
+    my ($class, $h) = @_;
+    return bless { handle => $h, own => 1 }, $class;
+}
+
+sub zeros { my ($class, $shape) = @_; return $class->new($shape, undef) }
+
+sub handle { $_[0]{handle} }
+
+sub shape {
+    my ($self) = @_;
+    return @{AI::MXNetTPU::FFI::nd_shape($self->{handle})};
+}
+
+sub values {
+    my ($self) = @_;
+    return unpack('f*', AI::MXNetTPU::FFI::nd_copyto($self->{handle}));
+}
+
+sub scalar { AI::MXNetTPU::FFI::nd_scalar($_[0]{handle}) }
+
+sub attach_grad { AI::MXNetTPU::FFI::attach_grad($_[0]{handle}); $_[0] }
+
+sub grad {
+    my ($self) = @_;
+    my $g = AI::MXNetTPU::FFI::grad_of($self->{handle});
+    return AI::MXNetTPU::NDArray->_from_handle($g);
+}
+
+sub backward { AI::MXNetTPU::FFI::backward($_[0]{handle}); $_[0] }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::nd_free($self->{handle})
+        if $self->{own} && defined $self->{handle};
+}
+
+package AI::MXNetTPU::Operator;
+use strict;
+use warnings;
+use JSON::PP ();
+
+sub new {
+    my ($class, $name) = @_;
+    return bless { name => $name, attrs => {} }, $class;
+}
+
+sub set_attr {
+    my ($self, %kv) = @_;
+    @{$self->{attrs}}{keys %kv} = CORE::values %kv;
+    return $self;
+}
+
+sub invoke {
+    my ($self, @inputs) = @_;
+    my $attrs = JSON::PP->new->canonical->allow_nonref
+        ->encode($self->{attrs});
+    my $outs = AI::MXNetTPU::FFI::op_invoke(
+        $self->{name}, [map { $_->handle } @inputs], $attrs);
+    my @nd = map { AI::MXNetTPU::NDArray->_from_handle($_) } @$outs;
+    return wantarray ? @nd : $nd[0];
+}
+
+package AI::MXNetTPU::Optimizer;
+use strict;
+use warnings;
+use JSON::PP ();
+
+sub new {
+    my ($class, $name, %params) = @_;
+    my $json = JSON::PP->new->canonical->encode(\%params);
+    my $h = AI::MXNetTPU::FFI::optimizer_create($name, $json);
+    return bless { handle => $h }, $class;
+}
+
+sub update {
+    my ($self, $index, $weight, $grad) = @_;
+    AI::MXNetTPU::FFI::optimizer_update(
+        $self->{handle}, $index, $weight->handle, $grad->handle);
+    return $self;
+}
+
+package AI::MXNetTPU::AutoGrad;
+use strict;
+use warnings;
+
+sub record_start { AI::MXNetTPU::FFI::record_start() }
+sub record_stop  { AI::MXNetTPU::FFI::record_stop() }
+
+sub record {
+    my ($class, $fn) = @_;
+    record_start();
+    my @r = eval { $fn->() };
+    my $err = $@;
+    record_stop();
+    die $err if $err;
+    return wantarray ? @r : $r[0];
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl training API for the TPU-native MXNet-parity build
+
+=head1 SYNOPSIS
+
+    use AI::MXNetTPU;
+    my $x  = AI::MXNetTPU::NDArray->new([64, 16], \@data);
+    my $w  = AI::MXNetTPU::NDArray->new([8, 16], \@init);
+    $w->attach_grad;
+    my $sgd = AI::MXNetTPU::Optimizer->new('sgd', learning_rate => 0.5);
+    my $loss = AI::MXNetTPU::AutoGrad->record(sub {
+        my $h = AI::MXNetTPU::Operator->new('FullyConnected')
+            ->set_attr(num_hidden => 8)->invoke($x, $w, $b);
+        ...
+    });
+    $loss->backward;
+    $sgd->update(0, $w, $w->grad);
+
+=cut
