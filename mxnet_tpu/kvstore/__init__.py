@@ -4,3 +4,4 @@ Reference: ``python/mxnet/kvstore/`` + ``src/kvstore/`` (SURVEY.md §2.1
 "KVStore", §3.4 call stack).
 """
 from .kvstore import KVStore, KVStoreBase, create
+from . import horovod  # registers the allreduce-semantics backend
