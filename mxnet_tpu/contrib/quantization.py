@@ -50,9 +50,11 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
                     = None) -> Symbol:
     """Rewrite ``sym`` into its int8 form (reference: ``quantize_graph``
     pass driven from ``contrib/quantization.py``)."""
-    if quantized_dtype not in ("int8", "auto"):
-        raise MXNetError("quantized_dtype must be 'int8'/'auto' (symmetric "
-                         "int8 is the TPU-native path)")
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError("quantized_dtype must be 'int8'/'uint8'/'auto' "
+                         "(s8 weights; 'auto' picks u8 activations for "
+                         "non-negative calibrated ranges, the reference "
+                         "quantized-conv default)")
     excluded_sym_names = set(excluded_sym_names)
     excluded_op_names = set(excluded_op_names)
     offline = set(offline_params)
@@ -90,7 +92,9 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
             qmap[k] = ((qv, 0), (mnv, 0), (mxv, 0))
             return qmap[k]
         fn, fs = get_float(node, slot)
-        attrs: Dict[str, Any] = {"out_type": "int8"}
+        # activations follow quantized_dtype; quantize_v2 resolves
+        # "auto" per node from the calibrated min (u8 iff min >= 0)
+        attrs: Dict[str, Any] = {"out_type": quantized_dtype}
         rng = calib_info.get(node.name)
         if rng is not None:
             attrs["min_calib_range"] = float(rng[0])

@@ -14,9 +14,11 @@ TPU-native design:
   so there is no cuDNN-int8/oneDNN bridge to replicate: the same XLA op
   that serves the fp32 path serves the int8 path at double the MAC rate.
 * Quantization is **symmetric** for int8 (zero-point 0, scale
-  ``127 / max|x|``), matching the reference's GPU int8 path; uint8
-  (affine, zero-point 0 at ``min==0``) is supported for quantize/
-  dequantize only.
+  ``127 / max|x|``), matching the reference's GPU int8 path.  uint8
+  activations (zero-point-0 affine at ``min==0`` — the reference
+  quantized-conv default for post-ReLU data) are a supported COMPUTE
+  path in quantized conv/FC: the u8×s8 product widens to s32 (HLO has
+  no mixed-sign int8 dot); s8×s8 remains the MXU-native fast path.
 * Every quantized op follows the reference calling convention: inputs are
   ``(qdata..., min..., max...)`` triples and outputs are
   ``(qout, out_min, out_max)`` so graphs thread value ranges alongside
@@ -91,8 +93,27 @@ def quantize_v2(data, out_type="int8", min_calib_range=None,
     else:
         mn = jnp.asarray(float(min_calib_range))
         mx = jnp.asarray(float(max_calib_range))
-    return quantize(data, mn, mx,
-                    out_type=("int8" if out_type == "auto" else out_type))
+    if out_type == "auto":
+        # reference quantize_v2 "auto": uint8 when the calibrated range
+        # is non-negative (post-ReLU activations), else int8.  Runtime
+        # (traced) ranges cannot branch -> int8.
+        if min_calib_range is not None and float(min_calib_range) >= 0:
+            out_type = "uint8"
+        else:
+            out_type = "int8"
+    if out_type == "uint8":
+        # the u8 COMPUTE path (quantized conv/FC) is zero-point-0 —
+        # q = x * 255/max — so the calibrated quantization must use the
+        # range [0, max], not an affine [min, max]: an affine u8 with
+        # min > 0 would silently shift every product (reference's u8
+        # convs are likewise zero-point-0 for non-negative data)
+        if min_calib_range is not None and float(min_calib_range) < 0:
+            raise MXNetError(
+                "quantize_v2: out_type='uint8' needs a non-negative "
+                "calibrated range (got min=%r); use int8 or 'auto'"
+                % (min_calib_range,))
+        mn = jnp.zeros_like(mn)
+    return quantize(data, mn, mx, out_type=out_type)
 
 
 @register("_contrib_dequantize", no_grad=True, aliases=("dequantize",))
@@ -125,15 +146,16 @@ def requantize(qdata, min_range, max_range, min_calib_range=None,
     return q.astype(jnp.int8), -r_out, r_out
 
 
-def _mul_out_range(min_a, max_a, min_b, max_b):
-    """Output range of an s8×s8→s32 product chain: the int32 value equals
+def _mul_out_range(min_a, max_a, min_b, max_b, qa=127.0):
+    """Output range of a q8×s8→s32 product chain: the int32 value equals
     ``float * scale_a * scale_b``, so reporting ``±INT32_MAX/(sa*sb)``
     makes ``dequantize`` exact (reference:
-    ``quantization_range_for_multiplication``)."""
+    ``quantization_range_for_multiplication``).  ``qa`` is the data
+    quantum count: 127 for s8, 255 for u8 (zero-point-0 affine)."""
     jnp = _j()
     ra = _real_range(min_a, max_a)
     rb = _real_range(min_b, max_b)
-    sa = 127.0 / jnp.maximum(ra, 1e-30)
+    sa = qa / jnp.maximum(ra, 1e-30)
     sb = 127.0 / jnp.maximum(rb, 1e-30)
     r_out = _INT32_MAX / (sa * sb)
     return -r_out, r_out, sa * sb
@@ -145,6 +167,24 @@ def _check_int8(name, *arrs):
         if a is not None and a.dtype != jnp.int8:
             raise MXNetError("%s requires int8 inputs (got %s); quantize "
                              "with out_type='int8'" % (name, a.dtype))
+
+
+def _check_q8(name, data, weight):
+    """Activations may be int8 or uint8 (the reference's quantized conv
+    defaults to uint8 activations post-ReLU, zero-point 0); weights are
+    always symmetric int8."""
+    jnp = _j()
+    if data.dtype not in (jnp.int8, jnp.uint8):
+        raise MXNetError("%s requires int8/uint8 data (got %s)"
+                         % (name, data.dtype))
+    if weight.dtype != jnp.int8:
+        raise MXNetError("%s requires int8 weight (got %s)"
+                         % (name, weight.dtype))
+
+
+def _data_qmax(data):
+    jnp = _j()
+    return 255.0 if data.dtype == jnp.uint8 else 127.0
 
 
 @register("_contrib_quantized_fully_connected", num_outputs=3, no_grad=True,
@@ -163,14 +203,24 @@ def quantized_fully_connected(data, weight, bias=None, min_data=None,
         data, weight, min_data, max_data, min_weight, max_weight = (
             data, weight, bias, min_data, max_data, min_weight)
         bias = None
-    _check_int8("quantized_fully_connected", data, weight)
+    _check_q8("quantized_fully_connected", data, weight)
+    qa = _data_qmax(data)
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape((x.shape[0], -1))
-    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-                          preferred_element_type=jnp.int32)
+    if x.dtype == jnp.uint8:
+        # mixed u8×s8 dots are not HLO-expressible; widen to s32 (the
+        # s8×s8 path below stays the MXU-native fast path)
+        out = lax.dot_general(x.astype(jnp.int32),
+                              weight.astype(jnp.int32),
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    else:
+        out = lax.dot_general(x, weight,
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
     mn, mx, scale_out = _mul_out_range(min_data, max_data,
-                                       min_weight, max_weight)
+                                       min_weight, max_weight, qa=qa)
     if bias is not None and not no_bias:
         # re-scale int8 bias into the int32 accumulator's scale
         rb = _real_range(min_bias, max_bias)
@@ -194,7 +244,11 @@ def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
         data, weight, min_data, max_data, min_weight, max_weight = (
             data, weight, bias, min_data, max_data, min_weight)
         bias = None
-    _check_int8("quantized_conv", data, weight)
+    _check_q8("quantized_conv", data, weight)
+    qa = _data_qmax(data)
+    if data.dtype == jnp.uint8:
+        data = data.astype(jnp.int32)
+        weight = weight.astype(jnp.int32)
     nd_spatial = data.ndim - 2
     stride = tuple(stride)[:nd_spatial] or (1,) * nd_spatial
     pad = tuple(pad)[:nd_spatial] or (0,) * nd_spatial
@@ -209,7 +263,7 @@ def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=jnp.int32)
     mn, mx, scale_out = _mul_out_range(min_data, max_data,
-                                       min_weight, max_weight)
+                                       min_weight, max_weight, qa=qa)
     if bias is not None and not no_bias:
         rb = _real_range(min_bias, max_bias)
         bias_f = bias.astype(jnp.float32) * (rb / 127.0)

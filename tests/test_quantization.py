@@ -172,3 +172,69 @@ def test_quantized_max_pooling_int8():
         kernel=(2, 2), stride=(2, 2), pool_type="max")
     ref = np.array([[[[ -3, -1], [5, 7]]]], dtype=np.int8)
     np.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+def test_quantized_conv_uint8_activations():
+    """u8 activations (zero-point-0 affine, the reference quantized-conv
+    default for post-ReLU data) x s8 weights match fp32 (round-3
+    missing #7)."""
+    np.random.seed(4)
+    x = np.random.uniform(0, 1, (2, 3, 8, 8)).astype(np.float32)  # >= 0
+    w = np.random.uniform(-1, 1, (5, 3, 3, 3)).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=5, no_bias=True).asnumpy()
+    qx, mnx, mxx = nd.quantize_v2(nd.array(x), out_type="auto",
+                                  min_calib_range=0.0,
+                                  max_calib_range=float(x.max()))
+    assert qx.dtype == np.uint8              # auto + min>=0 -> u8
+    qw, mnw, mxw = nd.quantize_v2(nd.array(w), out_type="int8")
+    out32, mno, mxo = nd.quantized_conv(
+        qx, qw, mnx, mxx, mnw, mxw, kernel=(3, 3), num_filter=5,
+        no_bias=True)
+    out = nd.dequantize(out32, mno, mxo).asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.15, np.max(np.abs(out - ref))
+    # auto with a negative min stays int8
+    qn, _, _ = nd.quantize_v2(nd.array(x - 0.5), out_type="auto",
+                              min_calib_range=-0.5,
+                              max_calib_range=0.5)
+    assert qn.dtype == np.int8
+
+
+def test_quantized_fc_uint8_activations():
+    np.random.seed(5)
+    x = np.random.uniform(0, 1, (8, 16)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 16)).astype(np.float32)
+    ref = x @ w.T
+    qx, mnx, mxx = nd.quantize_v2(nd.array(x), out_type="uint8",
+                                  min_calib_range=0.0,
+                                  max_calib_range=float(x.max()))
+    qw, mnw, mxw = nd.quantize_v2(nd.array(w), out_type="int8")
+    out32, mno, mxo = nd.quantized_fully_connected(
+        qx, qw, mnx, mxx, mnw, mxw, num_hidden=4, no_bias=True)
+    out = nd.dequantize(out32, mno, mxo).asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.1, np.max(np.abs(out - ref))
+
+
+def test_quantized_uint8_positive_min_zero_point_correct():
+    """Review regression (round 3): 'auto'-selected u8 with a POSITIVE
+    calibrated min must still compute correctly — the calibrated u8
+    quantization is forced to zero-point-0 (range [0, max]), because
+    the compute ops assume q = x*255/max."""
+    np.random.seed(6)
+    x = np.random.uniform(0.5, 1.0, (8, 16)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 16)).astype(np.float32)
+    ref = x @ w.T
+    qx, mnx, mxx = nd.quantize_v2(nd.array(x), out_type="auto",
+                                  min_calib_range=0.5,
+                                  max_calib_range=1.0)
+    assert qx.dtype == np.uint8
+    assert float(mnx.asnumpy()) == 0.0       # zero-point-0 range
+    qw, mnw, mxw = nd.quantize_v2(nd.array(w), out_type="int8")
+    out32, mno, mxo = nd.quantized_fully_connected(
+        qx, qw, mnx, mxx, mnw, mxw, num_hidden=4, no_bias=True)
+    out = nd.dequantize(out32, mno, mxo).asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.1, np.max(np.abs(out - ref))
+    # explicitly-negative calibrated min cannot be u8
+    with pytest.raises(mx.MXNetError):
+        nd.quantize_v2(nd.array(w), out_type="uint8",
+                       min_calib_range=-1.0, max_calib_range=1.0)

