@@ -370,3 +370,45 @@ def test_mpi_launcher_missing_runner(capsys):
     code = launch_mod.launch_mpi(args, ["python", "x.py"],
                                  runner="mpirun_definitely_missing")
     assert code == 127
+
+
+def test_horovod_backend_and_plugin_contract():
+    """The KVStoreBase plug-in contract works for backends registered
+    OUTSIDE kvstore.py (round-3 missing #5): the bundled horovod-style
+    allreduce backend, plus a test-local external backend."""
+    kv = mx.kv.create("horovod")
+    assert kv.type == "horovod"
+    # pushpull ≡ allreduce over the device list
+    vals = [mx.nd.full((4,), float(i + 1), ctx=mx.cpu(i))
+            for i in range(2)]
+    outs = [mx.nd.zeros((4,), ctx=mx.cpu(i)) for i in range(2)]
+    kv.pushpull("w", vals, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 3.0)
+    # broadcast: root value lands on every replica
+    kv.broadcast("w", mx.nd.full((4,), 7.0), out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 7.0)
+    # classic push/pull shim keeps Trainer-style callers alive
+    kv.push("k", [mx.nd.ones((2,)), mx.nd.ones((2,))])
+    got = mx.nd.zeros((2,))
+    kv.pull("k", out=got)
+    np.testing.assert_allclose(got.asnumpy(), 2.0)
+
+    # external plug-in defined here, registered through the public API
+    from mxnet_tpu.kvstore import KVStoreBase
+
+    @KVStoreBase.register("test_external")
+    class _Ext:
+        def __init__(self):
+            self.type = "test_external"
+            self.calls = []
+
+        def pushpull(self, key, value, out=None, priority=0):
+            self.calls.append(key)
+            return value
+
+    kv2 = mx.kv.create("test_external")
+    assert kv2.type == "test_external"
+    kv2.pushpull("g", mx.nd.ones((1,)))
+    assert kv2.calls == ["g"]
