@@ -1,0 +1,65 @@
+"""Reduced repro: XLA:CPU AllReducePromotion crash on the gradient of a
+partial-manual shard_map containing a bf16 ppermute (round-3 verdict
+#9; upstream-issue quality).
+
+Observed on jax 0.9.0 / CPU backend with 8 virtual devices::
+
+    F hlo_instruction.cc:1585] Invalid binary instruction opcode copy
+    ... xla::(anonymous namespace)::CloneAllReduce()
+    ... xla::ChangeOpDataType::RunImpl()
+    ... xla::AllReducePromotion::RunImpl()
+
+Mechanism: the transpose of a shard_map whose manual axes are a strict
+subset of the mesh ({"pp"} of a pp×dp mesh) emits an all-reduce over
+``pp`` for the replicated-parameter gradient whose ``to_apply``
+reduction computation is rooted in a ``copy`` instruction;
+AllReducePromotion (which promotes bf16 all-reduces to f32 on CPU)
+clones that reducer via ``HloInstruction::CreateBinary``, which
+CHECK-fails on the non-binary ``copy`` opcode.  TPU does not run this
+pass, and an f32 parameter at the shard_map boundary (cast to bf16
+inside the manual region — the workaround in ``parallel/pipeline.py``)
+avoids the bf16 all-reduce entirely.
+
+Run:  JAX_PLATFORMS=cpu python docs/xla_cpu_bf16_pp_repro.py
+      (crashes the process with the CHECK failure above;
+       pass --workaround to see the f32-boundary version succeed)
+"""
+import sys
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def main():
+    workaround = "--workaround" in sys.argv
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("pp", "dp"))
+
+    def pp_body(x, w):
+        wb = w.astype(jnp.bfloat16) if workaround else w
+        y = jnp.dot(x, wb,
+                    preferred_element_type=jnp.float32)
+        y = y.astype(jnp.bfloat16)
+        y = jax.lax.ppermute(y, "pp", [(0, 1), (1, 0)])
+        return y
+
+    f = jax.shard_map(pp_body, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P("pp"), axis_names={"pp"},
+                      check_vma=False)
+
+    def loss(w, x):
+        return f(x, w).astype(jnp.float32).sum()
+
+    w = jnp.ones((16, 16),
+                 jnp.float32 if workaround else jnp.bfloat16)
+    x = jnp.ones((4, 16), jnp.bfloat16)
+    g = jax.jit(jax.grad(loss))(w, x)
+    print("grad ok:", g.dtype)        # only reached with --workaround
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import queue
 import socket
 import struct
 import threading
@@ -340,6 +341,81 @@ class DistKVStore:
         self._pull_version: Dict[object, int] = {}
         self._push_round: Dict[object, int] = {}
         self._compressor = None
+        # async push pipeline (reference: push/pull are engine ops whose
+        # var deps let comm overlap backward compute — SURVEY.md §3.4).
+        # push() enqueues the wire RPC to a background sender; pull/
+        # barrier/init are sync points that drain the queue first.
+        # Worker exceptions are deferred and rethrown at the next sync
+        # (the engine's deferred-exception contract).
+        self._async_push = os.environ.get(
+            "MXNET_KVSTORE_ASYNC_PUSH", "1").lower() not in (
+                "0", "false", "off")
+        self._q: "queue.Queue" = queue.Queue()
+        self._q_exc = None
+        self._sender = None
+        if self._async_push:
+            self._sender = threading.Thread(target=self._sender_loop,
+                                            daemon=True)
+            self._sender.start()
+
+    # -- async sender ------------------------------------------------------
+    def _sender_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            msg, key = item
+            try:
+                # after a failure the store is poisoned (_drain raises
+                # forever); skipping the rest of the queue is safe
+                # because no later state can be trusted anyway
+                if self._q_exc is None:
+                    self._rpc(*msg, key=key)
+            except Exception as e:  # noqa: BLE001 — deferred to sync
+                self._q_exc = e
+            finally:
+                self._q.task_done()
+
+    def _enqueue_rpc(self, *msg, key=None):
+        if self._async_push:
+            self._q.put((msg, key))
+        else:
+            self._rpc(*msg, key=key)
+
+    def _drain(self):
+        """Sync point: wait for queued pushes; rethrow deferred errors.
+
+        A failed push POISONS the store permanently (the error rethrows
+        on every later sync op): the worker's round counters have
+        advanced past pushes the server never saw, so continuing would
+        silently desynchronize dist_sync aggregation — the reference's
+        ps-lite van likewise treats a dead transport as fatal.
+        Recreate the store to recover."""
+        if self._async_push:
+            self._q.join()
+        if self._q_exc is not None:
+            raise MXNetError("async push failed (store is now "
+                             "unusable, recreate it): %s"
+                             % (self._q_exc,))
+
+    def close(self):
+        """Stop the sender thread and close the server connections."""
+        if self._sender is not None and self._sender.is_alive():
+            self._q.put(None)
+            self._sender.join(timeout=5)
+            self._sender = None
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- api --------------------------------------------------------------
 
@@ -373,6 +449,7 @@ class DistKVStore:
         return out
 
     def init(self, key, value):
+        self._drain()
         keys, values = _kv_lists(key, value)
         for k, v in zip(keys, values):
             if self._rank == 0:
@@ -393,13 +470,13 @@ class DistKVStore:
             if self._compressor is not None:
                 payload, shape, dtype = self._compressor.compress(
                     k, _to_numpy(reduced))
-                self._rpc("cpush", k,
-                          (payload, shape, dtype,
-                           self._compressor.threshold),
-                          self._rank, rnd, key=k)
+                self._enqueue_rpc("cpush", k,
+                                  (payload, shape, dtype,
+                                   self._compressor.threshold),
+                                  self._rank, rnd, key=k)
             else:
-                self._rpc("push", k, _to_numpy(reduced), self._rank, rnd,
-                          key=k)
+                self._enqueue_rpc("push", k, _to_numpy(reduced),
+                                  self._rank, rnd, key=k)
             if self._sync:
                 # one aggregate-update per round of pushes
                 self._pull_version[k] = \
@@ -408,6 +485,7 @@ class DistKVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from ..ndarray.ndarray import NDArray
         from .. import ndarray as nd
+        self._drain()
         keys, outs = _kv_lists(key, out)
         for k, olist in zip(keys, outs):
             if not isinstance(olist, (list, tuple)):
@@ -436,6 +514,7 @@ class DistKVStore:
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the server (reference: serialized updater
         command from worker-0 → server applies updates)."""
+        self._drain()
         if self._rank == 0:
             blob = pickle.dumps(optimizer,
                                 protocol=pickle.HIGHEST_PROTOCOL)
@@ -450,6 +529,7 @@ class DistKVStore:
         self._compressor = create_compressor(compression_params)
 
     def barrier(self):
+        self._drain()
         self._rpc_all("barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -86,7 +86,39 @@ def pipeline_apply(stage_fn, stacked_params, x, aux=None, *, mesh,
     n_iter = n_microbatches + n_stages - 1
     fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
+    # XLA:CPU workaround: AllReducePromotion crashes ("Invalid binary
+    # instruction opcode copy") cloning the bf16 gradient all-reduce
+    # this partial-manual shard_map produces — reduced repro committed
+    # at docs/xla_cpu_bf16_pp_repro.py.  Keep bf16 PARAM leaves f32
+    # across the shard_map boundary on CPU (their grad psum then runs
+    # f32, which the pass leaves alone) and cast back inside the manual
+    # region; activations and compute stay bf16.  TPU takes the direct
+    # path.
+    cpu_bf16_fix = mesh.devices.flat[0].platform == "cpu"
+    p_dtypes = jax.tree_util.tree_map(lambda a: a.dtype, staged)
+    x_dtype = xm.dtype
+    aux_dtypes = jax.tree_util.tree_map(lambda a: a.dtype, auxm)
+
+    def _widen(t):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if a.dtype == jnp.bfloat16 else a, t)
+
+    def _narrow(t, dtypes):
+        return jax.tree_util.tree_map(lambda a, d: a.astype(d), t,
+                                      dtypes)
+
+    if cpu_bf16_fix:
+        # every input replicated over the manual axis whose grad needs
+        # a pp all-reduce must cross the boundary as f32 (params AND
+        # activations/aux) — see the repro note above
+        staged, xm, auxm = _widen(staged), _widen(xm), _widen(auxm)
+
     def per_shard(staged_p, xm, auxm):
+        if cpu_bf16_fix:
+            staged_p = _narrow(staged_p, p_dtypes)
+            xm = xm.astype(x_dtype)
+            auxm = _narrow(auxm, aux_dtypes)
         stage_p = _tree_index(staged_p, 0)      # squeeze P(axis) block
         s = jax.lax.axis_index(axis)
 

@@ -412,3 +412,69 @@ def test_horovod_backend_and_plugin_contract():
     assert kv2.type == "test_external"
     kv2.pushpull("g", mx.nd.ones((1,)))
     assert kv2.calls == ["g"]
+
+
+def test_async_push_overlaps_compute():
+    """Round-3 weak #6: pushes must overlap caller compute (reference:
+    push/pull are engine ops whose deps let comm run under backward).
+    With a server whose push handling is slowed to ~80ms, three pushes
+    plus ~240ms of host 'compute' must finish well under the serial
+    sum; the trailing pull drains the queue and sees all pushes."""
+    server = DistServer(num_workers=1, sync_mode=True)
+    orig_apply = server._apply_push
+
+    def slow_apply(key, agg):
+        time.sleep(0.08)
+        return orig_apply(key, agg)
+
+    server._apply_push = slow_apply
+    server.start()
+    env = _env(server.port, 0, 1)
+    old = dict(os.environ)
+    os.environ.update(env)
+    try:
+        kv = DistKVStore("dist_sync")
+        assert kv._async_push
+        kv.init("w", mx.nd.zeros((64,)))
+        t0 = time.time()
+        for _ in range(3):
+            kv.push("w", mx.nd.ones((64,)))
+            time.sleep(0.08)            # caller-side "compute"
+        overlapped = time.time() - t0
+        out = mx.nd.zeros((64,))
+        kv.pull("w", out=out)           # sync point: drains the queue
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+        # serial would be >= 3*(0.08 push + 0.08 compute) = 0.48s before
+        # the pull; overlapped push costs ~enqueue only
+        assert overlapped < 0.40, overlapped
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+        server.shutdown()
+
+
+def test_async_push_error_surfaces_at_sync_point():
+    """A push that dies on the wire must rethrow at the next sync op
+    (the engine's deferred-exception contract) and poison the store —
+    continuing would desynchronize the server's round counters."""
+    server = DistServer(num_workers=1, sync_mode=True)
+    server.start()
+    env = _env(server.port, 0, 1)
+    old = dict(os.environ)
+    os.environ.update(env)
+    try:
+        kv = DistKVStore("dist_sync")
+        kv.init("w", mx.nd.zeros((4,)))
+        for s in kv._socks:             # kill transport under the queue
+            s.close()
+        kv.push("w", mx.nd.ones((4,)))
+        with pytest.raises(mx.MXNetError, match="async push failed|pull failed"):
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)       # _drain rethrows the failure
+        # poisoned: every later sync op keeps raising
+        with pytest.raises(mx.MXNetError, match="async push failed"):
+            kv.pull("w", out=mx.nd.zeros((4,)))
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+        server.shutdown()
