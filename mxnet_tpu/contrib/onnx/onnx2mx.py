@@ -339,6 +339,90 @@ def _from_onnx_protobuf_pkg(path):
     }
 
 
+_ONNX_CAST_DT = {1: "float32", 2: "uint8", 3: "int8", 6: "int32",
+                 7: "int64", 10: "float16", 11: "float64"}
+
+
+def _try_fold(node, inits, shape_of):
+    """Importer-side constant folding: evaluate shape-arithmetic chains
+    (Shape→Gather→Unsqueeze→Concat→Expand/ConstantOfShape …, the idiom
+    external exporters use to build default RNN states and Reshape
+    targets) to numpy at import time.  Returns the np value, or None
+    when the node is not foldable."""
+    op = node["op_type"]
+    ins = node["inputs"]
+    a = node["attrs"]
+    if op == "Shape":
+        shp = shape_of(ins[0])
+        # dynamic dims decode as strings (dim_param) and unset dims as
+        # 0/() — folding those would bake a WRONG constant; only fold
+        # fully-known positive static shapes
+        if shp is None or len(shp) == 0 or not all(
+                isinstance(d, int) and not isinstance(d, bool) and d > 0
+                for d in shp):
+            return None
+        return _np.array(shp, dtype="int64")
+    vals = []
+    for nm in ins:
+        if nm == "":
+            vals.append(None)
+        elif nm in inits:
+            vals.append(_np.asarray(inits[nm]))
+        else:
+            return None
+    try:
+        if op == "Gather":
+            return _np.take(vals[0], vals[1].astype("int64"),
+                            axis=int(a.get("axis", 0)))
+        if op == "Unsqueeze":
+            axes = vals[1].ravel().astype(int) if len(vals) > 1 \
+                else _np.array(a["axes"], int)
+            # ONNX axes index the OUTPUT rank — normalize negatives
+            # against it before inserting (sequential expand_dims on
+            # raw negatives permutes dims)
+            out_rank = vals[0].ndim + len(axes)
+            norm = sorted(int(ax) % out_rank for ax in axes)
+            out = vals[0]
+            for ax in norm:
+                out = _np.expand_dims(out, ax)
+            return out
+        if op == "Squeeze":
+            if len(vals) > 1:
+                axes = tuple(int(x) for x in vals[1].ravel())
+            elif "axes" in a:
+                axes = tuple(int(x) for x in a["axes"])
+            else:
+                axes = None
+            return _np.squeeze(vals[0], axis=axes)
+        if op == "Concat":
+            return _np.concatenate(vals, axis=int(a.get("axis", 0)))
+        if op == "Expand":
+            return _np.broadcast_to(
+                vals[0], tuple(int(x) for x in vals[1])).copy()
+        if op == "ConstantOfShape":
+            v = _np.asarray(a.get("value", _np.zeros(1, "float32")))
+            return _np.full(tuple(int(x) for x in vals[0]),
+                            v.ravel()[0], dtype=v.dtype)
+        if op == "Cast":
+            dt = _ONNX_CAST_DT.get(int(a["to"]))
+            return None if dt is None else vals[0].astype(dt)
+        if op == "Range":
+            return _np.arange(vals[0].item(), vals[1].item(),
+                              vals[2].item())
+        if op in ("Add", "Sub", "Mul", "Div"):
+            if op == "Div" and all(v.dtype.kind in "iu" for v in vals):
+                # ONNX integer Div truncates toward zero (not floor)
+                q = _np.trunc(vals[0].astype("float64")
+                              / vals[1].astype("float64"))
+                return q.astype(_np.result_type(vals[0], vals[1]))
+            f = {"Add": _np.add, "Sub": _np.subtract,
+                 "Mul": _np.multiply, "Div": _np.divide}[op]
+            return f(vals[0], vals[1])
+    except Exception:
+        return None
+    return None
+
+
 def import_model(model):
     """Import an ONNX model (dict IR or ``.onnx`` path) →
     ``(sym, arg_params, aux_params)`` (reference: ``import_model``)."""
@@ -352,8 +436,27 @@ def import_model(model):
     ctx = _ImportCtx(inits)
 
     produced = {}   # onnx tensor name -> Symbol
+    known_shapes = {}
     for i in g["inputs"]:
         produced[i["name"]] = Variable(i["name"])
+        known_shapes[i["name"]] = tuple(i.get("shape", ()))
+    for k, v in inits.items():
+        known_shapes[k] = _np.asarray(v).shape
+
+    def shape_of(name):
+        if name in known_shapes:
+            return known_shapes[name]
+        s = produced.get(name)
+        if s is None:
+            return None
+        try:
+            feed = {n: known_shapes[n] for n in s.list_arguments()
+                    if n in known_shapes}
+            _, outs, _ = s.infer_shape(**feed)
+            known_shapes[name] = tuple(outs[0])
+            return known_shapes[name]
+        except Exception:
+            return None
 
     def get_input(node):
         def get(i):
@@ -367,6 +470,13 @@ def import_model(model):
         return get
 
     for node in g["nodes"]:
+        folded = _try_fold(node, inits, shape_of)
+        if folded is not None and len(node["outputs"]) == 1:
+            out = node["outputs"][0]
+            inits[out] = folded
+            known_shapes[out] = folded.shape
+            produced[out] = Variable(out)
+            continue
         imp = _IMPORTERS.get(node["op_type"])
         if imp is None:
             raise MXNetError("onnx import: no importer for %r"

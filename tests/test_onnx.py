@@ -375,3 +375,44 @@ def test_onnx_roundtrip_spatial_blocks():
             sym.reshape(x, shape=(1, 1, 3, 4)), scale=2,
             sample_type="nearest"),
         data_shape=(3, 4))
+
+
+def test_onnx_import_constant_folding_shape_chain():
+    """Shape→Gather→Unsqueeze→Concat→ConstantOfShape chains (the idiom
+    external exporters use for default RNN states and dynamic Reshape
+    targets) fold to initializers at import (round 3)."""
+    model = _min_model(
+        [{"op_type": "Shape", "name": "sh", "inputs": ["data"],
+          "outputs": ["shp"], "attrs": {}},
+         {"op_type": "Gather", "name": "g", "inputs": ["shp", "i1"],
+          "outputs": ["dim1"], "attrs": {"axis": 0}},
+         {"op_type": "Unsqueeze", "name": "u", "inputs": ["dim1", "ax0"],
+          "outputs": ["dim1v"], "attrs": {}},
+         {"op_type": "Concat", "name": "c1", "inputs": ["one", "dim1v"],
+          "outputs": ["zshape"], "attrs": {"axis": 0}},
+         {"op_type": "ConstantOfShape", "name": "z",
+          "inputs": ["zshape"], "outputs": ["fives"],
+          "attrs": {"value": np.full(1, 5.0, "float32")}},
+         {"op_type": "Concat", "name": "c2", "inputs": ["negone",
+                                                        "dim1v"],
+          "outputs": ["tgt"], "attrs": {"axis": 0}},
+         {"op_type": "Reshape", "name": "r", "inputs": ["data", "tgt"],
+          "outputs": ["rdata"], "attrs": {}},
+         {"op_type": "Add", "name": "a", "inputs": ["rdata", "fives"],
+          "outputs": ["out"], "attrs": {}}],
+        {"i1": np.array(1, "int64"), "ax0": np.array([0], "int64"),
+         "one": np.array([1], "int64"),
+         "negone": np.array([-1], "int64")},
+        in_shape=(2, 3))
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model as imp
+    s2, arg2, aux2 = imp(model)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    args = dict(arg2)
+    args["data"] = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    out = s2.bind(ctx=mx.cpu(), args=args,
+                  aux_states=aux2).forward()[0].asnumpy()
+    # zshape folded to (1,3) fives; tgt folded to [-1,3]
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(
+        out, np.arange(6, dtype="float32").reshape(2, 3) + 5.0)

@@ -132,8 +132,9 @@ def test_onnx_golden_bytes_stable():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("kind", ["lstm", "gru"])
-def test_rnn_onnx_torch_crosscheck(kind):
+@pytest.mark.parametrize("kind,default_state", [
+    ("lstm", False), ("gru", False), ("lstm", True), ("gru", True)])
+def test_rnn_onnx_torch_crosscheck(kind, default_state):
     """torch model → torch's own ONNX protobuf writer → our wire reader
     + importer → forward must match torch's forward.  External
     validation of both the byte codec and the gate-order mapping."""
@@ -151,11 +152,20 @@ def test_rnn_onnx_torch_crosscheck(kind):
         state = (h0t, torch.randn(1, N, H) * 0.3) if kind == "lstm" \
             else h0t
         with torch.no_grad():
-            y_ref = tm(xt, state)[0].numpy()
+            y_ref = tm(xt, None if default_state else state)[0].numpy()
         with tempfile.TemporaryDirectory() as d:
             pth = os.path.join(d, "t.onnx")
-            in_names = ["data", "h0"] + (["c0"] if kind == "lstm" else [])
-            torch.onnx.export(tm, (xt, state), pth, opset_version=13,
+            if default_state:
+                # torch builds zero states via a Shape/Gather/Concat/
+                # Expand chain — exercises the importer's constant
+                # folding (round 3)
+                in_names = ["data"]
+                export_args = (xt,)
+            else:
+                in_names = ["data", "h0"] + (["c0"] if kind == "lstm"
+                                             else [])
+                export_args = (xt, state)
+            torch.onnx.export(tm, export_args, pth, opset_version=13,
                               input_names=in_names, output_names=["out"],
                               dynamo=False)
             s2, arg2, aux2 = import_model(pth)

@@ -339,6 +339,21 @@ def build_sweep_cases():
     return cases
 
 
+def _write_record(path, n_cases, record, failed, errored):
+    """Incremental per-case record (the sweep takes hours through the
+    tunnel; a partial record beats none if the run is cut short)."""
+    if not path:
+        return
+    import json
+    done = len(record)
+    with open(path, "w") as f:
+        json.dump({"summary": {"cases": n_cases, "completed": done,
+                               "pass": done - len(failed) - len(errored),
+                               "fail": len(failed),
+                               "harness_error": len(errored)},
+                   "cases": record}, f, indent=1, sort_keys=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", default=None,
@@ -407,17 +422,13 @@ def main():
                 record[name] = {"status": "error",
                                 "error": str(e)[:200]}
                 print("err %s: %s" % (name, str(e)[:120]), flush=True)
+        if args.record and len(record) % 25 == 0:
+            _write_record(args.record, len(cases), record, failed,
+                          errored)
     n_pass = len(cases) - len(failed) - len(errored)
     print("%d/%d consistent (%d FAIL, %d harness-errored)"
           % (n_pass, len(cases), len(failed), len(errored)))
-    if args.record:
-        import json
-        with open(args.record, "w") as f:
-            json.dump({"summary": {"cases": len(cases),
-                                   "pass": n_pass,
-                                   "fail": len(failed),
-                                   "harness_error": len(errored)},
-                       "cases": record}, f, indent=1, sort_keys=True)
+    _write_record(args.record, len(cases), record, failed, errored)
     return 1 if failed else 0
 
 
