@@ -361,7 +361,13 @@ def _try_fold(node, inits, shape_of):
                 isinstance(d, int) and not isinstance(d, bool) and d > 0
                 for d in shp):
             return None
-        return _np.array(shp, dtype="int64")
+        # opset-15 start/end attributes slice the returned shape
+        rank = len(shp)
+        start = int(a.get("start", 0))
+        end = int(a.get("end", rank))
+        start = max(0, min(rank, start + rank if start < 0 else start))
+        end = max(0, min(rank, end + rank if end < 0 else end))
+        return _np.array(shp[start:end], dtype="int64")
     vals = []
     for nm in ins:
         if nm == "":
@@ -407,8 +413,9 @@ def _try_fold(node, inits, shape_of):
             dt = _ONNX_CAST_DT.get(int(a["to"]))
             return None if dt is None else vals[0].astype(dt)
         if op == "Range":
+            # ONNX: output dtype follows the inputs' dtype
             return _np.arange(vals[0].item(), vals[1].item(),
-                              vals[2].item())
+                              vals[2].item()).astype(vals[0].dtype)
         if op in ("Add", "Sub", "Mul", "Div"):
             if op == "Div" and all(v.dtype.kind in "iu" for v in vals):
                 # ONNX integer Div truncates toward zero (not floor)

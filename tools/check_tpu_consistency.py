@@ -346,12 +346,15 @@ def _write_record(path, n_cases, record, failed, errored):
         return
     import json
     done = len(record)
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"summary": {"cases": n_cases, "completed": done,
                                "pass": done - len(failed) - len(errored),
                                "fail": len(failed),
                                "harness_error": len(errored)},
                    "cases": record}, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)      # atomic: a cut-short run keeps the last
+                               # complete record instead of a torn file
 
 
 def main():
