@@ -176,6 +176,9 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
     jax = _jax()
     jnp = _j()
     nd = data.ndim - 2
+    if nd < 1:
+        raise MXNetError("Pooling: data must be 3-D/4-D/5-D (N, C, "
+                         "spatial...), got %d-D" % data.ndim)
     if global_pool:
         ax = tuple(range(2, data.ndim))
         if pool_type == "max":
@@ -183,6 +186,13 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
         if pool_type in ("avg", "lp"):
             return jnp.mean(data, axis=ax, keepdims=True)
         return jnp.sum(data, axis=ax, keepdims=True)
+    if not kernel:
+        # reference pooling.cc requires the kernel for non-global
+        # pooling; a defaulted empty kernel would silently reduce over
+        # a 1x..x1 window (identity), whose select-and-scatter VJP is
+        # additionally backend-divergent for degenerate windows
+        raise MXNetError("Pooling: kernel is required unless "
+                         "global_pool=True")
     kernel = _tup(kernel, nd)
     stride = _tup(stride or 1, nd)
     pad = _tup(pad or 0, nd)

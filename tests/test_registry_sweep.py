@@ -102,6 +102,8 @@ FWD_ONLY = {
 # mode: "grad" numeric-gradient, "fwd" invoke+finite check
 SPECS = {
   # --- nn ---------------------------------------------------------------
+  "Pooling": ("grad", lambda: ([A(2, 3, 6, 6)],
+              dict(kernel=(2, 2), stride=(2, 2), pool_type="max"))),
   "Convolution": ("grad", lambda: ([A(2, 3, 6, 6), A(4, 3, 3, 3), A(4)],
                   dict(kernel=(3, 3), num_filter=4, pad=(1, 1)))),
   "Deconvolution": ("grad", lambda: ([A(2, 3, 5, 5), A(3, 4, 2, 2),

@@ -1,0 +1,26 @@
+#!/bin/sh
+# Drive the cpu-vs-tpu oracle to completion across tunnel wedges: the
+# PjRt client cannot recover once the axon relay errors, so the tool
+# exits with code 3 and a resume index; this wrapper restarts it in a
+# fresh process until every case has run.
+set -u
+cd "$(dirname "$0")/.."
+RECORD=${1:-docs/tpu_consistency_record.json}
+START=0
+while :; do
+    python tools/check_tpu_consistency.py --record "$RECORD" \
+        --start "$START" > /tmp/oracle_chunk.log 2>&1
+    rc=$?
+    cat /tmp/oracle_chunk.log
+    if [ "$rc" != 3 ]; then
+        exit "$rc"
+    fi
+    NEXT=$(grep -o "resume with --start [0-9]*" /tmp/oracle_chunk.log \
+           | tail -1 | grep -o "[0-9]*$")
+    if [ -z "$NEXT" ] || [ "$NEXT" = "$START" ]; then
+        # same case wedges a fresh process twice -> skip it
+        NEXT=$((START + 1))
+    fi
+    START=$NEXT
+    sleep 10
+done
