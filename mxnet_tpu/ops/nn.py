@@ -183,8 +183,14 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
         ax = tuple(range(2, data.ndim))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
-        if pool_type in ("avg", "lp"):
+        if pool_type == "avg":
             return jnp.mean(data, axis=ax, keepdims=True)
+        if pool_type == "lp":
+            # p-norm over the whole spatial extent, matching the
+            # windowed lp branch below (reference pooling.cc)
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(data), p_value), axis=ax,
+                        keepdims=True), 1.0 / p_value)
         return jnp.sum(data, axis=ax, keepdims=True)
     if not kernel:
         # reference pooling.cc requires the kernel for non-global

@@ -303,9 +303,20 @@ def build_sweep_cases():
 
     cases = []
     dropped = []
+    # cross-backend comparison is ILL-POSED for these (documented, not
+    # silent): eigen/singular vectors are sign- and degenerate-order-
+    # indeterminate between backends; the CPU sweep's reconstruction-
+    # style checks cover their correctness instead
+    SIGN_AMBIGUOUS = {"_linalg_syevd": "eigenvector sign/order is "
+                                       "backend-indeterminate",
+                      "_np_linalg_svd": "singular-vector signs are "
+                                        "backend-indeterminate"}
     for name in sorted(rec):
         r = rec[name]
         if r.get("status") != "pass":
+            continue
+        if name in SIGN_AMBIGUOUS:
+            dropped.append((name, SIGN_AMBIGUOUS[name]))
             continue
         grad = r.get("mode") == "grad"
         try:
@@ -383,7 +394,8 @@ def main():
     if not args.no_sweep:
         cases += build_sweep_cases()
     if args.family:
-        cases = [c for c in cases if c[0].startswith(args.family)]
+        prefixes = tuple(args.family.split(","))
+        cases = [c for c in cases if c[0].startswith(prefixes)]
     if args.max_cases:
         cases = cases[:args.max_cases]
     total_cases = len(cases)
@@ -479,6 +491,40 @@ def main():
         if args.record and len(record) % 25 == 0:
             _write_record(args.record, total_cases, record, failed,
                           errored)
+    # end-of-run retry of backend-errored cases: the client is healthy
+    # here (later cases ran), so a REPEATED "TPU backend error" on a
+    # case whose CPU run passes is a genuine TPU-only crash, not a
+    # tunnel hiccup — reclassify it as FAIL
+    from mxnet_tpu.context import cpu as _cpu
+    for name, fn, inputs, grad in cases:
+        if record.get(name, {}).get("status") != "error":
+            continue
+        if "TPU backend error" not in record[name].get("error", ""):
+            continue
+        try:
+            check_consistency(fn, inputs, grad=grad, rtol=2e-3,
+                              atol=1e-5)
+            errored.remove(name)
+            record[name] = {"status": "pass",
+                            "mode": "grad" if grad else "fwd",
+                            "note": "passed on end-of-run retry "
+                                    "(transient tunnel error)"}
+            print("ok  %s (retry)" % name, flush=True)
+        except Exception as e2:  # noqa: BLE001
+            try:
+                check_consistency(fn, inputs, ctx_list=[_cpu()],
+                                  grad=grad, rtol=2e-3, atol=1e-5)
+                cpu_ok = True
+            except Exception:
+                cpu_ok = False
+            if cpu_ok:
+                errored.remove(name)
+                failed.append(name)
+                record[name] = {"status": "FAIL",
+                                "error": "tpu-only crash (repeated): %s"
+                                         % str(e2)[:160]}
+                print("FAIL %s (tpu-only, repeated)" % name, flush=True)
+
     n_pass = len(record) - len(failed) - len(errored)
     print("%d/%d consistent (%d FAIL, %d harness-errored)"
           % (n_pass, len(record), len(failed), len(errored)))

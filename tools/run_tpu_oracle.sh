@@ -18,7 +18,10 @@ while :; do
     NEXT=$(grep -o "resume with --start [0-9]*" /tmp/oracle_chunk.log \
            | tail -1 | grep -o "[0-9]*$")
     if [ -z "$NEXT" ] || [ "$NEXT" = "$START" ]; then
-        # same case wedges a fresh process twice -> skip it
+        # same case wedges a fresh process twice -> skip it; the record
+        # will show completed < cases for it (no pass/fail/skip bucket)
+        echo "WARNING: case $START wedged two fresh processes —" \
+             "permanently skipped; record is one case short" >&2
         NEXT=$((START + 1))
     fi
     START=$NEXT
