@@ -619,6 +619,18 @@ def _rnn_importer(mode):
     return imp
 
 
+@register_op_importer("Expand")
+def _expand_imp(node, get, attrs, ctx):
+    """Runtime Expand with a constant target shape → the internal
+    ``_onnx_expand`` op (BIDIRECTIONAL broadcast: target dims of 1 keep
+    the larger input dim and either rank may be smaller, per the ONNX
+    spec — MXNet's broadcast_to cannot express that).  Fully-constant
+    Expands fold earlier in ``_try_fold``."""
+    shape = _ints(ctx.const(node["inputs"][1]))
+    return _sym_op("_onnx_expand", [get(0)], {"shape": shape},
+                   name=node["name"])
+
+
 @register_op_importer("Constant")
 def _constant_imp(node, get, attrs, ctx):
     """Constant node → initializer (consumers read it via ctx.const or

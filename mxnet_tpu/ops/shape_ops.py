@@ -132,8 +132,30 @@ def repeat(data, repeats=1, axis=None, **kw):
 @register("broadcast_to")
 def broadcast_to(data, shape=None, **kw):
     jnp = _j()
-    # MXNet allows 0 meaning "keep this dim"
-    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    # MXNet allows 0 meaning "keep this dim".  Rank growth (numpy/ONNX
+    # Expand style) right-aligns the input dims: the old same-rank zip
+    # silently misaligned the 0-rule for longer targets.
+    tgt = list(shape)
+    lead = len(tgt) - data.ndim
+    if lead < 0:
+        raise MXNetError(
+            "broadcast_to: target rank %d < data rank %d"
+            % (len(tgt), data.ndim))
+    for i, d in enumerate(data.shape):
+        if tgt[lead + i] == 0:
+            tgt[lead + i] = d
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("_onnx_expand")
+def _onnx_expand(data, shape=None, **kw):
+    """ONNX Expand semantics (importer-internal): BIDIRECTIONAL
+    numpy-style broadcast of data against the target shape — a target
+    dim of 1 keeps the larger input dim, and either side may have the
+    smaller rank (unlike MXNet broadcast_to, whose target must
+    dominate)."""
+    jnp = _j()
+    tgt = jnp.broadcast_shapes(tuple(data.shape), tuple(shape))
     return jnp.broadcast_to(data, tgt)
 
 

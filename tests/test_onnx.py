@@ -416,3 +416,49 @@ def test_onnx_import_constant_folding_shape_chain():
     assert out.shape == (2, 3)
     np.testing.assert_allclose(
         out, np.arange(6, dtype="float32").reshape(2, 3) + 5.0)
+
+
+def test_onnx_import_runtime_expand():
+    """Expand with a constant target shape on a runtime tensor →
+    broadcast_to (the fully-constant form folds instead)."""
+    model = _min_model(
+        [{"op_type": "Relu", "name": "r", "inputs": ["data"],
+          "outputs": ["rd"], "attrs": {}},
+         {"op_type": "Expand", "name": "e", "inputs": ["rd", "tgt"],
+          "outputs": ["out"], "attrs": {}}],
+        {"tgt": np.array([4, 1, 3], "int64")}, in_shape=(1, 3))
+    s2, arg2, aux2 = import_model(model)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    args = dict(arg2)
+    args["data"] = nd.array(np.array([[-1., 2., 3.]], "float32"))
+    out = s2.bind(ctx=mx.cpu(), args=args,
+                  aux_states=aux2).forward()[0].asnumpy()
+    assert out.shape == (4, 1, 3)
+    np.testing.assert_allclose(out[2, 0], [0., 2., 3.])
+
+
+def test_onnx_import_expand_bidirectional():
+    """ONNX Expand's bidirectional rule: target dims of 1 keep the
+    larger input dim; a smaller-rank target is valid too."""
+    for tgt, in_shape, want in (
+            ([1, 3], (2, 3), (2, 3)),
+            ([3], (2, 3), (2, 3)),
+            ([2, 1, 3], (1, 3), (2, 1, 3))):
+        model = _min_model(
+            [{"op_type": "Relu", "name": "r", "inputs": ["data"],
+              "outputs": ["rd"], "attrs": {}},
+             {"op_type": "Expand", "name": "e", "inputs": ["rd", "tgt"],
+              "outputs": ["out"], "attrs": {}}],
+            {"tgt": np.array(tgt, "int64")}, in_shape=in_shape)
+        s2, arg2, aux2 = import_model(model)
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        args = dict(arg2)
+        data = np.random.rand(*in_shape).astype("float32")
+        args["data"] = nd.array(data)
+        out = s2.bind(ctx=mx.cpu(), args=args,
+                      aux_states=aux2).forward()[0].asnumpy()
+        assert out.shape == want, (tgt, out.shape)
+        np.testing.assert_allclose(
+            out, np.broadcast_to(np.maximum(data, 0), want))

@@ -104,6 +104,8 @@ SPECS = {
   # --- nn ---------------------------------------------------------------
   "Pooling": ("grad", lambda: ([A(2, 3, 6, 6)],
               dict(kernel=(2, 2), stride=(2, 2), pool_type="max"))),
+  "_onnx_expand": ("grad", lambda: ([A(1, 3)],
+                   dict(shape=(2, 1, 3)))),
   "Convolution": ("grad", lambda: ([A(2, 3, 6, 6), A(4, 3, 3, 3), A(4)],
                   dict(kernel=(3, 3), num_filter=4, pad=(1, 1)))),
   "Deconvolution": ("grad", lambda: ([A(2, 3, 5, 5), A(3, 4, 2, 2),
