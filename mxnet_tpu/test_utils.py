@@ -216,7 +216,7 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=None, grad=True,
         nd_in = []
         is_float = []
         for x in inputs:
-            xa = np.asarray(x)
+            xa = x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
             f = np.issubdtype(xa.dtype, np.floating)
             is_float.append(f)
             nd_in.append(nd.array(xa, dtype=dt if f else xa.dtype,
