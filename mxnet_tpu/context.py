@@ -73,9 +73,13 @@ class Context:
         # sees its own gpu(0..n)); cross-host placement happens only
         # through mesh shardings.
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
+            # local_devices(backend=...) keeps the cpu path process-local
+            # too — jax.devices("cpu") is cluster-global under multi-host
+            # and could hand a non-zero worker another host's CPU device.
             try:
                 devs = [d for d in jax.local_devices()
-                        if d.platform == "cpu"] or jax.devices("cpu")
+                        if d.platform == "cpu"] \
+                    or jax.local_devices(backend="cpu")
             except RuntimeError:
                 # CPU backend absent (rare); fall back to default backend.
                 devs = jax.local_devices()

@@ -79,9 +79,13 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
             return fmap[k]
         raise MXNetError("internal: no float version of %s" % node.name)
 
-    def get_quantized(node, slot) -> Tuple:
+    def get_quantized(node, slot, param=False) -> Tuple:
         """Int8 triple for an input edge, inserting quantize_v2 or offline
-        param vars as needed."""
+        param vars as needed.  ``param=True`` marks a weight/bias edge of a
+        quantized op: those are ALWAYS symmetric s8 regardless of
+        ``quantized_dtype`` — quantized_fully_connected/conv rescale them
+        assuming rb/127, and a uint8 quantize would clip negative bias
+        values to 0 (reference: params are s8 even under uint8 mode)."""
         k = fkey(node, slot)
         if k in qmap:
             return qmap[k]
@@ -93,8 +97,10 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
             return qmap[k]
         fn, fs = get_float(node, slot)
         # activations follow quantized_dtype; quantize_v2 resolves
-        # "auto" per node from the calibrated min (u8 iff min >= 0)
-        attrs: Dict[str, Any] = {"out_type": quantized_dtype}
+        # "auto" per node from the calibrated min (u8 iff min >= 0).
+        # Param edges (see docstring) are forced s8.
+        out_type = "int8" if param else quantized_dtype
+        attrs: Dict[str, Any] = {"out_type": out_type}
         rng = calib_info.get(node.name)
         if rng is not None:
             attrs["min_calib_range"] = float(rng[0])
@@ -129,10 +135,10 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
             if opname in _QUANTIZED_OPS:
                 no_bias = bool(node.attrs.get("no_bias", False))
                 data_q = get_quantized(*node.inputs[0])
-                w_q = get_quantized(*node.inputs[1])
+                w_q = get_quantized(*node.inputs[1], param=True)
                 ins = [data_q[0], w_q[0]]
                 if not no_bias and len(node.inputs) > 2:
-                    b_q = get_quantized(*node.inputs[2])
+                    b_q = get_quantized(*node.inputs[2], param=True)
                     ins.append(b_q[0])
                 ins += [data_q[1], data_q[2], w_q[1], w_q[2]]
                 if not no_bias and len(node.inputs) > 2:

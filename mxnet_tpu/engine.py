@@ -110,18 +110,15 @@ class Engine:
         machinery — the invoke fast lane calls this so ``wait_for_all``
         stays a true sync point."""
         import weakref
-        if type(result) in (tuple, list):
-            for r in result:
-                if hasattr(r, "block_until_ready"):
-                    try:
-                        self._recent.append(weakref.ref(r))
-                    except TypeError:
-                        pass
-        elif hasattr(result, "block_until_ready"):
-            try:
-                self._recent.append(weakref.ref(result))
-            except TypeError:
-                pass
+        import jax
+        # mirror push(): walk the full pytree so nested structures (a
+        # tuple holding a list of arrays) don't escape the sync ring
+        for leaf in jax.tree_util.tree_leaves(result):
+            if hasattr(leaf, "block_until_ready"):
+                try:
+                    self._recent.append(weakref.ref(leaf))
+                except TypeError:
+                    pass
 
     def wait_for_all(self):
         """Block until all outstanding device work completes; deferred

@@ -25,6 +25,21 @@ class HorovodKVStore:
 
     def __init__(self):
         self.type = "horovod"
+        # Scope guard: this backend reduces across the *process-local*
+        # device list only.  On a multi-host job the reference
+        # KVStoreHorovod wraps hvd.allreduce/hvd.broadcast, which reduce
+        # across processes; silently doing a local-only sum there would
+        # diverge gradients per host.  Refuse loudly instead — multi-host
+        # jobs should use the GSPMD dp path (``DataParallelTrainer``) or
+        # the dist kvstore, both of which are cross-process.
+        from ..parallel import multihost
+        if multihost.is_initialized() and multihost.num_hosts() > 1:
+            raise MXNetError(
+                "kvstore 'horovod' is single-process scope in this "
+                "framework (local-device allreduce only); on a %d-host "
+                "job use kvstore 'dist_sync' or the GSPMD "
+                "DataParallelTrainer, whose collectives span processes"
+                % multihost.num_hosts())
 
     @property
     def rank(self) -> int:

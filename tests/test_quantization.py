@@ -238,3 +238,35 @@ def test_quantized_uint8_positive_min_zero_point_correct():
     with pytest.raises(mx.MXNetError):
         nd.quantize_v2(nd.array(w), out_type="uint8",
                        min_calib_range=-1.0, max_calib_range=1.0)
+
+
+def test_uint8_mode_params_stay_s8():
+    """Advisor regression (round 3): with quantized_dtype='uint8', the
+    quantize_v2 inserted for a NON-offline weight/bias edge must be s8 —
+    a u8 quantize clips the negative half of a bias to zero and the
+    quantized op's rb/127 rescale then silently mis-scales it."""
+    np.random.seed(7)
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    fc = mx.sym.FullyConnected(data, weight=w, bias=b, num_hidden=4,
+                               name="fc")
+    # offline_params EMPTY: weight and bias edges get inserted quantize_v2
+    qsym = qz.quantize_symbol(fc, offline_params=(),
+                              quantized_dtype="uint8")
+    x = np.random.uniform(0, 1, (8, 16)).astype(np.float32)
+    wv = np.random.uniform(-1, 1, (4, 16)).astype(np.float32)
+    bv = np.array([-3.0, -1.0, 1.0, 3.0], np.float32)   # negative halves
+    ref = x @ wv.T + bv
+    exe = qsym.bind(ctx=mx.cpu(),
+                    args={"data": nd.array(x), "w": nd.array(wv),
+                          "b": nd.array(bv)},
+                    grad_req="null")
+    out = exe.forward(is_train=False)[0].asnumpy()
+    err = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-6)
+    assert err < 0.1, "uint8-mode bias mis-quantized: rel err %.3f" % err
+    # and the param quantizes really are s8 in the rewritten graph
+    for node in qsym._nodes():
+        if node.name in ("w_quantize", "b_quantize"):
+            assert node.attrs.get("out_type") == "int8", \
+                (node.name, node.attrs)
