@@ -62,6 +62,11 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
 
     fmap: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
     qmap: Dict[Tuple[int, int], Tuple] = {}
+    # param (weight/bias) quantizes are cached separately from activation
+    # quantizes: a tensor consumed BOTH as an activation and as a param of
+    # two quantized ops must not reuse the activation's (possibly u8)
+    # quantize for the param edge, which is always s8
+    pmap: Dict[Tuple[int, int], Tuple] = {}
 
     def fkey(node, slot):
         return (id(node), slot)
@@ -87,14 +92,19 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
         assuming rb/127, and a uint8 quantize would clip negative bias
         values to 0 (reference: params are s8 even under uint8 mode)."""
         k = fkey(node, slot)
-        if k in qmap:
-            return qmap[k]
+        cache = pmap if param else qmap
+        if k in cache:
+            return cache[k]
         if node.is_var and node.name in offline:
-            qv = _Node(None, node.name + "_quantize")
-            mnv = _Node(None, node.name + "_quantize_min")
-            mxv = _Node(None, node.name + "_quantize_max")
-            qmap[k] = ((qv, 0), (mnv, 0), (mxv, 0))
-            return qmap[k]
+            # offline vars are symmetric s8 — one triple serves both
+            # activation and param edges
+            if k not in qmap:
+                qv = _Node(None, node.name + "_quantize")
+                mnv = _Node(None, node.name + "_quantize_min")
+                mxv = _Node(None, node.name + "_quantize_max")
+                qmap[k] = ((qv, 0), (mnv, 0), (mxv, 0))
+            cache[k] = qmap[k]
+            return cache[k]
         fn, fs = get_float(node, slot)
         # activations follow quantized_dtype; quantize_v2 resolves
         # "auto" per node from the calibrated min (u8 iff min >= 0).
@@ -105,10 +115,16 @@ def quantize_symbol(sym: Symbol, excluded_sym_names: Sequence[str] = (),
         if rng is not None:
             attrs["min_calib_range"] = float(rng[0])
             attrs["max_calib_range"] = float(rng[1])
-        qn = _mk("_contrib_quantize_v2", [(fn, fs)], attrs,
-                 node.name + "_quantize")
-        qmap[k] = ((qn, 0), (qn, 1), (qn, 2))
-        return qmap[k]
+        # keep graph node names unique when the same edge is quantized
+        # once per kind
+        name = node.name + "_quantize"
+        if param and k in qmap:
+            name = node.name + "_quantize_s8"
+        elif not param and k in pmap:
+            name = node.name + "_quantize_act"
+        qn = _mk("_contrib_quantize_v2", [(fn, fs)], attrs, name)
+        cache[k] = ((qn, 0), (qn, 1), (qn, 2))
+        return cache[k]
 
     def quantizable(node) -> bool:
         if node.is_var or node.name in excluded_sym_names:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import weakref
 from typing import Any, Callable, List, Optional
 
 __all__ = ["Engine", "engine", "bulk", "set_bulk_size"]
@@ -95,25 +96,16 @@ class Engine:
         if self.synchronous:
             _block(result)
         else:
-            import weakref
-            import jax
-            for leaf in jax.tree_util.tree_leaves(result):
-                if hasattr(leaf, "block_until_ready"):
-                    try:
-                        self._recent.append(weakref.ref(leaf))
-                    except TypeError:
-                        pass
+            self.note(result)
         return result
 
     def note(self, result):
         """Record op outputs in the recent ring without the push() hook
         machinery — the invoke fast lane calls this so ``wait_for_all``
-        stays a true sync point."""
-        import weakref
-        import jax
-        # mirror push(): walk the full pytree so nested structures (a
-        # tuple holding a list of arrays) don't escape the sync ring
-        for leaf in jax.tree_util.tree_leaves(result):
+        stays a true sync point.  Walks the full pytree so nested
+        structures (a tuple holding a list of arrays) don't escape."""
+        from jax.tree_util import tree_leaves
+        for leaf in tree_leaves(result):
             if hasattr(leaf, "block_until_ready"):
                 try:
                     self._recent.append(weakref.ref(leaf))

@@ -32,14 +32,32 @@ class HorovodKVStore:
         # diverge gradients per host.  Refuse loudly instead — multi-host
         # jobs should use the GSPMD dp path (``DataParallelTrainer``) or
         # the dist kvstore, both of which are cross-process.
+        # Cheap check here (no backend side effect — process_count() in
+        # __init__ would force-initialize JAX and break a LATER
+        # jax.distributed.initialize()); the authoritative
+        # jax.process_count() check runs at each collective, by which
+        # point the backend is necessarily up.
         from ..parallel import multihost
         if multihost.is_initialized() and multihost.num_hosts() > 1:
-            raise MXNetError(
-                "kvstore 'horovod' is single-process scope in this "
-                "framework (local-device allreduce only); on a %d-host "
-                "job use kvstore 'dist_sync' or the GSPMD "
-                "DataParallelTrainer, whose collectives span processes"
-                % multihost.num_hosts())
+            self._refuse_multiprocess(multihost.num_hosts())
+
+    @staticmethod
+    def _refuse_multiprocess(nproc: int):
+        raise MXNetError(
+            "kvstore 'horovod' is single-process scope in this "
+            "framework (local-device allreduce only); on a %d-process "
+            "job use kvstore 'dist_sync' or the GSPMD "
+            "DataParallelTrainer, whose collectives span processes"
+            % nproc)
+
+    def _check_scope(self):
+        """Refuse multi-process jobs — the local-device reduce would
+        silently diverge gradients per host (reference KVStoreHorovod
+        wraps hvd.allreduce, which is cross-process)."""
+        import jax
+        nproc = jax.process_count()
+        if nproc > 1:
+            self._refuse_multiprocess(nproc)
 
     @property
     def rank(self) -> int:
@@ -55,6 +73,7 @@ class HorovodKVStore:
     def broadcast(self, key, value, out=None, priority=0):
         """Root's value replaces every ``out`` replica (reference:
         ``KVStoreHorovod.broadcast`` ≡ hvd.broadcast)."""
+        self._check_scope()
         if out is None:
             return value
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -68,6 +87,7 @@ class HorovodKVStore:
         """Combined allreduce: sum the per-device values, give every
         ``out`` replica the reduced result (reference:
         ``KVStoreHorovod.pushpull`` ≡ hvd.allreduce(average=False))."""
+        self._check_scope()
         vals = value if isinstance(value, (list, tuple)) else [value]
         if not vals:
             raise MXNetError("pushpull: empty value list")

@@ -266,7 +266,10 @@ def test_uint8_mode_params_stay_s8():
     err = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-6)
     assert err < 0.1, "uint8-mode bias mis-quantized: rel err %.3f" % err
     # and the param quantizes really are s8 in the rewritten graph
+    found = {}
     for node in qsym._nodes():
         if node.name in ("w_quantize", "b_quantize"):
-            assert node.attrs.get("out_type") == "int8", \
-                (node.name, node.attrs)
+            found[node.name] = node.attrs.get("out_type")
+    assert set(found) == {"w_quantize", "b_quantize"}, \
+        "param quantize nodes missing/renamed: %r" % (found,)
+    assert all(t == "int8" for t in found.values()), found
