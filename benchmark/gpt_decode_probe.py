@@ -1,0 +1,169 @@
+"""GPT decode-step ablation probe (round-4 verdict item #1b).
+
+Times the real 12L/d768/V32k decode configuration with pieces of the
+per-step work ablated, to locate where the ~1 ms/token goes.  Each
+variant is the SAME scan structure as ``models/gpt.py generate`` —
+only the decode-step body changes.  Differenced 64/448-token timings
+(docs/perf.md "Methodology").
+
+Variants:
+  full        the real step (attention + cache update + FFN + logits)
+  no_attn     skip the attention einsums/softmax (attn := q); cache
+              update (DUS) still runs
+  no_dus      skip the cache update; attention reads the zero cache
+  no_cache    skip both (isolates matmul/FFN/logits + loop overhead)
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.models import gpt, transformer as T
+
+
+def step(params, cfg, token, pos, caches, *, attn_on, dus_on):
+    cdt = jnp.dtype(cfg.dtype)
+    B = token.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    dh = D // H
+
+    x = params["tok_emb"][token].astype(cdt)
+    x = x + jax.lax.dynamic_index_in_dim(
+        params["pos_emb"], pos, keepdims=False).astype(cdt)
+    x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                      params["emb_ln"]["b"].astype(cdt))
+
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        dn = lambda w: w.astype(cdt)
+        qkv = x @ jnp.concatenate(
+            [layer["wq"], layer["wk"], layer["wv"]], axis=1).astype(cdt)
+        q = qkv[:, :D].reshape(B, H, dh)
+        k = qkv[:, D:2 * D].reshape(B, H, dh)
+        v = qkv[:, 2 * D:].reshape(B, H, dh)
+        if dus_on:
+            ck = jax.lax.dynamic_update_index_in_dim(
+                cache["k"], k[:, :, None], pos, 2)
+            cv = jax.lax.dynamic_update_index_in_dim(
+                cache["v"], v[:, :, None], pos, 2)
+        else:
+            ck, cv = cache["k"], cache["v"]
+        new_caches.append({"k": ck, "v": cv})
+        if attn_on:
+            L = ck.shape[2]
+            s = jnp.einsum("bhd,bhld->bhl", q, ck,
+                           preferred_element_type=jnp.float32) \
+                / jnp.sqrt(jnp.float32(dh))
+            valid = jnp.arange(L)[None, None, :] <= pos
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bhl,bhld->bhd", p.astype(cdt), cv,
+                              preferred_element_type=jnp.float32
+                              ).astype(cdt)
+        else:
+            attn = q
+        attn = attn.reshape(B, D) @ dn(layer["wo"]) + dn(layer["bo"])
+        x = T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
+                          dn(layer["ln1"]["b"]))
+        h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
+                        approximate=True)
+        h = h @ dn(layer["w2"]) + dn(layer["b2"])
+        x = T._layer_norm(x + h, dn(layer["ln2"]["g"]),
+                          dn(layer["ln2"]["b"]))
+
+    h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt),
+                    approximate=True)
+    h = T._layer_norm(h, params["mlm_ln"]["g"].astype(cdt),
+                      params["mlm_ln"]["b"].astype(cdt))
+    logits = (h @ params["tok_emb"].T.astype(cdt)).astype(jnp.float32)
+    return logits + params["mlm_bias"].astype(jnp.float32), new_caches
+
+
+@functools.lru_cache(maxsize=None)
+def _runner(cfg, B, P, max_new, attn_on, dus_on, n_layers):
+    """Build the jitted runner ONCE per (shape, variant) — a fresh
+    jax.jit wrapper per call would recompile every time."""
+    total = P + max_new
+    H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+
+    @jax.jit
+    def run(params, prompt):
+        caches = [{"k": jnp.zeros((B, H, total, dh),
+                                  jnp.dtype(cfg.dtype)),
+                   "v": jnp.zeros((B, H, total, dh),
+                                  jnp.dtype(cfg.dtype))}
+                  for _ in range(n_layers)]
+
+        def prefill(carry, t):
+            caches, _ = carry
+            logits, caches = step(params, cfg, prompt[:, t], t, caches,
+                                  attn_on=attn_on, dus_on=dus_on)
+            return (caches, logits), ()
+
+        (caches, logits), _ = jax.lax.scan(
+            prefill, (caches, jnp.zeros((B, cfg.vocab_size),
+                                        jnp.float32)),
+            jnp.arange(P))
+
+        def decode(carry, i):
+            caches, logits = carry
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits, caches = step(params, cfg, tok, P + i, caches,
+                                  attn_on=attn_on, dus_on=dus_on)
+            return (caches, logits), tok
+
+        (_, logits), toks = jax.lax.scan(
+            decode, (caches, logits), jnp.arange(max_new - 1))
+        return toks
+
+    return run
+
+
+def run_variant(cfg, params, prompt, max_new, *, attn_on, dus_on):
+    B, P = prompt.shape
+    run = _runner(cfg, B, P, max_new, attn_on, dus_on,
+                  len(params["layers"]))
+    return run(params, prompt)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    cfg = gpt.gpt_config(vocab_size=32000, max_len=512, d_model=768,
+                         n_heads=12, n_layers=12, d_ff=3072,
+                         dropout=0.0, use_flash=False, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 8)),
+                         jnp.int32)
+
+    def timed(n, **kw):
+        out = run_variant(cfg, params, prompt, n, **kw)
+        jax.device_get(out.ravel()[:1])
+        best = 1e9
+        for _ in range(args.reps):
+            t0 = time.time()
+            out = run_variant(cfg, params, prompt, n, **kw)
+            jax.device_get(out.ravel()[:1])
+            best = min(best, time.time() - t0)
+        return best
+
+    for name, kw in (("full", dict(attn_on=True, dus_on=True)),
+                     ("no_attn", dict(attn_on=False, dus_on=True)),
+                     ("no_dus", dict(attn_on=True, dus_on=False)),
+                     ("no_cache", dict(attn_on=False, dus_on=False))):
+        t64, t448 = timed(64, **kw), timed(448, **kw)
+        per = (t448 - t64) / 384
+        print("%-9s per_tok=%.3f ms  tok/s=%.0f"
+              % (name, per * 1e3, 8 / per), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,13 @@ def bench_flash():
     return best / K * 1e3    # ms per fwd+bwd
 
 
-def bench_gpt_decode():
+def _bench_gpt_decode_common(label, quantize):
+    """Shared decode bench: GPT-2-small-class model, differenced
+    64/448-token timings.  generate() is ONE dispatch for the whole
+    decode, so the tunnel's per-dispatch fixed cost (measured
+    100-300 ms, fluctuating WITHIN a session) would dominate a
+    single-length timing — difference two lengths to report the
+    device-only decode rate (docs/perf.md "Methodology")."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -109,14 +115,12 @@ def bench_gpt_decode():
                          n_heads=12, n_layers=12, d_ff=3072,
                          dropout=0.0, use_flash=False, remat=False)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    if quantize:
+        params = gpt.quantize_decode_params(params)
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 8)),
                          jnp.int32)
-    # generate() is ONE dispatch for the whole decode, so the tunnel's
-    # per-dispatch fixed cost (measured 100-300 ms, fluctuating WITHIN
-    # a session) would dominate a single-length timing.  Difference two
-    # lengths to report the device-only decode rate (docs/perf.md
-    # "Methodology": differenced timings or K >= 150).
+
     def timed(n, reps=3):
         out = gpt.generate(params, cfg, prompt, max_new_tokens=n)
         jax.device_get(out.ravel()[:1])
@@ -131,10 +135,19 @@ def bench_gpt_decode():
     per_tok = (t448 - t64) / 384
     if per_tok <= 0:
         raise RuntimeError(
-            "gpt_decode: tunnel dispatch noise exceeded the device-time "
+            "%s: tunnel dispatch noise exceeded the device-time "
             "delta (t64=%.1fms t448=%.1fms) — rerun when the tunnel "
-            "settles" % (t64 * 1e3, t448 * 1e3))
+            "settles" % (label, t64 * 1e3, t448 * 1e3))
     return 8 / per_tok
+
+
+def bench_gpt_decode():
+    return _bench_gpt_decode_common("gpt_decode", quantize=False)
+
+
+def bench_gpt_decode_w8():
+    """Weight-only int8 decode (round 4)."""
+    return _bench_gpt_decode_common("gpt_decode_w8", quantize=True)
 
 
 BENCHES = {
@@ -142,6 +155,7 @@ BENCHES = {
     "bert_base_tok_s": (bench_bert, "higher"),
     "flash_8192_fwdbwd_ms": (bench_flash, "lower"),
     "gpt_decode_tok_s": (bench_gpt_decode, "higher"),
+    "gpt_decode_w8_tok_s": (bench_gpt_decode_w8, "higher"),
 }
 
 BAR = 0.15

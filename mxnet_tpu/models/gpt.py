@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional
 from . import transformer as T
 
 __all__ = ["gpt_config", "gpt_tiny", "init_params", "forward",
-           "make_train_step", "generate"]
+           "make_train_step", "generate", "quantize_decode_params"]
 
 
 def gpt_config(**kw):
@@ -76,10 +76,68 @@ def make_train_step(cfg, mesh=None, learning_rate=1e-4,
 # incremental decoding
 # ---------------------------------------------------------------------------
 
+def quantize_decode_params(params):
+    """Weight-only int8 quantization of the decode-path matmul weights.
+
+    Per-output-channel symmetric s8 (the scheme `ops/quantization.py`'s
+    MXU dots use): each 2-D weight becomes ``{"q": int8, "s": f32
+    per-channel scale}`` with ``W ≈ q * s``.  Decode at small batch is
+    weight-streaming-heavy (docs/hbm_bandwidth.md: bf16 decode runs
+    ~4.5× below the HBM floor, and ~220 MB of the traffic is weights) —
+    halving the weight bytes halves that term.  Activations stay bf16;
+    the dequant convert fuses into the matmul operand, so int8 streams
+    from HBM and the MXU still runs bf16.
+
+    Biases, layer norms, pos_emb, and MoE blocks stay float.
+    ``tok_emb`` is quantized per-ROW (vocab) so one table serves both
+    the embedding lookup (``q[t] * s[t]``) and the logits projection
+    (``h @ q.T * s``).
+    """
+    import jax.numpy as jnp
+
+    def q_cols(w):                       # (in, out): per-column scale
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=0) / 127.0, 1e-8)
+        qw = jnp.clip(jnp.round(w / s[None, :]), -127, 127
+                      ).astype(jnp.int8)
+        return {"q": qw, "s": s.astype(jnp.float32)}
+
+    def q_rows(w):                       # (vocab, d): per-row scale
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=1) / 127.0, 1e-8)
+        qw = jnp.clip(jnp.round(w / s[:, None]), -127, 127
+                      ).astype(jnp.int8)
+        return {"q": qw, "s": s.astype(jnp.float32)}
+
+    out = dict(params)
+    out["tok_emb"] = q_rows(params["tok_emb"])
+    out["mlm_dense"] = q_cols(params["mlm_dense"])
+    layers = []
+    for layer in params["layers"]:
+        nl = dict(layer)
+        # attention projections exist in every layer (MoE swaps only
+        # the FFN); gate just the dense-FFN weights on "moe"
+        for k in ("wq", "wk", "wv", "wo"):
+            nl[k] = q_cols(layer[k])
+        if "moe" not in layer:
+            for k in ("w1", "w2"):
+                nl[k] = q_cols(layer[k])
+        layers.append(nl)
+    out["layers"] = layers
+    return out
+
+
+def _wmm(x, w, cdt):
+    """x @ W for a float or weight-only-int8 ({"q","s"}) weight."""
+    if isinstance(w, dict) and "q" in w:
+        return (x @ w["q"].astype(cdt)) * w["s"].astype(cdt)
+    return x @ w.astype(cdt)
+
+
 def _decode_one(params, cfg, token, pos, caches):
     """One decode step: token (B,) int32 at position pos; caches is a
-    list of per-layer dicts {"k": (B, L, H, dh), "v": ...}.  Returns
-    (logits (B, V), new caches)."""
+    list of per-layer dicts {"kv": (B*H, L, 2*dh)} (fused batch·head
+    leading dim, k and v halves of one buffer — see the layout notes in
+    the attention block), or {"kv": int8, "s": (B*H, L, 2)} for the
+    int8 KV path.  Returns (logits (B, V), new caches)."""
     import jax
     import jax.numpy as jnp
 
@@ -88,7 +146,12 @@ def _decode_one(params, cfg, token, pos, caches):
     D, H = cfg.d_model, cfg.n_heads
     dh = D // H
 
-    x = params["tok_emb"][token].astype(cdt)           # (B, D)
+    emb = params["tok_emb"]
+    if isinstance(emb, dict):                          # weight-only int8
+        x = emb["q"][token].astype(cdt) * \
+            emb["s"][token].astype(cdt)[:, None]
+    else:
+        x = emb[token].astype(cdt)                     # (B, D)
     x = x + jax.lax.dynamic_index_in_dim(
         params["pos_emb"], pos, keepdims=False).astype(cdt)
     x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
@@ -98,24 +161,86 @@ def _decode_one(params, cfg, token, pos, caches):
     for layer, cache in zip(params["layers"], caches):
         def dn(w):
             return w.astype(cdt)
-        q = (x @ dn(layer["wq"]) + dn(layer["bq"])).reshape(B, H, dh)
-        k = (x @ dn(layer["wk"]) + dn(layer["bk"])).reshape(B, H, dh)
-        v = (x @ dn(layer["wv"]) + dn(layer["bv"])).reshape(B, H, dh)
-        ck = jax.lax.dynamic_update_index_in_dim(cache["k"],
-                                                 k[:, None], pos, 1)
-        cv = jax.lax.dynamic_update_index_in_dim(cache["v"],
-                                                 v[:, None], pos, 1)
-        new_caches.append({"k": ck, "v": cv})
-        L = ck.shape[1]
-        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
-                       ck.astype(jnp.float32)) / jnp.sqrt(
-                           jnp.float32(dh))
-        valid = jnp.arange(L)[None, None, :] <= pos
-        s = jnp.where(valid, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhl,blhd->bhd", p,
-                          cv.astype(jnp.float32)).astype(cdt)
-        attn = attn.reshape(B, D) @ dn(layer["wo"]) + dn(layer["bo"])
+        # fused QKV: one (D, 3D) matmul instead of three — the concat is
+        # loop-invariant, so XLA hoists it out of the decode scan and
+        # streams one contiguous weight per step
+        wq, wk, wv = layer["wq"], layer["wk"], layer["wv"]
+        if isinstance(wq, dict):
+            qkv = (x @ jnp.concatenate(
+                [wq["q"], wk["q"], wv["q"]], axis=1).astype(cdt)) * \
+                jnp.concatenate([wq["s"], wk["s"], wv["s"]]).astype(cdt)
+        else:
+            qkv = x @ jnp.concatenate([wq, wk, wv], axis=1).astype(cdt)
+        qkv = qkv + jnp.concatenate(
+            [dn(layer["bq"]), dn(layer["bk"]), dn(layer["bv"])])
+        q, k, v = (qkv[:, :D].reshape(B * H, dh),
+                   qkv[:, D:2 * D].reshape(B * H, dh),
+                   qkv[:, 2 * D:].reshape(B * H, dh))
+        # caches are (B*H, L, dh) and attention is a pair of batched
+        # dot_generals over the fused batch dim.  Measured on chip
+        # (benchmark/gpt_decode_probe.py, docs/perf.md "GPT decode"):
+        # this formulation streams the caches at HBM bandwidth, where
+        # the (B, L, H, dh)-layout einsum ran ~3x slower and the
+        # per-step attention dominated decode.  bf16 dots with f32
+        # accumulation — casting the cache itself to f32 materialized
+        # a full copy every step.
+        if "s" in cache:
+            # int8 KV cache (generate(kv_int8=True)): per-(row, token)
+            # symmetric s8 with the dequant folded into the dots — the
+            # k scale multiplies the scores (contraction is over dh, so
+            # s[:, l] scales by scale[:, l, 0]), the v scale folds into
+            # the softmax weights before the second dot.  Halves the
+            # cache stream (docs/perf.md "GPT decode").
+            sk = jnp.maximum(jnp.max(jnp.abs(k), axis=1) / 127.0, 1e-8)
+            sv = jnp.maximum(jnp.max(jnp.abs(v), axis=1) / 127.0, 1e-8)
+            kq = jnp.clip(jnp.round(k / sk[:, None]), -127, 127
+                          ).astype(jnp.int8)
+            vq = jnp.clip(jnp.round(v / sv[:, None]), -127, 127
+                          ).astype(jnp.int8)
+            ckv = jax.lax.dynamic_update_index_in_dim(
+                cache["kv"], jnp.concatenate([kq, vq], axis=1)[:, None],
+                pos, 1)
+            cs = jax.lax.dynamic_update_index_in_dim(
+                cache["s"],
+                jnp.stack([sk, sv], axis=1
+                          ).astype(jnp.float32)[:, None], pos, 1)
+            new_caches.append({"kv": ckv, "s": cs})
+            L = ckv.shape[1]
+            s = jax.lax.dot_general(
+                ckv[:, :, :dh].astype(cdt), q,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, L)
+            s = s * cs[:, :, 0] / jnp.sqrt(jnp.float32(dh))
+            valid = jnp.arange(L)[None, :] <= pos
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jax.lax.dot_general(
+                (p * cs[:, :, 1]).astype(cdt),
+                ckv[:, :, dh:].astype(cdt),
+                (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, dh)
+        else:
+            # one fused (k|v) buffer per layer: a single DUS per step
+            # and two dots over slices — 24 small DUS ops/step cost
+            # ~0.1 ms of fixed overhead vs 12 (measured, docs/perf.md)
+            ckv = jax.lax.dynamic_update_index_in_dim(
+                cache["kv"], jnp.concatenate([k, v], axis=1)[:, None],
+                pos, 1)
+            new_caches.append({"kv": ckv})
+            L = ckv.shape[1]
+            s = jax.lax.dot_general(
+                ckv[:, :, :dh], q, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, L)
+            s = s / jnp.sqrt(jnp.float32(dh))
+            valid = jnp.arange(L)[None, :] <= pos
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(cdt)
+            attn = jax.lax.dot_general(
+                p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, dh)
+        attn = attn.astype(cdt)
+        attn = _wmm(attn.reshape(B, D), layer["wo"], cdt) + \
+            dn(layer["bo"])
         x = T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
                           dn(layer["ln1"]["b"]))
         if "moe" in layer:
@@ -127,29 +252,40 @@ def _decode_one(params, cfg, token, pos, caches):
                            dtype=cdt)
             h = h[:, 0, :]
         else:
-            h = jax.nn.gelu(x @ dn(layer["w1"]) + dn(layer["b1"]),
+            h = jax.nn.gelu(_wmm(x, layer["w1"], cdt) + dn(layer["b1"]),
                             approximate=True)
-            h = h @ dn(layer["w2"]) + dn(layer["b2"])
+            h = _wmm(h, layer["w2"], cdt) + dn(layer["b2"])
         x = T._layer_norm(x + h, dn(layer["ln2"]["g"]),
                           dn(layer["ln2"]["b"]))
 
-    h = jax.nn.gelu(x @ params["mlm_dense"].astype(cdt),
+    h = jax.nn.gelu(_wmm(x, params["mlm_dense"], cdt),
                     approximate=True)
     h = T._layer_norm(h, params["mlm_ln"]["g"].astype(cdt),
                       params["mlm_ln"]["b"].astype(cdt))
-    logits = h @ params["tok_emb"].T.astype(cdt) + \
-        params["mlm_bias"].astype(cdt)
+    emb = params["tok_emb"]
+    if isinstance(emb, dict):
+        # h @ W.T with W ≈ q * s[:, None]  →  (h @ q.T) * s[None, :];
+        # scale applied in f32 on the small (B, V) output
+        logits = (h @ emb["q"].T.astype(cdt)).astype(jnp.float32) * \
+            emb["s"][None, :]
+    else:
+        logits = (h @ emb.T.astype(cdt)).astype(jnp.float32)
+    logits = logits + params["mlm_bias"].astype(jnp.float32)
     return logits.astype(jnp.float32), new_caches
 
 
 def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
-             rng=None):
+             rng=None, kv_int8=False):
     """Autoregressive generation with KV caches.
 
     prompt: (B, P) int32.  temperature 0 → greedy argmax; otherwise
     softmax sampling.  Returns (B, P + max_new_tokens) int32.  The whole
     loop (prefill + decode scan) jits into one program per
     (P, max_new_tokens) pair.
+
+    ``kv_int8=True`` stores the KV caches as per-token symmetric s8
+    (halves decode's dominant HBM stream — docs/perf.md "GPT decode");
+    combine with ``quantize_decode_params`` for weight-only int8.
     """
     import jax
     import jax.numpy as jnp
@@ -168,7 +304,8 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
                          % (total, cfg.max_len))
     H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
 
-    cache_key = (cfg, B, P, max_new_tokens, float(temperature))
+    cache_key = (cfg, B, P, max_new_tokens, float(temperature),
+                 bool(kv_int8))
     cached = _generate_cache.get(cache_key)
     if cached is not None:
         return cached(params, prompt, rng)
@@ -178,8 +315,12 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
     n_layers = len(params["layers"])
 
     def empty_caches():
-        return [{"k": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype)),
-                 "v": jnp.zeros((B, total, H, dh), jnp.dtype(cfg.dtype))}
+        if kv_int8:
+            return [{"kv": jnp.zeros((B * H, total, 2 * dh), jnp.int8),
+                     "s": jnp.zeros((B * H, total, 2), jnp.float32)}
+                    for _ in range(n_layers)]
+        return [{"kv": jnp.zeros((B * H, total, 2 * dh),
+                                 jnp.dtype(cfg.dtype))}
                 for _ in range(n_layers)]
 
     @jax.jit

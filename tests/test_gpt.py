@@ -113,3 +113,84 @@ def test_gpt_train_step_sharded():
     tokens = jnp.arange(4 * 32, dtype=jnp.int32).reshape(4, 32) % 100
     state, loss = step(state, {"tokens": tokens}, jax.random.PRNGKey(1))
     assert np.isfinite(float(loss))
+
+@pytest.mark.slow
+def test_int8_weight_only_decode_parity():
+    """Weight-only int8 decode (round 4): teacher-forced logits must
+    track fp within quantization tolerance, and greedy generation must
+    agree with fp on nearly every step."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=512, d_model=128, n_heads=4, n_layers=3,
+               d_ff=256)
+    params = T.init_params(jax.random.PRNGKey(7), cfg)
+    qparams = gpt.quantize_decode_params(params)
+
+    # structure: 2-D matmul weights became {"q" s8, "s" f32}
+    assert qparams["tok_emb"]["q"].dtype == jnp.int8
+    for l in qparams["layers"]:
+        assert l["wq"]["q"].dtype == jnp.int8
+        assert l["ln1"]["g"].dtype != jnp.int8      # norms stay float
+
+    B, L = 2, 24
+    tokens = ((jnp.arange(B * L, dtype=jnp.int32).reshape(B, L) * 7)
+              % cfg.vocab_size)
+
+    def teacher_forced(p):
+        H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+        caches = [{"kv": jnp.zeros((B * H, L, 2 * dh), jnp.float32)}
+                  for _ in range(cfg.n_layers)]
+        outs = []
+        for t in range(L):
+            logits, caches = gpt._decode_one(p, cfg, tokens[:, t], t,
+                                             caches)
+            outs.append(logits)
+        return jnp.stack(outs, axis=1)              # (B, L, V)
+
+    lf = np.asarray(teacher_forced(params))
+    lq = np.asarray(teacher_forced(qparams))
+
+    # cosine similarity per position and top-1 agreement
+    num = (lf * lq).sum(-1)
+    den = np.linalg.norm(lf, axis=-1) * np.linalg.norm(lq, axis=-1)
+    cos = num / (den + 1e-9)
+    assert cos.min() > 0.99, "logit cosine dropped to %.4f" % cos.min()
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree >= 0.95, "top-1 agreement %.3f" % agree
+
+    # end-to-end greedy generate with quantized params runs and mostly
+    # matches fp greedy
+    prompt = tokens[:, :4]
+    of = np.asarray(gpt.generate(params, cfg, prompt, 8))
+    oq = np.asarray(gpt.generate(qparams, cfg, prompt, 8))
+    assert of.shape == oq.shape
+    assert (of == oq).mean() >= 0.8, (of, oq)
+
+
+@pytest.mark.slow
+def test_int8_kv_cache_decode_parity():
+    """Round-4: the int8 KV-cache path (generate(kv_int8=True)) must
+    track fp decode — per-token s8 quantization with scales folded into
+    the attention dots."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=512, d_model=128, n_heads=4, n_layers=3,
+               d_ff=256)
+    params = T.init_params(jax.random.PRNGKey(11), cfg)
+    prompt = ((jnp.arange(2 * 5, dtype=jnp.int32).reshape(2, 5) * 13)
+              % cfg.vocab_size)
+    of = np.asarray(gpt.generate(params, cfg, prompt, 12))
+    okv = np.asarray(gpt.generate(params, cfg, prompt, 12,
+                                  kv_int8=True))
+    assert of.shape == okv.shape
+    # greedy decode should agree on (nearly) every token at these
+    # scales; a k/v scale-column swap or mis-fold collapses agreement
+    assert (of == okv).mean() >= 0.9, (of, okv)
+    # combined with weight-only int8 it still decodes sanely
+    oq = np.asarray(gpt.generate(gpt.quantize_decode_params(params),
+                                 cfg, prompt, 12, kv_int8=True))
+    assert (of == oq).mean() >= 0.7, (of, oq)
