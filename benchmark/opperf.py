@@ -87,10 +87,15 @@ def _bench_one(name, ctx, warmup, runs, use_default=False):
     args = [nd.array(rng.uniform(0.5, 1.5, s).astype("float32"),
                      ctx=ctx) for s in shapes]
 
+    n_out_box = [1]
+
     def run_eager():
         # registry.invoke threads the PRNG key for needs_rng samplers
         out = registry.invoke(op, args, tuple(pos), dict(kw))
-        (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
+        if isinstance(out, (list, tuple)):
+            n_out_box[0] = len(out)
+            out = out[0]
+        out.wait_to_read()
 
     try:
         run_eager()
@@ -115,6 +120,20 @@ def _bench_one(name, ctx, warmup, runs, use_default=False):
         run_eager()
     eager_us = (time.perf_counter() - t0) / runs * 1e6
 
+    # dispatch-path classification (round-4 tail analysis): which lane
+    # did the eager calls ride?
+    if not op.cacheable:
+        path = "uncacheable"
+    elif not registry._EAGER_JIT:
+        path = "eager-jit-off"
+    elif op.name in registry._EAGER_BLACKLIST:
+        path = "blacklisted"       # impl not jit-safe -> retrace per call
+    elif any(id(op) == k[0] for k in registry._EAGER_CACHE):
+        path = "jit-cached"
+    else:
+        path = "cache-miss"        # unhashable attrs / non-array inputs
+    n_out = n_out_box[0]
+
     # jitted kernel time
     jargs = [a._data for a in args]
 
@@ -134,7 +153,8 @@ def _bench_one(name, ctx, warmup, runs, use_default=False):
         jit_us = None
 
     return {"op": name, "eager_us": round(eager_us, 2),
-            "jit_us": round(jit_us, 2) if jit_us is not None else None}
+            "jit_us": round(jit_us, 2) if jit_us is not None else None,
+            "path": path, "n_out": n_out}
 
 
 def run_op_benchmarks(ops=None, ctx=None, warmup=5, runs=50):
@@ -166,6 +186,9 @@ def main(argv=None):
     p.add_argument("--runs", type=int, default=50)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--json", default=None, help="write results to file")
+    p.add_argument("--tail", action="store_true",
+                   help="print the dispatch-tail analysis (quartiles "
+                        "by path class, slowest ops)")
     args = p.parse_args(argv)
 
     from mxnet_tpu.ops import registry
@@ -187,7 +210,40 @@ def main(argv=None):
         with open(args.json, "w") as f:
             json.dump(results, f, indent=2)
         print("wrote", args.json)
+    if args.tail:
+        _tail_report(results)
     return 0
+
+
+def _tail_report(results):
+    """Round-4 tail analysis: eager-latency quartiles overall and per
+    dispatch-path class, plus the slowest ops with their class."""
+    ok = [r for r in results if "eager_us" in r]
+    if not ok:
+        return
+    import statistics
+
+    def quart(rows):
+        xs = sorted(r["eager_us"] for r in rows)
+        n = len(xs)
+        return (xs[n // 4], statistics.median(xs), xs[(3 * n) // 4])
+
+    q1, q2, q3 = quart(ok)
+    print("\n== eager dispatch tail ==")
+    print("all %d ops: q1 %.0f  median %.0f  q3 %.0f us"
+          % (len(ok), q1, q2, q3))
+    by = {}
+    for r in ok:
+        by.setdefault(r.get("path", "?"), []).append(r)
+    for path, rows in sorted(by.items(), key=lambda kv: -len(kv[1])):
+        q1, q2, q3 = quart(rows)
+        print("  %-12s n=%3d  q1 %.0f  median %.0f  q3 %.0f us"
+              % (path, len(rows), q1, q2, q3))
+    print("slowest 20:")
+    for r in sorted(ok, key=lambda r: -r["eager_us"])[:20]:
+        print("  %-28s %8.1f us  %-12s n_out=%d"
+              % (r["op"], r["eager_us"], r.get("path", "?"),
+                 r.get("n_out", 1)))
 
 
 if __name__ == "__main__":

@@ -138,3 +138,22 @@ def test_kill_switch(monkeypatch):
     out = nd.relu(a).asnumpy()
     np.testing.assert_array_equal(out, 1.0)
     assert len(R._EAGER_CACHE) == 0
+
+
+def test_user_error_does_not_poison_blacklist():
+    """Round-4 dispatch-tail fix: a caller error (wrong arity) on the
+    FIRST call of an op must not blacklist it — only genuinely
+    untraceable impls go to the retrace-per-call path."""
+    from mxnet_tpu.ops.registry import get_op, invoke
+
+    name = "_np_outer"
+    R._EAGER_BLACKLIST.discard(name)
+    op = get_op(name)
+    with pytest.raises(Exception):
+        invoke(op, [nd.ones((4,))])        # outer needs two operands
+    assert name not in R._EAGER_BLACKLIST, \
+        "user arity error poisoned the blacklist"
+    out = invoke(op, [nd.ones((4,)), nd.ones((3,))])
+    assert out.shape == (4, 3)
+    # and the correct call landed in the compiled-callable cache
+    assert any(k[0] == id(op) for k in R._EAGER_CACHE)
