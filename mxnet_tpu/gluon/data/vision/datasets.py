@@ -19,7 +19,8 @@ from ..dataset import _DownloadedDataset, Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageListDataset",
-           "ImageRecordDataset", "ImageFolderDataset", "SyntheticMNIST"]
+           "ImageRecordDataset", "ImageFolderDataset", "SyntheticMNIST",
+           "SyntheticInstanceSegDataset"]
 
 
 def _read_idx_images(path):
@@ -271,3 +272,67 @@ class ImageListDataset(Dataset):
         from ....image import imread
         path, label = self._items[idx]
         return imread(path, flag=self._flag), label
+
+
+class SyntheticInstanceSegDataset(Dataset):
+    """Hermetic instance-segmentation dataset (round 4): random
+    axis-aligned rectangles and ellipses rendered as images with
+    per-instance binary masks, boxes, and class labels — the minimal
+    data path a Mask R-CNN-style head needs
+    (``_contrib_mrcnn_mask_target``), in an environment with no
+    COCO-class corpus (reference consumer:
+    ``src/operator/contrib/mrcnn_mask_target.cu`` via GluonCV's
+    ``MaskTargetGenerator``).
+
+    Each item: ``(image (C, H, W) float32, label dict)`` with
+    ``boxes (M, 4)`` corner coords, ``classes (M,)`` int (1 = rect,
+    2 = ellipse), ``masks (M, H, W)`` float32 binary; ``M`` instances
+    padded to ``max_instances`` with class 0 rows.
+    """
+
+    def __init__(self, num_samples=64, size=64, max_instances=3,
+                 seed=0):
+        import numpy as np
+        self._n = num_samples
+        self._size = size
+        self._max = max_instances
+        self._seed = seed
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        import numpy as np
+        rng = np.random.RandomState(self._seed * 100003 + idx)
+        S, M = self._size, self._max
+        img = rng.uniform(0.0, 0.1, (3, S, S)).astype("float32")
+        n_inst = rng.randint(1, M + 1)
+        boxes = np.zeros((M, 4), "float32")
+        classes = np.zeros((M,), "int32")
+        masks = np.zeros((M, S, S), "float32")
+        yy, xx = np.mgrid[0:S, 0:S]
+        for i in range(n_inst):
+            w = rng.randint(S // 6, S // 2)
+            h = rng.randint(S // 6, S // 2)
+            x0 = rng.randint(0, S - w)
+            y0 = rng.randint(0, S - h)
+            cls = rng.randint(1, 3)
+            if cls == 1:                       # rectangle
+                m = ((yy >= y0) & (yy < y0 + h)
+                     & (xx >= x0) & (xx < x0 + w))
+            else:                              # ellipse
+                # strict < keeps every mask pixel inside the stored
+                # [x0, x0+w-1] x [y0, y0+h-1] box (boundary pixels at
+                # exactly 1.0 would fall one past it)
+                cy, cx = y0 + h / 2.0, x0 + w / 2.0
+                m = (((yy - cy) / (h / 2.0)) ** 2
+                     + ((xx - cx) / (w / 2.0)) ** 2) < 1.0
+            masks[i] = m.astype("float32")
+            boxes[i] = (x0, y0, x0 + w - 1, y0 + h - 1)
+            classes[i] = cls
+            color = rng.uniform(0.4, 1.0, (3, 1))
+            img[:, m] = color
+        return (nd.array(img),
+                {"boxes": nd.array(boxes),
+                 "classes": nd.array(classes),
+                 "masks": nd.array(masks)})

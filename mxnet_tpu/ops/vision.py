@@ -456,6 +456,66 @@ def roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
     return jax.vmap(one)(rois)
 
 
+@register("_contrib_mrcnn_mask_target", num_outputs=2, no_grad=True)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=None, num_classes=None, mask_size=(14, 14),
+                      sample_ratio=2, aligned=False, **kw):
+    """Mask R-CNN mask-target generator (reference:
+    ``src/operator/contrib/mrcnn_mask_target.cu``; consumed by the
+    GluonCV-style Mask R-CNN training loop).
+
+    Inputs:
+      rois        (B, N, 4) corner-format proposals, image coords
+      gt_masks    (B, M, H, W) binary instance masks
+      matches     (B, N) int — index into M of each roi's matched gt
+      cls_targets (B, N) int — sampled class per roi: 0 = background,
+                  c >= 1 = foreground class c (mask-head channel c-1)
+
+    Outputs (both (B, N, C, MSh, MSw), C = ``num_classes``):
+      mask_targets — the matched gt mask ROIAligned to ``mask_size``,
+                     written at channel ``cls-1`` for positive rois,
+                     zero elsewhere
+      mask_cls     — sigmoid-CE weights: 1 at channel ``cls-1`` of
+                     positive rois, else 0
+
+    TPU-native: static shapes throughout — each (roi, bin) samples a
+    fixed ``sample_ratio²`` bilinear grid from the matched mask (the
+    same vectorized-gather core as ``_contrib_ROIAlign``), and the
+    class scatter is a one-hot product instead of a data-dependent
+    write."""
+    jax = _jax()
+    jnp = _j()
+    if num_classes is None:
+        raise MXNetError("_contrib_mrcnn_mask_target: num_classes "
+                         "is required")
+    C = int(num_classes)
+    try:
+        MH, MW = mask_size
+    except TypeError:
+        MH = MW = int(mask_size)
+
+    def one(rois_b, masks_b, match_b, cls_b):
+        # batch-index column = matched gt index: ROIAlign then crops
+        # each roi straight out of ITS matched instance mask
+        full = jnp.concatenate(
+            [match_b.astype("float32")[:, None],
+             rois_b.astype("float32")], axis=1)        # (N, 5)
+        crop = roi_align(masks_b[:, None].astype("float32"), full,
+                         pooled_size=(MH, MW), spatial_scale=1.0,
+                         sample_ratio=sample_ratio,
+                         aligned=aligned)              # (N, 1, MH, MW)
+        cls = cls_b.astype("int32")
+        onehot = ((jnp.arange(C, dtype="int32")[None, :]
+                   == cls[:, None] - 1)
+                  & (cls[:, None] > 0)).astype("float32")  # (N, C)
+        w = onehot[:, :, None, None]
+        return crop * w, jnp.broadcast_to(w, (w.shape[0], C, MH, MW))
+
+    targets, weights = jax.vmap(one)(rois, gt_masks, matches,
+                                     cls_targets)
+    return targets, weights
+
+
 # ---------------------------------------------------------------------------
 # Spatial transformer family
 # ---------------------------------------------------------------------------

@@ -196,6 +196,12 @@ SPECS = {
       nd.array(np.array([[[10., 10, 20, 20], [30, 30, 50, 50]]],
                         "float32")),
       nd.array(np.array([[[12., 11, 22, 21]]], "float32"))], {})),
+  "_contrib_mrcnn_mask_target": ("fwd", lambda: ([
+      nd.array(np.array([[[0., 0., 7., 7.]]], "float32")),
+      nd.array(np.ones((1, 1, 8, 8), "float32")),
+      nd.array(np.zeros((1, 1), "int32")),
+      nd.array(np.ones((1, 1), "int32"))],
+      dict(num_rois=1, num_classes=2, mask_size=(2, 2)))),
   "MultiBoxTarget": ("fwd", lambda: ([
       nd.array(np.array([[[0.1, 0.1, 0.4, 0.4]]], "float32")),
       nd.array(np.array([[[0, 0.1, 0.1, 0.45, 0.45]]], "float32")),
