@@ -297,6 +297,78 @@ def test_multichip_dryrun_no_involuntary_remat():
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "PALLAS_AXON_POOL_IPS": ""})
     assert r.returncode == 0, r.stderr[-2000:]
-    assert r.stdout.count("loss") == 3, r.stdout
+    # 3 transformer mesh configs + the conv+BN dp config (round 4)
+    assert r.stdout.count("loss") == 4, r.stdout
     assert "Involuntary full rematerialization" not in r.stderr, \
         r.stderr[-3000:]
+
+
+@pytest.mark.slow
+def test_data_parallel_bn_is_global_stats():
+    """Pin BatchNorm semantics under GSPMD dp (round-4 verdict item #2).
+
+    GSPMD is semantics-preserving: ``jnp.mean`` over the batch axis of a
+    dp-sharded array is the GLOBAL batch mean (XLA inserts the
+    cross-replica reduce), so a dp-sharded ``nn.BatchNorm`` computes
+    SyncBatchNorm statistics — unlike reference MXNet's data-parallel
+    BN, which normalizes each device's shard with per-device stats
+    (upstream SyncBatchNorm was the separate opt-in:
+    ``src/operator/contrib/sync_batch_norm-inl.h``).  This test builds a
+    batch whose two dp shards have wildly different means, so the two
+    semantics produce far-apart losses, and asserts the dp loss equals
+    the global-stats loss.  docs/architecture.md "BatchNorm under
+    GSPMD" documents the contract.
+    """
+    import numpy as np
+    from mxnet_tpu import nd, gluon, autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    np.random.seed(0)
+    N, D = 4, 8                             # per-shard batch, dp degree
+    shards = [np.random.randn(N, 4, 6, 6).astype("float32")
+              + 10.0 * (i - D / 2) for i in range(D)]
+    X = np.concatenate(shards)              # shard means far apart
+    Y = np.tile(np.arange(2), N * D // 2).astype("int64")
+
+    def build():
+        np.random.seed(42)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                    nn.Activation("relu"), nn.GlobalAvgPool2D(),
+                    nn.Dense(2))
+        net.initialize(mx.initializer.Xavier(rnd_type="uniform",
+                                             magnitude=2.0))
+        net(nd.array(X[:2]))
+        return net
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # dp=8: first step's loss, before any update
+    tr = DataParallelTrainer(build(), loss_fn, "sgd",
+                             {"learning_rate": 0.0},
+                             mesh=make_mesh({"dp": D}))
+    loss_dp = float(tr.step(nd.array(X), nd.array(Y)).asnumpy())
+
+    # global-stats single-device run (train mode => batch stats)
+    net = build()
+    with autograd.record():
+        l_global = loss_fn(net(nd.array(X)), nd.array(Y))
+    loss_global = float(l_global.mean().asnumpy())
+
+    # per-device-stats run: each shard normalized with its own stats
+    net = build()
+    with autograd.record():
+        ls = [loss_fn(net(nd.array(s)),
+                      nd.array(Y[i * N:(i + 1) * N])).mean()
+              for i, s in enumerate(shards)]
+    loss_perdev = float(sum(l.asnumpy() for l in ls)) / D
+
+    # the two semantics must actually be distinguishable on this data
+    assert abs(loss_global - loss_perdev) > 1e-2, \
+        (loss_global, loss_perdev)
+    # and the dp run must match the GLOBAL (SyncBatchNorm) semantics
+    assert abs(loss_dp - loss_global) < 1e-3, \
+        ("dp loss %.5f, global %.5f, perdev %.5f"
+         % (loss_dp, loss_global, loss_perdev))
