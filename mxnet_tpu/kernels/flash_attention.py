@@ -43,8 +43,29 @@ def _min_seq():
     return int(_os.environ.get("MXNET_FLASH_MIN_SEQ", "4096"))
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
-            sm_scale, causal):
+def _dropout_keep(bh, q_pos, k_pos, seed, rate):
+    """Deterministic per-position keep mask for fused attention dropout.
+
+    Counter-based: a murmur-style uint32 mix of (batch·head, absolute q
+    position, absolute k position, seed) — every kernel (fwd, dq, dkv)
+    regenerates the SAME mask for a tile from positions alone, so
+    nothing is stored and no cross-kernel PRNG-state bookkeeping
+    exists.  Runs in interpreter mode too (plain jnp integer ops, no
+    ``pltpu.prng_*``), which is what makes the CPU parity oracle
+    possible (tests/test_flash_dropout.py)."""
+    import jax.numpy as jnp
+    u = jnp.uint32
+    x = (q_pos.astype(u)[:, None] * u(2654435761)) ^ \
+        (k_pos.astype(u)[None, :] * u(97780813)) ^ \
+        (bh.astype(u) * u(2246822519)) ^ seed.astype(u)
+    x = (x ^ (x >> u(16))) * u(2246822519)
+    x = (x ^ (x >> u(13))) * u(3266489917)
+    x = x ^ (x >> u(16))
+    return x >= u(min(int(rate * 4294967296.0), 4294967295))
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
+            block_k, sm_scale, causal, dropout):
     import jax
     import jax.numpy as jnp
 
@@ -52,6 +73,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
     bq, dh = q.shape
     T = k_ref.shape[1]
     nk = T // block_k
+    bh = pl.program_id(0)
     q_pos = pl.program_id(1) * bq + jnp.arange(bq)
 
     m0 = jnp.full((bq, 1), -jnp.inf, dtype=jnp.float32)
@@ -66,15 +88,21 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
         msk = mask_ref[0, 0, pl.dslice(i * block_k, block_k)]
+        k_pos = i * block_k + jnp.arange(block_k)
         valid = msk[None, :] != 0
         if causal:
-            k_pos = i * block_k + jnp.arange(block_k)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         s = jnp.where(valid, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # the softmax denominator accumulates the UNDROPPED p — dropout
+        # applies to the normalized probabilities, not the logits
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            keep = _dropout_keep(bh, q_pos, k_pos, seed_ref[0],
+                                 dropout)
+            p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
         acc_new = acc * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -93,11 +121,12 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k,
     lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
-                   block_k=128):
+def _flash_fwd_tpu(q, k, v, mask, seed, causal=False, dropout=0.0,
+                   block_q=128, block_k=128):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, dh = q.shape
     sm_scale = 1.0 / math.sqrt(dh)
@@ -121,7 +150,7 @@ def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
     # unprovable for Mosaic.
     out, lse = pl.pallas_call(
         functools.partial(_kernel, block_k=block_k, sm_scale=sm_scale,
-                          causal=causal),
+                          causal=causal, dropout=dropout),
         interpret=_INTERPRET,
         out_shape=[jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
                    jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32)],
@@ -131,18 +160,20 @@ def _flash_fwd_tpu(q, k, v, mask, causal=False, block_q=128,
             pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, dh), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, 1, T), lambda bh, qi, H=H: (bh // H, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dh), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
         ],
-    )(qt, kt, vt, mask_arr[:, None, :])
+    )(qt, kt, vt, mask_arr[:, None, :], seed)
     return (out.reshape(B, H, T, dh).transpose(0, 2, 1, 3),
             lse.reshape(B, H, T))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   mask_ref, dq_ref, *, block_k, sm_scale, causal):
+                   mask_ref, seed_ref, dq_ref, *, block_k, sm_scale,
+                   causal, dropout):
     import jax
     import jax.numpy as jnp
 
@@ -153,6 +184,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bq, dh = q.shape
     T = k_ref.shape[1]
     nk = T // block_k
+    bh = pl.program_id(0)
     q_pos = pl.program_id(1) * bq + jnp.arange(bq)
 
     def body(i, acc):
@@ -162,14 +194,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
         msk = mask_ref[0, 0, pl.dslice(i * block_k, block_k)]
+        k_pos = i * block_k + jnp.arange(block_k)
         valid = msk[None, :] != 0
         if causal:
-            k_pos = i * block_k + jnp.arange(block_k)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # (BQ, BK)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # (BQ, BK)
+        if dropout > 0.0:
+            # dS = P ∘ (D∘dP̃ − delta): the same positional keep mask
+            # the forward used, regenerated — never stored
+            keep = _dropout_keep(bh, q_pos, k_pos, seed_ref[0],
+                                 dropout)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
         ds = p * (dp - delta[:, None]) * sm_scale
         return acc + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -186,8 +224,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    mask_ref, dk_ref, dv_ref, *, block_q, sm_scale,
-                    causal):
+                    mask_ref, seed_ref, dk_ref, dv_ref, *, block_q,
+                    sm_scale, causal, dropout):
     import jax
     import jax.numpy as jnp
 
@@ -196,6 +234,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bk, dh = k.shape
     T = q_ref.shape[1]
     nq = T // block_q
+    bh = pl.program_id(0)
     k_pos = pl.program_id(1) * bk + jnp.arange(bk)
     msk = mask_ref[0, 0, pl.dslice(pl.program_id(1) * bk, bk)]
 
@@ -205,21 +244,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.dslice(j * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]
         delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        q_pos = j * block_q + jnp.arange(block_q)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (BQ, BK)
         valid = msk[None, :] != 0
         if causal:
-            q_pos = j * block_q + jnp.arange(block_q)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
-        # dV += P^T dO
+        if dropout > 0.0:
+            keep = _dropout_keep(bh, q_pos, k_pos, seed_ref[0],
+                                 dropout)
+            inv = 1.0 / (1.0 - dropout)
+            p_drop = jnp.where(keep, p, 0.0) * inv
+        else:
+            keep = None
+            p_drop = p
+        # dV += P̃^T dO (the DROPPED probabilities feed V's gradient)
         dv_acc = dv_acc + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (BK, dh)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (BQ, BK)
+        if keep is not None:
+            dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta[:, None]) * sm_scale
         # dK += dS^T Q
         dk_acc = dk_acc + jax.lax.dot_general(
@@ -239,10 +288,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, mask, out, lse, g, causal=False,
-                   block_q=128, block_k=128):
+def _flash_bwd_tpu(q, k, v, mask, seed, out, lse, g, causal=False,
+                   dropout=0.0, block_q=128, block_k=128):
     import jax
     import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, dh = q.shape
     sm_scale = 1.0 / math.sqrt(dh)
@@ -264,7 +314,8 @@ def _flash_bwd_tpu(q, k, v, mask, out, lse, g, causal=False,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k,
-                          sm_scale=sm_scale, causal=causal),
+                          sm_scale=sm_scale, causal=causal,
+                          dropout=dropout),
         interpret=_INTERPRET,
         out_shape=jax.ShapeDtypeStruct((B * H, T, dh), q.dtype),
         grid=(B * H, T // block_q),
@@ -276,14 +327,16 @@ def _flash_bwd_tpu(q, k, v, mask, out, lse, g, causal=False,
             pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, T), lambda bh, qi, H=H: (bh // H, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh),
                                lambda bh, qi: (bh, qi, 0)),
-    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :])
+    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :], seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q,
-                          sm_scale=sm_scale, causal=causal),
+                          sm_scale=sm_scale, causal=causal,
+                          dropout=dropout),
         interpret=_INTERPRET,
         out_shape=[jax.ShapeDtypeStruct((B * H, T, dh), k.dtype),
                    jax.ShapeDtypeStruct((B * H, T, dh), v.dtype)],
@@ -296,18 +349,20 @@ def _flash_bwd_tpu(q, k, v, mask, out, lse, g, causal=False,
             pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, 1, T), lambda bh, ki, H=H: (bh // H, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, dh), lambda bh, ki: (bh, ki, 0)),
         ],
-    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :])
+    )(qt, kt, vt, dot, lse_f, delta, mask_arr[:, None, :], seed)
 
     unpack = lambda x: x.reshape(B, H, T, dh).transpose(0, 2, 1, 3)
     return unpack(dq), unpack(dk), unpack(dv)
 
 
-def _reference_attention(q, k, v, mask, causal=False):
+def _reference_attention(q, k, v, mask, causal=False, dropout=0.0,
+                         seed=None):
     import jax
     import jax.numpy as jnp
     dh = q.shape[-1]
@@ -320,26 +375,41 @@ def _reference_attention(q, k, v, mask, causal=False):
         logits = jnp.where(tri[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if dropout > 0.0:
+        # the SAME positional hash mask the Pallas kernels use, built
+        # dense — the fallback and the kernel paths drop identical
+        # entries for a given seed (and this is the parity oracle)
+        B, T, H, _ = q.shape
+        pos = jnp.arange(T, dtype=jnp.int32)
+        bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
+              + jnp.arange(H, dtype=jnp.int32)[None, :])   # (B, H)
+        keep = jax.vmap(lambda b: _dropout_keep(
+            b, pos, pos, seed[0], dropout))(bh.reshape(-1))
+        keep = keep.reshape(B, H, T, T)
+        probs = jnp.where(keep, probs, 0).astype(q.dtype) \
+            * (1.0 / (1.0 - dropout))
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def _make_flash(causal):
+def _make_flash(causal, dropout):
     import jax
 
     @jax.custom_vjp
-    def _flash(q, k, v, mask):
-        out, _ = _flash_fwd_tpu(q, k, v, mask, causal=causal)
+    def _flash(q, k, v, mask, seed):
+        out, _ = _flash_fwd_tpu(q, k, v, mask, seed, causal=causal,
+                                dropout=dropout)
         return out
 
-    def fwd(q, k, v, mask):
-        out, lse = _flash_fwd_tpu(q, k, v, mask, causal=causal)
-        return out, (q, k, v, mask, out, lse)
+    def fwd(q, k, v, mask, seed):
+        out, lse = _flash_fwd_tpu(q, k, v, mask, seed, causal=causal,
+                                  dropout=dropout)
+        return out, (q, k, v, mask, seed, out, lse)
 
     def bwd(res, g):
-        q, k, v, mask, out, lse = res
-        dq, dk, dv = _flash_bwd_tpu(q, k, v, mask, out, lse, g,
-                                    causal=causal)
-        return dq, dk, dv, None
+        q, k, v, mask, seed, out, lse = res
+        dq, dk, dv = _flash_bwd_tpu(q, k, v, mask, seed, out, lse, g,
+                                    causal=causal, dropout=dropout)
+        return dq, dk, dv, None, None
 
     _flash.defvjp(fwd, bwd)
     return _flash
@@ -348,20 +418,43 @@ def _make_flash(causal):
 _flash_cached = {}
 
 
-def flash_attention(q, k, v, mask=None, causal=False):
+def flash_attention(q, k, v, mask=None, causal=False, dropout=0.0,
+                    dropout_seed=None):
     """(B, T, H, dh) attention with a fused online-softmax TPU kernel;
     ``causal=True`` adds the autoregressive lower-triangular mask.
 
+    ``dropout`` > 0 applies attention-probability dropout INSIDE the
+    kernels (fwd + both bwd) via a positional counter hash keyed by
+    ``dropout_seed`` (int32 scalar; required when dropout > 0) — no
+    (T, T) mask is ever materialized, and the backward regenerates the
+    identical mask from positions (SURVEY.md §5.7; round-4 item #7).
+
     Falls back to the jnp reference off-TPU (CPU tests) or when shapes
-    don't tile (T not divisible by the 128 block, dh not lane-aligned).
+    don't tile (T not divisible by the 128 block, dh not lane-aligned);
+    the fallback applies the same hash dropout.
     """
     import jax
+    import jax.numpy as jnp
+    dropout = float(dropout)
+    if not 0.0 <= dropout < 1.0:
+        raise ValueError("flash_attention: dropout must be in [0, 1), "
+                         "got %r" % dropout)
+    if dropout > 0.0:
+        if dropout_seed is None:
+            raise ValueError("flash_attention: dropout > 0 requires "
+                             "dropout_seed")
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    else:
+        seed = jnp.zeros(1, jnp.int32)
     platform = jax.devices()[0].platform
     B, T, H, dh = q.shape
     if not _INTERPRET and (platform == "cpu" or T < _min_seq()):
-        return _reference_attention(q, k, v, mask, causal=causal)
+        return _reference_attention(q, k, v, mask, causal=causal,
+                                    dropout=dropout, seed=seed)
     if T % 128 != 0 or dh not in (64, 128, 256):
-        return _reference_attention(q, k, v, mask, causal=causal)
-    if causal not in _flash_cached:
-        _flash_cached[causal] = _make_flash(causal)
-    return _flash_cached[causal](q, k, v, mask)
+        return _reference_attention(q, k, v, mask, causal=causal,
+                                    dropout=dropout, seed=seed)
+    key = (causal, dropout)
+    if key not in _flash_cached:
+        _flash_cached[key] = _make_flash(causal, dropout)
+    return _flash_cached[key](q, k, v, mask, seed)

@@ -200,11 +200,16 @@ def _layer_norm(x, g, b, eps=1e-12):
     return (x - mean) / jnp.sqrt(var + eps) * g + b
 
 
-def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None):
+def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
+               dropout_key=None):
     """(B, T, H, dh) attention.  With ``cfg.seq_parallel`` and an 'sp'
     mesh axis the sequence stays sharded and attention runs as ring /
     Ulysses over ICI; otherwise the pallas flash kernel on TPU when
-    enabled, jnp reference elsewhere (also the CPU/test path)."""
+    enabled, jnp reference elsewhere (also the CPU/test path).
+
+    ``dropout_key`` non-None enables attention-probability dropout at
+    ``cfg.dropout`` — on the flash path it is FUSED into the Pallas
+    kernels (round-4 item #7), never materializing the (T, T) mask."""
     import jax
     import jax.numpy as jnp
     if cfg.seq_parallel and mesh is not None and "sp" in mesh.axis_names \
@@ -216,6 +221,13 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None):
     if cfg.use_flash:
         try:
             from ..kernels.flash_attention import flash_attention
+            if dropout_key is not None and cfg.dropout > 0:
+                seed = jax.random.randint(dropout_key, (), 0,
+                                          2**31 - 1, jnp.int32)
+                return flash_attention(q, k, v, mask=mask,
+                                       causal=cfg.causal,
+                                       dropout=cfg.dropout,
+                                       dropout_seed=seed)
             return flash_attention(q, k, v, mask=mask, causal=cfg.causal)
         except Exception:
             pass
@@ -229,6 +241,14 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None):
         logits = jnp.where(tri[None, None], logits, -1e9)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
+    if dropout_key is not None and cfg.dropout > 0:
+        # same attention-probability dropout as the flash path — the
+        # non-flash reference must not silently train with weaker
+        # regularization than the same cfg under use_flash
+        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1 - cfg.dropout),
+                          0).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -247,7 +267,12 @@ def _encoder_layer(x, layer, mask, cfg: TransformerConfig, train, key,
     q = (x @ dn(layer["wq"]) + dn(layer["bq"])).reshape(B, T, H, dh)
     k = (x @ dn(layer["wk"]) + dn(layer["bk"])).reshape(B, T, H, dh)
     v = (x @ dn(layer["wv"]) + dn(layer["bv"])).reshape(B, T, H, dh)
-    attn = _attention(q, k, v, mask, cfg, mesh).reshape(B, T, D)
+    if train and cfg.dropout > 0:
+        key, attn_sub = jax.random.split(key)
+    else:
+        attn_sub = None
+    attn = _attention(q, k, v, mask, cfg, mesh,
+                      dropout_key=attn_sub).reshape(B, T, D)
     attn = attn @ dn(layer["wo"]) + dn(layer["bo"])
     if train and cfg.dropout > 0:
         key, sub = jax.random.split(key)
