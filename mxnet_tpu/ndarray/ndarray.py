@@ -632,14 +632,18 @@ def _creation_ctx(ctx):
 
 def array(source_array, ctx=None, dtype=None) -> NDArray:
     import jax
-    jnp = _jnp()
     ctx = _creation_ctx(ctx)
     if isinstance(source_array, NDArray):
         source_array = source_array.asnumpy()
     np_arr = _np.asarray(source_array, dtype=dtype)
     if np_arr.dtype == _np.float64 and dtype is None:
         np_arr = np_arr.astype(_np.float32)
-    data = jax.device_put(jnp.asarray(np_arr), ctx.jax_device)
+    # device_put the NUMPY buffer directly: wrapping it in jnp.asarray
+    # first would materialize it on the DEFAULT device and then move it
+    # — under the tunneled TPU backend that turned every cpu-context
+    # nd.array() into a full wire round trip (measured 4.3 s for a
+    # 38 MB batch; docs/perf.md "End-to-end input pipeline")
+    data = jax.device_put(np_arr, ctx.jax_device)
     return NDArray(data, ctx=ctx)
 
 
