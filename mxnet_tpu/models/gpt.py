@@ -132,6 +132,139 @@ def _wmm(x, w, cdt):
     return x @ w.astype(cdt)
 
 
+def _embed(params, tokens, cdt):
+    """Token embedding lookup for float or weight-only-int8 tables
+    (shared by the prefill pass and the decode step)."""
+    emb = params["tok_emb"]
+    if isinstance(emb, dict):
+        return emb["q"][tokens].astype(cdt) * \
+            emb["s"][tokens].astype(cdt)[..., None]
+    return emb[tokens].astype(cdt)
+
+
+def _qkv(layer, x, cdt):
+    """Fused QKV matmul (one (D, 3D) weight; the concat is
+    loop/call-invariant so XLA hoists it) for float or int8 weights,
+    bias included.  Shared by prefill and decode."""
+    import jax.numpy as jnp
+    wq, wk, wv = layer["wq"], layer["wk"], layer["wv"]
+    if isinstance(wq, dict):
+        qkv = (x @ jnp.concatenate(
+            [wq["q"], wk["q"], wv["q"]], axis=1).astype(cdt)) * \
+            jnp.concatenate([wq["s"], wk["s"], wv["s"]]).astype(cdt)
+    else:
+        qkv = x @ jnp.concatenate([wq, wk, wv], axis=1).astype(cdt)
+    return qkv + jnp.concatenate(
+        [layer["bq"].astype(cdt), layer["bk"].astype(cdt),
+         layer["bv"].astype(cdt)])
+
+
+def _lm_head(params, x, cdt):
+    """gelu(mlm_dense) → LN → tied-embedding logits (+bias), f32 out.
+    Shared by prefill and decode; handles the int8 embedding table's
+    per-row scales on the output."""
+    import jax
+    import jax.numpy as jnp
+    h = jax.nn.gelu(_wmm(x, params["mlm_dense"], cdt),
+                    approximate=True)
+    h = T._layer_norm(h, params["mlm_ln"]["g"].astype(cdt),
+                      params["mlm_ln"]["b"].astype(cdt))
+    emb = params["tok_emb"]
+    if isinstance(emb, dict):
+        logits = (h @ emb["q"].T.astype(cdt)).astype(jnp.float32) * \
+            emb["s"][None, :]
+    else:
+        logits = (h @ emb.T.astype(cdt)).astype(jnp.float32)
+    return logits + params["mlm_bias"].astype(jnp.float32)
+
+
+def _prefill_full(params, cfg, tokens, total, kv_int8=False):
+    """Whole-prompt prefill in ONE causal forward pass (round 4; the
+    scan-of-_decode_one prefill cost P sequential decoder steps — a
+    single batched pass keeps the MXU busy and is O(P) faster in
+    wall-clock for prompt-heavy generation).
+
+    tokens: (B, P) int32.  Returns (last_logits (B, V) f32, caches) with
+    per-layer caches sized ``total`` and positions [0, P) filled —
+    exactly the state the decode scan expects.  Handles the same weight
+    formats as ``_decode_one`` (float or weight-only int8) and the int8
+    KV cache layout.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(cfg.dtype)
+    B, P = tokens.shape
+    D, H = cfg.d_model, cfg.n_heads
+    dh = D // H
+
+    x = _embed(params, tokens, cdt)                    # (B, P, D)
+    x = x + params["pos_emb"][:P].astype(cdt)[None]
+    x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                      params["emb_ln"]["b"].astype(cdt))
+
+    caches = []
+    for layer in params["layers"]:
+        def dn(w):
+            return w.astype(cdt)
+        qkv = _qkv(layer, x, cdt)
+        q = qkv[:, :, :D].reshape(B, P, H, dh)
+        k = qkv[:, :, D:2 * D].reshape(B, P, H, dh)
+        v = qkv[:, :, 2 * D:].reshape(B, P, H, dh)
+
+        # the full-sequence causal attention rides the same path the
+        # training forward uses — flash kernel past MXNET_FLASH_MIN_SEQ
+        # (no O(P^2) materialization for long prompts), jnp reference
+        # below it / off-TPU
+        from ..kernels.flash_attention import flash_attention
+        attn = flash_attention(q, k, v, causal=True).reshape(B, P, D)
+        attn = _wmm(attn, layer["wo"], cdt) + dn(layer["bo"])
+        x = T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
+                          dn(layer["ln1"]["b"]))
+        if "moe" in layer:
+            from ..parallel.moe import moe_ffn
+            h, _ = moe_ffn(x, layer["moe"], n_experts=cfg.n_experts,
+                           top_k=cfg.expert_top_k,
+                           capacity_factor=cfg.capacity_factor,
+                           dtype=cdt)
+        else:
+            h = jax.nn.gelu(_wmm(x, layer["w1"], cdt) + dn(layer["b1"]),
+                            approximate=True)
+            h = _wmm(h, layer["w2"], cdt) + dn(layer["b2"])
+        x = T._layer_norm(x + h, dn(layer["ln2"]["g"]),
+                          dn(layer["ln2"]["b"]))
+
+        # fill the decode caches: (B*H, L, dh) prefix [0, P)
+        kf = k.transpose(0, 2, 1, 3).reshape(B * H, P, dh)
+        vf = v.transpose(0, 2, 1, 3).reshape(B * H, P, dh)
+        if kv_int8:
+            sk = jnp.maximum(jnp.max(jnp.abs(kf), axis=2) / 127.0,
+                             1e-8)                     # (B*H, P)
+            sv = jnp.maximum(jnp.max(jnp.abs(vf), axis=2) / 127.0,
+                             1e-8)
+            kq = jnp.clip(jnp.round(kf / sk[:, :, None]), -127, 127
+                          ).astype(jnp.int8)
+            vq = jnp.clip(jnp.round(vf / sv[:, :, None]), -127, 127
+                          ).astype(jnp.int8)
+            ckv = jnp.zeros((B * H, total, 2 * dh), jnp.int8)
+            ckv = jax.lax.dynamic_update_slice(
+                ckv, jnp.concatenate([kq, vq], axis=2), (0, 0, 0))
+            cs = jnp.zeros((B * H, total, 2), jnp.float32)
+            cs = jax.lax.dynamic_update_slice(
+                cs, jnp.stack([sk, sv], axis=2).astype(jnp.float32),
+                (0, 0, 0))
+            caches.append({"kv": ckv, "s": cs})
+        else:
+            ckv = jnp.zeros((B * H, total, 2 * dh), cdt)
+            ckv = jax.lax.dynamic_update_slice(
+                ckv, jnp.concatenate([kf, vf], axis=2).astype(cdt),
+                (0, 0, 0))
+            caches.append({"kv": ckv})
+
+    logits = _lm_head(params, x[:, -1], cdt)           # (B, V) f32
+    return logits, caches
+
+
 def _decode_one(params, cfg, token, pos, caches):
     """One decode step: token (B,) int32 at position pos; caches is a
     list of per-layer dicts {"kv": (B*H, L, 2*dh)} (fused batch·head
@@ -146,12 +279,7 @@ def _decode_one(params, cfg, token, pos, caches):
     D, H = cfg.d_model, cfg.n_heads
     dh = D // H
 
-    emb = params["tok_emb"]
-    if isinstance(emb, dict):                          # weight-only int8
-        x = emb["q"][token].astype(cdt) * \
-            emb["s"][token].astype(cdt)[:, None]
-    else:
-        x = emb[token].astype(cdt)                     # (B, D)
+    x = _embed(params, token, cdt)                     # (B, D)
     x = x + jax.lax.dynamic_index_in_dim(
         params["pos_emb"], pos, keepdims=False).astype(cdt)
     x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
@@ -161,18 +289,7 @@ def _decode_one(params, cfg, token, pos, caches):
     for layer, cache in zip(params["layers"], caches):
         def dn(w):
             return w.astype(cdt)
-        # fused QKV: one (D, 3D) matmul instead of three — the concat is
-        # loop-invariant, so XLA hoists it out of the decode scan and
-        # streams one contiguous weight per step
-        wq, wk, wv = layer["wq"], layer["wk"], layer["wv"]
-        if isinstance(wq, dict):
-            qkv = (x @ jnp.concatenate(
-                [wq["q"], wk["q"], wv["q"]], axis=1).astype(cdt)) * \
-                jnp.concatenate([wq["s"], wk["s"], wv["s"]]).astype(cdt)
-        else:
-            qkv = x @ jnp.concatenate([wq, wk, wv], axis=1).astype(cdt)
-        qkv = qkv + jnp.concatenate(
-            [dn(layer["bq"]), dn(layer["bk"]), dn(layer["bv"])])
+        qkv = _qkv(layer, x, cdt)
         q, k, v = (qkv[:, :D].reshape(B * H, dh),
                    qkv[:, D:2 * D].reshape(B * H, dh),
                    qkv[:, 2 * D:].reshape(B * H, dh))
@@ -302,43 +419,19 @@ def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,
     if total > cfg.max_len:
         raise ValueError("generate: %d tokens > cfg.max_len=%d"
                          % (total, cfg.max_len))
-    H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
-
     cache_key = (cfg, B, P, max_new_tokens, float(temperature),
                  bool(kv_int8))
     cached = _generate_cache.get(cache_key)
     if cached is not None:
         return cached(params, prompt, rng)
 
-    # close over plain ints only — capturing `params` here would pin the
-    # first call's weights alive inside the cached jit closure
-    n_layers = len(params["layers"])
-
-    def empty_caches():
-        if kv_int8:
-            return [{"kv": jnp.zeros((B * H, total, 2 * dh), jnp.int8),
-                     "s": jnp.zeros((B * H, total, 2), jnp.float32)}
-                    for _ in range(n_layers)]
-        return [{"kv": jnp.zeros((B * H, total, 2 * dh),
-                                 jnp.dtype(cfg.dtype))}
-                for _ in range(n_layers)]
-
     @jax.jit
     def run(params, prompt, rng):
-        caches = empty_caches()
-
-        # prefill: feed prompt tokens one by one through the cached
-        # decoder (small P; full-sequence prefill is a later fusion)
-        def prefill(carry, t):
-            caches, _ = carry
-            logits, caches = _decode_one(params, cfg, prompt[:, t], t,
-                                         caches)
-            return (caches, logits), ()
-
-        (caches, logits), _ = jax.lax.scan(
-            prefill, (caches, jnp.zeros((B, cfg.vocab_size),
-                                        jnp.float32)),
-            jnp.arange(P))
+        # whole-prompt prefill: ONE causal forward builds the caches and
+        # the last position's logits (round 4 — the previous scan of
+        # per-token decoder steps cost P sequential passes)
+        logits, caches = _prefill_full(params, cfg, prompt, total,
+                                       kv_int8=kv_int8)
 
         def sample(logits, key):
             if temperature == 0.0:

@@ -94,6 +94,31 @@ class DataParallelTrainer:
         import jax
         vals = [p.data()._data for p in self._param_objs]
         if self._trivial:
+            # Guardrail (round 4): on a trivial mesh no sharding commit
+            # happens, so params initialized without ctx=mx.tpu() would
+            # keep the whole train step on the HOST backend — resnet18
+            # silently ran at 25 s/step on this 1-vCPU box while
+            # looking like a TPU run.  Move host-platform params onto
+            # the mesh device instead (one-time transfer, same place
+            # sync_back reads from).
+            dev = self.mesh.devices.ravel()[0]
+            if dev.platform != "cpu":
+                moved = False
+                out = []
+                for v in vals:
+                    vdev = next(iter(v.devices()))
+                    if vdev.platform == "cpu":
+                        out.append(jax.device_put(v, dev))
+                        moved = True
+                    else:
+                        out.append(v)
+                if moved:
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "DataParallelTrainer: moved host-resident "
+                        "params onto %s (initialize with ctx=mx.tpu() "
+                        "to avoid the transfer)", dev)
+                return out
             return vals
         from .multihost import host_staged_put
         return [host_staged_put(v, self._rep) for v in vals]
@@ -217,17 +242,35 @@ class DataParallelTrainer:
         self._jit_step = jax.jit(step, donate_argnums=(0,))
         self._multi_jit = {}
 
+    def _place_batch(self, d, l):
+        """Batch placement: shard over the mesh, or (trivial mesh) move
+        host arrays to the accelerator so they match the params the
+        round-4 guardrail placed there."""
+        import jax
+        if not self._trivial:
+            return (jax.device_put(d, self._batch_sharding),
+                    jax.device_put(l, self._batch_sharding))
+        dev = self.mesh.devices.ravel()[0]
+        if dev.platform != "cpu":
+            def plat(x):                 # numpy input counts as host
+                try:
+                    return next(iter(x.devices())).platform
+                except AttributeError:
+                    return "cpu"
+            if plat(d) == "cpu":
+                d = jax.device_put(d, dev)
+            if plat(l) == "cpu":
+                l = jax.device_put(l, dev)
+        return d, l
+
     def step(self, data, label):
         """One data-parallel training step; returns scalar loss."""
-        import jax
         from ..ndarray.ndarray import NDArray, _wrap
         d = data._data if isinstance(data, NDArray) else data
         l = label._data if isinstance(label, NDArray) else label
         if self._jit_step is None:
             self._build(d, l)
-        if not self._trivial:
-            d = jax.device_put(d, self._batch_sharding)
-            l = jax.device_put(l, self._batch_sharding)
+        d, l = self._place_batch(d, l)
         self._state, loss = self._jit_step(self._state, d, l)
         return _wrap(loss)
 
@@ -282,7 +325,7 @@ class DataParallelTrainer:
 
             self._multi_jit[key] = jax.jit(multi, donate_argnums=(0,))
         if self._trivial:
-            pass
+            d, l = self._place_batch(d, l)
         elif superbatch:
             sb = NamedSharding(
                 self.mesh, P(None, self._data_axis))
