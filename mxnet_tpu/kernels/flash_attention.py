@@ -14,6 +14,7 @@ blockwise so nothing quadratic is ever materialized.
 """
 from __future__ import annotations
 
+import collections as _collections
 import functools
 import math
 
@@ -415,7 +416,11 @@ def _make_flash(causal, dropout):
     return _flash
 
 
-_flash_cached = {}
+# LRU-bounded: keyed by (causal, dropout-rate); a dropout-rate schedule
+# sweeping many distinct rates would otherwise grow this dict (and each
+# entry's compiled custom_vjp closures) without bound (round-4 advisor).
+_flash_cached = _collections.OrderedDict()
+_FLASH_CACHE_MAX = 16
 
 
 def flash_attention(q, k, v, mask=None, causal=False, dropout=0.0,
@@ -432,6 +437,13 @@ def flash_attention(q, k, v, mask=None, causal=False, dropout=0.0,
     Falls back to the jnp reference off-TPU (CPU tests) or when shapes
     don't tile (T not divisible by the 128 block, dh not lane-aligned);
     the fallback applies the same hash dropout.
+
+    Memory note: the fallback materializes the (B, H, T, T) keep mask
+    densely on top of the probs tensor, so dropout training roughly
+    doubles attention peak memory versus dropout=0 on that path.  If
+    that OOMs at a T below ``MXNET_FLASH_MIN_SEQ`` (default 4096),
+    lower the env var to route those lengths to the fused kernels,
+    which never build the mask.
     """
     import jax
     import jax.numpy as jnp
@@ -455,6 +467,12 @@ def flash_attention(q, k, v, mask=None, causal=False, dropout=0.0,
         return _reference_attention(q, k, v, mask, causal=causal,
                                     dropout=dropout, seed=seed)
     key = (causal, dropout)
-    if key not in _flash_cached:
-        _flash_cached[key] = _make_flash(causal, dropout)
-    return _flash_cached[key](q, k, v, mask, seed)
+    fn = _flash_cached.get(key)
+    if fn is None:
+        fn = _make_flash(causal, dropout)
+        _flash_cached[key] = fn
+        if len(_flash_cached) > _FLASH_CACHE_MAX:
+            _flash_cached.popitem(last=False)
+    else:
+        _flash_cached.move_to_end(key)
+    return fn(q, k, v, mask, seed)

@@ -23,6 +23,8 @@ import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
+_FLASH_FALLBACK_LOGGED = False
+
 __all__ = ["TransformerConfig", "init_params", "forward",
            "forward_with_aux", "make_train_step", "bert_base", "bert_tiny"]
 
@@ -219,6 +221,14 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
             q, k, v, mask, mesh=mesh, seq_axis="sp",
             method=cfg.seq_parallel, causal=cfg.causal)
     if cfg.use_flash:
+        # argument validation happens BEFORE the try: a bad dropout
+        # value is the caller's bug and must surface — silently
+        # training on the bernoulli fallback would change the dropout
+        # mask stream vs the fused positional-hash mask (round-4
+        # advisor).  Kernel-internal ValueErrors still fall back.
+        if dropout_key is not None and not 0.0 <= float(cfg.dropout) < 1.0:
+            raise ValueError("attention dropout must be in [0, 1), "
+                             "got %r" % (cfg.dropout,))
         try:
             from ..kernels.flash_attention import flash_attention
             if dropout_key is not None and cfg.dropout > 0:
@@ -230,7 +240,21 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
                                        dropout_seed=seed)
             return flash_attention(q, k, v, mask=mask, causal=cfg.causal)
         except Exception:
-            pass
+            # kernel failure → jnp fallback below; log once so a
+            # kernel regression can't silently change RNG semantics
+            # (round-4 advisor)
+            global _FLASH_FALLBACK_LOGGED
+            if not _FLASH_FALLBACK_LOGGED:
+                _FLASH_FALLBACK_LOGGED = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "flash_attention failed; falling back to the jnp "
+                    "attention path (bernoulli dropout mask). "
+                    "Set MXNET_FLASH_DEBUG=1 to re-raise instead.",
+                    exc_info=True)
+            import os
+            if os.environ.get("MXNET_FLASH_DEBUG", "0") == "1":
+                raise
     dh = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
     if mask is not None:
