@@ -183,6 +183,34 @@ def main():
                           round(min(1.0, max(0.0, hidden / t_loader)),
                                 2)}), flush=True)
 
+    # -- the same loop THROUGH the public API (round-5 item #3) --------
+    # DevicePrefetchIter owns decode + superbatch + upload in its
+    # worker thread; the consumer loop is just run_steps per super.
+    from mxnet_tpu.io import DevicePrefetchIter
+    it.reset()                  # earlier stages left the cursor mid-epoch
+    pf = DevicePrefetchIter(it, super_size=S, ctx=mx.tpu())
+    b0 = pf.next()                              # warm the pipeline
+    losses = tr.run_steps(b0.data[0], b0.label[0])
+    float(losses.asnumpy()[-1])
+    t0 = time.perf_counter()
+    done = 0
+    while done < args.epochs * nsuper:
+        try:
+            b = pf.next()
+        except StopIteration:
+            pf.reset()
+            continue
+        losses = tr.run_steps(b.data[0], b.label[0])
+        done += 1
+    float(losses.asnumpy()[-1])
+    t_api = (time.perf_counter() - t0) / (args.epochs * nsuper)
+    pf.close()
+    print(json.dumps({"stage": "api(DevicePrefetchIter)",
+                      "ms_per_super": round(t_api * 1e3, 1),
+                      "img_s": round(imgs_per_super / t_api, 1),
+                      "vs_handrolled":
+                          round(t_overlap / t_api, 3)}), flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -1,4 +1,5 @@
 """IO API (reference: ``python/mxnet/io/``)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, ImageRecordIter, MXDataIter, CSVIter,
-                 LibSVMIter, register_iter, list_iters)
+                 PrefetchingIter, DevicePrefetchIter, ImageRecordIter,
+                 MXDataIter, CSVIter, LibSVMIter, register_iter,
+                 list_iters)

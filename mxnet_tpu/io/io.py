@@ -20,8 +20,8 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter",
-           "CSVIter", "LibSVMIter"]
+           "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "ImageRecordIter", "MXDataIter", "CSVIter", "LibSVMIter"]
 
 _ITER_REG = Registry("data_iter")
 
@@ -390,6 +390,208 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class _PrefetchState:
+    """Shared state between a DevicePrefetchIter and its worker thread.
+    The thread holds ONLY this object — never the iterator — so the
+    iterator stays collectable and its finalizer can stop the thread."""
+    __slots__ = ("iter", "S", "ctx", "q", "go", "lock", "thread",
+                 "stop", "epoch")
+
+    def __init__(self):
+        self.stop = False
+        self.epoch = 0
+
+
+def _prefetch_decode_super(st):
+    """Decode S batches under the lock; returns (epoch, host) — the
+    epoch is read under the SAME lock so a concurrent reset() cannot
+    tag a fresh-epoch superbatch with the old epoch."""
+    with st.lock:
+        epoch = st.epoch
+        ds, ls = [], []
+        for _ in range(st.S):
+            try:
+                b = st.iter.next()
+            except StopIteration:
+                return epoch, None   # end of epoch (partial S dropped)
+            ds.append([d.asnumpy() for d in b.data])
+            ls.append([l.asnumpy() for l in b.label])
+    n_d, n_l = len(ds[0]), len(ls[0])
+    data = [_np.stack([row[i] for row in ds]) for i in range(n_d)]
+    label = [_np.stack([row[i] for row in ls]) for i in range(n_l)]
+    return epoch, (data, label)
+
+
+def _prefetch_put(st, item):
+    import queue
+    while not st.stop:
+        try:
+            st.q.put(item, timeout=0.2)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _prefetch_worker(st):
+    while not st.stop:
+        try:
+            epoch, host = _prefetch_decode_super(st)
+            if host is None:
+                item = None
+            else:
+                data, label = host
+                # the upload happens HERE, in the prefetch thread:
+                # nd.array device_puts the numpy buffer directly
+                # (round-4 fix), and PjRt async dispatch lets it
+                # proceed under the consumer's in-flight run_steps
+                item = DataBatch(
+                    data=[nd.array(d, ctx=st.ctx) for d in data],
+                    label=[nd.array(l, ctx=st.ctx) for l in label],
+                    pad=0, index=None)
+        except Exception as e:       # deferred-exception contract: the
+            item = e                 # consumer rethrows in next()
+            with st.lock:
+                epoch = st.epoch
+        if item is None or isinstance(item, Exception):
+            # park until reset() re-arms the epoch.  clear() BEFORE the
+            # put: if it came after, a consumer that sees the item and
+            # calls reset() immediately could set() in between and the
+            # clear would erase the wakeup, parking the worker forever
+            st.go.clear()
+            if not _prefetch_put(st, (epoch, item)):
+                return
+            # the epoch check breaks the park when a reset() ran before
+            # the clear() above (its set() would have been erased)
+            while not st.stop and st.epoch == epoch \
+                    and not st.go.wait(timeout=0.2):
+                pass
+        elif not _prefetch_put(st, (epoch, item)):
+            return
+
+
+def _prefetch_close(st):
+    st.stop = True
+    st.go.set()
+    try:                             # unblock a worker stuck on put()
+        st.q.get_nowait()
+    except Exception:
+        pass
+    st.thread.join(timeout=2)
+
+
+class DevicePrefetchIter(DataIter):
+    """Prefetch-to-DEVICE superbatch iterator (round-4 verdict item #3 —
+    the e2e benchmark's winning pipeline shape as a public API).
+
+    Wraps any host :class:`DataIter`: a background thread decodes
+    ``super_size`` consecutive batches, stacks them into ONE
+    ``(S, B, ...)`` host superbatch and uploads it to ``ctx`` — all
+    while the consumer is still training on the previous superbatch.
+    Each yielded :class:`DataBatch` holds device-resident NDArrays that
+    feed straight into ``DataParallelTrainer.run_steps`` (one compiled
+    ``lax.scan`` dispatch consuming all S steps), so per-batch dispatch
+    latency and synchronous per-batch H2D both disappear from the
+    steady-state loop::
+
+        it = DevicePrefetchIter(ImageRecordIter(...), super_size=8,
+                                ctx=mx.tpu())
+        for batch in it:                      # (S, B, C, H, W) on device
+            losses = trainer.run_steps(batch.data[0], batch.label[0])
+
+    Reference: ``PrefetcherIter`` double-buffering (SURVEY.md §3.5) —
+    that design overlapped host decode with per-batch copy; this one
+    additionally amortizes the dispatch (docs/perf.md "End-to-end
+    pipeline → device training").
+
+    A trailing partial superbatch (fewer than ``super_size`` batches
+    left in the epoch) is dropped: emitting it would change the scanned
+    step count and recompile ``run_steps`` every epoch tail.
+
+    ``close()`` stops the worker thread and releases the queued
+    superbatch; it is also registered as a ``weakref.finalize`` so an
+    abandoned iterator is torn down when garbage-collected (the thread
+    itself only references a private state object, never the iterator,
+    so collection actually happens).
+    """
+
+    def __init__(self, base_iter, super_size=8, ctx=None):
+        super().__init__()
+        if super_size < 1:
+            raise MXNetError("DevicePrefetchIter: super_size must be >= 1")
+        import queue
+        import weakref
+        self.iter = base_iter
+        self.S = int(super_size)
+        self.batch_size = getattr(base_iter, "batch_size", 0)
+        self.current_batch = None
+        st = self._st = _PrefetchState()
+        st.iter = base_iter
+        st.S = self.S
+        st.ctx = ctx
+        st.q = queue.Queue(maxsize=1)
+        st.go = threading.Event()
+        st.lock = threading.Lock()
+        self._finalizer = weakref.finalize(self, _prefetch_close, st)
+        st.thread = threading.Thread(target=_prefetch_worker, args=(st,),
+                                     daemon=True)
+        st.thread.start()
+
+    # -- consumer -----------------------------------------------------------
+    def next(self):
+        st = self._st
+        while True:
+            epoch, item = st.q.get()
+            if epoch != st.epoch:
+                continue             # stale item decoded before reset()
+            if item is None:
+                raise StopIteration
+            if isinstance(item, Exception):
+                raise MXNetError(
+                    "DevicePrefetchIter worker failed: %r" % item) \
+                    from item
+            self.current_batch = item
+            return item
+
+    def reset(self):
+        # invalidate anything decoded so far (epoch tag), reset the
+        # underlying iterator (the lock waits out an in-flight decode),
+        # and un-park the worker if it hit the end of the epoch
+        st = self._st
+        with st.lock:
+            st.epoch += 1
+            st.iter.reset()
+        st.go.set()
+
+    def close(self):
+        """Stop the prefetch thread and drop the queued superbatch."""
+        self._finalizer()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    @property
+    def provide_data(self):
+        return [DataDesc(d.name, (self.S,) + tuple(d.shape),
+                         getattr(d, "dtype", _np.float32))
+                for d in self.iter.provide_data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(d.name, (self.S,) + tuple(d.shape),
+                         getattr(d, "dtype", _np.float32))
+                for d in self.iter.provide_label]
 
 
 class ImageRecordIter(DataIter):

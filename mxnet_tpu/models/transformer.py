@@ -214,6 +214,13 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
     kernels (round-4 item #7), never materializing the (T, T) mask."""
     import jax
     import jax.numpy as jnp
+    # argument validation for EVERY attention path (flash, jnp, ring):
+    # a bad dropout value is the caller's bug and must surface — the
+    # jnp path would otherwise silently compute bernoulli(p<0) /
+    # negative scaling (round-4 advisor; round-5 review).
+    if dropout_key is not None and not 0.0 <= float(cfg.dropout) < 1.0:
+        raise ValueError("attention dropout must be in [0, 1), "
+                         "got %r" % (cfg.dropout,))
     if cfg.seq_parallel and mesh is not None and "sp" in mesh.axis_names \
             and mesh.shape["sp"] > 1:
         from ..parallel.ring_attention import sequence_parallel_attention
@@ -221,14 +228,6 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
             q, k, v, mask, mesh=mesh, seq_axis="sp",
             method=cfg.seq_parallel, causal=cfg.causal)
     if cfg.use_flash:
-        # argument validation happens BEFORE the try: a bad dropout
-        # value is the caller's bug and must surface — silently
-        # training on the bernoulli fallback would change the dropout
-        # mask stream vs the fused positional-hash mask (round-4
-        # advisor).  Kernel-internal ValueErrors still fall back.
-        if dropout_key is not None and not 0.0 <= float(cfg.dropout) < 1.0:
-            raise ValueError("attention dropout must be in [0, 1), "
-                             "got %r" % (cfg.dropout,))
         try:
             from ..kernels.flash_attention import flash_attention
             if dropout_key is not None and cfg.dropout > 0:

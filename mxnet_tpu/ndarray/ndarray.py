@@ -32,6 +32,12 @@ def _jnp():
     return jnp
 
 
+# Set by profiler._mem_start() when ``profile_memory=True`` is active:
+# called with every chunk buffer entering the NDArray layer (construction
+# and chunk-swap mutation).  None → zero overhead on the hot path.
+_MEM_HOOK = None
+
+
 def _dev_of(data):
     try:
         devs = data.devices()
@@ -76,6 +82,8 @@ class NDArray:
         self._grad_req = "null"
         self._ag = None
         self._ctx_hint = ctx
+        if _MEM_HOOK is not None:
+            _MEM_HOOK(data)
 
     # ------------------------------------------------------------------
     # chunk swap = mutation
@@ -85,6 +93,8 @@ class NDArray:
         version counter — reference: engine write-var version++."""
         self._data = new_data
         self._version += 1
+        if _MEM_HOOK is not None:
+            _MEM_HOOK(new_data)
 
     # ------------------------------------------------------------------
     # properties

@@ -24,7 +24,8 @@ from .base import MXNetError
 from .engine import Engine
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "Task", "Frame", "Event", "Counter", "Marker"]
+           "resume", "memory_stats", "Task", "Frame", "Event", "Counter",
+           "Marker"]
 
 _lock = threading.Lock()
 _config = {
@@ -70,6 +71,179 @@ def _op_hook(event: str, name: str):
             })
             if _config["aggregate_stats"]:
                 _agg[n].append(dur)
+            if _MEM["enabled"]:
+                # peak-by-op attribution: the live-bytes high-water
+                # mark observed at each op's completion (reference:
+                # storage_profiler.h entries keyed by the operator
+                # whose execution allocated them)
+                _mem_drain_locked()
+                rec = _agg_mem.get(n)
+                if rec is None:
+                    _agg_mem[n] = [1, _MEM["live"]]
+                else:
+                    rec[0] += 1
+                    if _MEM["live"] > rec[1]:
+                        rec[1] = _MEM["live"]
+        if _MEM["enabled"] and _MEM["device"]:
+            _mem_sample_device()
+
+
+# ---------------------------------------------------------------------------
+# Memory profiling (round-4 verdict item #4; reference:
+# ``src/profiler/storage_profiler.h``).  The reference tracked ITS
+# allocator's alloc/free pairs; in this build PjRt owns raw device
+# memory, so the analogs are (a) NDArray chunk buffers — every
+# imperative result/parameter passes through the NDArray layer, hooked
+# below via weakref finalizers; (b) per-device PjRt ``memory_stats()``
+# where the backend exposes them (TPU yes, CPU no — probed at
+# ``set_state('run')``); (c) the native host pool via ``MXStorageStats``
+# over the C ABI.  dump() gains counter tracks, dumps() a memory table.
+# ---------------------------------------------------------------------------
+
+_MEM = {"enabled": False, "live": 0, "peak": 0, "n_alloc": 0,
+        "device": False, "last_dev_sample": 0.0, "session": 0}
+_agg_mem: Dict[str, List[int]] = {}   # op name -> [calls, peak live bytes]
+_mem_live_bufs: Dict[int, int] = {}   # id(buffer) -> nbytes
+# freed-buffer keys land here from weakref finalizers and are drained
+# under _lock later: a finalizer can fire from GC INSIDE a section that
+# already holds the (non-reentrant) _lock, so it must never take it —
+# deque.append is atomic under the GIL
+_mem_freed = None  # collections.deque, created lazily
+_DEV_SAMPLE_US = 50_000.0  # throttle device RPC sampling to 20 Hz
+
+
+def _mem_free(key: int, session: int):
+    dq = _mem_freed
+    if dq is not None:
+        dq.append((key, session))
+
+
+def _mem_drain_locked():
+    """Apply deferred finalizer frees.  Caller holds _lock."""
+    dq = _mem_freed
+    if not dq:
+        return
+    while True:
+        try:
+            key, session = dq.popleft()
+        except IndexError:
+            break
+        if session != _MEM["session"]:
+            continue                  # buffer from a previous session
+        _MEM["live"] -= _mem_live_bufs.pop(key, 0)
+
+
+def _mem_note(buf):
+    """Account one NDArray chunk buffer (called from the NDArray layer
+    when memory profiling is active)."""
+    key = id(buf)
+    try:
+        nbytes = int(buf.nbytes)
+    except Exception:
+        return
+    import weakref
+    with _lock:
+        _mem_drain_locked()
+        if key in _mem_live_bufs:
+            return
+        try:
+            weakref.finalize(buf, _mem_free, key, _MEM["session"])
+        except TypeError:
+            return  # buffer type without weakref support
+        _mem_live_bufs[key] = nbytes
+        _MEM["live"] += nbytes
+        _MEM["n_alloc"] += 1
+        if _MEM["live"] > _MEM["peak"]:
+            _MEM["peak"] = _MEM["live"]
+        if _state["running"] and not _state["paused"]:
+            _events.append({
+                "name": "ndarray_live_bytes", "ph": "C",
+                "ts": _now_us(), "pid": os.getpid(),
+                "args": {"bytes": _MEM["live"]},
+            })
+
+
+def _mem_sample_device():
+    """Emit per-device bytes_in_use counters (throttled — on the
+    tunneled backend each ``memory_stats()`` is an RPC)."""
+    now = _now_us()
+    if now - _MEM["last_dev_sample"] < _DEV_SAMPLE_US:
+        return
+    _MEM["last_dev_sample"] = now
+    try:
+        import jax
+        for d in jax.devices():
+            st = d.memory_stats()
+            if not st:
+                continue
+            with _lock:
+                _events.append({
+                    "name": "%s:%d bytes_in_use" % (d.platform, d.id),
+                    "ph": "C", "ts": now, "pid": os.getpid(),
+                    "args": {"bytes": st.get("bytes_in_use", 0),
+                             "peak": st.get("peak_bytes_in_use", 0)},
+                })
+    except Exception:
+        pass
+
+
+def _mem_start():
+    import collections
+    import jax
+    global _mem_freed
+    try:
+        _MEM["device"] = bool(jax.devices()[0].memory_stats())
+    except Exception:
+        _MEM["device"] = False
+    with _lock:
+        # re-baseline: a second profiling session must not inherit the
+        # previous run's peak/live or see frees of its buffers
+        _MEM["session"] += 1
+        _MEM["live"] = 0
+        _MEM["peak"] = 0
+        _MEM["n_alloc"] = 0
+        _mem_live_bufs.clear()
+        _agg_mem.clear()
+        _mem_freed = collections.deque()
+    _MEM["enabled"] = True
+    from .ndarray import ndarray as _ndmod
+    _ndmod._MEM_HOOK = _mem_note
+
+
+def _mem_stop():
+    _MEM["enabled"] = False
+    from .ndarray import ndarray as _ndmod
+    _ndmod._MEM_HOOK = None
+
+
+def memory_stats() -> dict:
+    """Current framework-level memory accounting: NDArray live/peak
+    bytes, allocation count, per-device PjRt stats (where supported),
+    and native host-pool stats (when the native lib is loaded)."""
+    with _lock:
+        _mem_drain_locked()
+        out = {"ndarray_live_bytes": _MEM["live"],
+               "ndarray_peak_bytes": _MEM["peak"],
+               "ndarray_allocs": _MEM["n_alloc"], "devices": {}}
+    if _MEM["device"]:
+        try:
+            import jax
+            for d in jax.devices():
+                st = d.memory_stats()
+                if st:
+                    out["devices"]["%s:%d" % (d.platform, d.id)] = {
+                        "bytes_in_use": st.get("bytes_in_use", 0),
+                        "peak_bytes_in_use": st.get(
+                            "peak_bytes_in_use", 0)}
+        except Exception:
+            pass
+    try:
+        from . import native
+        if native.available():
+            out["host_pool"] = native.storage_stats()
+    except Exception:
+        pass
+    return out
 
 
 def set_config(**kwargs):
@@ -92,6 +266,8 @@ def set_state(state_name: str = "stop"):
             Engine.get().add_op_hook(hook)
             _state["hook"] = hook
             _state["running"] = True
+            if _config["profile_memory"]:
+                _mem_start()
             if _config["xla_profile"] and not _state["xla_running"]:
                 import jax
                 try:
@@ -103,6 +279,8 @@ def set_state(state_name: str = "stop"):
         if _state["running"]:
             Engine.get().remove_op_hook(_state["hook"])
             _state["running"] = False
+            if _MEM["enabled"]:
+                _mem_stop()
             if _state["xla_running"]:
                 import jax
                 try:
@@ -153,6 +331,34 @@ def dumps(reset: bool = False) -> str:
                 sum(ds) / len(ds)))
         if reset:
             _agg.clear()
+    if _config["profile_memory"] and (_MEM["n_alloc"] or _agg_mem):
+        lines.append("")
+        lines.append("Memory Statistics:")
+        lines.append("%-40s %16s" % ("Counter", "Bytes"))
+        lines.append("%-40s %16d" % ("ndarray_live", _MEM["live"]))
+        lines.append("%-40s %16d" % ("ndarray_peak", _MEM["peak"]))
+        lines.append("%-40s %16d" % ("ndarray_allocs", _MEM["n_alloc"]))
+        ms = memory_stats()
+        for dev, st in sorted(ms.get("devices", {}).items()):
+            lines.append("%-40s %16d" % (
+                dev + " bytes_in_use", st["bytes_in_use"]))
+            lines.append("%-40s %16d" % (
+                dev + " peak_bytes_in_use", st["peak_bytes_in_use"]))
+        hp = ms.get("host_pool")
+        if hp:
+            lines.append("%-40s %16d" % ("host_pool_allocated",
+                                         hp["allocated"]))
+            lines.append("%-40s %16d" % ("host_pool_pooled",
+                                         hp["pooled"]))
+        lines.append("")
+        lines.append("Peak live bytes by operator:")
+        lines.append("%-40s %8s %16s" % ("Name", "Calls", "Peak(bytes)"))
+        with _lock:
+            for name in sorted(_agg_mem, key=lambda n: -_agg_mem[n][1]):
+                calls, peak = _agg_mem[name]
+                lines.append("%-40s %8d %16d" % (name, calls, peak))
+            if reset:
+                _agg_mem.clear()
     return "\n".join(lines)
 
 

@@ -42,6 +42,9 @@ _SLOW_GROUPS = {
     "test_pipeline_moe": "c", "test_parallel": "c",
     "test_ring_attention": "c",
     # group d: ~220s (everything else)
+    # group e: ~4min — the collective-matrix pins compile 6 parallel
+    # configs' steady-state train steps; too heavy to share a group
+    "test_collective_matrix": "e",
 }
 
 

