@@ -32,7 +32,12 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", "150"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
-    net = vision.resnet50_v1()
+    # stem_s2d: exact space-to-depth reparameterization of the 7x7/s2
+    # stem (same function class, lossless weight mapping — see
+    # SpaceToDepthStem; measured ~+1% on this chip).  BENCH_S2D=0
+    # restores the literal reference stem.
+    s2d = os.environ.get("BENCH_S2D", "1") == "1"
+    net = vision.resnet50_v1(stem_s2d=s2d)
     net.initialize(mx.initializer.Xavier(), ctx=ctx)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 

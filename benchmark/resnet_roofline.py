@@ -69,27 +69,33 @@ def diff_time(make_loop, arg, k1=30, k2=120):
 # ---------------------------------------------------------------------------
 
 def conv_shapes():
-    """Every distinct ResNet-50 conv as (label, H, W, Cin, Cout, k, stride).
+    """Every distinct ResNet-50 conv as (label, H, W, Cin, Cout, k,
+    stride, count) — count is the shape's multiplicity in the model.
 
     Spatial sizes are the conv's INPUT resolution at 224^2 images.
     """
-    out = [("stem7x7/2", 224, 224, 3, 64, 7, 2)]
+    out = [("stem7x7/2", 224, 224, 3, 64, 7, 2, 1)]
     res = 56
     cin = 64
     for si, (n, mid, cout, stride) in enumerate(STAGES):
         s = si + 1
-        out.append(("s%d 1x1 %d->%d" % (s, cin, mid), res, res, cin, mid,
-                    1, 1))
-        out.append(("s%d 3x3/%d %d->%d" % (s, stride, mid, mid), res, res,
-                    mid, mid, 3, stride))
         r2 = res // stride
-        out.append(("s%d 1x1 %d->%d" % (s, mid, cout), r2, r2, mid, cout,
-                    1, 1))
-        out.append(("s%d sc 1x1/%d %d->%d" % (s, stride, cin, cout), res,
-                    res, cin, cout, 1, stride))
-        # non-first blocks: 1x1 cout->mid at r2
-        out.append(("s%d 1x1 %d->%d" % (s, cout, mid), r2, r2, cout, mid,
-                    1, 1))
+        out.append(("s%d 1x1 %d->%d" % (s, cin, mid), res, res, cin,
+                    mid, 1, 1, 1))
+        if stride > 1:
+            out.append(("s%d 3x3/%d %d->%d" % (s, stride, mid, mid),
+                        res, res, mid, mid, 3, stride, 1))
+            out.append(("s%d 3x3/1 %d->%d" % (s, mid, mid), r2, r2,
+                        mid, mid, 3, 1, n - 1))
+        else:
+            out.append(("s%d 3x3/1 %d->%d" % (s, mid, mid), res, res,
+                        mid, mid, 3, 1, n))
+        out.append(("s%d 1x1 %d->%d" % (s, mid, cout), r2, r2, mid,
+                    cout, 1, 1, n))
+        out.append(("s%d sc 1x1/%d %d->%d" % (s, stride, cin, cout),
+                    res, res, cin, cout, 1, stride, 1))
+        out.append(("s%d 1x1 %d->%d" % (s, cout, mid), r2, r2, cout,
+                    mid, 1, 1, n - 1))
         cin = cout
         res = r2
     return out
@@ -103,11 +109,19 @@ def conv_cost(h, w, cin, cout, k, stride):
     return flops, bytes_
 
 
-def run_layers(k1, k2):
+def run_layers(k1, k2, K=60):
+    """Per-shape conv cost via 2-vs-1 in-body differencing: each scan
+    body runs the measured op once or twice on perturbed inputs (no
+    CSE) with an identical carry chain, at the SAME scan length K — the
+    dispatch constant AND the carry-chain tax cancel exactly in the
+    difference (the earlier chained-input probe folded a full-tensor
+    perturbation pass into every small conv's number)."""
+    del k1, k2  # kept for CLI compat; K-differencing is not used here
     rows = []
-    print("%-22s %7s %7s %7s | %8s %8s %6s" % (
-        "shape", "fwd ms", "dgrad", "wgrad", "roof ms", "TF/s", "eff"))
-    for label, h, w, cin, cout, k, stride in conv_shapes():
+    print("%-22s %3s %7s %7s %7s | %8s %8s %6s" % (
+        "shape", "x", "fwd ms", "dgrad", "wgrad", "roof ms", "TF/s",
+        "eff"))
+    for label, h, w, cin, cout, k, stride, count in conv_shapes():
         ho, wo = h // stride, w // stride
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(B, h, w, cin), DT)
@@ -119,47 +133,90 @@ def run_layers(k1, k2):
                 xx, ww, (stride, stride), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
-        # fwd: chain through x perturbation (keep shapes static)
-        def mk_fwd(K):
-            def loop(x0):
-                def body(xc, _):
-                    y = fwd(xc, wt)
-                    # fold output back into input so nothing hoists
-                    xc = xc * (1 + 1e-12 * jnp.mean(y).astype(DT))
-                    return xc, ()
-                return jax.lax.scan(body, x0, None, length=K)[0]
-            return loop
+        # second-application constants: differ from the first ONLY in
+        # small operands (weights / cotangent constants) so no CSE fires
+        # and the 2-vs-1 delta is exactly one extra conv — everything
+        # else in the body (carry update, any shared scalar scaling) is
+        # identical between the two scans and cancels
+        wt2 = wt * DT(1.01)
+        dy2 = dy * DT(1.01)
 
-        # dgrad/wgrad via vjp of the conv alone
-        def mk_grad(K, which):
-            def loop(dy0):
-                def body(dc, _):
-                    _, vjp = jax.vjp(fwd, x, wt)
-                    dx, dw = vjp(dc)
-                    g = dx if which == "dgrad" else dw
-                    dc = dc * (1 + 1e-12 * jnp.mean(g).astype(DT))
-                    return dc, ()
-                return jax.lax.scan(body, dy0, None, length=K)[0]
-            return loop
+        def measure(op_out):
+            """op_out(xc, i, C) -> scalar folding application i's
+            result (C = the big operands, passed as jit ARGS — captured
+            device constants would be re-uploaded inside the program
+            body and trip the tunnel's request-size limit); timed with
+            1 vs 2 applications inside an identical chain at the same
+            scan length K (dispatch + chain tax cancel)."""
+            def mk(n):
+                def loop(x0, C):
+                    def body(xc, _):
+                        acc = op_out(xc, 0, C)
+                        if n == 2:
+                            acc = acc + op_out(xc, 1, C)
+                        xc = xc * (1 + 1e-12 * acc.astype(DT))
+                        return xc, ()
+                    return jax.lax.scan(body, x0, None, length=K)[0]
+                return loop
+            C = (wt, wt2, dy, dy2)
+            def timed(loopfn):
+                f = jax.jit(loopfn)
+                r = f(x, C)
+                hard_sync(r)
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.time()
+                    r = f(x, C)
+                    hard_sync(r)
+                    best = min(best, time.time() - t0)
+                return best
+            t1 = timed(mk(1))
+            t2 = timed(mk(2))
+            return max((t2 - t1) / K * 1e3, 0.0)
 
-        tf_ = diff_time(mk_fwd, x, k1, k2)
-        tdg = diff_time(lambda K: mk_grad(K, "dgrad"), dy, k1, k2)
-        twg = diff_time(lambda K: mk_grad(K, "wgrad"), dy, k1, k2)
+        def fwd_out(xc, i, C):
+            wtA, wtB, _, _ = C
+            return jnp.mean(fwd(xc, wtA if i == 0 else wtB))
+
+        def dgrad_out(xc, i, C):
+            # dx = conv_transpose(dy, w) is independent of the input,
+            # so chain through a cheap carry-derived scalar on dy.
+            # dyc is identical for both applications (CSE merges it),
+            # so the 2-vs-1 delta stays one conv.
+            wtA, wtB, dyA, _ = C
+            s = jnp.sum(xc[0, 0, 0]).astype(DT)
+            dyc = dyA * (1 + 1e-12 * s)
+            _, vjp_x = jax.vjp(
+                lambda xx: fwd(xx, wtA if i == 0 else wtB), xc)
+            (dx,) = vjp_x(dyc)
+            return jnp.mean(dx)
+
+        def wgrad_out(xc, i, C):
+            # dw = x (*) dy depends on the carried input directly
+            wtA, _, dyA, dyB = C
+            _, vjp_w = jax.vjp(lambda ww: fwd(xc, ww), wtA)
+            (dw,) = vjp_w(dyA if i == 0 else dyB)
+            return jnp.mean(dw)
+
+        tf_ = measure(fwd_out)
+        tdg = measure(dgrad_out)
+        twg = measure(wgrad_out)
 
         flops, bytes_ = conv_cost(h, w, cin, cout, k, stride)
         roof_ms = max(flops / PEAK_TF, bytes_ / PEAK_BW) * 1e3
         tfs = flops / (tf_ * 1e-3) / 1e12 if tf_ > 0 else float("inf")
         eff = roof_ms / tf_ if tf_ > 0 else float("inf")
-        rows.append((label, tf_, tdg, twg, roof_ms, tfs, eff))
-        print("%-22s %7.3f %7.3f %7.3f | %8.3f %8.1f %5.0f%%" % (
-            label, tf_, tdg, twg, roof_ms, tfs, eff * 100))
-    tot_f = sum(r[1] for r in rows)
-    tot_d = sum(r[2] for r in rows)
-    tot_w = sum(r[3] for r in rows)
-    tot_roof = sum(r[4] for r in rows)
-    print("-" * 78)
-    print("%-22s %7.3f %7.3f %7.3f | roofline(all three)=%.2f ms" % (
-        "TOTAL (unique shapes)", tot_f, tot_d, tot_w, 3 * tot_roof))
+        rows.append((label, count, tf_, tdg, twg, roof_ms, tfs, eff))
+        print("%-22s %3d %7.3f %7.3f %7.3f | %8.3f %8.1f %5.0f%%" % (
+            label, count, tf_, tdg, twg, roof_ms, tfs, eff * 100),
+            flush=True)
+    tot_f = sum(r[1] * r[2] for r in rows)
+    tot_d = sum(r[1] * r[3] for r in rows)
+    tot_w = sum(r[1] * r[4] for r in rows)
+    tot_roof = sum(r[1] * r[5] for r in rows)
+    print("-" * 82)
+    print("%-26s %7.3f %7.3f %7.3f | weighted roofline(x3)=%.2f ms"
+          % ("WEIGHTED TOTAL", tot_f, tot_d, tot_w, 3 * tot_roof))
     return rows
 
 
@@ -224,9 +281,9 @@ def make_model(bn_mode="f32", stem="conv7", pool="max", relu=True,
         rng = np.random.RandomState(0)
 
         def W(*s):
-            # s given HWIO; transpose for NCHW's OIHW weights
+            # s given HWIO; transpose 4-D conv weights to OIHW for NCHW
             w = rng.randn(*s) * (1.0 / np.sqrt(np.prod(s[:-1])))
-            if layout == "NCHW":
+            if layout == "NCHW" and w.ndim == 4:
                 w = w.transpose(3, 2, 0, 1)
             return jnp.asarray(w, DT)
 

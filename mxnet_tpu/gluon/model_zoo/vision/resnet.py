@@ -12,15 +12,63 @@ from ... import nn
 from ....base import MXNetError
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
-           "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
-           "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
-           "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
-           "get_resnet"]
+           "BottleneckV1", "BottleneckV2", "SpaceToDepthStem",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+           "resnet101_v2", "resnet152_v2", "get_resnet"]
 
 
 def _conv3x3(channels, stride, in_channels):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                      use_bias=False, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """TPU stem rewrite (opt-in via ``stem_s2d=True``): space-to-depth
+    block 2 followed by a 4x4 stride-1 conv over 12 channels — an EXACT
+    reparameterization of the 7x7/s2 3-channel stem (the standard TPU
+    ResNet transformation: a stride-2 conv on 3 input channels maps
+    badly onto the 128-lane MXU; stride-1 on 12 channels maps better).
+
+    Derivation: with ``i-3 = 2a + di`` (taps i in [0,7), parity di in
+    {0,1}, a in [-2,2)), ``x[2y+i-3]`` becomes ``z[y+a]`` at s2d
+    channel (di, dj, c), so the 7x7/s2 conv equals a 4x4/s1 conv with
+    asymmetric padding (2,1) on the s2d tensor.  ``convert_weight``
+    maps trained 7x7 weights into this layout losslessly
+    (exactness pinned by tests/test_gluon.py::test_s2d_stem_exact).
+
+    Measured on v5e-1 (benchmark/resnet_roofline.py): ~1% whole-step
+    win on ResNet-50 bf16 b128 training (docs/perf.md round 5).
+    """
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, kernel_size=4, strides=1,
+                                  padding=0, use_bias=False,
+                                  in_channels=12)
+
+    def hybrid_forward(self, F, x):
+        z = F.space_to_depth(x, block_size=2)
+        z = F.pad(z, mode="constant", constant_value=0,
+                  pad_width=(0, 0, 0, 0, 2, 1, 2, 1))
+        return self.conv(z)
+
+    @staticmethod
+    def convert_weight(w7):
+        """(O, C, 7, 7) trained stem weights -> (O, 4C, 4, 4)."""
+        import numpy as np
+        O, C = w7.shape[:2]
+        w4 = np.zeros((O, 4 * C, 4, 4), w7.dtype)
+        for di in range(2):
+            for dj in range(2):
+                for a in range(-2, 2):
+                    for b in range(-2, 2):
+                        i, j = 2 * a + di + 3, 2 * b + dj + 3
+                        if 0 <= i < 7 and 0 <= j < 7:
+                            w4[:, (di * 2 + dj) * C:(di * 2 + dj + 1)
+                               * C, a + 2, b + 2] = w7[:, :, i, j]
+        return w4
 
 
 class BasicBlockV1(HybridBlock):
@@ -146,7 +194,7 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -154,8 +202,11 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                if stem_s2d:
+                    self.features.add(SpaceToDepthStem(channels[0]))
+                else:
+                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                                use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -186,7 +237,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -195,8 +246,11 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                if stem_s2d:
+                    self.features.add(SpaceToDepthStem(channels[0]))
+                else:
+                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                                use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))

@@ -410,7 +410,7 @@ def _prefetch_decode_super(st):
     tag a fresh-epoch superbatch with the old epoch."""
     with st.lock:
         epoch = st.epoch
-        ds, ls = [], []
+        ds, ls, pad = [], [], 0
         for _ in range(st.S):
             try:
                 b = st.iter.next()
@@ -418,10 +418,11 @@ def _prefetch_decode_super(st):
                 return epoch, None   # end of epoch (partial S dropped)
             ds.append([d.asnumpy() for d in b.data])
             ls.append([l.asnumpy() for l in b.label])
+            pad += int(b.pad or 0)
     n_d, n_l = len(ds[0]), len(ls[0])
     data = [_np.stack([row[i] for row in ds]) for i in range(n_d)]
     label = [_np.stack([row[i] for row in ls]) for i in range(n_l)]
-    return epoch, (data, label)
+    return epoch, (data, label, pad)
 
 
 def _prefetch_put(st, item):
@@ -442,15 +443,18 @@ def _prefetch_worker(st):
             if host is None:
                 item = None
             else:
-                data, label = host
+                data, label, pad = host
                 # the upload happens HERE, in the prefetch thread:
                 # nd.array device_puts the numpy buffer directly
                 # (round-4 fix), and PjRt async dispatch lets it
-                # proceed under the consumer's in-flight run_steps
+                # proceed under the consumer's in-flight run_steps.
+                # pad = total padded (wrapped-duplicate) samples
+                # across the S stacked batches, so consumers can
+                # down-weight them as with any padded DataBatch.
                 item = DataBatch(
                     data=[nd.array(d, ctx=st.ctx) for d in data],
                     label=[nd.array(l, ctx=st.ctx) for l in label],
-                    pad=0, index=None)
+                    pad=pad, index=None)
         except Exception as e:       # deferred-exception contract: the
             item = e                 # consumer rethrows in next()
             with st.lock:
@@ -527,6 +531,7 @@ class DevicePrefetchIter(DataIter):
         self.S = int(super_size)
         self.batch_size = getattr(base_iter, "batch_size", 0)
         self.current_batch = None
+        self._exhausted = False
         st = self._st = _PrefetchState()
         st.iter = base_iter
         st.S = self.S
@@ -542,13 +547,20 @@ class DevicePrefetchIter(DataIter):
     # -- consumer -----------------------------------------------------------
     def next(self):
         st = self._st
+        # an exhausted (or closed / worker-failed) iterator keeps
+        # raising StopIteration until reset() — the worker is parked
+        # then, so blocking on the queue would deadlock the consumer
+        if self._exhausted or st.stop:
+            raise StopIteration
         while True:
             epoch, item = st.q.get()
             if epoch != st.epoch:
                 continue             # stale item decoded before reset()
             if item is None:
+                self._exhausted = True
                 raise StopIteration
             if isinstance(item, Exception):
+                self._exhausted = True   # worker parked; reset() re-arms
                 raise MXNetError(
                     "DevicePrefetchIter worker failed: %r" % item) \
                     from item
@@ -563,6 +575,7 @@ class DevicePrefetchIter(DataIter):
         with st.lock:
             st.epoch += 1
             st.iter.reset()
+        self._exhausted = False
         st.go.set()
 
     def close(self):

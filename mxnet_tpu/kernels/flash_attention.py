@@ -65,6 +65,23 @@ def _dropout_keep(bh, q_pos, k_pos, seed, rate):
     return x >= u(min(int(rate * 4294967296.0), 4294967295))
 
 
+def dense_keep_mask(B, H, T, seed, rate):
+    """Dense (B, H, T, T) positional-hash keep mask — the SAME stream
+    the fused kernels regenerate blockwise from positions.  Single
+    construction point for every dense consumer (the jnp fallback
+    below, the transformer's non-flash path, the parity oracle), so
+    the 'one dropout semantics across all paths' invariant cannot
+    drift (round-5 review).  ``seed``: int32 scalar."""
+    import jax
+    import jax.numpy as jnp
+    pos = jnp.arange(T, dtype=jnp.int32)
+    bh = (jnp.arange(B, dtype=jnp.uint32)[:, None] * jnp.uint32(H)
+          + jnp.arange(H, dtype=jnp.uint32)[None, :]).reshape(-1)
+    keep = jax.vmap(lambda b: _dropout_keep(b, pos, pos, seed,
+                                            float(rate)))(bh)
+    return keep.reshape(B, H, T, T)
+
+
 def _kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
             block_k, sm_scale, causal, dropout):
     import jax
@@ -381,12 +398,7 @@ def _reference_attention(q, k, v, mask, causal=False, dropout=0.0,
         # dense — the fallback and the kernel paths drop identical
         # entries for a given seed (and this is the parity oracle)
         B, T, H, _ = q.shape
-        pos = jnp.arange(T, dtype=jnp.int32)
-        bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
-              + jnp.arange(H, dtype=jnp.int32)[None, :])   # (B, H)
-        keep = jax.vmap(lambda b: _dropout_keep(
-            b, pos, pos, seed[0], dropout))(bh.reshape(-1))
-        keep = keep.reshape(B, H, T, T)
+        keep = dense_keep_mask(B, H, T, seed[0], dropout)
         probs = jnp.where(keep, probs, 0).astype(q.dtype) \
             * (1.0 / (1.0 - dropout))
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)

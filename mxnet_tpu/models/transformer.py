@@ -240,17 +240,18 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
             return flash_attention(q, k, v, mask=mask, causal=cfg.causal)
         except Exception:
             # kernel failure → jnp fallback below; log once so a
-            # kernel regression can't silently change RNG semantics
-            # (round-4 advisor)
+            # kernel regression can't silently degrade performance
+            # (round-4 advisor).  Since round 5 the fallback applies
+            # the SAME positional-hash dropout mask as the kernels, so
+            # only speed changes, not RNG semantics.
             global _FLASH_FALLBACK_LOGGED
             if not _FLASH_FALLBACK_LOGGED:
                 _FLASH_FALLBACK_LOGGED = True
                 import logging
                 logging.getLogger(__name__).warning(
                     "flash_attention failed; falling back to the jnp "
-                    "attention path (bernoulli dropout mask). "
-                    "Set MXNET_FLASH_DEBUG=1 to re-raise instead.",
-                    exc_info=True)
+                    "attention path. Set MXNET_FLASH_DEBUG=1 to "
+                    "re-raise instead.", exc_info=True)
             import os
             if os.environ.get("MXNET_FLASH_DEBUG", "0") == "1":
                 raise
@@ -265,11 +266,18 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, mesh=None,
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
     if dropout_key is not None and cfg.dropout > 0:
-        # same attention-probability dropout as the flash path — the
-        # non-flash reference must not silently train with weaker
-        # regularization than the same cfg under use_flash
-        keep = jax.random.bernoulli(dropout_key, 1 - cfg.dropout,
-                                    probs.shape)
+        # the SAME positional-hash keep mask the fused flash kernels
+        # regenerate (kernels/flash_attention.dense_keep_mask), seeded
+        # identically — one dropout semantics across both paths, and
+        # the hash is pure fusable integer elementwise over iotas, so
+        # XLA folds it into the probs consumer instead of generating
+        # and materializing (B, H, T, T) RNG uniforms (measured: the
+        # bernoulli mask cost ~22% of the bert-base step — round 5)
+        from ..kernels.flash_attention import dense_keep_mask
+        B, T, H, _ = q.shape
+        seed = jax.random.randint(dropout_key, (), 0, 2**31 - 1,
+                                  jnp.int32)
+        keep = dense_keep_mask(B, H, T, seed, cfg.dropout)
         probs = jnp.where(keep, probs / (1 - cfg.dropout),
                           0).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

