@@ -515,6 +515,15 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
     grad_shardings = (param_shardings(cfg, mesh)
                       if mesh is not None and mesh.size > 1 else None)
 
+    # NOTE (round 5): constraining grads to the ZeRO-1 dp-composed
+    # sharding here instead was tried and REVERTED — under dp·sp·tp it
+    # fights the shardings the backward propagates and retriggers
+    # "Involuntary full rematerialization" (caught by
+    # test_multichip_dryrun_no_involuntary_remat).  It is also
+    # unnecessary: with the moments sharded, GSPMD already consumes
+    # the grad psum shard-wise under plain dp — the reduce-scatter-
+    # equivalent pattern — as pinned by tests/test_collective_matrix.py.
+
     def step(state, batch, rng):
         params, opt_state = state
         if cfg.fast_rng and cfg.dropout > 0:
