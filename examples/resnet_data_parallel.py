@@ -64,6 +64,29 @@ def main():
              args.batch_size * args.iters / dt))
     trainer.sync_back()   # write trained params into the Gluon block
 
+    # --- the same loop fed by the prefetch-to-device pipeline --------
+    # DevicePrefetchIter decodes + stacks `super_size` batches and
+    # uploads the (S, B, ...) superbatch in a background thread while
+    # the device still runs the previous run_steps dispatch — the
+    # production input path (docs/perf.md "End-to-end pipeline").
+    from mxnet_tpu.io import DevicePrefetchIter, NDArrayIter
+    n = args.batch_size * 8
+    X = rng.randn(n, 3, S, S).astype("float32")
+    Y = rng.randint(0, 1000, (n,))
+    pf = DevicePrefetchIter(NDArrayIter(X, Y,
+                                        batch_size=args.batch_size),
+                            super_size=4, ctx=ctx)
+    for epoch in range(2):
+        for batch in pf:
+            losses = trainer.run_steps(batch.data[0], batch.label[0])
+        if epoch == 0:
+            pf.reset()     # between epochs only — a final reset would
+                           # re-arm the worker for a wasted decode+H2D
+    trainer.sync()
+    trainer.sync_back()    # the block now holds the trained params
+    print("prefetch-pipeline loss %.4f" % float(losses[-1].asnumpy()))
+    pf.close()
+
 
 if __name__ == "__main__":
     main()
