@@ -285,3 +285,20 @@ def test_resnet_stem_s2d_builds():
         out = net(nd.array(np.random.RandomState(0).randn(
             2, 3, 64, 64).astype("float32")))
         assert out.shape == (2, 10)
+
+
+def test_s2d_stem_hybridize():
+    """The s2d stem traces under hybridize() (space_to_depth + pad +
+    conv all compose into the cached graph) with identical outputs."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10, stem_s2d=True)
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(
+        2, 3, 64, 64).astype("float32"))
+    y0 = net(x).asnumpy()
+    net.hybridize()
+    y1 = net(x).asnumpy()
+    y2 = net(x).asnumpy()           # cached-graph path
+    assert np.allclose(y0, y1, atol=1e-5)
+    assert np.allclose(y1, y2)
