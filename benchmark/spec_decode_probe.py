@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Speculative-decode probe: accept-rate × K ablation (round 6).
+
+Measures ``models/gpt.py generate_speculative`` against plain
+``generate`` on the GPT-2-small-class decode config (12L, d768, V32k,
+weight-only int8 — the single-stream record holder), batches 1 and 8.
+Speculation changes the benchmark definition itself: the unit is
+*accepted tokens per verify step*, so every row reports the accept
+rate alongside tok/s.
+
+Three sections:
+
+``--micro``   per-term costs: one ``_decode_one`` step vs one
+              ``_decode_block`` verify step of S=K+1 tokens, scanned
+              on-device with the caches as the chained carry.  The
+              ratio c_S/c_1 is the break-even accept count: ngram
+              speculation (zero draft cost) wins iff accepted-per-iter
+              + 1 > c_S/c_1.
+``--e2e``     end-to-end tok/s + accept rate per (batch, K, drafter,
+              workload), differenced n_lo/n_hi-token timings
+              (docs/perf.md "Methodology").  Drafters: ``ngram``
+              (prompt-lookup, zero cost), ``self`` (2-layer slice of
+              the target, w8).  Workloads: ``random`` (i.i.d. prompt —
+              adversarial floor) and ``loop`` (repeating-pattern
+              prompt — prompt-lookup's favorable regime).
+``--calib``   accept-rate calibration: the full target as its own
+              drafter.  Greedy accept would be 1.0 if draft and verify
+              logits were bitwise equal; the draft path runs
+              ``_decode_one`` while verify runs ``_decode_block``, so
+              the measured shortfall (0.79–0.96 on the random-init
+              checkpoint, whose near-flat logits make argmax ties
+              cheap to flip) is exactly the block-vs-single
+              reduction-order argmax-flip rate.  A LOW rate here
+              (< ~0.7) is an accept-plumbing bug, not a workload
+              property; rollback correctness is unaffected either way
+              (the rejected-is-replayed path is the gated one).
+
+Usage::
+
+    python benchmark/spec_decode_probe.py                # all sections
+    python benchmark/spec_decode_probe.py --micro --json out.json
+    python benchmark/spec_decode_probe.py --quick        # small model smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _real_cfg(quick=False):
+    from mxnet_tpu.models import gpt
+    if quick:
+        return gpt.gpt_config(vocab_size=512, max_len=512, d_model=64,
+                              n_heads=4, n_layers=2, d_ff=128,
+                              dropout=0.0, use_flash=False, remat=False)
+    return gpt.gpt_config(vocab_size=32000, max_len=512, d_model=768,
+                          n_heads=12, n_layers=12, d_ff=3072,
+                          dropout=0.0, use_flash=False, remat=False)
+
+
+def _prompts(cfg, batch, workload):
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    if workload == "random":
+        return jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, 8)),
+                           jnp.int32)
+    # loop: a short repeating pattern — the structured-text proxy.
+    # Offsetting per row keeps rows distinct (accepts are
+    # batch-min-synchronized, so identical rows would overstate them).
+    pat = np.array([7, 23, 99, 5], np.int64)
+    buf = np.stack([(pat + 3 * b) % cfg.vocab_size for b in
+                    range(batch)])
+    return jnp.asarray(np.tile(buf, (1, 4)), jnp.int32)   # (B, 16)
+
+
+def micro_block_cost(cfg, params, batch, Ks, steps=40, reps=3):
+    """ms per _decode_one step vs per _decode_block(S) verify step.
+    Scanned on-device; the caches chain through the carry so XLA cannot
+    hoist the body (perf.md Methodology hazard #3)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+
+    P = 8
+    rows = []
+    for S in [1] + [k + 1 for k in Ks]:
+        total = P + steps * S
+        if total > cfg.max_len:
+            steps_s = (cfg.max_len - P) // S
+        else:
+            steps_s = steps
+        prompt = _prompts(cfg, batch, "random")[:, :P]
+
+        @jax.jit
+        def run(params, prompt):
+            logits, caches = gpt._prefill_full(params, cfg, prompt,
+                                               P + steps_s * S)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+
+            def body(carry, i):
+                tok, caches = carry
+                if S == 1:
+                    lg, caches = gpt._decode_one(params, cfg, tok,
+                                                 P + i, caches)
+                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                else:
+                    blk = jnp.tile(tok[:, None], (1, S))
+                    lg, caches = gpt._decode_block(params, cfg, blk,
+                                                   P + i * S, caches)
+                    nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                return (nxt, caches), ()
+
+            (tok, _), _ = jax.lax.scan(body, (tok, caches),
+                                       jnp.arange(steps_s))
+            return tok
+
+        r = run(params, prompt)
+        jax.device_get(r.ravel()[:1])
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.time()
+            r = run(params, prompt)
+            jax.device_get(r.ravel()[:1])
+            best = min(best, time.time() - t0)
+        ms = best / steps_s * 1e3
+        rows.append({"section": "micro", "batch": batch, "S": S,
+                     "ms_per_step": round(ms, 3), "steps": steps_s})
+        print("  micro b%-3d S=%d  %7.2f ms/step%s"
+              % (batch, S, ms,
+                "" if S == 1 else "  (c_S/c_1 = %.2f)"
+                % (ms / rows[0]["ms_per_step"])), flush=True)
+    return rows
+
+
+def _timed_spec(fn, reps=2):
+    import jax
+    out, st = fn()
+    jax.device_get(out.ravel()[:1])
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.time()
+        out, st = fn()
+        jax.device_get(out.ravel()[:1])
+        best = min(best, time.time() - t0)
+    return best, {k: int(v) for k, v in st.items()}
+
+
+def e2e(cfg, params, batch, Ks, n_lo, n_hi, calib=False, sweep=True):
+    """Differenced n_lo/n_hi tok/s + accept rates for each config.
+    ``sweep=False`` (the --calib-only invocation) runs just the
+    baseline + calibration rows."""
+    import jax
+    from mxnet_tpu.models import gpt
+
+    qparams = gpt.quantize_decode_params(params)
+    rows = []
+
+    def record(name, K, drafter, workload, run):
+        t_lo, _ = _timed_spec(lambda: run(n_lo))
+        t_hi, st = _timed_spec(lambda: run(n_hi))
+        dt = t_hi - t_lo
+        tok_s = batch * (n_hi - n_lo) / dt if dt > 0 else float("nan")
+        acc = st["accepted"] / max(st["drafted"], 1)
+        per_iter = st["tokens"] / max(st["iters"], 1)
+        rows.append({"section": "e2e", "config": name, "batch": batch,
+                     "K": K, "drafter": drafter, "workload": workload,
+                     "tok_s": round(tok_s, 1),
+                     "accept_rate": round(acc, 3),
+                     "tokens_per_iter": round(per_iter, 3),
+                     "iters": st["iters"]})
+        print("  %-26s b%-3d  %8.1f tok/s   accept %.2f  "
+              "tokens/iter %.2f" % (name, batch, tok_s, acc, per_iter),
+              flush=True)
+
+    # baseline: plain generate, w8
+    def base(workload):
+        prompt = _prompts(cfg, batch, workload)
+
+        def run(n):
+            out = gpt.generate(qparams, cfg, prompt, n)
+            return out, {"iters": n, "drafted": 0, "accepted": 0,
+                         "tokens": n}
+        return run
+
+    record("generate_w8", 0, "-", "random", base("random"))
+
+    if calib:
+        # full target as its own drafter — near-1.0 greedy accepts;
+        # the shortfall measures block-vs-single argmax flips (see
+        # module docstring), a low rate flags accept-plumbing bugs
+        prompt = _prompts(cfg, batch, "random")
+        K = Ks[len(Ks) // 2]
+
+        def run(n):
+            return gpt.generate_speculative(
+                qparams, cfg, prompt, n, K=K, drafter="self",
+                draft_params=qparams, draft_cfg=cfg, return_stats=True)
+        record("spec_self_full(calib)", K, "self", "random", run)
+
+    if not sweep:
+        return rows
+
+    for workload in ("random", "loop"):
+        prompt = _prompts(cfg, batch, workload)
+        for K in Ks:
+            def run(n, K=K, prompt=prompt):
+                return gpt.generate_speculative(
+                    qparams, cfg, prompt, n, K=K, drafter="ngram",
+                    return_stats=True)
+            record("spec_ngram_K%d" % K, K, "ngram", workload, run)
+
+    # self drafter: 2-layer slice of the target, w8 (no extra weights)
+    dparams, dcfg = gpt.draft_slice_params(params, cfg, n_layers=2)
+    qd = gpt.quantize_decode_params(dparams)
+    for workload in ("random", "loop"):
+        prompt = _prompts(cfg, batch, workload)
+        K = Ks[len(Ks) // 2]
+
+        def run(n, prompt=prompt):
+            return gpt.generate_speculative(
+                qparams, cfg, prompt, n, K=K, drafter="self",
+                draft_params=qd, draft_cfg=dcfg, return_stats=True)
+        record("spec_self2L_w8_K%d" % K, K, "self", workload, run)
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="speculative decode probe")
+    p.add_argument("--micro", action="store_true")
+    p.add_argument("--e2e", action="store_true")
+    p.add_argument("--calib", action="store_true")
+    p.add_argument("--quick", action="store_true",
+                   help="tiny model (smoke test of the harness itself)")
+    p.add_argument("--batches", default="1,8")
+    p.add_argument("--ks", default="2,4,8")
+    p.add_argument("--n-lo", type=int, default=64)
+    p.add_argument("--n-hi", type=int, default=448)
+    p.add_argument("--json", default=None)
+    args = p.parse_args(argv)
+    if not (args.micro or args.e2e or args.calib):
+        args.micro = args.e2e = args.calib = True
+
+    import jax
+    from mxnet_tpu.models import gpt
+    print("backend:", jax.devices()[0].platform, flush=True)
+
+    cfg = _real_cfg(args.quick)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    Ks = [int(k) for k in args.ks.split(",")]
+    n_lo, n_hi = args.n_lo, args.n_hi
+    if args.quick:
+        n_lo, n_hi = 16, 64
+
+    all_rows = []
+    for batch in [int(b) for b in args.batches.split(",")]:
+        if args.micro:
+            print("== micro (b%d): per-step decode vs verify-block "
+                  "cost ==" % batch, flush=True)
+            qparams = gpt.quantize_decode_params(params)
+            all_rows += micro_block_cost(cfg, qparams, batch, Ks)
+        if args.e2e or args.calib:
+            print("== e2e (b%d): tok/s and accept rate ==" % batch,
+                  flush=True)
+            all_rows += e2e(cfg, params, batch, Ks, n_lo, n_hi,
+                            calib=args.calib, sweep=args.e2e)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(all_rows, f, indent=1)
+        print("wrote", args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

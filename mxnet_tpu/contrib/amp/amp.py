@@ -77,6 +77,8 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
         lists.TARGET_DTYPE_OPS.extend(target_precision_ops)
     if fp32_ops:
         lists.FP32_OPS.extend(fp32_ops)
+    if target_precision_ops or fp32_ops:
+        lists._rebuild_sets()   # keep lists.classify() in sync
     _registry.set_cast_hook(_make_hook(target_dtype))
     _state["initialized"] = True
     _state["target_dtype"] = target_dtype

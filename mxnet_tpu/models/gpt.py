@@ -18,7 +18,8 @@ from typing import Any, Dict, Optional
 from . import transformer as T
 
 __all__ = ["gpt_config", "gpt_tiny", "init_params", "forward",
-           "make_train_step", "generate", "quantize_decode_params"]
+           "make_train_step", "generate", "generate_speculative",
+           "quantize_decode_params", "draft_slice_params"]
 
 
 def gpt_config(**kw):
@@ -389,6 +390,425 @@ def _decode_one(params, cfg, token, pos, caches):
         logits = (h @ emb.T.astype(cdt)).astype(jnp.float32)
     logits = logits + params["mlm_bias"].astype(jnp.float32)
     return logits.astype(jnp.float32), new_caches
+
+
+def _decode_block(params, cfg, tokens, pos, caches):
+    """Batched multi-token decode step (the speculative-verify forward):
+    ``tokens`` is (B, S) int32 occupying positions [pos, pos+S) — ONE
+    causal forward over the block against the KV caches, instead of S
+    sequential ``_decode_one`` steps.
+
+    Writes the block's k/v into the caches at [pos, pos+S) FIRST, then
+    attends with the per-row causal mask (block row i sees cache slots
+    <= pos+i) — so ``_decode_one`` is exactly the S=1 special case.
+    ``_decode_one`` deliberately stays a SEPARATE implementation, not
+    an S=1 wrapper: its squeezed (B, D) formulation is the compiled
+    shape behind the recorded on-chip decode rates, which this round
+    cannot re-measure (keep the three copies of the layer block —
+    here, ``_decode_one``, ``_prefill_full`` — in sync by hand).
+    Returns (logits (B, S, V) f32, new caches).  Handles the same
+    weight formats (float / weight-only int8) and both KV-cache layouts
+    ({"kv"} float, {"kv","s"} int8) as ``_decode_one``.
+
+    Stale cache slots beyond the committed length need no active
+    rollback: the next block write at the committed position overwrites
+    them before any mask ever exposes them (the speculative loop's
+    rollback-by-pointer contract, tested by
+    ``test_spec_rollback_forced_rejections``)."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(cfg.dtype)
+    B, S = tokens.shape
+    D, H = cfg.d_model, cfg.n_heads
+    dh = D // H
+
+    x = _embed(params, tokens, cdt)                    # (B, S, D)
+    x = x + jax.lax.dynamic_slice(
+        params["pos_emb"], (pos, 0),
+        (S, D)).astype(cdt)[None]
+    x = T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                      params["emb_ln"]["b"].astype(cdt))
+
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        def dn(w):
+            return w.astype(cdt)
+        qkv = _qkv(layer, x, cdt)                      # (B, S, 3D)
+        q = qkv[:, :, :D].reshape(B, S, H, dh) \
+            .transpose(0, 2, 1, 3).reshape(B * H, S, dh)
+        k = qkv[:, :, D:2 * D].reshape(B, S, H, dh) \
+            .transpose(0, 2, 1, 3).reshape(B * H, S, dh)
+        v = qkv[:, :, 2 * D:].reshape(B, S, H, dh) \
+            .transpose(0, 2, 1, 3).reshape(B * H, S, dh)
+        if "s" in cache:
+            # int8 KV cache: per-(row, token) symmetric s8, scales
+            # folded into the dots exactly as in _decode_one
+            sk = jnp.maximum(jnp.max(jnp.abs(k), axis=2) / 127.0, 1e-8)
+            sv = jnp.maximum(jnp.max(jnp.abs(v), axis=2) / 127.0, 1e-8)
+            kq = jnp.clip(jnp.round(k / sk[:, :, None]), -127, 127
+                          ).astype(jnp.int8)
+            vq = jnp.clip(jnp.round(v / sv[:, :, None]), -127, 127
+                          ).astype(jnp.int8)
+            ckv = jax.lax.dynamic_update_slice(
+                cache["kv"], jnp.concatenate([kq, vq], axis=2),
+                (0, pos, 0))
+            cs = jax.lax.dynamic_update_slice(
+                cache["s"],
+                jnp.stack([sk, sv], axis=2).astype(jnp.float32),
+                (0, pos, 0))
+            new_caches.append({"kv": ckv, "s": cs})
+            L = ckv.shape[1]
+            s = jax.lax.dot_general(
+                ckv[:, :, :dh].astype(cdt), q,
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, L, S)
+            s = s * cs[:, :, 0][:, :, None] / jnp.sqrt(jnp.float32(dh))
+            valid = jnp.arange(L)[None, :, None] <= \
+                pos + jnp.arange(S)[None, None, :]
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=1)
+            attn = jax.lax.dot_general(
+                (p * cs[:, :, 1][:, :, None]).astype(cdt),
+                ckv[:, :, dh:].astype(cdt),
+                (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, S, dh)
+        else:
+            ckv = jax.lax.dynamic_update_slice(
+                cache["kv"],
+                jnp.concatenate([k, v], axis=2).astype(cdt),
+                (0, pos, 0))
+            new_caches.append({"kv": ckv})
+            L = ckv.shape[1]
+            s = jax.lax.dot_general(
+                ckv[:, :, :dh], q, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, L, S)
+            s = s / jnp.sqrt(jnp.float32(dh))
+            valid = jnp.arange(L)[None, :, None] <= \
+                pos + jnp.arange(S)[None, None, :]
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=1).astype(cdt)
+            attn = jax.lax.dot_general(
+                p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # (B*H, S, dh)
+        attn = attn.astype(cdt).reshape(B, H, S, dh) \
+            .transpose(0, 2, 1, 3).reshape(B, S, D)
+        attn = _wmm(attn, layer["wo"], cdt) + dn(layer["bo"])
+        x = T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
+                          dn(layer["ln1"]["b"]))
+        if "moe" in layer:
+            from ..parallel.moe import moe_ffn
+            h, _ = moe_ffn(x, layer["moe"], n_experts=cfg.n_experts,
+                           top_k=cfg.expert_top_k,
+                           capacity_factor=cfg.capacity_factor,
+                           dtype=cdt)
+        else:
+            h = jax.nn.gelu(_wmm(x, layer["w1"], cdt) + dn(layer["b1"]),
+                            approximate=True)
+            h = _wmm(h, layer["w2"], cdt) + dn(layer["b2"])
+        x = T._layer_norm(x + h, dn(layer["ln2"]["g"]),
+                          dn(layer["ln2"]["b"]))
+
+    return _lm_head(params, x, cdt), new_caches       # (B, S, V) f32
+
+
+def draft_slice_params(params, cfg, n_layers=2):
+    """Self-drafting config (b): the draft model is the target's own
+    first ``n_layers`` decoder layers with the shared embedding / LM
+    head — zero extra weights to train or store, shares the tokenizer
+    and embedding shapes by construction.  Returns (draft_params,
+    draft_cfg) for ``generate_speculative(drafter="self")``; combine
+    with ``quantize_decode_params`` for a w8 draft."""
+    dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+    dparams = dict(params)
+    dparams["layers"] = list(params["layers"][:n_layers])
+    return dparams, dcfg
+
+
+def _draft_ngram(token_buf, n_next, K, g):
+    """Zero-cost prompt-lookup drafter (drafter option (b)): find the
+    most recent earlier occurrence of the last ``g`` committed tokens
+    in the sequence so far and propose the K tokens that followed it
+    (prompt-lookup / n-gram speculation).  Pure vectorized compares —
+    no model forward.  token_buf (B, BUF) with positions [0, n_next)
+    committed; falls back to repeating the last token when no match.
+    Returns (B, K) int32 proposals for positions [n_next, n_next+K)."""
+    import jax
+    import jax.numpy as jnp
+
+    B, BUF = token_buf.shape
+    W = BUF - g + 1                       # candidate window starts
+    key = jax.lax.dynamic_slice(token_buf, (0, n_next - g), (B, g))
+    eq = jnp.ones((B, W), bool)
+    for j in range(g):
+        eq = eq & (token_buf[:, j:W + j] == key[:, j:j + 1])
+    # a usable match must end before the key itself and have its
+    # continuation start inside the committed region
+    starts = jnp.arange(W)[None, :]
+    eq = eq & (starts + g < n_next)
+    score = jnp.where(eq, starts, -1)
+    s_star = jnp.max(score, axis=1)                    # (B,)
+    found = s_star >= 0
+    idx = s_star[:, None] + g + jnp.arange(K)[None, :]
+    # continuation elements past the committed pointer would read
+    # stale-draft slots — fall back to the last committed token there
+    # (proposal quality only; the verify step gates correctness)
+    ok = found[:, None] & (idx < n_next)
+    cand = jnp.take_along_axis(token_buf, jnp.clip(idx, 0, BUF - 1),
+                               axis=1)
+    last = jax.lax.dynamic_slice(token_buf, (0, n_next - 1), (B, 1))
+    return jnp.where(ok, cand,
+                     jnp.broadcast_to(last, (B, K))).astype(jnp.int32)
+
+
+def generate_speculative(params, cfg, prompt, max_new_tokens, *, K=4,
+                         drafter="ngram", draft_params=None,
+                         draft_cfg=None, ngram=2, temperature=0.0,
+                         rng=None, kv_int8=False, return_stats=False):
+    """Speculative (multi-token) generation: draft K candidate tokens
+    per iteration, verify them in ONE batched causal forward on the
+    target model (``_decode_block``), and accept the longest prefix
+    that matches what the target itself would have produced — plus the
+    target's own token at the first mismatch — so every iteration
+    commits 1..K+1 tokens with the OUTPUT DISTRIBUTION OF PLAIN
+    ``generate``: greedy speculative decode is token-identical, and
+    temperature>0 uses the draft-rejection sampling rule (accept d with
+    prob min(1, p(d)/q(d)); on rejection sample the renormalized
+    residual max(p-q, 0)) whose marginals equal target sampling.
+
+    Numerics caveat: "token-identical" is bit-exact under float32
+    compute (``tests/test_gpt.py`` pins it).  Under bfloat16 compute
+    the block-verify and single-step forwards may reduce in different
+    orders, and a 1-ulp argmax tie in the target logits can resolve
+    differently — rare on trained checkpoints (real logit gaps are
+    orders above 1 ulp), common on random-init ones (near-flat
+    logits); same caveat class as the w8 decode parity gates.  The
+    accepted sequence always follows the target's own block-forward
+    argmax exactly.
+
+    Drafters
+    --------
+    ``drafter="ngram"``: zero-cost prompt-lookup — propose the K tokens
+    that followed the most recent earlier occurrence of the last
+    ``ngram`` tokens (no draft model; wins on repetitive/structured
+    text).  ``drafter="self"``: a small self-drafting GPT
+    (``draft_params``/``draft_cfg``, same vocab; e.g.
+    ``draft_slice_params`` for a layer-slice draft, optionally w8 via
+    ``quantize_decode_params``) runs K+1 sequential cached decode steps
+    per iteration.
+
+    Batch semantics: acceptance is synchronized across the batch (the
+    committed pointer advances by ``min`` of the per-row accept counts
+    +1), which keeps the KV caches and position bookkeeping scalar —
+    rows that accepted more simply keep their verified tokens as the
+    next iteration's pending/drafts, so per-row outputs are unchanged.
+    Rejected positions roll back by POINTER only: their cache slots are
+    overwritten by the next block write before any causal mask exposes
+    them.
+
+    The whole prefill + draft + verify + accept loop compiles into one
+    XLA program per shape (``lax.while_loop``), same as ``generate``.
+    Needs ``P + max_new_tokens + K <= cfg.max_len`` (the verify block
+    may overshoot the last position by up to K).
+
+    ``return_stats=True`` additionally returns a dict with ``iters``
+    (verify steps), ``drafted``/``accepted`` (accept rate =
+    accepted/drafted), and ``tokens`` committed — the
+    accepted-tokens-per-verify-step numbers the benchmark gates use.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not cfg.causal:
+        cfg = dataclasses.replace(cfg, causal=True)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if K < 1:
+        raise ValueError("generate_speculative: K must be >= 1")
+    if drafter == "self":
+        if draft_params is None or draft_cfg is None:
+            raise ValueError("drafter='self' needs draft_params and "
+                             "draft_cfg")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError("draft model must share the vocab")
+        if not draft_cfg.causal:
+            draft_cfg = dataclasses.replace(draft_cfg, causal=True)
+    elif drafter != "ngram":
+        raise ValueError("drafter must be 'ngram' or 'self'")
+
+    B, P = prompt.shape
+    if max_new_tokens <= 0:
+        return (prompt, {"iters": 0, "drafted": 0, "accepted": 0,
+                         "tokens": 0}) if return_stats else prompt
+    total = P + max_new_tokens + K      # verify may overshoot by <=K
+    if total > cfg.max_len:
+        raise ValueError(
+            "generate_speculative: %d tokens (incl. K=%d overshoot "
+            "headroom) > cfg.max_len=%d" % (total, K, cfg.max_len))
+    if drafter == "self" and total > draft_cfg.max_len:
+        raise ValueError("draft_cfg.max_len too small: need %d"
+                         % total)
+
+    cache_key = (cfg, B, P, max_new_tokens, K, drafter, draft_cfg,
+                 ngram, float(temperature), bool(kv_int8),
+                 bool(return_stats))
+    cached = _generate_cache.get(cache_key)
+    if cached is not None:
+        return cached(params, draft_params, prompt, rng)
+
+    S = K + 1
+
+    @jax.jit
+    def run(params, draft_params, prompt, rng):
+        f32 = jnp.float32
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits / temperature, axis=-1).astype(jnp.int32)
+
+        logits, caches = _prefill_full(params, cfg, prompt, total,
+                                       kv_int8=kv_int8)
+        rng, sub = jax.random.split(rng)
+        pending = sample(logits, sub)                  # (B,)
+
+        # token_buf holds prompt + committed tokens; slots past the
+        # committed pointer hold stale drafts (never read: the ngram
+        # drafter masks on the committed length)
+        token_buf = jnp.zeros((B, total), jnp.int32)
+        token_buf = jax.lax.dynamic_update_slice(token_buf, prompt,
+                                                 (0, 0))
+        token_buf = jax.lax.dynamic_update_slice(
+            token_buf, pending[:, None], (0, P))
+
+        if drafter == "self":
+            _, dcaches = _prefill_full(draft_params, draft_cfg, prompt,
+                                       total)
+        else:
+            dcaches = None
+
+        def draft_k(dcaches, token_buf, n, pending, key):
+            """Propose drafts (B, K) for positions [n+1, n+K]; returns
+            (dcaches, drafts, q) with q (B, K, V) the draft proposal
+            distributions (None semantics for ngram: one-hot)."""
+            if drafter == "ngram":
+                return dcaches, _draft_ngram(token_buf, n + 1, K,
+                                             ngram), None
+
+            def dstep(carry, i):
+                dc, tok, k2 = carry
+                lg, dc = _decode_one(draft_params, draft_cfg, tok,
+                                     n + i, dc)
+                k2, s2 = jax.random.split(k2)
+                nxt = sample(lg, s2)
+                return (dc, nxt, k2), (nxt, lg)
+
+            # K+1 steps: step i feeds the token at position n+i, so the
+            # draft caches end the iteration filled through n+K (the
+            # all-accepted case needs slot n+K next round); the last
+            # step's proposal is discarded.
+            (dcaches, _, _), (toks, lgs) = jax.lax.scan(
+                dstep, (dcaches, pending, key), jnp.arange(S))
+            drafts = toks[:K].T.astype(jnp.int32)      # (B, K)
+            if temperature == 0.0:
+                q = None
+            else:
+                q = jax.nn.softmax(
+                    lgs[:K].astype(f32) / temperature,
+                    axis=-1).transpose(1, 0, 2)        # (B, K, V)
+            return dcaches, drafts, q
+
+        def body(carry):
+            caches, dcaches, token_buf, pending, emitted, key, \
+                iters, accepted = carry
+            n = P + emitted - 1           # cache position of `pending`
+            key, kd, ka, kr = jax.random.split(key, 4)
+            dcaches, drafts, q = draft_k(dcaches, token_buf, n,
+                                         pending, kd)
+
+            block = jnp.concatenate([pending[:, None], drafts], axis=1)
+            logits_blk, caches = _decode_block(params, cfg, block, n,
+                                               caches)  # (B, S, V)
+
+            if temperature == 0.0:
+                tgt = jnp.argmax(logits_blk, axis=-1) \
+                    .astype(jnp.int32)                 # (B, S)
+                ok = drafts == tgt[:, :K]              # (B, K)
+            else:
+                p = jax.nn.softmax(logits_blk.astype(f32) / temperature,
+                                   axis=-1)            # (B, S, V)
+                p_d = jnp.take_along_axis(
+                    p[:, :K], drafts[:, :, None], axis=2)[:, :, 0]
+                if q is None:            # deterministic (one-hot) draft
+                    ratio = p_d
+                else:
+                    q_d = jnp.take_along_axis(
+                        q, drafts[:, :, None], axis=2)[:, :, 0]
+                    ratio = p_d / jnp.maximum(q_d, 1e-30)
+                u = jax.random.uniform(ka, (B, K))
+                ok = u < ratio
+            a_b = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                          axis=1)                      # (B,)
+            a = jnp.min(a_b)              # batch-synchronized commit
+
+            if temperature == 0.0:
+                cont = jax.lax.dynamic_index_in_dim(
+                    tgt, a, axis=1, keepdims=False)    # (B,)
+            else:
+                # residual sampling at the first rejected position;
+                # rows that accepted past `a` keep their verified draft
+                p_a = jax.lax.dynamic_index_in_dim(p, a, axis=1,
+                                                   keepdims=False)
+                if q is None:
+                    q_a = jax.nn.one_hot(
+                        jax.lax.dynamic_index_in_dim(
+                            drafts, jnp.minimum(a, K - 1), axis=1,
+                            keepdims=False),
+                        cfg.vocab_size, dtype=f32)
+                else:
+                    q_a = jax.lax.dynamic_index_in_dim(
+                        q, jnp.minimum(a, K - 1), axis=1,
+                        keepdims=False)
+                res = jnp.maximum(p_a - jnp.where(a >= K, 0.0, 1.0)
+                                  * q_a, 0.0)
+                rs = jnp.sum(res, axis=-1, keepdims=True)
+                res = jnp.where(rs > 0, res / jnp.maximum(rs, 1e-30),
+                                p_a)
+                cont_s = jax.random.categorical(
+                    kr, jnp.log(res + 1e-30), axis=-1
+                ).astype(jnp.int32)
+                d_a = jax.lax.dynamic_index_in_dim(
+                    drafts, jnp.minimum(a, K - 1), axis=1,
+                    keepdims=False)
+                cont = jnp.where(a_b > a, d_a, cont_s)
+
+            token_buf = jax.lax.dynamic_update_slice(token_buf, drafts,
+                                                     (0, n + 1))
+            token_buf = jax.lax.dynamic_update_slice(
+                token_buf, cont[:, None], (0, n + a + 1))
+            return (caches, dcaches, token_buf, cont,
+                    emitted + a + 1, key, iters + 1,
+                    accepted + a)
+
+        def cond(carry):
+            return carry[4] < max_new_tokens
+
+        init = (caches, dcaches, token_buf, pending,
+                jnp.int32(1), rng, jnp.int32(0), jnp.int32(0))
+        (_, _, token_buf, _, emitted, _, iters, accepted) = \
+            jax.lax.while_loop(cond, body, init)
+
+        out = token_buf[:, :P + max_new_tokens]
+        if return_stats:
+            return out, {"iters": iters, "drafted": iters * K,
+                         "accepted": accepted, "tokens": emitted}
+        return out
+
+    if len(_generate_cache) >= _GENERATE_CACHE_MAX:
+        _generate_cache.pop(next(iter(_generate_cache)))
+    _generate_cache[cache_key] = run
+    return run(params, draft_params, prompt, rng)
 
 
 def generate(params, cfg, prompt, max_new_tokens, *, temperature=0.0,

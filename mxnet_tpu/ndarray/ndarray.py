@@ -367,8 +367,10 @@ class NDArray:
         flag; SURVEY.md §4.7)."""
         import contextlib
         if self.size >= 2**31:
-            import jax
-            return jax.enable_x64(True)
+            # jax.enable_x64 (deprecated alias) was removed; the
+            # experimental context manager is the stable spelling
+            from jax.experimental import enable_x64
+            return enable_x64(True)
         return contextlib.nullcontext()
 
     def _widen_index_arrays(self, k):

@@ -19,9 +19,42 @@ from typing import Optional, Sequence, Tuple
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "default_mesh", "current_mesh", "mesh_scope",
-           "live_axis"]
+           "live_axis", "shard_map_compat"]
 
 _CURRENT = []
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, axis_names=None,
+                     check_vma=True):
+    """``jax.shard_map`` across the jax version drift (round 6, same
+    class as the ``enable_x64`` spelling fixes): jax >= 0.5 exposes
+    ``jax.shard_map(..., axis_names=..., check_vma=...)``; 0.4.x has
+    ``jax.experimental.shard_map.shard_map(..., auto=..., check_rep=…)``
+    where ``auto`` is the complement of ``axis_names`` (the axes left
+    automatic) and ``check_rep`` is the old name for the replication
+    check.
+
+    Caveat: on 0.4.x the FULL-manual form lowers fine (ring attention),
+    but the partial-manual form (``axis_names`` a strict subset — the
+    pipeline's ``pp``-only mapping with ``dp`` auto) hits a GSPMD
+    tile-assignment bug under scan; those paths need the >= 0.5-era
+    lowering (tests/test_pipeline_moe.py documents the failure)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {"check_rep": check_vma}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - set(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kw)
 
 
 def make_mesh(shape: Optional[dict] = None, devices=None):

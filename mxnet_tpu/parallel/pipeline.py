@@ -155,7 +155,8 @@ def pipeline_apply(stage_fn, stacked_params, x, aux=None, *, mesh,
         # aux sums across stages.
         return out_acc[None], aux_acc[None]
 
-    sharded = jax.shard_map(
+    from .mesh import shard_map_compat
+    sharded = shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=(P(axis), P(axis)),

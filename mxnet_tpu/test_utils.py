@@ -148,8 +148,8 @@ def check_numeric_gradient(fn, inputs, eps=1e-4, rtol=1e-2, atol=1e-4,
     true float64 — without it XLA silently downcasts and the central
     difference loses half its digits.
     """
-    import jax
-    with jax.enable_x64(True):
+    from jax.experimental import enable_x64
+    with enable_x64(True):
         return _check_numeric_gradient_x64(fn, inputs, eps, rtol, atol,
                                            dtype)
 

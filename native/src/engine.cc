@@ -43,7 +43,8 @@ void Engine::DeleteVar(EngineVar* var) {
 void Engine::PushAsync(std::function<int(std::string*)> fn,
                        std::vector<EngineVar*> const_vars,
                        std::vector<EngineVar*> mutate_vars,
-                       int priority, const char* name, bool always_run) {
+                       int priority, const char* name, bool always_run,
+                       bool sync_op) {
   // dedup: a var both read and mutated counts as mutated only (reference:
   // ThreadedEngine deduplicates const/mutate overlap)
   std::sort(mutate_vars.begin(), mutate_vars.end());
@@ -76,7 +77,7 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
       v->exception = err.empty() ? nullptr
                                  : std::make_shared<std::string>(err);
     }
-    if (!err.empty()) {
+    if (!err.empty() && first_err.empty()) {  // own failure only (above)
       std::lock_guard<std::mutex> lk(err_mu_);
       if (global_err_.empty()) global_err_ = err;
     }
@@ -91,6 +92,7 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
   op->seq = seq_.fetch_add(1);
   op->name = name;
   op->always_run = always_run;
+  op->sync_op = sync_op;
   outstanding_.fetch_add(1);
   Schedule(op);
 }
@@ -147,16 +149,20 @@ void Engine::WorkerLoop() {
 
 void Engine::Execute(Opr* op) {
   // propagate input exceptions without running (reference: dependent ops
-  // of a failed op are skipped, error flows to their outputs)
+  // of a failed op are skipped, error flows to their outputs).  A sync_op
+  // (WaitForVar's serialized waiter) consumes the var's deferred error in
+  // its own fn and must not re-propagate it.
   std::string input_err;
-  for (auto* v : op->const_vars) {
-    std::lock_guard<std::mutex> lk(v->mu);
-    if (v->exception) { input_err = *v->exception; break; }
-  }
-  if (input_err.empty()) {
-    for (auto* v : op->mutate_vars) {
+  if (!op->sync_op) {
+    for (auto* v : op->const_vars) {
       std::lock_guard<std::mutex> lk(v->mu);
       if (v->exception) { input_err = *v->exception; break; }
+    }
+    if (input_err.empty()) {
+      for (auto* v : op->mutate_vars) {
+        std::lock_guard<std::mutex> lk(v->mu);
+        if (v->exception) { input_err = *v->exception; break; }
+      }
     }
   }
   std::string err;
@@ -170,10 +176,16 @@ void Engine::Execute(Opr* op) {
   } else {
     err = input_err;
   }
-  OnComplete(op, err);
+  // Only an op that failed ITSELF records the global error.  A skipped
+  // dependent (or an always_run helper like wait_for_var's sync op)
+  // propagates the error to its output vars but must not re-populate
+  // global_err_ — WaitForVar clears the global entry on rethrow, and a
+  // propagating op completing after that clear would resurrect a
+  // stale error into the next WaitForAll.
+  OnComplete(op, err, /*own_failure=*/input_err.empty() && !err.empty());
 }
 
-void Engine::OnComplete(Opr* op, const std::string& err) {
+void Engine::OnComplete(Opr* op, const std::string& err, bool own_failure) {
   auto exc = err.empty() ? nullptr : std::make_shared<std::string>(err);
   for (auto* v : op->const_vars) {
     std::lock_guard<std::mutex> lk(v->mu);
@@ -183,11 +195,13 @@ void Engine::OnComplete(Opr* op, const std::string& err) {
   for (auto* v : op->mutate_vars) {
     std::lock_guard<std::mutex> lk(v->mu);
     v->active_write = false;
-    v->version++;
-    if (exc) v->exception = exc;
+    if (!op->sync_op) {        // a sync waiter is not a real write:
+      v->version++;            // no version bump, no error write-back
+      if (exc) v->exception = exc;
+    }
     ProcessQueue(v);
   }
-  if (exc) {
+  if (own_failure) {
     std::lock_guard<std::mutex> lk(err_mu_);
     if (global_err_.empty()) global_err_ = err;
   }
@@ -225,11 +239,20 @@ std::string Engine::WaitForVar(EngineVar* var) {
       std::string e = *var->exception;
       var->exception = nullptr;
       std::lock_guard<std::mutex> lk(err_mu_);
-      global_err_.clear();
+      if (global_err_ == e) global_err_.clear();
       return e;
     }
     return "";
   }
+  // The waiter is pushed as a WRITE (sync_op): it dispatches only after
+  // every op pushed before this call has completed — including dependent
+  // readers that must observe the var's exception and be skipped.  The
+  // old read-op waiter raced them: its high priority let it run (and
+  // clear the exception, rethrow-once) before an already-queued
+  // dependent executed, so the dependent saw a clean var and ran.
+  // Consuming + clearing inside the fn keeps the rethrow-once clear
+  // ordered with the var's dependency stream; sync_op suppresses the
+  // version bump and error write-back a real write would do.
   std::mutex mu;
   std::condition_variable cv;
   bool done = false;
@@ -238,21 +261,21 @@ std::string Engine::WaitForVar(EngineVar* var) {
       [&](std::string*) {
         {
           std::lock_guard<std::mutex> vlk(var->mu);
-          if (var->exception) var_err = *var->exception;
+          if (var->exception) {
+            var_err = *var->exception;
+            var->exception = nullptr;  // rethrow-once semantics
+          }
         }
         std::lock_guard<std::mutex> lk(mu);
         done = true;
         cv.notify_all();
         return 0;
       },
-      {var}, {}, /*priority=*/1 << 20, "wait_for_var", /*always_run=*/true);
+      {}, {var}, /*priority=*/1 << 20, "wait_for_var",
+      /*always_run=*/true, /*sync_op=*/true);
   std::unique_lock<std::mutex> lk(mu);
   cv.wait(lk, [&] { return done; });
   if (!var_err.empty()) {
-    {
-      std::lock_guard<std::mutex> vlk(var->mu);
-      var->exception = nullptr;  // rethrow-once semantics
-    }
     // Clear the global error only if it is THIS error; a different failed
     // op's deferred error must still surface at WaitForAll.
     std::lock_guard<std::mutex> elk(err_mu_);

@@ -55,6 +55,11 @@ struct Opr {
   std::string name;
   bool always_run = false;  // run fn even when an input carries an exception
                             // (sync/wait ops must always signal)
+  bool sync_op = false;     // WaitForVar's sync writer: serializes behind
+                            // everything already pushed on the var but is
+                            // not a real write — no version bump, no
+                            // exception propagation (its fn consumes the
+                            // var's deferred error itself)
   EngineVar* delete_target = nullptr;  // var freed after this op completes
   std::atomic<int> wait{0};
 };
@@ -74,7 +79,7 @@ class Engine {
                  std::vector<EngineVar*> const_vars,
                  std::vector<EngineVar*> mutate_vars,
                  int priority = 0, const char* name = "",
-                 bool always_run = false);
+                 bool always_run = false, bool sync_op = false);
 
   // Returns empty string on success, else the deferred error (cleared).
   std::string WaitForVar(EngineVar* var);
@@ -86,7 +91,7 @@ class Engine {
   void Schedule(Opr* op);
   void Dispatch(Opr* op);
   void Execute(Opr* op);
-  void OnComplete(Opr* op, const std::string& err);
+  void OnComplete(Opr* op, const std::string& err, bool own_failure);
   void ProcessQueue(EngineVar* var);  // var->mu must be held
   void DecWait(Opr* op);
   void WorkerLoop();

@@ -18,7 +18,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; it still honors the
+    # XLA_FLAGS --xla_force_host_platform_device_count set above as
+    # long as the backend has not initialized yet
+    pass
 
 import numpy as _np
 import pytest

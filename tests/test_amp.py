@@ -92,6 +92,64 @@ def test_overflow_skips_update():
         amp.disable()
 
 
+def test_amp_registry_classification_complete():
+    """Round-6 sweep (verdict weak #5): every canonical registry op
+    must carry an explicit AMP class — target / fp32 / widest /
+    passthrough-safe.  A new op landing unclassified fails here instead
+    of silently riding the hook's implicit else-branch; MXU-family ops
+    (dot/conv/rnn/gemm/matmul) additionally may NOT hide in the
+    passthrough list — they must be an explicit target (or a justified
+    fp32/widest) entry."""
+    import re
+    from mxnet_tpu.contrib.amp import lists
+    from mxnet_tpu.ops import registry
+
+    canon = sorted({registry.get_op(n).name for n in registry.list_ops()})
+
+    unclassified = [n for n in canon if lists.classify(n) is None]
+    assert not unclassified, (
+        "%d registry ops have no AMP classification — add each to "
+        "TARGET_DTYPE_OPS / FP32_OPS / WIDEST_TYPE_CASTS / "
+        "PASSTHROUGH_SAFE_OPS in contrib/amp/lists.py: %s"
+        % (len(unclassified), unclassified))
+
+    # no op may sit in two classes (first-match in the hook would
+    # silently shadow the second)
+    from collections import Counter
+    seen = Counter(lists.TARGET_DTYPE_OPS + lists.FP32_OPS +
+                   lists.WIDEST_TYPE_CASTS + lists.PASSTHROUGH_SAFE_OPS)
+    dupes = [n for n, c in seen.items() if c > 1]
+    assert not dupes, "ops in more than one AMP list: %s" % dupes
+
+    # the MXU families must be deliberately placed, never passthrough.
+    # quantized int8 conv/fc are exempt: their matmuls are already int8
+    # with explicit scales (see the PASSTHROUGH_SAFE_OPS note).
+    mxu = re.compile(r"(?i)(dot|conv|rnn|gemm|matmul|correlation|"
+                     r"interleaved|einsum|tensordot)")
+    for n in canon:
+        if not mxu.search(n) or n.startswith("_contrib_quantized_"):
+            continue
+        cls = lists.classify(n)
+        assert cls in ("target", "fp32"), (
+            "MXU-family op %r classified %r — must be an explicit "
+            "'target' (or justified 'fp32') entry" % (n, cls))
+
+    # stale entries: every listed name must still exist in the registry
+    # (aliases allowed) so the lists cannot rot as ops get renamed
+    for n in seen:
+        assert registry.op_exists(n), "AMP list entry %r is not a " \
+            "registered op" % n
+
+
+def test_amp_classify_helper():
+    from mxnet_tpu.contrib.amp import lists
+    assert lists.classify("dot") == "target"
+    assert lists.classify("softmax") == "fp32"
+    assert lists.classify("Concat") == "widest"
+    assert lists.classify("relu") == "passthrough"
+    assert lists.classify("no_such_op_xyz") is None
+
+
 def test_convert_symbol_inserts_casts():
     data = mx.sym.Variable("data")
     fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")

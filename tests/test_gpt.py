@@ -169,6 +169,314 @@ def test_int8_weight_only_decode_parity():
     assert (of == oq).mean() >= 0.8, (of, oq)
 
 
+# ---------------------------------------------------------------------------
+# speculative decode (fast tier: the distribution-exactness gates)
+# ---------------------------------------------------------------------------
+
+def test_spec_decode_greedy_exact_ngram():
+    """Greedy speculative decode must be TOKEN-IDENTICAL to plain
+    ``generate`` — the distribution-exactness gate for the accept rule
+    (longest matching prefix + the target's own token at the first
+    mismatch), for every K."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=128, max_len=64)
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    B, P, N = 2, 6, 12
+    # repetitive prompt so the ngram drafter actually gets accepts on
+    # one row while the other stays adversarial
+    prompt = jnp.asarray([[7, 9, 7, 9, 7, 9],
+                          [3, 11, 5, 2, 17, 23]], jnp.int32)
+    ref = gpt.generate(params, cfg, prompt, N)
+    for K in (1, 2, 4):
+        out, st = gpt.generate_speculative(
+            params, cfg, prompt, N, K=K, drafter="ngram",
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert int(st["tokens"]) >= N
+        assert int(st["iters"]) >= 1
+        assert 0 <= int(st["accepted"]) <= int(st["drafted"])
+        # every iteration commits at least one token
+        assert int(st["iters"]) <= N
+
+
+def test_spec_decode_greedy_exact_self_drafter():
+    """Self-drafting (layer-slice draft model, optionally w8) must also
+    be token-identical under greedy — acceptance only ever compares
+    against the TARGET's argmax, so a bad draft costs speed, never
+    correctness."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=128, max_len=64)
+    params = T.init_params(jax.random.PRNGKey(4), cfg)
+    B, P, N = 2, 5, 10
+    prompt = ((jnp.arange(B * P, dtype=jnp.int32).reshape(B, P) * 13)
+              % 100) + 1
+    ref = gpt.generate(params, cfg, prompt, N)
+
+    dparams, dcfg = gpt.draft_slice_params(params, cfg, n_layers=1)
+    out, st = gpt.generate_speculative(
+        params, cfg, prompt, N, K=3, drafter="self",
+        draft_params=dparams, draft_cfg=dcfg, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # w8 draft model: still exact (quantization changes the PROPOSALS,
+    # never the accepted distribution)
+    qd = gpt.quantize_decode_params(dparams)
+    out = gpt.generate_speculative(
+        params, cfg, prompt, N, K=3, drafter="self",
+        draft_params=qd, draft_cfg=dcfg)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_spec_decode_quantized_target_paths():
+    """Speculative decode over the quantized decode-path options (w8
+    weights, int8 KV cache) stays token-identical to plain generate
+    with the SAME options — exactness is relative to the target
+    configuration, whatever its numerics."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=128, max_len=64)
+    params = T.init_params(jax.random.PRNGKey(7), cfg)
+    prompt = ((jnp.arange(2 * 4, dtype=jnp.int32).reshape(2, 4) * 7)
+              % 100) + 1
+    qparams = gpt.quantize_decode_params(params)
+    ref = gpt.generate(qparams, cfg, prompt, 8)
+    out = gpt.generate_speculative(qparams, cfg, prompt, 8, K=3,
+                                   drafter="ngram")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    refk = gpt.generate(params, cfg, prompt, 8, kv_int8=True)
+    outk = gpt.generate_speculative(params, cfg, prompt, 8, K=3,
+                                    drafter="ngram", kv_int8=True)
+    np.testing.assert_array_equal(np.asarray(outk), np.asarray(refk))
+
+
+def test_spec_rollback_forced_rejections():
+    """KV-cache rollback: force a draft rejection at EVERY position
+    j = 0..K across iterations and assert (a) committed tokens equal
+    the non-speculative greedy sequence exactly, (b) committed cache
+    slots match the sequential ``_decode_one`` reference (bit-identical
+    up to XLA's block-vs-single matmul reduction order, < 1e-6 here),
+    and (c) the next step's logits from the speculative caches argmax-
+    match the reference bitwise.  Rejected slots are rolled back by
+    POINTER only — the next block write must overwrite them before any
+    mask exposes them."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=64, max_len=64)
+    params = T.init_params(jax.random.PRNGKey(5), cfg)
+    B, P, K, N = 2, 5, 3, 8
+    prompt = ((jnp.arange(B * P, dtype=jnp.int32).reshape(B, P) * 7)
+              % 60) + 1
+    total = P + N + K
+
+    # reference: prefill + N-1 sequential greedy decode steps
+    logits, rcaches = gpt._prefill_full(params, cfg, prompt, total)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    ref_toks = [tok]
+    for i in range(N - 1):
+        logits, rcaches = gpt._decode_one(params, cfg, tok, P + i,
+                                          rcaches)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        ref_toks.append(tok)
+    ref_arr = np.stack([np.asarray(t) for t in ref_toks], 1)
+
+    # speculative path with ADVERSARIAL drafts: correct up to position
+    # j, deliberately wrong from j on — j cycles 0..K so every
+    # rejection depth (including accept-all, j=K) is exercised
+    logits, caches = gpt._prefill_full(params, cfg, prompt, total)
+    pending = jnp.argmax(logits, -1).astype(jnp.int32)
+    emitted, j, spec_toks = 1, 0, [pending]
+    forced_depths = set()
+    while emitted < N:
+        correct = [ref_arr[:, emitted + i] if emitted + i < N
+                   else np.zeros(B, np.int32) for i in range(K)]
+        drafts = np.stack(correct, 1).astype(np.int32)
+        jj = j % (K + 1)
+        forced_depths.add(jj)
+        if jj < K:
+            drafts[:, jj:] = (drafts[:, jj:] + 1) % cfg.vocab_size
+        drafts = jnp.asarray(drafts)
+        n = P + emitted - 1
+        block = jnp.concatenate([pending[:, None], drafts], 1)
+        lb, caches = gpt._decode_block(params, cfg, block, n, caches)
+        tgt = jnp.argmax(lb, -1).astype(jnp.int32)
+        ok = drafts == tgt[:, :K]
+        a = int(jnp.min(jnp.sum(
+            jnp.cumprod(ok.astype(jnp.int32), 1), 1)))
+        # the forced rejection must bite exactly where we planted it
+        # (unless the reference sequence ran out first)
+        assert a == min(jj, N - emitted), (a, jj, emitted)
+        cont = tgt[:, a]
+        for i in range(a):
+            spec_toks.append(drafts[:, i])
+        spec_toks.append(cont)
+        pending, emitted, j = cont, emitted + a + 1, j + 1
+    assert forced_depths == set(range(K + 1)), forced_depths
+
+    spec_arr = np.stack([np.asarray(t) for t in spec_toks], 1)[:, :N]
+    np.testing.assert_array_equal(spec_arr, ref_arr)
+
+    # committed cache slots [0, P+N-1) must match the sequential
+    # reference; stale rejected slots beyond them are irrelevant
+    for rc, sc in zip(rcaches, caches):
+        r = np.asarray(rc["kv"][:, :P + N - 1])
+        s = np.asarray(sc["kv"][:, :P + N - 1])
+        assert np.abs(r - s).max() < 1e-6
+    l_ref, _ = gpt._decode_one(params, cfg,
+                               jnp.asarray(ref_arr[:, -1]),
+                               P + N - 1, rcaches)
+    l_spec, _ = gpt._decode_one(params, cfg,
+                                jnp.asarray(spec_arr[:, -1]),
+                                P + N - 1, caches)
+    np.testing.assert_allclose(np.asarray(l_spec), np.asarray(l_ref),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(l_spec, -1)),
+        np.asarray(jnp.argmax(l_ref, -1)))
+
+
+def test_spec_decode_sampled_distribution():
+    """temperature>0: the rejection-sampling accept rule's MARGINALS
+    must equal target sampling.  Exact enumeration gives the true
+    marginal of the 2nd generated token; empirical distributions from
+    plain generate (control) and both speculative drafters must all sit
+    within the same sampling-noise band of it."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(vocab_size=16, max_len=32)
+    params = T.init_params(jax.random.PRNGKey(9), cfg)
+    B, P, N = 4, 4, 2
+    prompt = ((jnp.arange(B * P, dtype=jnp.int32).reshape(B, P) * 5)
+              % 16)
+
+    # exact marginal of token at position P+1 per row:
+    #   p2(t) = sum_t1 p1(t1) * p(t | prompt + t1)
+    logits1 = gpt.forward(params, prompt, cfg)[:, -1]
+    p1 = np.asarray(jax.nn.softmax(logits1, -1), np.float64)
+    p2 = np.zeros((B, cfg.vocab_size))
+    for t1 in range(cfg.vocab_size):
+        ext = jnp.concatenate(
+            [prompt, jnp.full((B, 1), t1, jnp.int32)], 1)
+        l2 = gpt.forward(params, ext, cfg)[:, -1]
+        p2 += p1[:, t1:t1 + 1] * np.asarray(jax.nn.softmax(l2, -1),
+                                            np.float64)
+
+    dparams, dcfg = gpt.draft_slice_params(params, cfg, n_layers=1)
+    M = 250
+
+    def empirical(fn):
+        cnt = np.zeros((B, cfg.vocab_size))
+        for i in range(M):
+            out = np.asarray(fn(jax.random.PRNGKey(10_000 + i)))
+            for b in range(B):
+                cnt[b, out[b, P + 1]] += 1
+        return cnt / M
+
+    runs = {
+        "generate": lambda r: gpt.generate(
+            params, cfg, prompt, N, temperature=1.0, rng=r),
+        "spec-ngram": lambda r: gpt.generate_speculative(
+            params, cfg, prompt, N, K=2, temperature=1.0,
+            drafter="ngram", rng=r),
+        "spec-self": lambda r: gpt.generate_speculative(
+            params, cfg, prompt, N, K=2, temperature=1.0,
+            drafter="self", draft_params=dparams, draft_cfg=dcfg,
+            rng=r),
+    }
+    # TV noise floor for M samples over V cats ~ sqrt(V/(2*pi*M))/...;
+    # empirically ~0.06 at M=250, V=16 — gate at 2.5x that
+    for name, fn in runs.items():
+        emp = empirical(fn)
+        tv = 0.5 * np.abs(emp - p2).sum(-1).max()
+        assert tv < 0.15, "%s marginal TV %.3f" % (name, tv)
+
+
+def test_spec_decode_bf16_agreement():
+    """Under bf16 compute, exactness is modulo 1-ulp argmax ties: the
+    block-verify and single-step forwards may reduce in different
+    orders, and the random-init checkpoint's near-flat logits make
+    such ties common — the worst case.  Gates: (a) f32 at the same
+    shapes stays token-exact (any bf16 divergence is ulp-ties, not
+    indexing); (b) if the bf16 output diverges from plain ``generate``,
+    the FIRST divergent position per row must sit on a near-tie of the
+    sequential model's logits (top-2 gap within a few bf16 ulps) —
+    after that the histories legitimately differ."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    B, P, N = 2, 8, 24
+    prompt = jnp.asarray(
+        np.tile([[5, 9, 5, 9, 5, 9, 5, 9]], (B, 1)), jnp.int32)
+
+    cfg = _cfg(vocab_size=512, max_len=128, dtype="float32")
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    ref = np.asarray(gpt.generate(params, cfg, prompt, N))
+    out = np.asarray(gpt.generate_speculative(
+        params, cfg, prompt, N, K=4, drafter="ngram"))
+    np.testing.assert_array_equal(out, ref)
+
+    cfg = _cfg(vocab_size=512, max_len=128, dtype="bfloat16")
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    ref = np.asarray(gpt.generate(params, cfg, prompt, N))
+    out = np.asarray(gpt.generate_speculative(
+        params, cfg, prompt, N, K=4, drafter="ngram"))
+    for b in range(B):
+        div = np.nonzero(ref[b] != out[b])[0]
+        if div.size == 0:
+            continue
+        i = int(div[0]) - P          # first divergent generated index
+        assert i >= 0, "diverged inside the prompt"
+        # sequential logits at the divergence: teacher-force ref up to
+        # it and read the top-2 gap
+        total = P + N + 4
+        logits, caches = gpt._prefill_full(params, cfg, prompt[b:b + 1],
+                                           total)
+        for j in range(i):
+            logits, caches = gpt._decode_one(
+                params, cfg, jnp.asarray(ref[b:b + 1, P + j]), P + j,
+                caches)
+        top2 = np.sort(np.asarray(logits)[0])[-2:]
+        gap, mag = top2[1] - top2[0], max(abs(top2[1]), 1.0)
+        # bf16 ulp at |x| is ~2^-8 * |x|; allow a few ulps of slack
+        assert gap <= 16.0 * mag * 2.0 ** -8, (
+            "bf16 divergence at generated idx %d is not a near-tie: "
+            "top-2 gap %.5f (mag %.2f)" % (i, gap, mag))
+
+
+def test_spec_decode_validation():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt, transformer as T
+
+    cfg = _cfg(max_len=16)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((1, 5), jnp.int32)
+    with pytest.raises(ValueError):
+        gpt.generate_speculative(params, cfg, prompt, 10, K=4)  # 5+10+4>16
+    with pytest.raises(ValueError):
+        gpt.generate_speculative(params, cfg, prompt, 4, K=0)
+    with pytest.raises(ValueError):
+        gpt.generate_speculative(params, cfg, prompt, 4, drafter="self")
+    with pytest.raises(ValueError):
+        gpt.generate_speculative(params, cfg, prompt, 4, drafter="huh")
+    # max_new_tokens=0 short-circuits
+    out = gpt.generate_speculative(params, cfg, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
 @pytest.mark.slow
 def test_int8_kv_cache_decode_parity():
     """Round-4: the int8 KV-cache path (generate(kv_int8=True)) must
@@ -194,3 +502,37 @@ def test_int8_kv_cache_decode_parity():
     oq = np.asarray(gpt.generate(gpt.quantize_decode_params(params),
                                  cfg, prompt, 12, kv_int8=True))
     assert (of == oq).mean() >= 0.7, (of, oq)
+
+
+@pytest.mark.slow
+def test_spec_decode_probe_smoke():
+    """CI smoke of the spec-decode bench harness (bounded: --quick tiny
+    model, 16/64-token timings).  Runs all three probe sections through
+    main() and checks the invariants the benchmark relies on: the
+    calibration config (full target as its own drafter) commits > 1
+    token/iter, every e2e row carries accept-rate accounting, and the
+    micro section produced the c_S/c_1 ratios."""
+    import json
+    import os
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmark"))
+    import spec_decode_probe
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "probe.json")
+        rc = spec_decode_probe.main(
+            ["--quick", "--batches", "1", "--ks", "2,4",
+             "--json", out])
+        assert rc == 0
+        rows = json.load(open(out))
+    micro = [r for r in rows if r["section"] == "micro"]
+    e2e = [r for r in rows if r["section"] == "e2e"]
+    assert {r["S"] for r in micro} == {1, 3, 5}
+    calib = [r for r in e2e if "calib" in r["config"]]
+    assert calib and calib[0]["tokens_per_iter"] > 1.5, calib
+    for r in e2e:
+        assert 0.0 <= r["accept_rate"] <= 1.0
+        assert r["tokens_per_iter"] >= 1.0 or r["K"] == 0

@@ -255,6 +255,27 @@ class TestNativeEngine:
         with pytest.raises(mx.MXNetError, match="boom"):
             eng.wait_for_all()
 
+    def test_wait_for_var_does_not_unskip_dependents(self):
+        """Round-6 regression (two engine races): (1) the wait_for_var
+        sync op used to run as a high-priority READ, so it could beat
+        an already-queued dependent to the var and clear the exception
+        (rethrow-once) before the dependent checked it — the dependent
+        then RAN instead of being skipped; (2) skipped/propagating ops
+        re-recorded the error into the global store after WaitForVar
+        cleared it, resurfacing a stale error at the next
+        wait_for_all.  Stress both orderings: ~90% failure rate per
+        loop before the fix."""
+        for i in range(50):
+            eng = native.NativeEngine()
+            var = eng.new_var()
+            ran = []
+            eng.push(lambda: 1 / 0, mutate_vars=[var])
+            eng.push(lambda: ran.append(1), const_vars=[var])
+            with pytest.raises(mx.MXNetError, match="ZeroDivisionError"):
+                eng.wait_for_var(var)
+            assert ran == [], "dependent ran instead of skipping (i=%d)" % i
+            eng.wait_for_all()   # stale global error would raise here
+
     def test_independent_vars_parallel(self):
         eng = native.NativeEngine(num_workers=4)
         v1, v2 = eng.new_var(), eng.new_var()
