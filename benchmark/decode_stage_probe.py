@@ -1,0 +1,132 @@
+"""JPEG decode-stage probe (VERDICT round-5 item #7, landed round 7):
+where does the native loader's per-image decode millisecond go —
+entropy (huffman) decode, IDCT+upsampling, or colorspace conversion —
+and what does DCT-domain 1/2-scale decode buy on the train-crop path
+when the source is large enough to allow it?
+
+    python benchmark/decode_stage_probe.py [--reps 50] [--json out]
+
+Sections:
+
+* ``stages`` — per-stage ms at 256 and 512 px sources
+  (``native.decode_profile``: huffman-only via jpeg_read_coefficients;
+  +IDCT via a full YCbCr decompress; full RGB; RGB with the
+  min_short-guarded DCT-domain scale).
+* ``e2e`` — the threaded loader (decode → resize_short 256 →
+  rand-crop 224 → mirror → normalize → NHWC) over 512 px JPEGs, the
+  case upstream's OpenCV augmenter serves with IMREAD_REDUCED: img/s
+  with ``dct_scale`` off vs on.  256 px sources are the guard's
+  negative control (scale never engages: 256 < 2x224).
+
+Results land in docs/perf.md "Input pipeline".
+"""
+import argparse
+import io as _io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _jpeg(hw, seed=0, quality=90):
+    """One structured JPEG (same construction as data_bench: real
+    entropy-coding work, not flat noise)."""
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    base = rng.randint(0, 255, (hw // 8, hw // 8, 3), "uint8")
+    img = np.kron(base, np.ones((8, 8, 1), "uint8"))
+    noise = rng.randint(0, 32, (hw, hw, 3), "uint8")
+    img = np.clip(img.astype("int32") + noise, 0, 255).astype("uint8")
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _make_rec(path_rec, path_idx, n, hw):
+    from mxnet_tpu import recordio
+    w = recordio.MXIndexedRecordIO(path_idx, path_rec, "w")
+    for i in range(n):
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        w.write_idx(i, recordio.pack(header, _jpeg(hw, seed=i)))
+    w.close()
+
+
+def _bench_loader(rec, idx, dct_scale, threads=1, epochs=3):
+    from mxnet_tpu import io as mio
+    it = mio.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 224, 224),
+        batch_size=32, rand_crop=True, rand_mirror=True, shuffle=True,
+        resize=256, preprocess_threads=threads, layout="NHWC",
+        dct_scale=dct_scale)
+    n = 0
+    for batch in it:                      # warm epoch
+        n += batch.data[0].shape[0]
+    best = 0.0
+    for _ in range(epochs):
+        it.reset()
+        t0 = time.time()
+        m = 0
+        for batch in it:
+            m += batch.data[0].shape[0]
+        best = max(best, m / (time.time() - t0))
+    return best
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=50)
+    ap.add_argument("--n", type=int, default=256,
+                    help="images per e2e rec")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu import native
+    if not native.available():
+        print("SKIP: native library unavailable")
+        return 0
+
+    rows = []
+    for hw, min_short in ((256, 224), (512, 256)):
+        buf = _jpeg(hw)
+        prof = native.decode_profile(buf, reps=args.reps,
+                                     min_short=min_short)
+        row = {"section": "stages", "src_px": hw,
+               "min_short": min_short,
+               "huffman_ms": round(prof["huffman_ms"], 3),
+               "idct_ms": round(prof["ycbcr_ms"] - prof["huffman_ms"],
+                                3),
+               "colorspace_ms": round(prof["rgb_ms"] - prof["ycbcr_ms"],
+                                      3),
+               "full_ms": round(prof["rgb_ms"], 3),
+               "scaled_ms": round(prof["scaled_ms"], 3)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    with tempfile.TemporaryDirectory() as td:
+        for hw in (256, 512):
+            rec = os.path.join(td, "p%d.rec" % hw)
+            idx = os.path.join(td, "p%d.idx" % hw)
+            _make_rec(rec, idx, args.n, hw)
+            off = _bench_loader(rec, idx, dct_scale=False)
+            on = _bench_loader(rec, idx, dct_scale=True)
+            row = {"section": "e2e", "src_px": hw,
+                   "img_s_full": round(off, 1),
+                   "img_s_dct_scale": round(on, 1),
+                   "speedup": round(on / off, 3)}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

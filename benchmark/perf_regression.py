@@ -210,6 +210,20 @@ def bench_gpt_decode_throughput():
                                     batch=128)
 
 
+def bench_gpt_serve():
+    """Continuous-batching serving gate (round 7): the paged-KV
+    ``ServingEngine`` on the seeded mixed-length Poisson workload
+    (benchmark/serve_bench.py, ``full`` preset: GPT-2-small-class w8,
+    16 slots, page 16, pool sized to the fixed-batch-8 contiguous HBM
+    budget).  tok/s counts REQUESTED generated tokens per wall second
+    from first arrival to last completion — it moves with slot
+    occupancy as well as step time (docs/perf.md "Serving"), so it is
+    not comparable to the fixed-batch decode gates."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    return serve_bench.run_gate("full")
+
+
 def bench_gpt_spec_decode():
     """Speculative decode gate (round 6): batch 8, w8 target, ngram
     (prompt-lookup) drafter at K=4 on the structured ("loop") workload
@@ -265,6 +279,7 @@ BENCHES = {
     "gpt_decode_w8_tok_s": (bench_gpt_decode_w8, "higher"),
     "gpt_decode_b128_w8_tok_s": (bench_gpt_decode_throughput, "higher"),
     "gpt_spec_decode_b8_tok_s": (bench_gpt_spec_decode, "higher"),
+    "gpt_serve_mixed_tok_s": (bench_gpt_serve, "higher"),
 }
 
 BAR = 0.15
@@ -273,8 +288,22 @@ BAR = 0.15
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true")
-    ap.add_argument("--only", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated gate name(s) to run alone "
+                         "(e.g. in CI for the gate a PR touched); "
+                         "unknown names are an error, not a silent "
+                         "no-op")
     args = ap.parse_args()
+
+    only = None
+    if args.only:
+        only = [s.strip() for s in args.only.split(",") if s.strip()]
+        unknown = sorted(set(only) - set(BENCHES))
+        if unknown:
+            print("unknown gate(s): %s\navailable: %s"
+                  % (", ".join(unknown), ", ".join(sorted(BENCHES))),
+                  file=sys.stderr)
+            return 2
 
     import mxnet_tpu as mx
     if mx.num_tpus() == 0:
@@ -289,7 +318,7 @@ def main():
     results = {}
     failures = []
     for name, (fn, direction) in BENCHES.items():
-        if args.only and args.only != name:
+        if only is not None and name not in only:
             continue
         v = fn()
         results[name] = round(v, 1)

@@ -1,0 +1,332 @@
+"""Continuous-batching serving benchmark (round 7): Poisson arrivals
+over a mixed prompt/output-length distribution, the paged-KV
+``ServingEngine`` vs the fixed-batch ``generate`` baseline at EQUAL
+HBM budget.
+
+    python benchmark/serve_bench.py                 # mid preset (CPU-able)
+    python benchmark/serve_bench.py --preset full   # chip gate config
+    python benchmark/serve_bench.py --quick         # CI smoke
+    python benchmark/serve_bench.py --sweep         # + occupancy/page-size
+
+Sections (rows carry {"section": ...} in the JSON):
+
+* ``e2e``     — the headline: R requests arrive Poisson(rate); the
+  engine admits them into ``num_slots`` slots as they arrive; the
+  baseline groups them into fixed batches of B = the slot count whose
+  CONTIGUOUS max-shape KV allocation equals the engine's page pool
+  (equal HBM), pads every batch to the workload max prompt/output
+  shape (one compiled program, standard static serving), and waits
+  for each batch to fully arrive before launching.  Reported:
+  useful tok/s (= requested generated tokens / wall clock from first
+  arrival to last completion), per-request normalized per-token
+  latency (completion - arrival) / tokens at p50/p99, and HBM held.
+* ``occupancy`` — closed-loop load of k in-flight requests for
+  k = slots/4, slots/2, slots (the batch-occupancy ablation).
+* ``pagesize`` — the e2e engine run swept over page_size (the sweep
+  that picked the default of 16).
+
+Both sides pre-warm their compiled programs before the clock; tok/s
+counts only requested tokens (baseline padding tokens are waste by
+construction — that is the point being measured).
+
+The ``gpt_serve_mixed_tok_s`` gate (benchmark/perf_regression.py) runs
+``run_gate()`` below: the full-size preset's e2e engine number.
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# --------------------------------------------------------------- presets ---
+
+@dataclasses.dataclass
+class Preset:
+    name: str
+    # model
+    vocab: int = 32000
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    w8: bool = True
+    dtype: str = "bfloat16"
+    # engine
+    num_slots: int = 16
+    page_size: int = 16
+    prefill_chunk: int = 16
+    # workload
+    n_requests: int = 64
+    rate: float = 100.0                   # arrivals/sec
+    prompt_lens: tuple = (16, 32, 64, 128, 192)
+    out_lens: tuple = (16, 32, 64, 128, 160)
+
+
+PRESETS = {
+    "full": Preset("full"),
+    # mid: small enough to measure end-to-end on the XLA:CPU host
+    "mid": Preset("mid", vocab=4096, d_model=256, n_heads=4,
+                  n_layers=4, d_ff=1024, max_len=256, w8=False,
+                  dtype="float32", num_slots=8, page_size=16,
+                  prefill_chunk=16, n_requests=32, rate=64.0,
+                  prompt_lens=(8, 16, 32, 64), out_lens=(8, 16, 32, 64)),
+    "quick": Preset("quick", vocab=256, d_model=64, n_heads=4,
+                    n_layers=2, d_ff=128, max_len=64, w8=False,
+                    dtype="float32", num_slots=4, page_size=4,
+                    prefill_chunk=8, n_requests=8, rate=50.0,
+                    prompt_lens=(4, 8, 12), out_lens=(4, 8, 12)),
+}
+
+
+def _model(p):
+    import jax
+    from mxnet_tpu.models import gpt
+    cfg = gpt.gpt_config(vocab_size=p.vocab, max_len=p.max_len,
+                         d_model=p.d_model, n_heads=p.n_heads,
+                         n_layers=p.n_layers, d_ff=p.d_ff,
+                         dropout=0.0, use_flash=False, remat=False,
+                         dtype=p.dtype)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    if p.w8:
+        params = gpt.quantize_decode_params(params)
+    return params, cfg
+
+
+def _workload(p, seed=0):
+    """[(arrival_s, prompt (P,) int32, n_new)] sorted by arrival."""
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out = []
+    for _ in range(p.n_requests):
+        t += rng.exponential(1.0 / p.rate)
+        P = int(rng.choice(p.prompt_lens))
+        N = int(rng.choice(p.out_lens))
+        prompt = rng.randint(1, p.vocab, P).astype(np.int32)
+        out.append((t, prompt, N))
+    return out
+
+
+def _lat_stats(per_req):
+    a = np.asarray(sorted(per_req))
+    return (float(np.percentile(a, 50)), float(np.percentile(a, 99)))
+
+
+# ------------------------------------------------------------------ runs ---
+
+def run_engine(params, cfg, p, workload, num_pages=None,
+               page_size=None, closed_loop_k=None):
+    """Open-loop (Poisson ``workload``) or closed-loop (``k`` always in
+    flight, workload gives the request shapes) engine run."""
+    from mxnet_tpu.serving import ServingEngine
+    page_size = page_size or p.page_size
+    # size the per-slot cap to the workload, not cfg.max_len — the
+    # equal-HBM pool budget is derived from the workload max shape
+    max_total = max(len(pr) + n for _, pr, n in workload)
+    pps = -(-max_total // page_size)
+    if num_pages is not None:
+        num_pages = max(num_pages, pps + 1)
+    eng = ServingEngine(params, cfg, num_slots=p.num_slots,
+                        page_size=page_size, num_pages=num_pages,
+                        pages_per_slot=pps,
+                        prefill_chunk=p.prefill_chunk)
+    # pre-warm the step program outside the clock (and drop the
+    # warmup's footprint from the reported stats)
+    widp, widn = workload[0][1], workload[0][2]
+    wid = eng.submit(widp, widn)
+    eng.run()
+    del eng.requests[wid]
+    for k in eng.stats:
+        eng.stats[k] = type(eng.stats[k])()
+
+    useful = sum(n for _, _, n in workload)
+    arrivals = {}
+    t0 = time.time()
+    peak_held = 0
+    if closed_loop_k is None:
+        pending = list(workload)
+        submitted = {}
+        while True:
+            now = time.time() - t0
+            while pending and pending[0][0] <= now:
+                at, prompt, n = pending.pop(0)
+                rid = eng.submit(prompt, n)
+                submitted[rid] = n
+                arrivals[rid] = at
+            r = eng.step()
+            peak_held = max(peak_held, eng.hbm_held)
+            if r is False:
+                if not pending:
+                    break
+                time.sleep(max(0.0, pending[0][0] - (time.time() - t0)))
+    else:
+        pending = list(workload)
+        submitted = {}
+        in_flight = 0
+        while pending or in_flight:
+            while pending and in_flight < closed_loop_k:
+                at, prompt, n = pending.pop(0)
+                rid = eng.submit(prompt, n)
+                submitted[rid] = n
+                arrivals[rid] = time.time() - t0
+                in_flight += 1
+            done = eng.step()
+            peak_held = max(peak_held, eng.hbm_held)
+            if done:
+                in_flight -= len(done)
+    wall = time.time() - t0
+
+    lat = []
+    for rid, n in submitted.items():
+        req = eng.requests[rid]
+        lat.append((req.token_times[-1] - t0 - arrivals[rid])
+                   / max(1, len(req.generated)))
+    p50, p99 = _lat_stats(lat)
+    return {"tok_s": useful / wall, "wall_s": wall, "lat_p50_s": p50,
+            "lat_p99_s": p99, "hbm_peak_held": peak_held,
+            "hbm_pool": eng.hbm_pool,
+            "occupancy": eng.stats["slot_occupancy_sum"]
+            / max(1, eng.stats["steps"]),
+            "preemptions": eng.stats["preemptions"],
+            "steps": eng.stats["steps"]}
+
+
+def run_fixed_batch(params, cfg, p, workload, batch):
+    """Static-batch baseline: batches of ``batch`` in arrival order,
+    every batch padded to the WORKLOAD max prompt/output shape (one
+    compiled program — standard static serving), launch waits for the
+    whole batch to have arrived."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    Pg = max(len(pr) for _, pr, _ in workload)
+    Ng = max(n for _, _, n in workload)
+
+    def pad(prompts):
+        out = np.ones((batch, Pg), np.int32)
+        for i, pr in enumerate(prompts):
+            out[i, :len(pr)] = pr
+        return jnp.asarray(out)
+
+    # pre-warm the compiled shape
+    o = gpt.generate(params, cfg, pad([workload[0][1]]), Ng)
+    jax.device_get(o.ravel()[:1])
+
+    useful = sum(n for _, _, n in workload)
+    t0 = time.time()
+    lat = []
+    for i in range(0, len(workload), batch):
+        grp = workload[i:i + batch]
+        wait_until = max(at for at, _, _ in grp)
+        now = time.time() - t0
+        if now < wait_until:
+            time.sleep(wait_until - now)
+        o = gpt.generate(params, cfg, pad([pr for _, pr, _ in grp]), Ng)
+        jax.device_get(o.ravel()[:1])
+        t_done = time.time() - t0
+        for at, _, n in grp:
+            lat.append((t_done - at) / max(1, n))
+    wall = time.time() - t0
+    from mxnet_tpu.serving.paged_kv import contiguous_kv_bytes
+    p50, p99 = _lat_stats(lat)
+    return {"tok_s": useful / wall, "wall_s": wall, "lat_p50_s": p50,
+            "lat_p99_s": p99,
+            "hbm_held": contiguous_kv_bytes(cfg, batch, Pg + Ng)}
+
+
+def _equal_hbm_pages(cfg, p, workload, batch):
+    """Engine page budget whose pool bytes match the baseline's
+    contiguous (batch, Pmax+Nmax) allocation."""
+    from mxnet_tpu.serving.paged_kv import contiguous_kv_bytes, \
+        PagedKVCache
+    Pg = max(len(pr) for _, pr, _ in workload)
+    Ng = max(n for _, _, n in workload)
+    budget = contiguous_kv_bytes(cfg, batch, Pg + Ng)
+    probe = PagedKVCache(cfg, 2, p.page_size)
+    return max(2, budget // probe.bytes_per_page)
+
+
+# ------------------------------------------------------------------ main ---
+
+def run_gate(preset="full"):
+    """The ``gpt_serve_mixed_tok_s`` gate: e2e engine tok/s on the
+    seeded mixed Poisson workload (equal-HBM config)."""
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    wl = _workload(p, seed=0)
+    batch = max(1, p.num_slots // 2)
+    pages = _equal_hbm_pages(cfg, p, wl, batch)
+    return run_engine(params, cfg, p, wl, num_pages=pages)["tok_s"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="mid",
+                    choices=sorted(PRESETS))
+    ap.add_argument("--quick", action="store_true",
+                    help="alias for --preset quick")
+    ap.add_argument("--sweep", action="store_true",
+                    help="also run the occupancy + page-size sweeps")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    p = PRESETS["quick" if args.quick else args.preset]
+
+    params, cfg = _model(p)
+    wl = _workload(p, seed=args.seed)
+    rows = []
+
+    # baseline batch = half the engine's slots, engine pool = the
+    # baseline's contiguous HBM: equal memory, 2x the concurrency
+    batch = max(1, p.num_slots // 2)
+    pages = _equal_hbm_pages(cfg, p, wl, batch)
+
+    base = run_fixed_batch(params, cfg, p, wl, batch)
+    base.update(section="e2e", config="fixed_batch_b%d" % batch)
+    rows.append(base)
+    print(json.dumps(base), flush=True)
+
+    e = run_engine(params, cfg, p, wl, num_pages=pages)
+    e.update(section="e2e", config="engine_s%d_ps%d"
+             % (p.num_slots, p.page_size))
+    rows.append(e)
+    print(json.dumps(e), flush=True)
+    print("engine/baseline tok_s: %.2fx  (equal HBM: pool %d B vs "
+          "contiguous %d B)" % (e["tok_s"] / base["tok_s"],
+                                e["hbm_pool"], base["hbm_held"]),
+          flush=True)
+
+    if args.sweep:
+        for k in sorted({max(1, p.num_slots // 4),
+                         max(1, p.num_slots // 2), p.num_slots}):
+            r = run_engine(params, cfg, p, wl, num_pages=pages,
+                           closed_loop_k=k)
+            r.update(section="occupancy", config="k%d" % k)
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+        for ps in (4, 8, 16, 32):
+            if ps > cfg.max_len:
+                continue
+            pp = _equal_hbm_pages(
+                cfg, dataclasses.replace(p, page_size=ps), wl, batch)
+            r = run_engine(params, cfg, p, wl, num_pages=pp,
+                           page_size=ps)
+            r.update(section="pagesize", config="ps%d" % ps)
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -628,8 +628,8 @@ class ImageRecordIter(DataIter):
                  resize=0, label_width=1, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  preprocess_threads=4, layout="NCHW", round_batch=True,
-                 data_name="data", label_name="softmax_label", ctx=None,
-                 **kwargs):
+                 dct_scale=True, data_name="data",
+                 label_name="softmax_label", ctx=None, **kwargs):
         super().__init__(batch_size)
         if path_imgidx is None:
             path_imgidx = path_imgrec[:-4] + ".idx" \
@@ -654,7 +654,8 @@ class ImageRecordIter(DataIter):
                 rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
                 label_width=label_width,
                 mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
-                scale=scale, layout=layout, round_batch=round_batch)
+                scale=scale, layout=layout, round_batch=round_batch,
+                dct_scale=dct_scale)
             self._py = None
         else:
             self._impl = None

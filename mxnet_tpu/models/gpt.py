@@ -179,6 +179,115 @@ def _lm_head(params, x, cdt):
     return logits + params["mlm_bias"].astype(jnp.float32)
 
 
+def _kv_quantize(k, v):
+    """Per-(row, token) symmetric s8 KV quantization over the head dim
+    — the int8-KV cache layout (round 4): a fused k|v int8 buffer plus
+    an f32 scale pair per (row, token).  Rank-agnostic (k/v may be
+    (R, dh) or (R, S, dh)); returns (kv_q int8 (..., 2*dh),
+    scales f32 (..., 2)).  Shared by prefill, both contiguous decode
+    steps, and the paged serving step."""
+    import jax.numpy as jnp
+    sk = jnp.maximum(jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
+    sv = jnp.maximum(jnp.max(jnp.abs(v), axis=-1) / 127.0, 1e-8)
+    kq = jnp.clip(jnp.round(k / sk[..., None]), -127, 127
+                  ).astype(jnp.int8)
+    vq = jnp.clip(jnp.round(v / sv[..., None]), -127, 127
+                  ).astype(jnp.int8)
+    return (jnp.concatenate([kq, vq], axis=-1),
+            jnp.stack([sk, sv], axis=-1).astype(jnp.float32))
+
+
+def _attend_rows(q, ckv, cs, pos, dh):
+    """Single-token attention over a fused (R, L, 2*dh) KV view.
+
+    q: (R, dh); pos: scalar or (R,) per-row absolute position — each
+    row attends to view slots <= its pos.  cs: the int8-KV (R, L, 2)
+    scale view, or None for a float view.  Returns (R, dh) f32.
+
+    The view is LAYOUT-AGNOSTIC: the contiguous path passes the cache
+    buffer itself ((B*H, L, 2*dh) fused batch·head rows — the
+    formulation that streams caches at HBM bandwidth, see the round-4
+    notes in ``_decode_one``), the paged path passes a block-table
+    gather of the page pool (mxnet_tpu/serving/) — so both share this
+    attention code, and per-row ``pos`` is what lets one program mix
+    rows at different sequence positions (continuous batching).
+
+    int8 views fold the dequant scales into the dots: the k scale
+    multiplies the scores (contraction is over dh), the v scale folds
+    into the softmax weights before the second dot."""
+    import jax
+    import jax.numpy as jnp
+    cdt = q.dtype
+    L = ckv.shape[1]
+    if cs is not None:
+        s = jax.lax.dot_general(
+            ckv[:, :, :dh].astype(cdt), q,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, L)
+        s = s * cs[:, :, 0] / jnp.sqrt(jnp.float32(dh))
+    else:
+        s = jax.lax.dot_general(
+            ckv[:, :, :dh], q, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, L)
+        s = s / jnp.sqrt(jnp.float32(dh))
+    valid = jnp.arange(L)[None, :] <= \
+        jnp.expand_dims(jnp.asarray(pos), -1)
+    s = jnp.where(valid, s, -1e30)
+    if cs is not None:
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jax.lax.dot_general(
+            (p * cs[:, :, 1]).astype(cdt),
+            ckv[:, :, dh:].astype(cdt),
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, dh)
+    else:
+        p = jax.nn.softmax(s, axis=-1).astype(cdt)
+        attn = jax.lax.dot_general(
+            p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, dh)
+    return attn
+
+
+def _attend_block(q, ckv, cs, pos, dh):
+    """Block (multi-token) attention over a fused (R, L, 2*dh) KV view:
+    q is (R, S, dh) occupying positions [pos, pos+S) — block row i
+    attends to view slots <= pos+i.  cs as in ``_attend_rows``.
+    Returns (R, S, dh) f32.  The speculative-verify forward and the
+    contiguous prefill-by-block path ride this."""
+    import jax
+    import jax.numpy as jnp
+    cdt = q.dtype
+    L = ckv.shape[1]
+    S = q.shape[1]
+    if cs is not None:
+        s = jax.lax.dot_general(
+            ckv[:, :, :dh].astype(cdt), q,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, L, S)
+        s = s * cs[:, :, 0][:, :, None] / jnp.sqrt(jnp.float32(dh))
+    else:
+        s = jax.lax.dot_general(
+            ckv[:, :, :dh], q, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, L, S)
+        s = s / jnp.sqrt(jnp.float32(dh))
+    valid = jnp.arange(L)[None, :, None] <= \
+        pos + jnp.arange(S)[None, None, :]
+    s = jnp.where(valid, s, -1e30)
+    if cs is not None:
+        p = jax.nn.softmax(s, axis=1)
+        attn = jax.lax.dot_general(
+            (p * cs[:, :, 1][:, :, None]).astype(cdt),
+            ckv[:, :, dh:].astype(cdt),
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, S, dh)
+    else:
+        p = jax.nn.softmax(s, axis=1).astype(cdt)
+        attn = jax.lax.dot_general(
+            p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # (R, S, dh)
+    return attn
+
+
 def _prefill_full(params, cfg, tokens, total, kv_int8=False):
     """Whole-prompt prefill in ONE causal forward pass (round 4; the
     scan-of-_decode_one prefill cost P sequential decoder steps — a
@@ -239,21 +348,11 @@ def _prefill_full(params, cfg, tokens, total, kv_int8=False):
         kf = k.transpose(0, 2, 1, 3).reshape(B * H, P, dh)
         vf = v.transpose(0, 2, 1, 3).reshape(B * H, P, dh)
         if kv_int8:
-            sk = jnp.maximum(jnp.max(jnp.abs(kf), axis=2) / 127.0,
-                             1e-8)                     # (B*H, P)
-            sv = jnp.maximum(jnp.max(jnp.abs(vf), axis=2) / 127.0,
-                             1e-8)
-            kq = jnp.clip(jnp.round(kf / sk[:, :, None]), -127, 127
-                          ).astype(jnp.int8)
-            vq = jnp.clip(jnp.round(vf / sv[:, :, None]), -127, 127
-                          ).astype(jnp.int8)
+            kvq, skv = _kv_quantize(kf, vf)
             ckv = jnp.zeros((B * H, total, 2 * dh), jnp.int8)
-            ckv = jax.lax.dynamic_update_slice(
-                ckv, jnp.concatenate([kq, vq], axis=2), (0, 0, 0))
+            ckv = jax.lax.dynamic_update_slice(ckv, kvq, (0, 0, 0))
             cs = jnp.zeros((B * H, total, 2), jnp.float32)
-            cs = jax.lax.dynamic_update_slice(
-                cs, jnp.stack([sk, sv], axis=2).astype(jnp.float32),
-                (0, 0, 0))
+            cs = jax.lax.dynamic_update_slice(cs, skv, (0, 0, 0))
             caches.append({"kv": ckv, "s": cs})
         else:
             ckv = jnp.zeros((B * H, total, 2 * dh), cdt)
@@ -304,39 +403,16 @@ def _decode_one(params, cfg, token, pos, caches):
         # a full copy every step.
         if "s" in cache:
             # int8 KV cache (generate(kv_int8=True)): per-(row, token)
-            # symmetric s8 with the dequant folded into the dots — the
-            # k scale multiplies the scores (contraction is over dh, so
-            # s[:, l] scales by scale[:, l, 0]), the v scale folds into
-            # the softmax weights before the second dot.  Halves the
-            # cache stream (docs/perf.md "GPT decode").
-            sk = jnp.maximum(jnp.max(jnp.abs(k), axis=1) / 127.0, 1e-8)
-            sv = jnp.maximum(jnp.max(jnp.abs(v), axis=1) / 127.0, 1e-8)
-            kq = jnp.clip(jnp.round(k / sk[:, None]), -127, 127
-                          ).astype(jnp.int8)
-            vq = jnp.clip(jnp.round(v / sv[:, None]), -127, 127
-                          ).astype(jnp.int8)
+            # symmetric s8 with the dequant folded into the dots
+            # (_attend_rows).  Halves the cache stream (docs/perf.md
+            # "GPT decode").
+            kvq, skv = _kv_quantize(k, v)
             ckv = jax.lax.dynamic_update_index_in_dim(
-                cache["kv"], jnp.concatenate([kq, vq], axis=1)[:, None],
-                pos, 1)
+                cache["kv"], kvq[:, None], pos, 1)
             cs = jax.lax.dynamic_update_index_in_dim(
-                cache["s"],
-                jnp.stack([sk, sv], axis=1
-                          ).astype(jnp.float32)[:, None], pos, 1)
+                cache["s"], skv[:, None], pos, 1)
             new_caches.append({"kv": ckv, "s": cs})
-            L = ckv.shape[1]
-            s = jax.lax.dot_general(
-                ckv[:, :, :dh].astype(cdt), q,
-                (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, L)
-            s = s * cs[:, :, 0] / jnp.sqrt(jnp.float32(dh))
-            valid = jnp.arange(L)[None, :] <= pos
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jax.lax.dot_general(
-                (p * cs[:, :, 1]).astype(cdt),
-                ckv[:, :, dh:].astype(cdt),
-                (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, dh)
+            attn = _attend_rows(q, ckv, cs, pos, dh)  # (B*H, dh)
         else:
             # one fused (k|v) buffer per layer: a single DUS per step
             # and two dots over slices — 24 small DUS ops/step cost
@@ -345,17 +421,7 @@ def _decode_one(params, cfg, token, pos, caches):
                 cache["kv"], jnp.concatenate([k, v], axis=1)[:, None],
                 pos, 1)
             new_caches.append({"kv": ckv})
-            L = ckv.shape[1]
-            s = jax.lax.dot_general(
-                ckv[:, :, :dh], q, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, L)
-            s = s / jnp.sqrt(jnp.float32(dh))
-            valid = jnp.arange(L)[None, :] <= pos
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(cdt)
-            attn = jax.lax.dot_general(
-                p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, dh)
+            attn = _attend_rows(q, ckv, None, pos, dh)  # (B*H, dh)
         attn = attn.astype(cdt)
         attn = _wmm(attn.reshape(B, D), layer["wo"], cdt) + \
             dn(layer["bo"])
@@ -444,53 +510,20 @@ def _decode_block(params, cfg, tokens, pos, caches):
         if "s" in cache:
             # int8 KV cache: per-(row, token) symmetric s8, scales
             # folded into the dots exactly as in _decode_one
-            sk = jnp.maximum(jnp.max(jnp.abs(k), axis=2) / 127.0, 1e-8)
-            sv = jnp.maximum(jnp.max(jnp.abs(v), axis=2) / 127.0, 1e-8)
-            kq = jnp.clip(jnp.round(k / sk[:, :, None]), -127, 127
-                          ).astype(jnp.int8)
-            vq = jnp.clip(jnp.round(v / sv[:, :, None]), -127, 127
-                          ).astype(jnp.int8)
-            ckv = jax.lax.dynamic_update_slice(
-                cache["kv"], jnp.concatenate([kq, vq], axis=2),
-                (0, pos, 0))
-            cs = jax.lax.dynamic_update_slice(
-                cache["s"],
-                jnp.stack([sk, sv], axis=2).astype(jnp.float32),
-                (0, pos, 0))
+            kvq, skv = _kv_quantize(k, v)
+            ckv = jax.lax.dynamic_update_slice(cache["kv"], kvq,
+                                               (0, pos, 0))
+            cs = jax.lax.dynamic_update_slice(cache["s"], skv,
+                                              (0, pos, 0))
             new_caches.append({"kv": ckv, "s": cs})
-            L = ckv.shape[1]
-            s = jax.lax.dot_general(
-                ckv[:, :, :dh].astype(cdt), q,
-                (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, L, S)
-            s = s * cs[:, :, 0][:, :, None] / jnp.sqrt(jnp.float32(dh))
-            valid = jnp.arange(L)[None, :, None] <= \
-                pos + jnp.arange(S)[None, None, :]
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=1)
-            attn = jax.lax.dot_general(
-                (p * cs[:, :, 1][:, :, None]).astype(cdt),
-                ckv[:, :, dh:].astype(cdt),
-                (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, S, dh)
+            attn = _attend_block(q, ckv, cs, pos, dh)  # (B*H, S, dh)
         else:
             ckv = jax.lax.dynamic_update_slice(
                 cache["kv"],
                 jnp.concatenate([k, v], axis=2).astype(cdt),
                 (0, pos, 0))
             new_caches.append({"kv": ckv})
-            L = ckv.shape[1]
-            s = jax.lax.dot_general(
-                ckv[:, :, :dh], q, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, L, S)
-            s = s / jnp.sqrt(jnp.float32(dh))
-            valid = jnp.arange(L)[None, :, None] <= \
-                pos + jnp.arange(S)[None, None, :]
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=1).astype(cdt)
-            attn = jax.lax.dot_general(
-                p, ckv[:, :, dh:], (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (B*H, S, dh)
+            attn = _attend_block(q, ckv, None, pos, dh)  # (B*H, S, dh)
         attn = attn.astype(cdt).reshape(B, H, S, dh) \
             .transpose(0, 2, 1, 3).reshape(B, S, D)
         attn = _wmm(attn, layer["wo"], cdt) + dn(layer["bo"])

@@ -1,0 +1,28 @@
+"""Continuous-batching GPT serving engine over a paged KV cache.
+
+The fixed-batch decode entry points (``models/gpt.py generate`` /
+``generate_speculative``) assume a batch of requests that start and
+finish together, with one contiguous max-seq KV allocation per slot —
+real mixed-length traffic pays padding in both HBM and tokens/sec.
+This package is the Orca-style fix: in-flight (iteration-level)
+batching with a vLLM-style paged KV cache.
+
+- ``paged_kv.PagedKVCache`` — fixed-size pages in one preallocated
+  pool per layer, per-request block tables, host-side free-list
+  allocator, int8-KV supported via the existing per-(row, token)
+  scale layout.
+- ``engine.ServingEngine`` — admits new requests into free decode
+  slots each iteration, runs (chunked) prefill for admitted requests
+  and one decode step for running requests in a SINGLE compiled XLA
+  program (padded to static slot/page shapes: exactly one compilation
+  per config), retires finished sequences, and recycles their pages.
+
+Benchmark: ``benchmark/serve_bench.py`` (Poisson arrivals over a mixed
+prompt/output-length distribution); gate ``gpt_serve_mixed_tok_s``.
+Exactness: paged greedy decode is token-identical to ``generate``
+under f32 (``tests/test_serving.py``).
+"""
+from .paged_kv import PagedKVCache
+from .engine import Request, ServingEngine
+
+__all__ = ["PagedKVCache", "Request", "ServingEngine"]

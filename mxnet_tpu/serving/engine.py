@@ -1,0 +1,492 @@
+"""Continuous-batching (Orca-style, iteration-level) GPT decode engine.
+
+One **step program** per engine config — compiled exactly once — takes
+a fixed-shape batch of T token rows, where each row is (token, slot,
+position): running requests contribute ONE decode row each, freshly
+admitted requests contribute up to ``prefill_chunk`` prompt rows
+(chunked prefill), and leftover rows are dead padding aimed at the
+scratch page.  The program embeds all rows, scatters every row's k/v
+into the paged pools at (block_table[slot][pos//page_size],
+pos%page_size), gathers each row's block-table view, and attends via
+the SAME ``_attend_rows`` code the contiguous decode step uses (with
+per-row positions instead of one scalar — that is the whole
+continuous-batching trick at the model level).  Greedy argmax logits
+are read at each slot's last live row.
+
+Scheduling (host side, plain Python — the device never sees dynamic
+shapes):
+
+1. retire finished sequences, recycle their pages;
+2. admit queued requests into free slots while the pool can cover
+   their prompt (+1 decode) pages;
+3. top up pages on demand as running sequences cross a page boundary —
+   if the pool is exhausted, preempt the YOUNGEST running request
+   (free its pages, requeue it at the front; it re-prefills its
+   committed tokens on re-admission, which under greedy decode is
+   recompute-exact);
+4. build the row batch, run the step program, commit sampled tokens,
+   check stop conditions.
+
+Exactness: under f32 greedy, engine outputs are token-identical to
+``models/gpt.py generate`` per request, whatever the batch mix,
+admission order, page reuse, or preemptions — pinned by
+``tests/test_serving.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..models import gpt as G
+from .paged_kv import PagedKVCache
+
+__all__ = ["Request", "ServingEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its in-flight bookkeeping."""
+    rid: int
+    prompt: np.ndarray                    # (P,) int32, immutable
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+    state: str = "queued"                 # queued|running|done|cancelled
+    # runtime (engine-owned)
+    slot: Optional[int] = None
+    pages: List[int] = dataclasses.field(default_factory=list)
+    n_prefilled: int = 0                  # input rows already fed
+    n_cached: int = 0                     # positions written to cache
+    pending: Optional[int] = None         # sampled, not yet in cache
+    submit_t: float = 0.0
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def resume_input(self):
+        """Prefill source: prompt + committed tokens (after a
+        preemption the whole committed sequence re-prefills)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    @property
+    def output(self):
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+
+# one compiled step program per (cfg, shape) — shared across engines
+_step_cache: Dict[Any, Any] = {}
+_STEP_CACHE_MAX = 8
+
+
+def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
+               kv_int8):
+    """Build (and cache) the jitted unified prefill+decode step."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (cfg, num_slots, n_rows, pages_per_slot, page_size,
+           bool(kv_int8))
+    fn = _step_cache.get(key)
+    if fn is not None:
+        return fn
+
+    cdt = jnp.dtype(cfg.dtype)
+    D, H = cfg.d_model, cfg.n_heads
+    dh = D // H
+    T = n_rows
+    L = pages_per_slot * page_size
+
+    def step(params, pools, tokens, row_slot, row_pos, row_live, bt,
+             slot_last_row):
+        x = G._embed(params, tokens, cdt)              # (T, D)
+        x = x + params["pos_emb"][row_pos].astype(cdt)
+        x = G.T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
+                            params["emb_ln"]["b"].astype(cdt))
+
+        # dead rows write to the scratch page and read garbage the
+        # host never looks at; bt carries one extra all-zero row
+        # (index num_slots) that dead rows point at, so their gathers
+        # touch only the scratch page instead of streaming slot 0's
+        # real pages
+        page_idx = row_pos // page_size                # (T,)
+        page = jnp.where(row_live,
+                         bt[row_slot, page_idx], 0)    # (T,)
+        off = row_pos % page_size
+        row_pages = bt[row_slot]                       # (T, PP)
+        pos_r = jnp.repeat(row_pos, H)                 # (T*H,)
+
+        new_pools = []
+        for layer, pool in zip(params["layers"], pools):
+            def dn(w):
+                return w.astype(cdt)
+            qkv = G._qkv(layer, x, cdt)                # (T, 3D)
+            q = qkv[:, :D].reshape(T, H, dh)
+            k = qkv[:, D:2 * D].reshape(T, H, dh)
+            v = qkv[:, 2 * D:].reshape(T, H, dh)
+
+            if kv_int8:
+                kvq, skv = G._kv_quantize(k, v)        # (T, H, 2dh/2)
+                pool_kv = pool["kv"].at[page, off].set(kvq)
+                pool_s = pool["s"].at[page, off].set(skv)
+                new_pools.append({"kv": pool_kv, "s": pool_s})
+                cs = pool_s[row_pages] \
+                    .transpose(0, 3, 1, 2, 4) \
+                    .reshape(T * H, L, 2)
+            else:
+                newkv = jnp.concatenate([k, v], axis=-1).astype(cdt)
+                pool_kv = pool["kv"].at[page, off].set(newkv)
+                new_pools.append({"kv": pool_kv})
+                cs = None
+            # block-table gather → the (R, L, 2*dh) view the shared
+            # attention code consumes (scatter-before-gather so every
+            # row sees its own k/v, same as the contiguous DUS order)
+            ckv = pool_kv[row_pages] \
+                .transpose(0, 3, 1, 2, 4) \
+                .reshape(T * H, L, 2 * dh)
+            attn = G._attend_rows(q.reshape(T * H, dh), ckv, cs,
+                                  pos_r, dh)           # (T*H, dh) f32
+            attn = attn.astype(cdt)
+            attn = G._wmm(attn.reshape(T, D), layer["wo"], cdt) + \
+                dn(layer["bo"])
+            x = G.T._layer_norm(x + attn, dn(layer["ln1"]["g"]),
+                                dn(layer["ln1"]["b"]))
+            if "moe" in layer:
+                from ..parallel.moe import moe_ffn
+                h, _ = moe_ffn(x[:, None, :], layer["moe"],
+                               n_experts=cfg.n_experts,
+                               top_k=cfg.expert_top_k,
+                               capacity_factor=cfg.capacity_factor,
+                               dtype=cdt)
+                h = h[:, 0, :]
+            else:
+                h = jax.nn.gelu(
+                    G._wmm(x, layer["w1"], cdt) + dn(layer["b1"]),
+                    approximate=True)
+                h = G._wmm(h, layer["w2"], cdt) + dn(layer["b2"])
+            x = G.T._layer_norm(x + h, dn(layer["ln2"]["g"]),
+                                dn(layer["ln2"]["b"]))
+
+        logits = G._lm_head(params, x, cdt)            # (T, V) f32
+        slot_logits = logits[slot_last_row]            # (S, V)
+        next_tok = jnp.argmax(slot_logits, axis=-1).astype(jnp.int32)
+        return next_tok, new_pools
+
+    fn = jax.jit(step, donate_argnums=(1,))
+    if len(_step_cache) >= _STEP_CACHE_MAX:
+        _step_cache.pop(next(iter(_step_cache)))
+    _step_cache[key] = fn
+    return fn
+
+
+class ServingEngine:
+    """Continuous-batching greedy decode over a ``PagedKVCache``.
+
+    Parameters
+    ----------
+    params, cfg : the GPT decode params/config (float or
+        ``quantize_decode_params`` weight-only int8 — same formats as
+        ``generate``).
+    num_slots : concurrent sequences per iteration (the decode batch).
+    page_size : tokens per KV page.
+    num_pages : pool capacity; default fully provisions every slot
+        (``num_slots * pages_per_slot + 1``) — pass less to serve more
+        slots than contiguous HBM would allow (page reuse + preemption
+        absorb the tail).
+    pages_per_slot : per-request length cap in pages; default covers
+        ``cfg.max_len``.
+    prefill_chunk : prompt tokens fed per iteration (chunked prefill
+        rides the same step program; bigger chunks prefill faster but
+        make every iteration's compiled batch wider).
+    kv_int8 : paged int8-KV cache (the round-4 scale layout).
+    """
+
+    def __init__(self, params, cfg, *, num_slots, page_size=16,
+                 num_pages=None, pages_per_slot=None, prefill_chunk=8,
+                 kv_int8=False):
+        if not cfg.causal:
+            cfg = dataclasses.replace(cfg, causal=True)
+        if num_slots < 1:
+            raise ValueError("ServingEngine: num_slots must be >= 1")
+        if prefill_chunk < 1:
+            raise ValueError("ServingEngine: prefill_chunk must be "
+                             ">= 1")
+        if pages_per_slot is None:
+            pages_per_slot = -(-cfg.max_len // page_size)
+        # the attention view may be wider than cfg.max_len (its tail
+        # is masked scratch); positions are bounded by submit()'s
+        # max_len check, which keeps pos_emb indexing in range
+        if num_pages is None:
+            num_pages = num_slots * pages_per_slot + 1
+        if num_pages < pages_per_slot + 1:
+            raise ValueError(
+                "ServingEngine: num_pages (%d) cannot hold one "
+                "max-length request (%d pages + scratch)"
+                % (num_pages, pages_per_slot))
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self.prefill_chunk = prefill_chunk
+        self.kv_int8 = bool(kv_int8)
+        self.max_seq = pages_per_slot * page_size
+        self.n_rows = num_slots + prefill_chunk
+        self.cache = PagedKVCache(cfg, num_pages, page_size,
+                                  kv_int8=self.kv_int8)
+        self._step_fn = _make_step(cfg, num_slots, self.n_rows,
+                                   pages_per_slot, page_size,
+                                   self.kv_int8)
+        self._queue: List[Request] = []
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        self._next_rid = 0
+        self.requests: Dict[int, Request] = {}
+        self.stats = {"steps": 0, "preemptions": 0, "admitted": 0,
+                      "decode_rows": 0, "prefill_rows": 0,
+                      "dead_rows": 0, "peak_pages": 0,
+                      "slot_occupancy_sum": 0.0}
+
+    # ------------------------------------------------------- intake --
+    def submit(self, prompt, max_new_tokens, eos_id=None):
+        """Queue a request; returns its id.  prompt: (P,) ints."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("submit: empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("submit: max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                "submit: %d tokens > engine max_seq %d (pages_per_slot"
+                " * page_size)" % (total, self.max_seq))
+        # the final sampled token never enters the cache, so cache
+        # positions top out at total - 1 <= max_len (same contract as
+        # generate: P + max_new <= cfg.max_len)
+        if total > self.cfg.max_len:
+            raise ValueError("submit: %d tokens > cfg.max_len=%d"
+                             % (total, self.cfg.max_len))
+        req = Request(rid=self._next_rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      eos_id=eos_id, submit_t=time.time())
+        self._next_rid += 1
+        self.requests[req.rid] = req
+        self._queue.append(req)
+        return req.rid
+
+    def cancel(self, rid):
+        """Force-retire a request (frees its slot and pages
+        immediately; queued requests are simply dropped).  A cancel
+        landing after completion — the inherent client race — is a
+        no-op: the finished output stays retrievable."""
+        req = self.requests[rid]
+        if req.state in ("done", "cancelled"):
+            return
+        if req.state == "queued":
+            self._queue.remove(req)
+        elif req.state == "running":
+            self._release(req)
+        req.state = "cancelled"
+
+    # ----------------------------------------------------- plumbing --
+    def _release(self, req):
+        if req.pages:
+            self.cache.free(req.pages)
+            req.pages = []
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+
+    def _preempt_for(self, req):
+        """Free one+ pages by preempting the youngest running request
+        other than ``req``; returns True if anything was preempted."""
+        victims = [r for r in self._slots
+                   if r is not None and r is not req]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: r.rid)
+        self._release(victim)
+        victim.state = "queued"
+        victim.n_prefilled = 0
+        victim.n_cached = 0
+        victim.pending = None
+        self._queue.insert(0, victim)
+        self.stats["preemptions"] += 1
+        return True
+
+    def _ensure_page(self, req, pos):
+        """Make req's block table cover position pos (allocating, or
+        preempting another request when the pool is dry)."""
+        idx = pos // self.page_size
+        while idx >= len(req.pages):
+            got = self.cache.alloc(1)
+            if got is None:
+                if not self._preempt_for(req):
+                    raise RuntimeError(
+                        "ServingEngine: page pool exhausted by a "
+                        "single request — grow num_pages")
+                continue
+            req.pages.extend(got)
+        return True
+
+    def _admit(self):
+        while self._queue:
+            free_slots = [i for i, r in enumerate(self._slots)
+                          if r is None]
+            if not free_slots:
+                return
+            req = self._queue[0]
+            inp = req.resume_input
+            need = -(-min(inp.size + 1, self.max_seq)
+                     // self.page_size)
+            got = self.cache.alloc(need)
+            if got is None:
+                return                     # stall admission, not decode
+            self._queue.pop(0)
+            req.pages = got
+            req.slot = free_slots[0]
+            req.state = "running"
+            req.n_prefilled = 0
+            req.n_cached = 0
+            req.pending = None
+            self._slots[req.slot] = req
+            self.stats["admitted"] += 1
+
+    # --------------------------------------------------------- step --
+    def step(self):
+        """One engine iteration.  Returns the list of request ids that
+        finished during this step (possibly empty); False when there
+        is nothing left to do."""
+        import jax.numpy as jnp
+
+        if not self._queue and all(r is None for r in self._slots):
+            return False
+        self._admit()
+
+        # ---- phase A: secure pages.  _ensure_page may PREEMPT the
+        # youngest running request, so all allocation happens before
+        # any row is built — a victim preempted here simply has no
+        # rows this step (build skips slot-less requests); allocating
+        # mid-build could free pages a built row already targets.
+        for req in list(self._slots):
+            if req is not None and req.pending is not None:
+                self._ensure_page(req, req.n_cached)
+        budget = self.prefill_chunk
+        plan = {}                          # rid -> prefill rows planned
+        for req in list(self._slots):
+            if req is None or req.pending is not None or budget <= 0:
+                continue
+            n = min(budget, req.resume_input.size - req.n_prefilled)
+            # _admit allocated ceil((input+1)/page_size) pages, so
+            # every prefill position is already covered — only the
+            # decode-row loop above can allocate (and thus preempt)
+            assert (req.n_prefilled + n - 1) // self.page_size \
+                < len(req.pages)
+            plan[req.rid] = n
+            budget -= n
+
+        # ---- phase B: build the fixed-shape row batch ----
+        T, S = self.n_rows, self.num_slots
+        tokens = np.zeros(T, np.int32)
+        row_slot = np.full(T, S, np.int32)     # dead → all-scratch bt row
+        row_pos = np.zeros(T, np.int32)
+        row_live = np.zeros(T, bool)
+        slot_last_row = np.zeros(S, np.int32)
+        samplers = []                      # requests that sample a token
+        r = 0
+        for req in list(self._slots):      # decode rows
+            if req is None or req.pending is None:
+                continue
+            tokens[r] = req.pending
+            row_slot[r] = req.slot
+            row_pos[r] = req.n_cached
+            row_live[r] = True
+            slot_last_row[req.slot] = r
+            samplers.append(req)
+            self.stats["decode_rows"] += 1
+            r += 1
+        for req in list(self._slots):      # chunked prefill rows
+            if req is None or req.pending is not None:
+                continue
+            inp = req.resume_input
+            for _ in range(plan.get(req.rid, 0)):
+                p = req.n_prefilled
+                tokens[r] = inp[p]
+                row_slot[r] = req.slot
+                row_pos[r] = p
+                row_live[r] = True
+                req.n_prefilled += 1
+                self.stats["prefill_rows"] += 1
+                if req.n_prefilled == inp.size:
+                    slot_last_row[req.slot] = r
+                    samplers.append(req)
+                r += 1
+
+        self.stats["dead_rows"] += T - r
+        self.stats["peak_pages"] = max(self.stats["peak_pages"],
+                                       self.cache.pages_in_use)
+        self.stats["slot_occupancy_sum"] += \
+            sum(r_ is not None for r_ in self._slots) / float(S)
+
+        bt = np.zeros((S + 1, self.pages_per_slot), np.int32)
+        for req in self._slots:
+            if req is not None and req.pages:
+                bt[req.slot, :len(req.pages)] = req.pages
+
+        next_tok, self.cache.pools = self._step_fn(
+            self.params, self.cache.pools,
+            jnp.asarray(tokens), jnp.asarray(row_slot),
+            jnp.asarray(row_pos), jnp.asarray(row_live),
+            jnp.asarray(bt), jnp.asarray(slot_last_row))
+        next_tok = np.asarray(next_tok)
+        self.stats["steps"] += 1
+        now = time.time()
+
+        finished = []
+        for req in samplers:
+            if req.slot is None:           # preempted this step
+                continue
+            # rows written this step are now cached
+            if req.pending is not None:
+                req.n_cached += 1
+            else:
+                req.n_cached = req.n_prefilled
+            tok = int(next_tok[req.slot])
+            req.generated.append(tok)
+            req.token_times.append(now)
+            req.pending = tok
+            if (len(req.generated) >= req.max_new_tokens
+                    or (req.eos_id is not None
+                        and tok == req.eos_id)):
+                req.state = "done"
+                self._release(req)
+                finished.append(req.rid)
+        # slots that fed prefill rows but did not finish their input
+        # this step just advance n_cached
+        for req in self._slots:
+            if req is not None and req.pending is None:
+                req.n_cached = req.n_prefilled
+        return finished
+
+    def run(self):
+        """Drain: step until every submitted request is done (or
+        cancelled).  Returns {rid: (P + generated,) int32}."""
+        while True:
+            out = self.step()
+            if out is False:
+                break
+        return {rid: req.output for rid, req in self.requests.items()
+                if req.state == "done"}
+
+    # --------------------------------------------------- accounting --
+    @property
+    def hbm_held(self):
+        return self.cache.bytes_held
+
+    @property
+    def hbm_pool(self):
+        return self.cache.bytes_pool

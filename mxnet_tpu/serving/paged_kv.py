@@ -1,0 +1,153 @@
+"""Paged KV cache: fixed-size pages in one preallocated pool per layer.
+
+Layout (per decoder layer):
+
+    kv pool : (num_pages, page_size, H, 2*dh)   cfg.dtype | int8
+    s pool  : (num_pages, page_size, H, 2)      f32        (kv_int8)
+
+i.e. each page holds ``page_size`` consecutive token positions of ONE
+sequence, all heads, k and v halves fused in the last axis — the same
+fused k|v layout the contiguous decode caches use ((B*H, L, 2*dh), see
+``models/gpt.py _decode_one``), just chopped along the token axis so
+pages from many sequences share one pool.  A request's cache is its
+**block table**: a (pages_per_slot,) int32 vector of page ids, entry j
+covering positions [j*page_size, (j+1)*page_size).  Attention gathers
+``pool[block_table]`` into exactly the (R, L, 2*dh) view
+``_attend_rows`` already consumes, so the paged and contiguous paths
+share attention code.
+
+Page 0 is the SCRATCH page: unallocated block-table entries and
+padding rows point at it, its contents are written by dead rows and
+never read under the position mask.  The allocator is a host-side
+free list — page ids are plain ints, allocation never touches the
+device; the pools themselves are donated through the engine's step
+program so the buffers update in place.
+
+No zero-fill on recycle: a freed page re-enters the pool with stale
+contents, but a sequence only ever attends to positions <= its own
+written length, and every one of those positions is written by that
+sequence before any mask exposes it (the same pointer-only argument
+as speculative rollback; pinned by the forced-retire test in
+``tests/test_serving.py``).
+
+int8-KV uses the per-(row, token) symmetric-s8 scale layout that
+``models/gpt.py _kv_quantize`` emits (round 4) — the s pool is the
+paged arrangement of the contiguous ``{"kv", "s"}`` cache's scale
+buffer.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ["PagedKVCache", "contiguous_kv_bytes"]
+
+
+def _dtype_size(dtype):
+    import jax.numpy as jnp
+    return jnp.dtype(dtype).itemsize
+
+
+def contiguous_kv_bytes(cfg, batch, total, kv_int8=False):
+    """HBM the contiguous allocator holds for a (batch, total)-shaped
+    decode: B*H*total*2*dh elements per layer (+ the f32 scale pair
+    per (row, token) when int8) — the baseline for the paged-vs-
+    contiguous comparison in benchmark/serve_bench.py."""
+    dh = cfg.d_model // cfg.n_heads
+    rows = batch * cfg.n_heads * total
+    per_row = 2 * dh * (1 if kv_int8 else _dtype_size(cfg.dtype))
+    if kv_int8:
+        per_row += 2 * 4                      # f32 scale pair
+    return rows * per_row * cfg.n_layers
+
+
+class PagedKVCache:
+    """Preallocated per-layer page pools + the host-side page
+    allocator.  ``pools`` is a list (one dict per layer) shaped for
+    the engine's step program; reassign it after every donated call."""
+
+    def __init__(self, cfg, num_pages, page_size, kv_int8=False):
+        import jax.numpy as jnp
+
+        if num_pages < 2:
+            raise ValueError("PagedKVCache: need >= 2 pages (page 0 "
+                             "is scratch)")
+        if page_size < 1:
+            raise ValueError("PagedKVCache: page_size must be >= 1")
+        self.cfg = cfg
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.kv_int8 = kv_int8
+        H = cfg.n_heads
+        dh = cfg.d_model // H
+        cdt = jnp.dtype(cfg.dtype)
+        self.pools = []
+        for _ in range(cfg.n_layers):
+            if kv_int8:
+                self.pools.append({
+                    "kv": jnp.zeros((num_pages, page_size, H, 2 * dh),
+                                    jnp.int8),
+                    "s": jnp.zeros((num_pages, page_size, H, 2),
+                                   jnp.float32),
+                })
+            else:
+                self.pools.append({
+                    "kv": jnp.zeros((num_pages, page_size, H, 2 * dh),
+                                    cdt),
+                })
+        # page 0 is scratch — never allocated
+        self._free = deque(range(1, num_pages))
+        self._in_use = 0
+
+    # ---------------------------------------------------- allocator --
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def pages_in_use(self):
+        return self._in_use
+
+    def alloc(self, n):
+        """Allocate n pages; returns a list of page ids or None if the
+        pool cannot satisfy the request (caller decides to stall or
+        preempt — the allocator never partially allocates)."""
+        if n < 0:
+            raise ValueError("alloc: n must be >= 0")
+        if n > len(self._free):
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        self._in_use += n
+        return out
+
+    def free(self, pages):
+        """Recycle pages (no zero-fill — see the module docstring)."""
+        for p in pages:
+            if not 1 <= p < self.num_pages:
+                raise ValueError("free: bad page id %r" % (p,))
+        self._free.extend(pages)
+        self._in_use -= len(pages)
+
+    # -------------------------------------------------- accounting ---
+    @property
+    def bytes_per_page(self):
+        """Device bytes one page costs across all layers."""
+        H = self.cfg.n_heads
+        dh = self.cfg.d_model // H
+        per_tok = H * 2 * dh * (1 if self.kv_int8
+                                else _dtype_size(self.cfg.dtype))
+        if self.kv_int8:
+            per_tok += H * 2 * 4
+        return per_tok * self.page_size * self.cfg.n_layers
+
+    @property
+    def bytes_held(self):
+        """HBM held by allocated (non-scratch, non-free) pages — the
+        number the serving benchmark reports against
+        ``contiguous_kv_bytes``."""
+        return self._in_use * self.bytes_per_page
+
+    @property
+    def bytes_pool(self):
+        """HBM the whole preallocated pool occupies (the capacity
+        budget the engine was configured with)."""
+        return self.num_pages * self.bytes_per_page

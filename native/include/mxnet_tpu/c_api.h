@@ -54,6 +54,16 @@ int MXImageRecordLoaderCreate(
     int part_index, int num_parts, int rand_crop, int rand_mirror,
     int resize_short, int label_width, const float* mean, const float* std_,
     float scale, int layout_nhwc, int round_batch, ImageLoaderHandle* out);
+/* As above plus dct_scale: 1 allows DCT-domain 1/2^k downscale decode on
+ * the rand_crop (train) path when the source short side stays >= the
+ * resize/crop target (round 7); 0 always decodes at full scale. */
+int MXImageRecordLoaderCreateEx(
+    const char* rec_path, const char* idx_path, int batch_size, int height,
+    int width, int channels, int num_threads, int shuffle, uint64_t seed,
+    int part_index, int num_parts, int rand_crop, int rand_mirror,
+    int resize_short, int label_width, const float* mean, const float* std_,
+    float scale, int layout_nhwc, int round_batch, int dct_scale,
+    ImageLoaderHandle* out);
 /* Fills pointers to the loader-owned batch (valid until next call); returns
  * batch_size via *out_bs, 0 at epoch end; *pad = wrapped padding samples. */
 int MXImageRecordLoaderNext(ImageLoaderHandle h, const float** data,
@@ -72,6 +82,11 @@ int MXImageDecode(const uint8_t* data, size_t size, int* h, int* w, int* c,
 int MXImageDecodeAlloc(const uint8_t* data, size_t size, int* h, int* w,
                        int* c, uint8_t** out_buf);
 int MXBufferFree(void* p);
+/* Per-stage JPEG decode timing (mean ms over reps) into out_ms[4]:
+ * [0] entropy/huffman only, [1] +IDCT (YCbCr, no colorspace conversion),
+ * [2] full RGB, [3] RGB with the min_short-guarded DCT-domain scale. */
+int MXImageDecodeProfile(const uint8_t* data, size_t size, int reps,
+                         int min_short, double* out_ms);
 
 /* ----- dependency engine ------------------------------------------------- */
 /* fn returns 0 on success; on failure it may write a NUL-terminated message

@@ -117,12 +117,13 @@ int MXRecordIOWriterTell(RecordIOWriterHandle h, uint64_t* out) {
 
 /* ----- image pipeline ---------------------------------------------------- */
 
-int MXImageRecordLoaderCreate(
+int MXImageRecordLoaderCreateEx(
     const char* rec_path, const char* idx_path, int batch_size, int height,
     int width, int channels, int num_threads, int shuffle, uint64_t seed,
     int part_index, int num_parts, int rand_crop, int rand_mirror,
     int resize_short, int label_width, const float* mean, const float* std_,
-    float scale, int layout_nhwc, int round_batch, ImageLoaderHandle* out) {
+    float scale, int layout_nhwc, int round_batch, int dct_scale,
+    ImageLoaderHandle* out) {
   API_BEGIN();
   mxnet_tpu::ImageRecParams p;
   p.batch_size = batch_size;
@@ -145,8 +146,22 @@ int MXImageRecordLoaderCreate(
   p.scale = scale;
   p.layout_nhwc = layout_nhwc;
   p.round_batch = round_batch;
+  p.dct_scale = dct_scale;
   *out = new mxnet_tpu::ImageRecordLoader(rec_path, idx_path, p);
   API_END();
+}
+
+int MXImageRecordLoaderCreate(
+    const char* rec_path, const char* idx_path, int batch_size, int height,
+    int width, int channels, int num_threads, int shuffle, uint64_t seed,
+    int part_index, int num_parts, int rand_crop, int rand_mirror,
+    int resize_short, int label_width, const float* mean, const float* std_,
+    float scale, int layout_nhwc, int round_batch, ImageLoaderHandle* out) {
+  return MXImageRecordLoaderCreateEx(
+      rec_path, idx_path, batch_size, height, width, channels, num_threads,
+      shuffle, seed, part_index, num_parts, rand_crop, rand_mirror,
+      resize_short, label_width, mean, std_, scale, layout_nhwc, round_batch,
+      /*dct_scale=*/1, out);
 }
 
 int MXImageRecordLoaderNext(ImageLoaderHandle h, const float** data,
@@ -211,6 +226,14 @@ int MXImageDecodeAlloc(const uint8_t* data, size_t size, int* h, int* w,
 int MXBufferFree(void* p) {
   free(p);
   return 0;
+}
+
+int MXImageDecodeProfile(const uint8_t* data, size_t size, int reps,
+                         int min_short, double* out_ms) {
+  API_BEGIN();
+  if (!mxnet_tpu::ProfileJPEGStages(data, size, reps, min_short, out_ms))
+    throw std::runtime_error("MXImageDecodeProfile: not a decodable JPEG");
+  API_END();
 }
 
 /* ----- engine ------------------------------------------------------------ */

@@ -6,6 +6,7 @@
 #include <setjmp.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cmath>
 #include <stdexcept>
 
@@ -22,9 +23,20 @@ void JpegErrorExit(j_common_ptr cinfo) {
   JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
   longjmp(err->setjmp_buffer, 1);
 }
+
+// Largest 1/2^k (k <= 3) DCT-domain scale keeping min(h, w) >=
+// min_short; 1 means full decode.
+int PickScaleDenom(int h, int w, int min_short) {
+  if (min_short <= 0) return 1;
+  int short_side = std::min(h, w);
+  int denom = 1;
+  while (denom < 8 && short_side / (denom * 2) >= min_short) denom *= 2;
+  return denom;
+}
 }  // namespace
 
-bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out) {
+bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out,
+                int min_short) {
   jpeg_decompress_struct cinfo;
   JpegErrorMgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
@@ -40,6 +52,15 @@ bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out) {
     return false;
   }
   cinfo.out_color_space = JCS_RGB;
+  int denom = PickScaleDenom(cinfo.image_height, cinfo.image_width,
+                             min_short);
+  if (denom > 1) {
+    // DCT-domain downscale: the IDCT itself emits the reduced-size
+    // image (libjpeg scaled idct), so huffman is the only stage still
+    // paying for the full resolution
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
   jpeg_start_decompress(&cinfo);
   out->h = cinfo.output_height;
   out->w = cinfo.output_width;
@@ -52,6 +73,77 @@ bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out) {
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ------------------------------------------------------- stage profile ----
+namespace {
+// One bounded decode pass.  mode 0: entropy (huffman) decode only via
+// jpeg_read_coefficients; 1: full decompress to YCbCr (huffman + IDCT
+// + upsampling, no colorspace conversion); 2: full RGB; 3: RGB with
+// the min_short-guarded DCT-domain scale.
+bool ProfilePass(const uint8_t* data, size_t size, int mode,
+                 int min_short, std::vector<uint8_t>* scratch) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrorExit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  if (mode == 0) {
+    if (!jpeg_read_coefficients(&cinfo)) {
+      jpeg_destroy_decompress(&cinfo);
+      return false;
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return true;
+  }
+  cinfo.out_color_space = mode == 1 ? JCS_YCbCr : JCS_RGB;
+  if (mode == 3) {
+    int denom = PickScaleDenom(cinfo.image_height, cinfo.image_width,
+                               min_short);
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
+  jpeg_start_decompress(&cinfo);
+  size_t stride =
+      static_cast<size_t>(cinfo.output_width) * cinfo.output_components;
+  if (scratch->size() < stride) scratch->resize(stride);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = scratch->data();
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+}  // namespace
+
+bool ProfileJPEGStages(const uint8_t* data, size_t size, int reps,
+                       int min_short, double out_ms[4]) {
+  if (reps < 1) reps = 1;
+  std::vector<uint8_t> scratch;
+  for (int mode = 0; mode < 4; ++mode) {
+    if (!ProfilePass(data, size, mode, min_short, &scratch))
+      return false;                      // warmup + validity check
+    auto t0 = std::chrono::steady_clock::now();
+    for (int r = 0; r < reps; ++r)
+      if (!ProfilePass(data, size, mode, min_short, &scratch))
+        return false;
+    out_ms[mode] = std::chrono::duration<double, std::milli>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count() /
+                   reps;
+  }
   return true;
 }
 
@@ -221,6 +313,13 @@ void ImageRecordLoader::WorkerBody(int tid) {
   std::string rec;
   DecodedImage img, resized, *cur;
   std::mt19937_64 rng(p_.seed * 2654435761u + tid * 40503u + epoch_);
+  // DCT-domain downscale target (train-crop path only): never drop
+  // the decoded short side below what resize/crop needs
+  int dct_min_short = 0;
+  if (p_.dct_scale && p_.rand_crop)
+    dct_min_short = p_.resize_short > 0
+                        ? p_.resize_short
+                        : std::max(p_.height, p_.width);
   const size_t total = num_batches_ * p_.batch_size;
   const size_t hw = static_cast<size_t>(p_.height) * p_.width;
 
@@ -268,7 +367,8 @@ void ImageRecordLoader::WorkerBody(int tid) {
 
     const uint8_t* jpg = reinterpret_cast<const uint8_t*>(rec.data()) + img_off;
     size_t jpg_len = rec.size() - img_off;
-    if (!DecodeJPEG(jpg, jpg_len, &img) && !DecodePNG(jpg, jpg_len, &img))
+    if (!DecodeJPEG(jpg, jpg_len, &img, dct_min_short) &&
+        !DecodePNG(jpg, jpg_len, &img))
       throw std::runtime_error("image decode failed (not JPEG/PNG?)");
 
     cur = &img;

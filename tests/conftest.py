@@ -47,7 +47,9 @@ _SLOW_GROUPS = {
     # group c: ~250s
     "test_pipeline_moe": "c", "test_parallel": "c",
     "test_ring_attention": "c",
-    # group d: ~220s (everything else)
+    # group d: ~220s (everything else, incl. test_serving — the
+    # continuous-batching engine, round 7)
+    "test_serving": "d",
     # group e: ~4min — the collective-matrix pins compile 6 parallel
     # configs' steady-state train steps; too heavy to share a group
     "test_collective_matrix": "e",

@@ -1,0 +1,271 @@
+"""Continuous-batching serving engine (mxnet_tpu/serving/): paged-KV
+greedy decode must be token-identical to ``models/gpt.py generate``
+under f32, page recycling must not leak across requests, and
+preemption-recompute must stay exact.  Slow tier, group d."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
+
+
+def _cfg(**kw):
+    from mxnet_tpu.models import gpt
+    base = dict(use_flash=False, remat=False, dropout=0.0,
+                dtype="float32", vocab_size=128, max_len=64)
+    base.update(kw)
+    return gpt.gpt_tiny(**base)
+
+
+def _ref(params, cfg, prompt, n, **kw):
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    return np.asarray(
+        gpt.generate(params, cfg, jnp.asarray(prompt)[None], n,
+                     **kw))[0]
+
+
+@pytest.mark.slow
+def test_paged_greedy_token_identical_mixed_lengths():
+    """The exactness pin: every request in a mixed prompt/output-length
+    batch decodes token-identically to plain ``generate`` (f32 greedy),
+    through admission waves, chunked prefill, and page recycling —
+    for float and weight-only-int8 params."""
+    import jax
+    from mxnet_tpu.models import gpt, transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(0)
+    shapes = [(5, 8), (3, 12), (9, 4), (2, 6), (7, 10), (4, 9)]
+    for p in (params, gpt.quantize_decode_params(params)):
+        eng = ServingEngine(p, cfg, num_slots=3, page_size=4,
+                            prefill_chunk=6)
+        reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32), N),
+                 N) for P, N in shapes]
+        outs = eng.run()
+        assert eng.stats["admitted"] == len(shapes)
+        for rid, N in reqs:
+            req = eng.requests[rid]
+            ref = _ref(p, cfg, req.prompt, N)
+            np.testing.assert_array_equal(outs[rid], ref)
+        # every page returned to the pool after the drain
+        assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_requests_join_in_flight():
+    """Iteration-level batching: a request submitted while others are
+    mid-decode joins the running batch and still decodes exactly."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(5), cfg)
+    rng = np.random.RandomState(1)
+    eng = ServingEngine(params, cfg, num_slots=3, page_size=4,
+                        prefill_chunk=8)
+    r1 = eng.submit(rng.randint(1, 90, 6).astype(np.int32), 14)
+    r2 = eng.submit(rng.randint(1, 90, 4).astype(np.int32), 10)
+    for _ in range(4):
+        eng.step()
+    # r1/r2 are mid-decode now; r3 joins in flight
+    r3 = eng.submit(rng.randint(1, 90, 5).astype(np.int32), 8)
+    outs = eng.run()
+    for rid, n in ((r1, 14), (r2, 10), (r3, 8)):
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, n))
+
+
+@pytest.mark.slow
+def test_forced_retire_page_reuse_no_leakage():
+    """Page recycling: force-retire a mid-flight request, then admit a
+    new one into a single-request-sized pool so it MUST reuse the
+    freed pages (no zero-fill on recycle) — its output must equal the
+    isolated reference, i.e. no cross-request leakage through stale
+    page contents."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.RandomState(2)
+    # pool = exactly one max-length request (+ scratch): a second
+    # request's lifetime footprint (5 pages of 5) cannot be served
+    # without consuming recycled pages
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        pages_per_slot=5, num_pages=6, prefill_chunk=8)
+    ra = eng.submit(rng.randint(1, 90, 8).astype(np.int32), 12)
+    for _ in range(5):
+        eng.step()
+    req_a = eng.requests[ra]
+    assert req_a.state == "running" and len(req_a.generated) > 0
+    pages_a = set(req_a.pages)
+    assert pages_a
+    eng.cancel(ra)                        # forced retire mid-flight
+    assert req_a.state == "cancelled"
+    assert eng.cache.pages_in_use == 0
+
+    rb = eng.submit(rng.randint(1, 90, 7).astype(np.int32), 12)
+    req_b = eng.requests[rb]
+    seen_b = set()
+    while eng.step() is not False:
+        seen_b |= set(req_b.pages)
+    # the new request really did sit on recycled pages
+    assert seen_b & pages_a, (seen_b, pages_a)
+    assert req_b.state == "done"
+    np.testing.assert_array_equal(
+        req_b.output, _ref(params, cfg, req_b.prompt, 12))
+
+
+@pytest.mark.slow
+def test_preemption_recompute_exact():
+    """An over-committed pool preempts the youngest running request
+    (pages freed, requeued, committed tokens re-prefilled on
+    re-admission) — greedy outputs must stay token-identical for every
+    request, preempted or not."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(9), cfg)
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(params, cfg, num_slots=4, page_size=4,
+                        pages_per_slot=8, num_pages=12,
+                        prefill_chunk=4)
+    reqs = []
+    for P, N in [(6, 20), (4, 24), (8, 16), (3, 22), (5, 18)]:
+        rid = eng.submit(rng.randint(1, 90, P).astype(np.int32), N)
+        reqs.append((rid, N))
+    outs = eng.run()
+    assert eng.stats["preemptions"] > 0, \
+        "pool was sized to force preemption"
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+    assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_paged_int8_kv_agreement():
+    """Paged int8-KV (per-(row, token) s8 pages + f32 scale pages)
+    tracks contiguous ``generate(kv_int8=True)`` the same way the
+    contiguous int8 path tracks fp — greedy agreement, not bit
+    equality (page-view gathers reduce in a different order)."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg(vocab_size=512, d_model=128, n_heads=4, n_layers=3,
+               d_ff=256)
+    params = T.init_params(jax.random.PRNGKey(11), cfg)
+    rng = np.random.RandomState(4)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        kv_int8=True, prefill_chunk=8)
+    reqs = [eng.submit(rng.randint(1, 500, P).astype(np.int32), 12)
+            for P in (5, 7)]
+    outs = eng.run()
+    for rid in reqs:
+        ref = _ref(params, cfg, eng.requests[rid].prompt, 12,
+                   kv_int8=True)
+        assert (outs[rid] == ref).mean() >= 0.9, (outs[rid], ref)
+
+
+@pytest.mark.slow
+def test_serving_eos_stops_early():
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(13), cfg)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = _ref(params, cfg, prompt, 12)
+    eos = int(ref[8])                     # a token greedy WILL emit
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=4)
+    rid = eng.submit(prompt, 12, eos_id=eos)
+    outs = eng.run()
+    assert outs[rid].size <= ref.size
+    assert outs[rid][-1] == eos
+    np.testing.assert_array_equal(outs[rid], ref[:outs[rid].size])
+
+
+@pytest.mark.slow
+def test_serve_bench_smoke():
+    """CI smoke of the serving bench harness (--quick preset): the e2e
+    section must carry both the engine and fixed-batch rows with the
+    accounting the gate and docs rely on."""
+    import json
+    import os
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmark"))
+    import serve_bench
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "serve.json")
+        rc = serve_bench.main(["--quick", "--json", out])
+        assert rc == 0
+        rows = json.load(open(out))
+    e2e = {r["config"].split("_")[0]: r for r in rows
+           if r["section"] == "e2e"}
+    assert set(e2e) == {"engine", "fixed"}
+    eng, base = e2e["engine"], e2e["fixed"]
+    assert eng["tok_s"] > 0 and base["tok_s"] > 0
+    assert 0.0 <= eng["occupancy"] <= 1.0
+    assert eng["hbm_peak_held"] <= eng["hbm_pool"]
+    # equal-HBM comparison: the page pool must not exceed the
+    # baseline's contiguous allocation
+    assert eng["hbm_pool"] <= base["hbm_held"]
+
+
+@pytest.mark.slow
+def test_serving_validation():
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine, PagedKVCache
+
+    cfg = _cfg(max_len=16)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=4)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(10, np.int32), 10)    # 20 > max_len 16
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(0, np.int32), 4)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(4, np.int32), 0)
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, num_slots=1, page_size=4,
+                      num_pages=3)               # < one request
+    with pytest.raises(ValueError):
+        PagedKVCache(cfg, num_pages=1, page_size=4)
+    assert eng.step() is False                   # idle engine
+    # indivisible page_size: the view rounds up past max_len (masked
+    # tail), construction succeeds, submit stays max_len-gated
+    eng7 = ServingEngine(params, cfg, num_slots=1, page_size=7)
+    assert eng7.max_seq == 21
+    with pytest.raises(ValueError):
+        eng7.submit(np.ones(8, np.int32), 9)     # 17 > max_len 16
+
+
+@pytest.mark.slow
+def test_cancel_after_done_is_noop():
+    """A cancel landing after completion (the inherent client race)
+    must not drop the finished output."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(1), cfg)
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=4)
+    rid = eng.submit(np.arange(1, 6, dtype=np.int32), 6)
+    outs = eng.run()
+    eng.cancel(rid)
+    assert eng.requests[rid].state == "done"
+    np.testing.assert_array_equal(eng.requests[rid].output, outs[rid])
