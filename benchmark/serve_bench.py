@@ -24,13 +24,30 @@ Sections (rows carry {"section": ...} in the JSON):
   k = slots/4, slots/2, slots (the batch-occupancy ablation).
 * ``pagesize`` — the e2e engine run swept over page_size (the sweep
   that picked the default of 16).
+* ``telemetry`` (round 8) — the e2e engine run repeated with
+  ``metrics=True``: latency percentiles now come from the ENGINE'S OWN
+  histograms (``serving_ttft_ms`` / ``serving_tbt_ms``,
+  ``mxnet_tpu/obs``) — the source of truth — with one external
+  wall-clock cross-check retained: the harness measures its own
+  per-token intervals around ``step()``, pushes them through an
+  identical histogram, and FAILS (RuntimeError) if the two p99s
+  diverge >10% (a silently skewed trace clock would fail here, not in
+  a dashboard weeks later).  The row also reports
+  ``overhead_incl_harness_pct`` vs the metrics-off e2e run — that
+  number includes the harness's own cross-check loop; the clean
+  metrics-only budget is gated at 3% by
+  ``gpt_serve_metrics_overhead_pct`` (closed loop, cross_check off).
 
 Both sides pre-warm their compiled programs before the clock; tok/s
 counts only requested tokens (baseline padding tokens are waste by
-construction — that is the point being measured).
+construction — that is the point being measured).  All timestamps are
+``time.perf_counter()`` — the engine's telemetry clock — so internal
+and external measurements subtract cleanly.
 
-The ``gpt_serve_mixed_tok_s`` gate (benchmark/perf_regression.py) runs
-``run_gate()`` below: the full-size preset's e2e engine number.
+The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
+``gpt_serve_metrics_overhead_pct`` gates (benchmark/perf_regression.py)
+run ``run_gate()`` / ``run_gate_telemetry()`` below on the full-size
+preset.
 """
 import argparse
 import dataclasses
@@ -121,10 +138,42 @@ def _lat_stats(per_req):
 
 # ------------------------------------------------------------------ runs ---
 
+def _hist_percentiles(samples_ms):
+    """Push wall-clock samples through the SAME fixed-bucket histogram
+    the engine uses, so the external cross-check compares estimator
+    against estimator (clock skew shows up; bucket quantization — up
+    to one bucket width — cancels)."""
+    from mxnet_tpu.obs import Histogram
+    h = Histogram("ext")
+    for s in samples_ms:
+        h.observe(s)
+    return h
+
+
+def _bucket_width_at(v, bounds):
+    """Width of the bucket containing v in the given histogram bounds
+    — the resolution floor of any percentile comparison at that
+    magnitude."""
+    from bisect import bisect_left
+    i = bisect_left(bounds, v)
+    if i >= len(bounds):
+        return bounds[-1]
+    return bounds[i] - (bounds[i - 1] if i > 0 else 0.0)
+
+
 def run_engine(params, cfg, p, workload, num_pages=None,
-               page_size=None, closed_loop_k=None):
+               page_size=None, closed_loop_k=None, metrics=False,
+               cross_check=True):
     """Open-loop (Poisson ``workload``) or closed-loop (``k`` always in
-    flight, workload gives the request shapes) engine run."""
+    flight, workload gives the request shapes) engine run.
+
+    ``metrics=True`` enables the engine's obs layer, reports TTFT/TBT
+    percentiles from the engine-internal histograms, and cross-checks
+    the TBT p99 against this harness's own external wall-clock
+    measurement — >10% divergence raises.  ``cross_check=False`` skips
+    the external measurement entirely: the overhead gate compares
+    metrics-off vs metrics-on ENGINE cost, so the harness's own
+    per-step observation work must not ride along on one side."""
     from mxnet_tpu.serving import ServingEngine
     page_size = page_size or p.page_size
     # size the per-slot cap to the workload, not cfg.max_len — the
@@ -136,36 +185,73 @@ def run_engine(params, cfg, p, workload, num_pages=None,
     eng = ServingEngine(params, cfg, num_slots=p.num_slots,
                         page_size=page_size, num_pages=num_pages,
                         pages_per_slot=pps,
-                        prefill_chunk=p.prefill_chunk)
+                        prefill_chunk=p.prefill_chunk,
+                        metrics=bool(metrics))
     # pre-warm the step program outside the clock (and drop the
-    # warmup's footprint from the reported stats)
+    # warmup's footprint from the reported stats/registry — the
+    # compile time would otherwise own the TTFT tail)
     widp, widn = workload[0][1], workload[0][2]
     wid = eng.submit(widp, widn)
     eng.run()
     del eng.requests[wid]
     for k in eng.stats:
         eng.stats[k] = type(eng.stats[k])()
+    if metrics:
+        eng.reset_metrics()
 
     useful = sum(n for _, _, n in workload)
     arrivals = {}
-    t0 = time.time()
+    t0 = time.perf_counter()
     peak_held = 0
+    # external wall-clock per-token observation (the cross-check):
+    # rid -> [tokens seen, timestamp of the last seen token / submit]
+    ext_seen = {}
+    ext_ttft_ms = []
+    ext_tbt_ms = []
+    observe_ext = metrics and cross_check
+
+    def _ext_collect():
+        """The external wall-clock measurement point: called after each
+        step() return (the engine commits <= 1 token/request/step).
+        Finished requests drop out of the scan so the per-step cost
+        tracks in-flight count, not total submissions."""
+        now_pc = time.perf_counter()
+        retired = []
+        for rid, st in ext_seen.items():
+            req = eng.requests[rid]
+            ng = len(req.generated)
+            if ng > st[0]:
+                dt_ms = (now_pc - st[1]) * 1e3
+                (ext_ttft_ms if st[0] == 0 else ext_tbt_ms).append(
+                    dt_ms)
+                st[0] = ng
+                st[1] = now_pc
+            if req.state in ("done", "cancelled"):
+                retired.append(rid)
+        for rid in retired:
+            del ext_seen[rid]
+
     if closed_loop_k is None:
         pending = list(workload)
         submitted = {}
         while True:
-            now = time.time() - t0
+            now = time.perf_counter() - t0
             while pending and pending[0][0] <= now:
                 at, prompt, n = pending.pop(0)
                 rid = eng.submit(prompt, n)
                 submitted[rid] = n
                 arrivals[rid] = at
+                if observe_ext:
+                    ext_seen[rid] = [0, time.perf_counter()]
             r = eng.step()
             peak_held = max(peak_held, eng.hbm_held)
+            if observe_ext:
+                _ext_collect()
             if r is False:
                 if not pending:
                     break
-                time.sleep(max(0.0, pending[0][0] - (time.time() - t0)))
+                time.sleep(max(0.0, pending[0][0]
+                               - (time.perf_counter() - t0)))
     else:
         pending = list(workload)
         submitted = {}
@@ -175,13 +261,17 @@ def run_engine(params, cfg, p, workload, num_pages=None,
                 at, prompt, n = pending.pop(0)
                 rid = eng.submit(prompt, n)
                 submitted[rid] = n
-                arrivals[rid] = time.time() - t0
+                arrivals[rid] = time.perf_counter() - t0
                 in_flight += 1
+                if observe_ext:
+                    ext_seen[rid] = [0, time.perf_counter()]
             done = eng.step()
             peak_held = max(peak_held, eng.hbm_held)
+            if observe_ext:
+                _ext_collect()
             if done:
                 in_flight -= len(done)
-    wall = time.time() - t0
+    wall = time.perf_counter() - t0
 
     lat = []
     for rid, n in submitted.items():
@@ -189,13 +279,81 @@ def run_engine(params, cfg, p, workload, num_pages=None,
         lat.append((req.token_times[-1] - t0 - arrivals[rid])
                    / max(1, len(req.generated)))
     p50, p99 = _lat_stats(lat)
-    return {"tok_s": useful / wall, "wall_s": wall, "lat_p50_s": p50,
-            "lat_p99_s": p99, "hbm_peak_held": peak_held,
-            "hbm_pool": eng.hbm_pool,
-            "occupancy": eng.stats["slot_occupancy_sum"]
-            / max(1, eng.stats["steps"]),
-            "preemptions": eng.stats["preemptions"],
-            "steps": eng.stats["steps"]}
+    out = {"tok_s": useful / wall, "wall_s": wall, "lat_p50_s": p50,
+           "lat_p99_s": p99, "hbm_peak_held": peak_held,
+           "hbm_pool": eng.hbm_pool,
+           "occupancy": eng.stats["slot_occupancy_sum"]
+           / max(1, eng.stats["steps"]),
+           "preemptions": eng.stats["preemptions"],
+           "steps": eng.stats["steps"]}
+    if metrics:
+        reg = eng.registry
+        h_ttft = reg.histogram("serving_ttft_ms")
+        h_tbt = reg.histogram("serving_tbt_ms")
+        h_step = reg.histogram("serving_step_ms")
+        out.update({
+            "ttft_p50_ms": h_ttft.percentile(50),
+            "ttft_p95_ms": h_ttft.percentile(95),
+            "ttft_p99_ms": h_ttft.percentile(99),
+            "tbt_p50_ms": h_tbt.percentile(50),
+            "tbt_p95_ms": h_tbt.percentile(95),
+            "tbt_p99_ms": h_tbt.percentile(99),
+            "step_p50_ms": h_step.percentile(50),
+        })
+        if not observe_ext:
+            return out
+        # the cross-check, two guards (both fail the BENCH, loudly):
+        #
+        # 1. MEAN — exact arithmetic on both sides (histogram sum/count
+        #    vs the raw external samples), so NO quantization noise: a
+        #    skewed trace clock (wrong clock source, unit confusion)
+        #    shifts every sample proportionally and is caught at 10%.
+        #    The 0.2 ms absolute floor covers the real measurement-
+        #    point separation (internal records at token commit inside
+        #    step(); external after step() returns + harness loop).
+        # 2. p99 — reported side by side as the operator-facing number;
+        #    gated at max(10%, one bucket width at that magnitude):
+        #    percentiles from a fixed-bucket estimator cannot be
+        #    compared finer than the containing bucket, and a handful
+        #    of tail samples landing across an edge under host load is
+        #    quantization, not skew.
+        ext_tbt = _hist_percentiles(ext_tbt_ms)
+        out["ext_ttft_p99_ms"] = \
+            _hist_percentiles(ext_ttft_ms).percentile(99)
+        out["ext_tbt_p99_ms"] = ext_tbt.percentile(99)
+        int_mean = h_tbt.sum / max(1, h_tbt.count)
+        ext_mean = sum(ext_tbt_ms) / max(1, len(ext_tbt_ms))
+        out["tbt_mean_ms"] = int_mean
+        out["ext_tbt_mean_ms"] = ext_mean
+        mean_diff = abs(int_mean - ext_mean)
+        if mean_diff > max(0.10 * ext_mean, 0.2):
+            raise RuntimeError(
+                "serve_bench: engine-internal TBT mean (%.3f ms) vs "
+                "external wall-clock mean (%.3f ms) diverge %.1f%% "
+                "(>10%%) — trace clock is skewed"
+                % (int_mean, ext_mean,
+                   100 * mean_diff / max(ext_mean, 1e-9)))
+        p99_diff = abs(out["tbt_p99_ms"] - out["ext_tbt_p99_ms"])
+        div = p99_diff / max(out["ext_tbt_p99_ms"], 1e-9)
+        out["tbt_p99_divergence"] = div
+        # the p99 hard-gate needs a real tail population: below ~100
+        # samples the p99 is the last order statistic and one
+        # host-scheduler spike between the two measurement points
+        # flips it a bucket (observed on the quick preset under
+        # parallel test load).  The mean gate above stays always-on —
+        # it is the actual clock-skew detector.
+        if len(ext_tbt_ms) >= 100 and \
+                p99_diff > max(0.10 * out["ext_tbt_p99_ms"],
+                               _bucket_width_at(out["ext_tbt_p99_ms"],
+                                                ext_tbt.bounds)):
+            raise RuntimeError(
+                "serve_bench: engine-internal TBT p99 (%.3f ms) vs "
+                "external wall-clock p99 (%.3f ms) diverge %.1f%% "
+                "(>10%% and more than one histogram bucket) — trace "
+                "clock or histogram is skewed"
+                % (out["tbt_p99_ms"], out["ext_tbt_p99_ms"],
+                   100 * div))
+    return out
 
 
 def run_fixed_batch(params, cfg, p, workload, batch):
@@ -266,6 +424,51 @@ def run_gate(preset="full"):
     return run_engine(params, cfg, p, wl, num_pages=pages)["tok_s"]
 
 
+_telemetry_gate_cache = {}
+
+
+def run_gate_telemetry(preset="full"):
+    """Shared run behind the ``gpt_serve_p99_ms`` and
+    ``gpt_serve_metrics_overhead_pct`` gates.
+
+    * ``p99_ms`` — engine-internal TBT p99 from the OPEN-loop e2e
+      workload with metrics on (the latency-distribution gate rides
+      the same Poisson workload as ``gpt_serve_mixed_tok_s``).
+    * ``overhead_pct`` — measured CLOSED-loop (k = num_slots, no
+      arrival pacing or sleeps) and BEST-OF-3 per side, the same
+      jitter-stripping the decode gates use: open-loop tok/s carries
+      multi-percent scheduler/arrival noise, and even closed-loop
+      single runs swing ±10-20% on a busy host — best-of-reps compares
+      the systematic per-step instrument cost, which is what the 3%
+      budget is about.
+
+    Memoized so the two gates share one set of runs."""
+    if preset in _telemetry_gate_cache:
+        return _telemetry_gate_cache[preset]
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    wl = _workload(p, seed=0)
+    batch = max(1, p.num_slots // 2)
+    pages = _equal_hbm_pages(cfg, p, wl, batch)
+    on = run_engine(params, cfg, p, wl, num_pages=pages, metrics=True)
+    k = p.num_slots
+    best_off = max(
+        run_engine(params, cfg, p, wl, num_pages=pages,
+                   closed_loop_k=k)["tok_s"] for _ in range(3))
+    # cross_check=False: the bar charges the ENGINE's instrument cost,
+    # not the harness's own external-observation loop
+    best_on = max(
+        run_engine(params, cfg, p, wl, num_pages=pages,
+                   closed_loop_k=k, metrics=True,
+                   cross_check=False)["tok_s"]
+        for _ in range(3))
+    out = {"p99_ms": on["tbt_p99_ms"],
+           "overhead_pct": 100.0 * (best_off / best_on - 1.0),
+           "tok_s_off": best_off, "tok_s_on": best_on}
+    _telemetry_gate_cache[preset] = out
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="mid",
@@ -274,9 +477,18 @@ def main(argv=None):
                     help="alias for --preset quick")
     ap.add_argument("--sweep", action="store_true",
                     help="also run the occupancy + page-size sweeps")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="skip the metrics-enabled telemetry section")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="profile the telemetry run and dump the "
+                         "combined chrome-trace (op events + request "
+                         "lifecycle spans) to FILE")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
+    if args.trace and args.no_telemetry:
+        ap.error("--trace needs the telemetry section; drop "
+                 "--no-telemetry")
     p = PRESETS["quick" if args.quick else args.preset]
 
     params, cfg = _model(p)
@@ -302,6 +514,46 @@ def main(argv=None):
           "contiguous %d B)" % (e["tok_s"] / base["tok_s"],
                                 e["hbm_pool"], base["hbm_held"]),
           flush=True)
+
+    if not args.no_telemetry:
+        # the metrics-enabled rerun: engine-internal histograms are the
+        # latency source of truth (the external wall-clock cross-check
+        # runs inside run_engine and raises on >10% p99 divergence)
+        t = run_engine(params, cfg, p, wl, num_pages=pages,
+                       metrics=True)
+        if args.trace:
+            # a SEPARATE profiled run produces the dump: tracing has
+            # its own per-step cost (event construction + locked
+            # appends) that must not contaminate the telemetry row's
+            # overhead number above
+            from mxnet_tpu import profiler
+            profiler.set_config(filename=args.trace)
+            profiler.set_state("run")
+            run_engine(params, cfg, p, wl, num_pages=pages,
+                       metrics=True)
+            profiler.set_state("stop")
+            print("chrome trace written to %s" % profiler.dump(),
+                  flush=True)
+        # NOTE the run behind this row keeps cross_check=True, so the
+        # tok/s delta vs the plain e2e run includes the HARNESS's own
+        # external-observation loop, not just the obs layer — hence
+        # the explicit key name.  The clean 3%-budget number is the
+        # gpt_serve_metrics_overhead_pct gate (closed loop,
+        # cross_check off, best-of-3).
+        t.update(section="telemetry", config="engine_metrics",
+                 overhead_incl_harness_pct=100.0
+                 * (e["tok_s"] / t["tok_s"] - 1.0))
+        rows.append(t)
+        print(json.dumps(t), flush=True)
+        print("telemetry: TBT p50/p95/p99 = %.2f/%.2f/%.2f ms "
+              "(engine-internal) vs external p99 %.2f ms "
+              "(divergence %.1f%%); TTFT p99 = %.1f ms; run overhead "
+              "incl. cross-check harness %.1f%% tok/s (the gated "
+              "metrics-only number is gpt_serve_metrics_overhead_pct)"
+              % (t["tbt_p50_ms"], t["tbt_p95_ms"], t["tbt_p99_ms"],
+                 t["ext_tbt_p99_ms"], 100 * t["tbt_p99_divergence"],
+                 t["ttft_p99_ms"], t["overhead_incl_harness_pct"]),
+              flush=True)
 
     if args.sweep:
         for k in sorted({max(1, p.num_slots // 4),

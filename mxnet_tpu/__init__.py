@@ -55,6 +55,7 @@ from . import util             # noqa: E402
 from . import numpy as np      # noqa: E402
 from . import numpy_extension as npx  # noqa: E402
 from . import profiler         # noqa: E402
+from . import obs              # noqa: E402
 from . import runtime          # noqa: E402
 from . import library          # noqa: E402
 from . import rtc              # noqa: E402

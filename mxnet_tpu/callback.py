@@ -3,6 +3,12 @@
 Reference: ``python/mxnet/callback.py`` (SURVEY.md §2.2 "Metrics & train
 utils": ``Speedometer`` samples/sec logging — the throughput number — and
 ``do_checkpoint``).
+
+Round 8: ``MetricsCallback`` gives the training loop the same telemetry
+surface as serving — batch counters, batch-interval histogram, and
+eval-metric gauges in an ``obs.MetricsRegistry``, all visible to
+``obs.prometheus_text()``; ``Speedometer`` optionally publishes its
+samples/sec into a registry gauge.
 """
 from __future__ import annotations
 
@@ -23,15 +29,23 @@ class BatchEndParam:
 
 class Speedometer:
     """Logs training speed and (optionally) metrics every ``frequent``
-    batches (reference: callback.Speedometer)."""
+    batches (reference: callback.Speedometer).  Pass ``registry`` (an
+    ``obs.MetricsRegistry``) to additionally publish the speed as the
+    ``training_samples_per_sec`` gauge on each log tick."""
 
-    def __init__(self, batch_size, frequent=50, auto_reset=True):
+    def __init__(self, batch_size, frequent=50, auto_reset=True,
+                 registry=None):
         self.batch_size = batch_size
         self.frequent = frequent
         self.auto_reset = auto_reset
         self.init = False
         self.tic = 0
         self.last_count = 0
+        self._speed_gauge = None
+        if registry is not None:
+            self._speed_gauge = registry.gauge(
+                "training_samples_per_sec",
+                "Speedometer throughput at the last log tick")
 
     def __call__(self, param: BatchEndParam):
         count = param.nbatch
@@ -42,6 +56,8 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / \
                     (time.time() - self.tic)
+                if self._speed_gauge is not None:
+                    self._speed_gauge.set(speed)
                 if param.eval_metric is not None:
                     nv = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -57,6 +73,60 @@ class Speedometer:
         else:
             self.init = True
             self.tic = time.time()
+
+
+class MetricsCallback:
+    """Batch-end callback feeding an ``obs.MetricsRegistry`` (round 8):
+
+    * ``training_batches_total`` counter and ``training_epoch`` /
+      ``training_nbatch`` gauges on every call;
+    * ``training_batch_interval_ms`` histogram (wall time between
+      batch-end callbacks — the training-step cadence);
+    * every ``frequent`` batches, each eval-metric value as a
+      ``training_metric_<name>`` gauge (names sanitized to the
+      Prometheus alphabet) plus an INFO-level registry snapshot line.
+
+    Uses the process default registry when none is given, so a bare
+    ``MetricsCallback()`` makes the training loop scrapeable through
+    ``obs.prometheus_text()`` alongside serving and native-runtime
+    metrics.
+    """
+
+    def __init__(self, registry=None, frequent=50, log=True):
+        from .obs import default_registry, sanitize_name
+        self._sanitize = sanitize_name
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.frequent = int(max(1, frequent))
+        self.log = log
+        self._batches = self.registry.counter(
+            "training_batches_total", "batch-end callbacks observed")
+        self._epoch = self.registry.gauge("training_epoch")
+        self._nbatch = self.registry.gauge("training_nbatch")
+        self._interval = self.registry.histogram(
+            "training_batch_interval_ms",
+            help="wall time between batch-end callbacks")
+        self._last_t = None
+
+    def __call__(self, param: BatchEndParam):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._interval.observe((now - self._last_t) * 1e3)
+        self._last_t = now
+        self._batches.inc()
+        self._epoch.set(param.epoch)
+        self._nbatch.set(param.nbatch)
+        if param.nbatch % self.frequent != 0:
+            return
+        if param.eval_metric is not None:
+            for name, val in param.eval_metric.get_name_value():
+                self.registry.gauge(
+                    "training_metric_" + self._sanitize(name)).set(val)
+        if self.log:
+            logging.info(
+                "Epoch[%d] Batch [%d]\tmetrics: %d batches, "
+                "interval p50 %.1f ms", param.epoch, param.nbatch,
+                self._batches.value, self._interval.percentile(50))
 
 
 class ProgressBar:

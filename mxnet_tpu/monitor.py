@@ -28,8 +28,14 @@ def _default_stat(x):
 
 
 class Monitor:
+    """``registry`` (round 8): pass an ``obs.MetricsRegistry`` to also
+    publish each scalar stat as a ``monitor_<name>`` gauge at ``toc``
+    time — the same telemetry surface the serving engine and
+    ``callback.MetricsCallback`` feed, scraped by
+    ``obs.prometheus_text()``."""
+
     def __init__(self, interval: int, stat_func: Optional[Callable] = None,
-                 pattern: str = ".*", sort: bool = False):
+                 pattern: str = ".*", sort: bool = False, registry=None):
         self.interval = interval
         self.stat_func = stat_func or _default_stat
         self.re_pattern = re.compile(pattern)
@@ -38,6 +44,7 @@ class Monitor:
         self.activated = False
         self.queue: List[Tuple[int, str, NDArray]] = []
         self._execs = []
+        self.registry = registry
 
     def install(self, exe):
         """Attach to an Executor (called by Module.install_monitor)."""
@@ -71,12 +78,20 @@ class Monitor:
         res = []
         queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \
             else self.queue
+        if self.registry is not None:
+            from .obs import sanitize_name
         for n, k, v_arr in queue:
+            scalar = None
             if isinstance(v_arr, NDArray):
                 v = v_arr.asnumpy()
                 s = str(v.reshape(-1)[0]) if v.size == 1 else str(v)
+                if v.size == 1:
+                    scalar = float(v.reshape(-1)[0])
             else:
                 s = str(v_arr)
+            if self.registry is not None and scalar is not None:
+                self.registry.gauge(
+                    "monitor_" + sanitize_name(k)).set(scalar)
             res.append((n, k, s))
         self.queue = []
         return res

@@ -25,8 +25,9 @@ from .base import MXNetError
 
 __all__ = ["available", "lib", "check_call", "RecordIOReader",
            "RecordIOWriter", "ImageRecordLoader", "imdecode",
-           "decode_profile", "NativeEngine", "Shm", "storage_stats",
-           "features"]
+           "decode_profile", "decode_profile_stats",
+           "decode_profile_reset", "NativeEngine", "engine_stats",
+           "Shm", "storage_stats", "features"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -282,6 +283,27 @@ def decode_profile(buf, reps=20, min_short=0):
             "rgb_ms": out[2], "scaled_ms": out[3]}
 
 
+def decode_profile_stats():
+    """Cumulative decode counters across ``imdecode`` and the threaded
+    loader workers (round 8): successful jpeg/png decodes, decodes where
+    the DCT-domain downscale engaged, and failures.  Resettable via
+    ``decode_profile_reset`` so the Prometheus exporter
+    (``mxnet_tpu.obs``) can publish per-scrape-interval rates."""
+    j = ctypes.c_uint64()
+    p = ctypes.c_uint64()
+    d = ctypes.c_uint64()
+    e = ctypes.c_uint64()
+    check_call(lib().MXImageDecodeProfileStats(
+        ctypes.byref(j), ctypes.byref(p), ctypes.byref(d),
+        ctypes.byref(e)))
+    return {"jpeg": j.value, "png": p.value, "dct_scaled": d.value,
+            "errors": e.value}
+
+
+def decode_profile_reset():
+    check_call(lib().MXImageDecodeProfileReset())
+
+
 # ------------------------------------------------------------------ engine --
 _engine_initialized = False
 
@@ -354,6 +376,21 @@ class NativeEngine:
         out = ctypes.c_uint64()
         check_call(lib().MXEngineVarVersion(var, ctypes.byref(out)))
         return out.value
+
+    def stats(self):
+        return engine_stats()
+
+
+def engine_stats():
+    """Dependency-engine telemetry (round 8): ops dispatched/executed,
+    worker condition-variable wakeups that found work, instantaneous
+    ready-queue depth, in-flight op count, and worker-thread count
+    (0 under NaiveEngine).  Counters are process-lifetime monotonic."""
+    vals = [ctypes.c_uint64() for _ in range(6)]
+    check_call(lib().MXEngineStats(*[ctypes.byref(v) for v in vals]))
+    keys = ("ops_dispatched", "ops_executed", "worker_wakeups",
+            "queue_depth", "outstanding", "workers")
+    return dict(zip(keys, (v.value for v in vals)))
 
 
 # ----------------------------------------------------------------- storage --

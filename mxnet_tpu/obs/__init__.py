@@ -1,0 +1,34 @@
+"""Structured observability for the serving engine, the native
+runtime, and the training loop (ISSUE round 8).
+
+Three pieces, one surface:
+
+* ``metrics`` — lock-cheap Counter / Gauge / fixed-bucket Histogram
+  instruments in a ``MetricsRegistry`` (no per-sample retained
+  allocation on the hot path).
+* ``trace`` — request-lifecycle chrome-trace spans emitted on the SAME
+  clock/pid convention as ``profiler.py``'s op events, so one dump
+  interleaves operator timing with per-request admission/prefill/
+  decode/preempt/retire swimlanes.
+* ``prometheus`` — text exposition joining the default registry, every
+  live ``ServingEngine`` registry, and the native-runtime counters
+  (dependency engine, image decode, host storage pool).
+
+Serving metrics are off by default: enable with
+``ServingEngine(..., metrics=True)`` or ``MXNET_SERVING_METRICS=1``.
+The disabled path is a single ``is None`` branch per step — no dormant
+instruments, no allocation.
+"""
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_MS_BUCKETS, sanitize_name)
+from .prometheus import (default_registry, engine_registries,
+                         prometheus_text, register_engine_registry)
+from .trace import RequestTraceEmitter, REQ_TID_BASE
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_MS_BUCKETS", "sanitize_name",
+    "default_registry", "engine_registries", "prometheus_text",
+    "register_engine_registry",
+    "RequestTraceEmitter", "REQ_TID_BASE",
+]

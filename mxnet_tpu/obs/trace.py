@@ -1,0 +1,107 @@
+"""Request-lifecycle chrome-trace spans on the profiler's clock.
+
+The serving engine's telemetry must land in the SAME dump as the
+profiler's op events (ISSUE round 8: one trace, one clock).  The
+convention:
+
+* **clock** — ``profiler.now_us()`` (``time.perf_counter`` µs), the
+  clock every profiler event already uses.  The serving engine records
+  ``Request.submit_t`` / ``token_times`` with ``time.perf_counter()``,
+  so lifecycle timestamps convert with a bare ``* 1e6``.
+* **pid/tid** — same ``pid`` as the op events (one process = one trace
+  group).  Op events use real thread ids as ``tid``; request rows use
+  ``tid = REQ_TID_BASE + rid`` — far above any OS thread id — with a
+  thread-name metadata event (``ph: "M"``) labelling the row
+  ``req <rid>``, so chrome://tracing shows one swimlane per request
+  under the process, interleaved with the operator lanes.
+* **gating** — spans are emitted only while ``profiler.is_recording()``
+  (mirroring the op hook); the metrics registry is independent of the
+  profiler state.  Emission is batched: the engine collects one step's
+  spans in a plain list and hands them over in a single locked append.
+
+Span vocabulary (cat ``serving``):
+
+* ``admission_wait`` — submit → slot admission (X span)
+* ``prefill[a:b)`` — one chunked-prefill step covering input rows a..b
+* ``decode`` — one decode step's slice on this request's row
+* ``first_token`` / ``preempt`` / ``resume`` / ``retire`` — instants
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .. import profiler
+
+__all__ = ["RequestTraceEmitter", "REQ_TID_BASE"]
+
+# Request swimlane tids start far above OS thread ids (Linux pids/tids
+# top out at ~4M; this keeps the spaces visibly disjoint in a dump).
+REQ_TID_BASE = 1 << 24
+
+
+class RequestTraceEmitter:
+    """Batched emitter of per-request lifecycle events.
+
+    One per serving engine.  All ``add_*`` methods append into an
+    internal list; ``flush()`` hands the batch to the profiler (a
+    no-op returning False while the profiler is not recording — the
+    batch is dropped, never retained, so an engine that runs for hours
+    without a profiler session holds no trace memory).
+    """
+
+    def __init__(self):
+        self._pid = os.getpid()
+        self._pending: List[dict] = []
+        self._batch_rids: set = set()   # rids touched in this batch
+        self._named: set = set()        # rids named in the CURRENT trace
+        self._gen = -1                  # profiler dump generation seen
+
+    def add_span(self, rid: int, name: str, t0_s: float, t1_s: float,
+                 args: Optional[dict] = None):
+        """Complete span from perf_counter seconds t0_s..t1_s."""
+        ev = {"name": name, "ph": "X", "ts": t0_s * 1e6,
+              "dur": max(0.0, (t1_s - t0_s) * 1e6), "pid": self._pid,
+              "tid": REQ_TID_BASE + rid, "cat": "serving"}
+        if args:
+            ev["args"] = args
+        self._pending.append(ev)
+        self._batch_rids.add(rid)
+
+    def add_instant(self, rid: int, name: str, t_s: float,
+                    args: Optional[dict] = None):
+        ev = {"name": name, "ph": "i", "ts": t_s * 1e6,
+              "pid": self._pid, "tid": REQ_TID_BASE + rid, "s": "t",
+              "cat": "serving"}
+        if args:
+            ev["args"] = args
+        self._pending.append(ev)
+        self._batch_rids.add(rid)
+
+    def flush(self) -> bool:
+        """Hand the batch to the profiler; drop it either way.
+
+        Swimlane metadata is decided here, not at add time: each
+        dump() starts a new trace file (``profiler.events_generation``
+        bumps), and every trace needs its own thread_name events or
+        later dumps show raw tids instead of "req N" lanes."""
+        if not self._pending:
+            return False
+        gen = profiler.events_generation()
+        if gen != self._gen:
+            self._gen = gen
+            self._named.clear()
+        meta = [{"name": "thread_name", "ph": "M", "pid": self._pid,
+                 "tid": REQ_TID_BASE + rid,
+                 "args": {"name": "req %d" % rid}}
+                for rid in sorted(self._batch_rids - self._named)]
+        ok = profiler.record_events(meta + self._pending)
+        self._pending = []
+        self._batch_rids = set()
+        if ok:
+            self._named.update(e["tid"] - REQ_TID_BASE for e in meta)
+        else:
+            # profiler not recording: nothing landed — a later session
+            # must re-emit all lane metadata
+            self._named.clear()
+        return ok

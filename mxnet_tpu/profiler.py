@@ -25,7 +25,8 @@ from .engine import Engine
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "memory_stats", "Task", "Frame", "Event", "Counter",
-           "Marker"]
+           "Marker", "now_us", "is_recording", "record_events",
+           "events_generation"]
 
 _lock = threading.Lock()
 _config = {
@@ -42,12 +43,41 @@ _config = {
 _events: List[dict] = []
 _agg: Dict[str, List[float]] = defaultdict(list)
 _state = {"running": False, "paused": False, "hook": None,
-          "xla_running": False}
+          "xla_running": False, "generation": 0}
 _starts = threading.local()
 
 
 def _now_us() -> float:
     return time.perf_counter() * 1e6
+
+
+def now_us() -> float:
+    """The shared trace clock: ``time.perf_counter()`` in microseconds.
+    Every event in a dump — op events, memory counters, user scopes,
+    and the serving layer's request-lifecycle spans (``mxnet_tpu/obs``)
+    — carries a ``ts`` on THIS clock, so they interleave correctly in
+    one chrome://tracing view."""
+    return _now_us()
+
+
+def is_recording() -> bool:
+    """True while collection is active (set_state('run'), not paused) —
+    external emitters (the obs layer) gate their trace writes on this
+    exactly like the op hook does."""
+    return _state["running"] and not _state["paused"]
+
+
+def record_events(events) -> bool:
+    """Append pre-formed chrome-trace event dicts (``ts``/``dur`` on the
+    ``now_us()`` clock) into the profiler's event stream.  Returns False
+    without touching the stream when not recording; the obs layer
+    batches a whole engine step's spans into one call so the lock is
+    taken once per step, not per event."""
+    if not is_recording():
+        return False
+    with _lock:
+        _events.extend(events)
+    return True
 
 
 def _op_hook(event: str, name: str):
@@ -314,7 +344,18 @@ def dump(finished: bool = True, filename: Optional[str] = None):
     if finished:
         with _lock:
             _events.clear()
+            # a new trace begins: emitters holding per-trace state
+            # (the obs layer's swimlane thread_name metadata) key off
+            # this to re-emit into the next dump
+            _state["generation"] += 1
     return fname
+
+
+def events_generation() -> int:
+    """Bumped every time a dump() clears the event stream — one value
+    per trace file.  External emitters re-send per-trace metadata
+    (ph "M" events) when it changes."""
+    return _state["generation"]
 
 
 def dumps(reset: bool = False) -> str:

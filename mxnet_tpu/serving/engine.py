@@ -31,15 +31,31 @@ Exactness: under f32 greedy, engine outputs are token-identical to
 ``models/gpt.py generate`` per request, whatever the batch mix,
 admission order, page reuse, or preemptions — pinned by
 ``tests/test_serving.py``.
+
+Telemetry (round 8, ``mxnet_tpu/obs``): with ``metrics=True`` (or
+``MXNET_SERVING_METRICS=1``) the engine feeds a per-engine
+``MetricsRegistry`` — request/step/row counters, queue-depth and
+page-pool gauges, TTFT / TBT / admission-wait / step-time histograms —
+and, while the profiler is recording, emits per-request lifecycle
+spans (admission_wait / prefill / decode / preempt / retire) into the
+profiler's chrome-trace stream on the shared ``perf_counter`` clock.
+All request timestamps (``Request.submit_t`` / ``token_times``) are on
+that clock.  Metrics are OFF by default; the disabled path is one
+``is None`` test per call site — no instruments exist, nothing
+allocates.
 """
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .. import profiler
+from ..engine import Engine as _HostEngine
 from ..models import gpt as G
 from .paged_kv import PagedKVCache
 
@@ -61,7 +77,11 @@ class Request:
     n_prefilled: int = 0                  # input rows already fed
     n_cached: int = 0                     # positions written to cache
     pending: Optional[int] = None         # sampled, not yet in cache
+    # timestamps are time.perf_counter() seconds — the profiler's trace
+    # clock (profiler.now_us() / 1e6), so lifecycle spans and op events
+    # interleave in one dump
     submit_t: float = 0.0
+    wait_start: float = 0.0               # submit or last preemption
     token_times: List[float] = dataclasses.field(default_factory=list)
 
     @property
@@ -184,6 +204,111 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     return fn
 
 
+_engine_seq = itertools.count()
+
+
+class _EngineObs:
+    """Per-engine observability bundle: a labeled ``MetricsRegistry``
+    (instrument handles bound once at construction — the step path
+    does attribute increments, never name lookups) plus the
+    request-span trace emitter.  Constructed only when metrics are
+    enabled; the engine otherwise carries ``_obs = None`` and every
+    call site is a single ``is None`` branch."""
+
+    def __init__(self, registry=None):
+        from .. import obs as O
+        if registry is None:
+            registry = O.MetricsRegistry(
+                labels={"engine": str(next(_engine_seq))})
+            # self-created registries join the process-wide Prometheus
+            # scrape; an explicitly passed registry stays caller-scoped
+            O.register_engine_registry(registry)
+        self.registry = registry
+        c, g, h = registry.counter, registry.gauge, registry.histogram
+        self.submitted = c("serving_requests_submitted_total",
+                           "requests accepted by submit()")
+        self.admitted = c("serving_requests_admitted_total",
+                          "admissions into a decode slot (resumes "
+                          "after preemption count again)")
+        self.finished = c("serving_requests_finished_total",
+                          "requests retired done")
+        self.cancelled = c("serving_requests_cancelled_total",
+                           "requests retired by cancel()")
+        self.preemptions = c("serving_preemptions_total",
+                             "youngest-victim preemptions")
+        self.steps = c("serving_steps_total", "engine iterations")
+        self.tokens = c("serving_tokens_total",
+                        "tokens committed to requests")
+        self.decode_rows = c("serving_decode_rows_total",
+                             "decode rows fed to the step program")
+        self.prefill_rows = c("serving_prefill_rows_total",
+                              "chunked-prefill rows fed")
+        self.dead_rows = c("serving_dead_rows_total",
+                           "padding rows aimed at the scratch page")
+        self.alloc_calls = c("serving_page_alloc_calls_total",
+                             "page-allocator calls")
+        self.pages_allocated = c("serving_pages_allocated_total",
+                                 "pages handed out")
+        self.pages_freed = c("serving_pages_freed_total",
+                             "pages recycled")
+        self.alloc_failures = c("serving_page_alloc_failures_total",
+                                "allocations refused by a dry pool "
+                                "(caller stalls or preempts)")
+        self.g_running = g("serving_running", "requests holding a slot")
+        self.g_queued = g("serving_queued", "requests waiting for a "
+                          "slot (incl. preempted)")
+        self.g_page_free = g("serving_page_free",
+                             "free-list length (pages)")
+        self.g_pages_in_use = g("serving_pages_in_use",
+                                "allocated non-scratch pages")
+        self.g_hbm_held = g("serving_hbm_held_bytes",
+                            "device bytes held by allocated pages")
+        self.g_step_decode = g("serving_step_decode_rows",
+                               "decode rows in the latest step")
+        self.g_step_prefill = g("serving_step_prefill_rows",
+                                "prefill rows in the latest step")
+        self.g_step_dead = g("serving_step_dead_rows",
+                             "dead rows in the latest step")
+        self.h_admission = h("serving_admission_wait_ms",
+                             help="submit (or preemption) -> slot "
+                                  "admission")
+        self.h_ttft = h("serving_ttft_ms",
+                        help="submit -> first committed token")
+        self.h_tbt = h("serving_tbt_ms",
+                       help="interval between committed tokens "
+                            "(preemption gaps included)")
+        self.h_step = h("serving_step_ms", help="engine step duration")
+        from ..obs import RequestTraceEmitter
+        self.trace = RequestTraceEmitter()
+        # last-seen allocator totals, so sync_cache feeds DELTAS: with
+        # a caller-shared registry two engines would otherwise assign
+        # competing cumulative values and the counters would go
+        # backwards (a Prometheus rate() reads that as a reset)
+        self._cache_seen = [0, 0, 0, 0]
+
+    def sync_cache(self, cache):
+        """Fold the allocator's plain-int telemetry into the registry
+        by increment (cache totals only grow between resets).  v <
+        last-seen means ``reset_telemetry()`` re-baselined the cache:
+        v IS the activity since the reset, so count it rather than
+        dropping everything until totals pass the stale baseline."""
+        vals = (cache.alloc_calls, cache.alloc_pages_total,
+                cache.freed_pages_total, cache.alloc_failures)
+        seen = self._cache_seen
+        for i, (ctr, v) in enumerate(zip(
+                (self.alloc_calls, self.pages_allocated,
+                 self.pages_freed, self.alloc_failures), vals)):
+            d = v - seen[i]
+            if d < 0:              # cache reset: restart from zero
+                d = v
+            if d > 0:
+                ctr.inc(d)
+            seen[i] = v
+        self.g_page_free.set(cache.free_pages)
+        self.g_pages_in_use.set(cache.pages_in_use)
+        self.g_hbm_held.set(cache.bytes_held)
+
+
 class ServingEngine:
     """Continuous-batching greedy decode over a ``PagedKVCache``.
 
@@ -204,11 +329,19 @@ class ServingEngine:
         rides the same step program; bigger chunks prefill faster but
         make every iteration's compiled batch wider).
     kv_int8 : paged int8-KV cache (the round-4 scale layout).
+    metrics : True/False enables/disables the obs layer; None (the
+        default) reads ``MXNET_SERVING_METRICS`` (off unless "1").
+        Disabled means NO instruments exist — the hot path pays one
+        ``is None`` branch.
+    registry : optional ``obs.MetricsRegistry`` to feed (tests /
+        callers wanting isolation); by default the engine creates its
+        own, labeled ``{engine="<n>"}``, and registers it with the
+        process-wide Prometheus scrape.
     """
 
     def __init__(self, params, cfg, *, num_slots, page_size=16,
                  num_pages=None, pages_per_slot=None, prefill_chunk=8,
-                 kv_int8=False):
+                 kv_int8=False, metrics=None, registry=None):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -250,6 +383,16 @@ class ServingEngine:
                       "decode_rows": 0, "prefill_rows": 0,
                       "dead_rows": 0, "peak_pages": 0,
                       "slot_occupancy_sum": 0.0}
+        if metrics is None:
+            # an explicitly supplied registry is a request for
+            # telemetry; otherwise the env var decides
+            metrics = registry is not None or \
+                os.environ.get("MXNET_SERVING_METRICS", "0") == "1"
+        elif not metrics and registry is not None:
+            raise ValueError(
+                "ServingEngine: registry= given but metrics=False — "
+                "the registry would be silently ignored")
+        self._obs = _EngineObs(registry) if metrics else None
 
     # ------------------------------------------------------- intake --
     def submit(self, prompt, max_new_tokens, eos_id=None):
@@ -270,12 +413,16 @@ class ServingEngine:
         if total > self.cfg.max_len:
             raise ValueError("submit: %d tokens > cfg.max_len=%d"
                              % (total, self.cfg.max_len))
+        now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
-                      eos_id=eos_id, submit_t=time.time())
+                      eos_id=eos_id, submit_t=now, wait_start=now)
         self._next_rid += 1
         self.requests[req.rid] = req
         self._queue.append(req)
+        if self._obs is not None:
+            self._obs.submitted.inc()
+            self._obs.g_queued.set(len(self._queue))
         return req.rid
 
     def cancel(self, rid):
@@ -291,6 +438,16 @@ class ServingEngine:
         elif req.state == "running":
             self._release(req)
         req.state = "cancelled"
+        if self._obs is not None:
+            self._obs.cancelled.inc()
+            self._obs.g_queued.set(len(self._queue))
+            self._obs.g_running.set(
+                sum(r is not None for r in self._slots))
+            if profiler.is_recording():
+                self._obs.trace.add_instant(
+                    rid, "retire", time.perf_counter(),
+                    args={"state": "cancelled"})
+                self._obs.trace.flush()
 
     # ----------------------------------------------------- plumbing --
     def _release(self, req):
@@ -316,6 +473,14 @@ class ServingEngine:
         victim.pending = None
         self._queue.insert(0, victim)
         self.stats["preemptions"] += 1
+        if self._obs is not None:
+            now = time.perf_counter()
+            victim.wait_start = now
+            self._obs.preemptions.inc()
+            if profiler.is_recording():
+                self._obs.trace.add_instant(
+                    victim.rid, "preempt", now,
+                    args={"committed": len(victim.generated)})
         return True
 
     def _ensure_page(self, req, pos):
@@ -355,6 +520,17 @@ class ServingEngine:
             req.pending = None
             self._slots[req.slot] = req
             self.stats["admitted"] += 1
+            if self._obs is not None:
+                now = time.perf_counter()
+                self._obs.admitted.inc()
+                self._obs.h_admission.observe(
+                    (now - req.wait_start) * 1e3)
+                if profiler.is_recording():
+                    self._obs.trace.add_span(
+                        req.rid, "admission_wait", req.wait_start, now)
+                    if req.generated:
+                        self._obs.trace.add_instant(req.rid, "resume",
+                                                    now)
 
     # --------------------------------------------------------- step --
     def step(self):
@@ -365,6 +541,9 @@ class ServingEngine:
 
         if not self._queue and all(r is None for r in self._slots):
             return False
+        obs = self._obs
+        tracing = obs is not None and profiler.is_recording()
+        t_step0 = time.perf_counter() if obs is not None else 0.0
         self._admit()
 
         # ---- phase A: secure pages.  _ensure_page may PREEMPT the
@@ -397,6 +576,9 @@ class ServingEngine:
         row_live = np.zeros(T, bool)
         slot_last_row = np.zeros(S, np.int32)
         samplers = []                      # requests that sample a token
+        decode_rids = []                   # trace: decode-row requests
+        prefill_spans = []                 # trace: (rid, row_lo, row_hi)
+        n_dec_rows = 0
         r = 0
         for req in list(self._slots):      # decode rows
             if req is None or req.pending is None:
@@ -408,11 +590,15 @@ class ServingEngine:
             slot_last_row[req.slot] = r
             samplers.append(req)
             self.stats["decode_rows"] += 1
+            n_dec_rows += 1
+            if tracing:
+                decode_rids.append(req.rid)
             r += 1
         for req in list(self._slots):      # chunked prefill rows
             if req is None or req.pending is not None:
                 continue
             inp = req.resume_input
+            p0 = req.n_prefilled
             for _ in range(plan.get(req.rid, 0)):
                 p = req.n_prefilled
                 tokens[r] = inp[p]
@@ -425,6 +611,8 @@ class ServingEngine:
                     slot_last_row[req.slot] = r
                     samplers.append(req)
                 r += 1
+            if tracing and req.n_prefilled > p0:
+                prefill_spans.append((req.rid, p0, req.n_prefilled))
 
         self.stats["dead_rows"] += T - r
         self.stats["peak_pages"] = max(self.stats["peak_pages"],
@@ -437,14 +625,24 @@ class ServingEngine:
             if req is not None and req.pages:
                 bt[req.slot, :len(req.pages)] = req.pages
 
-        next_tok, self.cache.pools = self._step_fn(
-            self.params, self.cache.pools,
-            jnp.asarray(tokens), jnp.asarray(row_slot),
-            jnp.asarray(row_pos), jnp.asarray(row_live),
-            jnp.asarray(bt), jnp.asarray(slot_last_row))
-        next_tok = np.asarray(next_tok)
+        if obs is not None:
+            # the step program is the serving layer's "operator": route
+            # its start/stop through the host engine's op-hook choke
+            # point so a recording profiler logs it as a cat-"operator"
+            # event interleaved with the request spans below
+            _HostEngine.get().notify("start", "serving_step")
+        try:
+            next_tok, self.cache.pools = self._step_fn(
+                self.params, self.cache.pools,
+                jnp.asarray(tokens), jnp.asarray(row_slot),
+                jnp.asarray(row_pos), jnp.asarray(row_live),
+                jnp.asarray(bt), jnp.asarray(slot_last_row))
+            next_tok = np.asarray(next_tok)    # device sync
+        finally:
+            if obs is not None:
+                _HostEngine.get().notify("stop", "serving_step")
         self.stats["steps"] += 1
-        now = time.time()
+        now = time.perf_counter()
 
         finished = []
         for req in samplers:
@@ -456,6 +654,16 @@ class ServingEngine:
             else:
                 req.n_cached = req.n_prefilled
             tok = int(next_tok[req.slot])
+            if obs is not None:
+                obs.tokens.inc()
+                if req.token_times:
+                    obs.h_tbt.observe(
+                        (now - req.token_times[-1]) * 1e3)
+                elif not req.generated:
+                    obs.h_ttft.observe((now - req.submit_t) * 1e3)
+                    if tracing:
+                        obs.trace.add_instant(req.rid, "first_token",
+                                              now)
             req.generated.append(tok)
             req.token_times.append(now)
             req.pending = tok
@@ -465,11 +673,45 @@ class ServingEngine:
                 req.state = "done"
                 self._release(req)
                 finished.append(req.rid)
+                if obs is not None:
+                    obs.finished.inc()
+                    if tracing:
+                        obs.trace.add_instant(
+                            req.rid, "retire", now,
+                            args={"tokens": len(req.generated)})
         # slots that fed prefill rows but did not finish their input
         # this step just advance n_cached
         for req in self._slots:
             if req is not None and req.pending is None:
                 req.n_cached = req.n_prefilled
+
+        if obs is not None:
+            obs.steps.inc()
+            obs.h_step.observe((now - t_step0) * 1e3)
+            # row-mix counters increment by THIS step's amounts (never
+            # assigned wholesale: engines sharing a caller-supplied
+            # registry must aggregate, not clobber); gauges carry the
+            # step's prefill-vs-decode mix (plan rows were all fed —
+            # the phase-A assert guarantees page coverage)
+            n_pre_rows = sum(plan.values())
+            obs.decode_rows.inc(n_dec_rows)
+            obs.prefill_rows.inc(n_pre_rows)
+            obs.dead_rows.inc(T - r)
+            obs.g_step_decode.set(n_dec_rows)
+            obs.g_step_prefill.set(n_pre_rows)
+            obs.g_step_dead.set(T - r)
+            obs.g_running.set(sum(r_ is not None
+                                  for r_ in self._slots))
+            obs.g_queued.set(len(self._queue))
+            obs.sync_cache(self.cache)
+            if tracing:
+                for rid in decode_rids:
+                    obs.trace.add_span(rid, "decode", t_step0, now)
+                for rid, p0, p1 in prefill_spans:
+                    obs.trace.add_span(rid, "prefill[%d:%d)"
+                                       % (p0, p1), t_step0, now,
+                                       args={"rows": p1 - p0})
+                obs.trace.flush()
         return finished
 
     def run(self):
@@ -483,6 +725,46 @@ class ServingEngine:
                 if req.state == "done"}
 
     # --------------------------------------------------- accounting --
+    @property
+    def metrics_enabled(self):
+        return self._obs is not None
+
+    @property
+    def registry(self):
+        """The engine's ``obs.MetricsRegistry`` (None when metrics are
+        disabled)."""
+        return self._obs.registry if self._obs is not None else None
+
+    def reset_metrics(self):
+        """Zero this engine's telemetry in place (warmup exclusion in
+        benches): registry values, the allocator's cumulative ints,
+        AND the delta tracker that folds the latter into the former —
+        resetting the first two but not the third would silently
+        swallow the warmup's worth of post-reset allocations."""
+        if self._obs is None:
+            return
+        self._obs.registry.reset_values()
+        self.cache.reset_telemetry()
+        self._obs._cache_seen = [0, 0, 0, 0]
+
+    def metrics(self):
+        """JSON-able telemetry snapshot: this engine's counters/gauges,
+        histogram summaries (count/sum/p50/p95/p99 ms), and — when the
+        native runtime is loaded — the dependency engine's
+        ``MXEngineStats``.  ``{"enabled": False}`` when metrics are
+        off."""
+        if self._obs is None:
+            return {"enabled": False}
+        snap = self._obs.registry.snapshot()
+        snap["enabled"] = True
+        try:
+            from .. import native
+            if native.available():
+                snap["native_engine"] = native.engine_stats()
+        except Exception:
+            pass
+        return snap
+
     @property
     def hbm_held(self):
         return self.cache.bytes_held

@@ -97,6 +97,14 @@ class PagedKVCache:
         # page 0 is scratch — never allocated
         self._free = deque(range(1, num_pages))
         self._in_use = 0
+        # allocator telemetry (round 8): plain ints bumped on the
+        # host-side alloc/free path — the serving engine exports them
+        # through its MetricsRegistry.  alloc_failures counts returns
+        # of None (the caller then stalls admission or preempts).
+        self.alloc_calls = 0
+        self.alloc_pages_total = 0
+        self.freed_pages_total = 0
+        self.alloc_failures = 0
 
     # ---------------------------------------------------- allocator --
     @property
@@ -113,11 +121,22 @@ class PagedKVCache:
         preempt — the allocator never partially allocates)."""
         if n < 0:
             raise ValueError("alloc: n must be >= 0")
+        self.alloc_calls += 1
         if n > len(self._free):
+            self.alloc_failures += 1
             return None
         out = [self._free.popleft() for _ in range(n)]
         self._in_use += n
+        self.alloc_pages_total += n
         return out
+
+    def reset_telemetry(self):
+        """Zero the allocator counters (warmup exclusion in benches;
+        the free list and in-use accounting are untouched)."""
+        self.alloc_calls = 0
+        self.alloc_pages_total = 0
+        self.freed_pages_total = 0
+        self.alloc_failures = 0
 
     def free(self, pages):
         """Recycle pages (no zero-fill — see the module docstring)."""
@@ -126,6 +145,7 @@ class PagedKVCache:
                 raise ValueError("free: bad page id %r" % (p,))
         self._free.extend(pages)
         self._in_use -= len(pages)
+        self.freed_pages_total += len(pages)
 
     # -------------------------------------------------- accounting ---
     @property

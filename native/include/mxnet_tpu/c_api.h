@@ -87,6 +87,13 @@ int MXBufferFree(void* p);
  * [2] full RGB, [3] RGB with the min_short-guarded DCT-domain scale. */
 int MXImageDecodeProfile(const uint8_t* data, size_t size, int reps,
                          int min_short, double* out_ms);
+/* Cumulative decode counters across imdecode + the threaded loader
+ * (profile passes excluded): successful JPEG/PNG decodes, decodes where
+ * the DCT-domain downscale engaged, and decode failures.  Resettable so
+ * the Prometheus exporter can publish per-interval pipeline rates. */
+int MXImageDecodeProfileStats(uint64_t* jpeg, uint64_t* png,
+                              uint64_t* dct_scaled, uint64_t* errors);
+int MXImageDecodeProfileReset(void);
 
 /* ----- dependency engine ------------------------------------------------- */
 /* fn returns 0 on success; on failure it may write a NUL-terminated message
@@ -108,6 +115,13 @@ int MXEnginePushAsync(MXEngineFn fn, void* param, MXEngineDeleter deleter,
 int MXEngineWaitForVar(EngineVarHandle var);
 int MXEngineWaitForAll(void);
 int MXEngineVarVersion(EngineVarHandle var, uint64_t* out);
+/* Engine telemetry (always-on relaxed atomics): ops pushed / executed,
+ * worker cv wakeups that found work, instantaneous ready-queue depth,
+ * in-flight op count, and worker-thread count (0 under NaiveEngine).
+ * Feeds the obs layer's Prometheus exposition. */
+int MXEngineStats(uint64_t* ops_dispatched, uint64_t* ops_executed,
+                  uint64_t* worker_wakeups, uint64_t* queue_depth,
+                  uint64_t* outstanding, uint64_t* workers);
 
 /* ----- pooled host storage ---------------------------------------------- */
 int MXStorageAlloc(size_t size, void** out);

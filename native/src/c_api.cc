@@ -194,8 +194,11 @@ int MXImageDecode(const uint8_t* data, size_t size, int* h, int* w, int* c,
   API_BEGIN();
   mxnet_tpu::DecodedImage img;
   if (!mxnet_tpu::DecodeJPEG(data, size, &img) &&
-      !mxnet_tpu::DecodePNG(data, size, &img))
+      !mxnet_tpu::DecodePNG(data, size, &img)) {
+    mxnet_tpu::GetDecodeStats().errors.fetch_add(1,
+                                                 std::memory_order_relaxed);
     throw std::runtime_error("MXImageDecode: unsupported image format");
+  }
   *h = img.h;
   *w = img.w;
   *c = img.c;
@@ -212,8 +215,11 @@ int MXImageDecodeAlloc(const uint8_t* data, size_t size, int* h, int* w,
   API_BEGIN();
   mxnet_tpu::DecodedImage img;
   if (!mxnet_tpu::DecodeJPEG(data, size, &img) &&
-      !mxnet_tpu::DecodePNG(data, size, &img))
+      !mxnet_tpu::DecodePNG(data, size, &img)) {
+    mxnet_tpu::GetDecodeStats().errors.fetch_add(1,
+                                                 std::memory_order_relaxed);
     throw std::runtime_error("MXImageDecodeAlloc: unsupported image format");
+  }
   *h = img.h;
   *w = img.w;
   *c = img.c;
@@ -233,6 +239,23 @@ int MXImageDecodeProfile(const uint8_t* data, size_t size, int reps,
   API_BEGIN();
   if (!mxnet_tpu::ProfileJPEGStages(data, size, reps, min_short, out_ms))
     throw std::runtime_error("MXImageDecodeProfile: not a decodable JPEG");
+  API_END();
+}
+
+int MXImageDecodeProfileStats(uint64_t* jpeg, uint64_t* png,
+                              uint64_t* dct_scaled, uint64_t* errors) {
+  API_BEGIN();
+  mxnet_tpu::DecodeStats& s = mxnet_tpu::GetDecodeStats();
+  *jpeg = s.jpeg.load(std::memory_order_relaxed);
+  *png = s.png.load(std::memory_order_relaxed);
+  *dct_scaled = s.dct_scaled.load(std::memory_order_relaxed);
+  *errors = s.errors.load(std::memory_order_relaxed);
+  API_END();
+}
+
+int MXImageDecodeProfileReset(void) {
+  API_BEGIN();
+  mxnet_tpu::ResetDecodeStats();
   API_END();
 }
 
@@ -301,6 +324,20 @@ int MXEngineVarVersion(EngineVarHandle var, uint64_t* out) {
   API_END();
 }
 
+int MXEngineStats(uint64_t* ops_dispatched, uint64_t* ops_executed,
+                  uint64_t* worker_wakeups, uint64_t* queue_depth,
+                  uint64_t* outstanding, uint64_t* workers) {
+  API_BEGIN();
+  mxnet_tpu::Engine::Stats s = GetEngine()->GetStats();
+  *ops_dispatched = s.ops_dispatched;
+  *ops_executed = s.ops_executed;
+  *worker_wakeups = s.worker_wakeups;
+  *queue_depth = s.queue_depth;
+  *outstanding = s.outstanding;
+  *workers = s.workers;
+  API_END();
+}
+
 /* ----- storage ----------------------------------------------------------- */
 
 int MXStorageAlloc(size_t size, void** out) {
@@ -365,7 +402,7 @@ int MXShmFree(ShmHandle h) {
 
 const char* MXLibInfoFeatures(void) {
   return "RECORDIO,IMAGE_JPEG,IMAGE_PNG,IMAGE_LOADER,ENGINE,NAIVE_ENGINE,"
-         "SHM,STORAGE_POOL";
+         "SHM,STORAGE_POOL,ENGINE_STATS,DECODE_STATS";
 }
 
 }  /* extern "C" */

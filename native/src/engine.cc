@@ -36,6 +36,7 @@ void Engine::DeleteVar(EngineVar* var) {
   op->name = "delete_var";
   op->always_run = true;
   op->delete_target = var;
+  stat_dispatched_.fetch_add(1, std::memory_order_relaxed);
   outstanding_.fetch_add(1);
   Schedule(op);
 }
@@ -58,9 +59,11 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
     if (!std::binary_search(mutate_vars.begin(), mutate_vars.end(), v))
       pure_const.push_back(v);
 
+  stat_dispatched_.fetch_add(1, std::memory_order_relaxed);
   if (naive_) {
     // synchronous: check input exceptions, run, store errors — same
     // observable semantics, zero async
+    stat_executed_.fetch_add(1, std::memory_order_relaxed);
     std::string first_err;
     for (auto* v : pure_const)
       if (v->exception && first_err.empty()) first_err = *v->exception;
@@ -140,6 +143,7 @@ void Engine::WorkerLoop() {
       std::unique_lock<std::mutex> lk(pool_mu_);
       pool_cv_.wait(lk, [&] { return stop_.load() || !ready_.empty(); });
       if (stop_.load() && ready_.empty()) return;
+      stat_wakeups_.fetch_add(1, std::memory_order_relaxed);
       op = ready_.top();
       ready_.pop();
     }
@@ -148,6 +152,7 @@ void Engine::WorkerLoop() {
 }
 
 void Engine::Execute(Opr* op) {
+  stat_executed_.fetch_add(1, std::memory_order_relaxed);
   // propagate input exceptions without running (reference: dependent ops
   // of a failed op are skipped, error flows to their outputs).  A sync_op
   // (WaitForVar's serialized waiter) consumes the var's deferred error in
@@ -282,6 +287,21 @@ std::string Engine::WaitForVar(EngineVar* var) {
     if (global_err_ == var_err) global_err_.clear();
   }
   return var_err;
+}
+
+Engine::Stats Engine::GetStats() {
+  Stats s;
+  s.ops_dispatched = stat_dispatched_.load(std::memory_order_relaxed);
+  s.ops_executed = stat_executed_.load(std::memory_order_relaxed);
+  s.worker_wakeups = stat_wakeups_.load(std::memory_order_relaxed);
+  s.workers = static_cast<uint64_t>(workers_.size());
+  int64_t out = outstanding_.load();
+  s.outstanding = out > 0 ? static_cast<uint64_t>(out) : 0;
+  {
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    s.queue_depth = static_cast<uint64_t>(ready_.size());
+  }
+  return s;
 }
 
 std::string Engine::WaitForAll() {

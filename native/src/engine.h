@@ -87,6 +87,22 @@ class Engine {
 
   bool naive() const { return naive_; }
 
+  // Monotonic engine telemetry (MXEngineStats, ISSUE round 8): counts
+  // are relaxed atomics bumped on the dispatch/execute paths — the
+  // cost is one uncontended atomic add per op, cheap enough to stay
+  // always-on.  queue_depth snapshots ready_.size() under pool_mu_
+  // (instantaneous, not monotonic); outstanding is the in-flight op
+  // count WaitForAll blocks on.
+  struct Stats {
+    uint64_t ops_dispatched;   // PushAsync calls (incl. naive + deletes)
+    uint64_t ops_executed;     // op fns completed (naive: == dispatched)
+    uint64_t worker_wakeups;   // WorkerLoop cv wakeups that found work
+    uint64_t queue_depth;      // ready ops not yet claimed by a worker
+    uint64_t outstanding;      // pushed, not yet completed
+    uint64_t workers;          // worker-thread count (0 under naive)
+  };
+  Stats GetStats();
+
  private:
   void Schedule(Opr* op);
   void Dispatch(Opr* op);
@@ -106,6 +122,9 @@ class Engine {
   bool naive_;
   std::atomic<bool> stop_{false};
   std::atomic<uint64_t> seq_{0};
+  std::atomic<uint64_t> stat_dispatched_{0};
+  std::atomic<uint64_t> stat_executed_{0};
+  std::atomic<uint64_t> stat_wakeups_{0};
   std::atomic<int64_t> outstanding_{0};
   std::mutex pool_mu_;
   std::condition_variable pool_cv_, all_done_cv_;

@@ -12,6 +12,19 @@
 
 namespace mxnet_tpu {
 
+DecodeStats& GetDecodeStats() {
+  static DecodeStats stats;
+  return stats;
+}
+
+void ResetDecodeStats() {
+  DecodeStats& s = GetDecodeStats();
+  s.jpeg.store(0, std::memory_order_relaxed);
+  s.png.store(0, std::memory_order_relaxed);
+  s.dct_scaled.store(0, std::memory_order_relaxed);
+  s.errors.store(0, std::memory_order_relaxed);
+}
+
 // ---------------------------------------------------------------- JPEG ----
 namespace {
 struct JpegErrorMgr {
@@ -73,6 +86,9 @@ bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out,
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
+  DecodeStats& stats = GetDecodeStats();
+  stats.jpeg.fetch_add(1, std::memory_order_relaxed);
+  if (denom > 1) stats.dct_scaled.fetch_add(1, std::memory_order_relaxed);
   return true;
 }
 
@@ -190,6 +206,7 @@ bool DecodePNG(const uint8_t* data, size_t size, DecodedImage* out) {
     rows[y] = out->pixels.data() + static_cast<size_t>(y) * out->w * 3;
   png_read_image(png, rows.data());
   png_destroy_read_struct(&png, &info, nullptr);
+  GetDecodeStats().png.fetch_add(1, std::memory_order_relaxed);
   return true;
 }
 
@@ -368,8 +385,10 @@ void ImageRecordLoader::WorkerBody(int tid) {
     const uint8_t* jpg = reinterpret_cast<const uint8_t*>(rec.data()) + img_off;
     size_t jpg_len = rec.size() - img_off;
     if (!DecodeJPEG(jpg, jpg_len, &img, dct_min_short) &&
-        !DecodePNG(jpg, jpg_len, &img))
+        !DecodePNG(jpg, jpg_len, &img)) {
+      GetDecodeStats().errors.fetch_add(1, std::memory_order_relaxed);
       throw std::runtime_error("image decode failed (not JPEG/PNG?)");
+    }
 
     cur = &img;
     if (p_.resize_short > 0) {

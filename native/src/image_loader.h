@@ -64,6 +64,21 @@ struct DecodedImage {
 bool DecodeJPEG(const uint8_t* data, size_t size, DecodedImage* out,
                 int min_short = 0);
 bool DecodePNG(const uint8_t* data, size_t size, DecodedImage* out);
+
+// Cumulative decode counters (ISSUE round 8): every successful
+// JPEG/PNG decode — imdecode, the threaded loader's workers, profile
+// passes excluded — bumps these relaxed atomics; dct_scaled counts
+// decodes where the DCT-domain downscale actually engaged.  Read via
+// MXImageDecodeProfileStats, reset via MXImageDecodeProfileReset so
+// the Prometheus surface can export per-interval pipeline rates.
+struct DecodeStats {
+  std::atomic<uint64_t> jpeg{0};
+  std::atomic<uint64_t> png{0};
+  std::atomic<uint64_t> dct_scaled{0};
+  std::atomic<uint64_t> errors{0};
+};
+DecodeStats& GetDecodeStats();
+void ResetDecodeStats();
 void ResizeBilinear(const DecodedImage& src, int out_h, int out_w,
                     DecodedImage* dst);
 
