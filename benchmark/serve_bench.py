@@ -378,20 +378,20 @@ def run_fixed_batch(params, cfg, p, workload, batch):
     jax.device_get(o.ravel()[:1])
 
     useful = sum(n for _, _, n in workload)
-    t0 = time.time()
+    t0 = time.perf_counter()
     lat = []
     for i in range(0, len(workload), batch):
         grp = workload[i:i + batch]
         wait_until = max(at for at, _, _ in grp)
-        now = time.time() - t0
+        now = time.perf_counter() - t0
         if now < wait_until:
             time.sleep(wait_until - now)
         o = gpt.generate(params, cfg, pad([pr for _, pr, _ in grp]), Ng)
         jax.device_get(o.ravel()[:1])
-        t_done = time.time() - t0
+        t_done = time.perf_counter() - t0
         for at, _, n in grp:
             lat.append((t_done - at) / max(1, n))
-    wall = time.time() - t0
+    wall = time.perf_counter() - t0
     from mxnet_tpu.serving.paged_kv import contiguous_kv_bytes
     p50, p99 = _lat_stats(lat)
     return {"tok_s": useful / wall, "wall_s": wall, "lat_p50_s": p50,

@@ -41,6 +41,141 @@ _EngineFn = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
                              ctypes.c_void_p, ctypes.c_int)
 _EngineDeleter = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
+_P = ctypes.POINTER
+
+# Complete ctypes prototype table for the C ABI — one entry per function
+# in native/include/mxnet_tpu/c_api.h, applied to the loaded library in
+# _load().  Explicit argtypes/restype everywhere closes the 64-bit
+# handle/size truncation class (a bare Python int passed where a pointer
+# or size_t is expected silently truncates to c_int without them).
+# Machine-checked against the header by tools/analysis/abi.py (rule
+# catalog: docs/static_analysis.md); drift fails tier-1
+# tests/test_static_analysis.py.
+#
+# Representation choices (mirrored in the checker's C->ctypes map):
+#   * `const char**` record out-params bind as POINTER(c_void_p) —
+#     records are binary, c_char_p would NUL-truncate on read;
+#   * `const uint8_t*` image buffers bind as c_char_p so Python bytes
+#     pass without copying.
+_PROTOTYPES = {
+    # ----- error handling / libinfo
+    "MXGetLastError": (ctypes.c_char_p, []),
+    "MXLibInfoFeatures": (ctypes.c_char_p, []),
+    # ----- RecordIO
+    "MXRecordIOReaderCreate": (
+        ctypes.c_int, [ctypes.c_char_p, _P(ctypes.c_void_p)]),
+    "MXRecordIOReaderFree": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXRecordIOReaderReadRecord": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_void_p),
+                       _P(ctypes.c_size_t)]),
+    "MXRecordIOReaderSeek": (
+        ctypes.c_int, [ctypes.c_void_p, ctypes.c_uint64]),
+    "MXRecordIOReaderTell": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_uint64)]),
+    "MXRecordIOWriterCreate": (
+        ctypes.c_int, [ctypes.c_char_p, _P(ctypes.c_void_p)]),
+    "MXRecordIOWriterFree": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXRecordIOWriterWriteRecord": (
+        ctypes.c_int, [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.c_size_t]),
+    "MXRecordIOWriterTell": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_uint64)]),
+    # ----- threaded image pipeline
+    "MXImageRecordLoaderCreate": (
+        ctypes.c_int,
+        [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_int, _P(ctypes.c_float),
+         _P(ctypes.c_float), ctypes.c_float, ctypes.c_int, ctypes.c_int,
+         _P(ctypes.c_void_p)]),
+    "MXImageRecordLoaderCreateEx": (
+        ctypes.c_int,
+        [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_int, _P(ctypes.c_float),
+         _P(ctypes.c_float), ctypes.c_float, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, _P(ctypes.c_void_p)]),
+    "MXImageRecordLoaderNext": (
+        ctypes.c_int, [ctypes.c_void_p, _P(_P(ctypes.c_float)),
+                       _P(_P(ctypes.c_float)), _P(ctypes.c_int),
+                       _P(ctypes.c_int)]),
+    "MXImageRecordLoaderReset": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXImageRecordLoaderNumSamples": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_int64)]),
+    "MXImageRecordLoaderFree": (ctypes.c_int, [ctypes.c_void_p]),
+    # ----- standalone image decode
+    "MXImageDecode": (
+        ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t,
+                       _P(ctypes.c_int), _P(ctypes.c_int),
+                       _P(ctypes.c_int), _P(ctypes.c_uint8),
+                       ctypes.c_size_t]),
+    "MXImageDecodeAlloc": (
+        ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t,
+                       _P(ctypes.c_int), _P(ctypes.c_int),
+                       _P(ctypes.c_int), _P(_P(ctypes.c_uint8))]),
+    "MXBufferFree": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXImageDecodeProfile": (
+        ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                       ctypes.c_int, _P(ctypes.c_double)]),
+    "MXImageDecodeProfileStats": (
+        ctypes.c_int, [_P(ctypes.c_uint64), _P(ctypes.c_uint64),
+                       _P(ctypes.c_uint64), _P(ctypes.c_uint64)]),
+    "MXImageDecodeProfileReset": (ctypes.c_int, []),
+    # ----- dependency engine
+    "MXEngineInit": (ctypes.c_int, [ctypes.c_int, ctypes.c_int]),
+    "MXEngineNewVar": (ctypes.c_int, [_P(ctypes.c_void_p)]),
+    "MXEngineDeleteVar": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXEnginePushAsync": (
+        ctypes.c_int, [_EngineFn, ctypes.c_void_p, _EngineDeleter,
+                       _P(ctypes.c_void_p), ctypes.c_int,
+                       _P(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+                       ctypes.c_char_p]),
+    "MXEngineWaitForVar": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXEngineWaitForAll": (ctypes.c_int, []),
+    "MXEngineVarVersion": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_uint64)]),
+    "MXEngineStats": (
+        ctypes.c_int, [_P(ctypes.c_uint64), _P(ctypes.c_uint64),
+                       _P(ctypes.c_uint64), _P(ctypes.c_uint64),
+                       _P(ctypes.c_uint64), _P(ctypes.c_uint64)]),
+    # ----- pooled host storage
+    "MXStorageAlloc": (
+        ctypes.c_int, [ctypes.c_size_t, _P(ctypes.c_void_p)]),
+    "MXStorageFree": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXStorageReleaseAll": (ctypes.c_int, []),
+    "MXStorageStats": (
+        ctypes.c_int, [_P(ctypes.c_uint64), _P(ctypes.c_uint64),
+                       _P(ctypes.c_uint64)]),
+    # ----- shm segments
+    "MXShmCreate": (
+        ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t,
+                       _P(ctypes.c_void_p)]),
+    "MXShmAttach": (
+        ctypes.c_int, [ctypes.c_char_p, _P(ctypes.c_void_p)]),
+    "MXShmData": (
+        ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_void_p),
+                       _P(ctypes.c_size_t)]),
+    "MXShmUnlink": (ctypes.c_int, [ctypes.c_void_p]),
+    "MXShmFree": (ctypes.c_int, [ctypes.c_void_p]),
+}
+
+
+def _apply_prototypes(lib_handle):
+    """Set argtypes/restype from _PROTOTYPES on every bound symbol;
+    returns the names the library does not export (stale build)."""
+    missing = []
+    for name, (restype, argtypes) in _PROTOTYPES.items():
+        try:
+            fn = getattr(lib_handle, name)
+        except AttributeError:
+            missing.append(name)
+            continue
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return missing
+
 
 def _try_build(force=False):
     if not os.path.isdir(_NATIVE_DIR):
@@ -75,8 +210,23 @@ def _load():
             except OSError:
                 _load_failed = True
                 return None
-        lib.MXGetLastError.restype = ctypes.c_char_p
-        lib.MXLibInfoFeatures.restype = ctypes.c_char_p
+        missing = _apply_prototypes(lib)
+        if missing:
+            # Header symbols absent from the binary: a stale build.
+            # Re-dlopen()ing the same path in THIS process would just
+            # bump the refcount on the already-loaded mapping (glibc
+            # dedupes by name), so rebuild for the NEXT interpreter and
+            # warn now; the missing symbols fail loudly at call time
+            # (AttributeError) rather than corrupting arguments
+            # silently.
+            import warnings
+            rebuilt = _try_build(force=True)
+            warnings.warn(
+                "native library is stale — missing symbols: %s "
+                "(%srestart the process to pick up the rebuilt "
+                "library)" % (", ".join(missing),
+                              "" if rebuilt else "rebuild FAILED; "),
+                RuntimeWarning)
         _lib = lib
     return _lib
 

@@ -637,7 +637,10 @@ class ServingEngine:
                 jnp.asarray(tokens), jnp.asarray(row_slot),
                 jnp.asarray(row_pos), jnp.asarray(row_live),
                 jnp.asarray(bt), jnp.asarray(slot_last_row))
-            next_tok = np.asarray(next_tok)    # device sync
+            # mxlint: allow(host-sync) -- intentional: the ONE device
+            # sync per step; the host scheduler branches on the sampled
+            # tokens (stop conditions, commits) before the next step
+            next_tok = np.asarray(next_tok)
         finally:
             if obs is not None:
                 _HostEngine.get().notify("stop", "serving_step")

@@ -320,7 +320,9 @@ int MXEngineWaitForAll(void) {
 
 int MXEngineVarVersion(EngineVarHandle var, uint64_t* out) {
   API_BEGIN();
-  *out = static_cast<mxnet_tpu::EngineVar*>(var)->version;
+  auto* v = static_cast<mxnet_tpu::EngineVar*>(var);
+  std::lock_guard<std::mutex> lk(v->mu);
+  *out = v->version;
   API_END();
 }
 

@@ -17,7 +17,15 @@ Engine::Engine(int num_workers, bool naive) : naive_(naive) {
 
 Engine::~Engine() {
   WaitForAll();
-  stop_.store(true);
+  {
+    // The predicate store must happen under pool_mu_: a worker that
+    // just evaluated the wait predicate false still holds the mutex
+    // until it blocks, so a store+notify landing in that window is
+    // lost and join() deadlocks (missed wakeup).  Locking orders the
+    // store against every predicate evaluation.
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    stop_.store(true);
+  }
   pool_cv_.notify_all();
   for (auto& w : workers_) w.join();
 }
@@ -62,13 +70,20 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
   stat_dispatched_.fetch_add(1, std::memory_order_relaxed);
   if (naive_) {
     // synchronous: check input exceptions, run, store errors — same
-    // observable semantics, zero async
+    // observable semantics, zero async.  Var fields still need their
+    // mutex: "synchronous" means in-caller-thread, not single-threaded
+    // — concurrent Python threads push on a NaiveEngine (ctypes drops
+    // the GIL), and unlocked version++/exception races corrupt both.
     stat_executed_.fetch_add(1, std::memory_order_relaxed);
     std::string first_err;
-    for (auto* v : pure_const)
+    for (auto* v : pure_const) {
+      std::lock_guard<std::mutex> lk(v->mu);
       if (v->exception && first_err.empty()) first_err = *v->exception;
-    for (auto* v : mutate_vars)
+    }
+    for (auto* v : mutate_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
       if (v->exception && first_err.empty()) first_err = *v->exception;
+    }
     std::string err;
     if (first_err.empty()) {
       if (fn(&err) != 0 && err.empty()) err = "operation failed";
@@ -76,6 +91,7 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
       err = first_err;
     }
     for (auto* v : mutate_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
       v->version++;
       v->exception = err.empty() ? nullptr
                                  : std::make_shared<std::string>(err);
@@ -101,6 +117,10 @@ void Engine::PushAsync(std::function<int(std::string*)> fn,
 }
 
 void Engine::Schedule(Opr* op) {
+  // sched_mu_ makes the whole var-set registration atomic w.r.t. other
+  // pushes (see engine.h) — per-var queue order then agrees with one
+  // global registration order and the waits-for graph cannot cycle.
+  std::lock_guard<std::mutex> reg(sched_mu_);
   int total = static_cast<int>(op->const_vars.size() + op->mutate_vars.size());
   op->wait.store(total + 1);  // +1 guard: avoid dispatch before scan finishes
   int satisfied = 0;
@@ -218,6 +238,8 @@ void Engine::OnComplete(Opr* op, const std::string& err, bool own_failure) {
   }
 }
 
+// mxlint: requires(EngineVar::mu) -- caller holds v->mu (documented
+// precondition, see engine.h)
 void Engine::ProcessQueue(EngineVar* v) {
   while (!v->queue.empty()) {
     auto& head = v->queue.front();
@@ -240,14 +262,18 @@ void Engine::ProcessQueue(EngineVar* v) {
 
 std::string Engine::WaitForVar(EngineVar* var) {
   if (naive_) {
-    if (var->exception) {
-      std::string e = *var->exception;
-      var->exception = nullptr;
-      std::lock_guard<std::mutex> lk(err_mu_);
-      if (global_err_ == e) global_err_.clear();
-      return e;
+    std::string e;
+    {
+      std::lock_guard<std::mutex> vlk(var->mu);
+      if (var->exception) {
+        e = *var->exception;
+        var->exception = nullptr;  // rethrow-once semantics
+      }
     }
-    return "";
+    if (e.empty()) return "";
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (global_err_ == e) global_err_.clear();
+    return e;
   }
   // The waiter is pushed as a WRITE (sync_op): it dispatches only after
   // every op pushed before this call has completed — including dependent

@@ -120,6 +120,16 @@ class Engine {
   };
 
   bool naive_;
+  // Serializes the Schedule() registration scan.  Registration of one
+  // op across its var set must be atomic w.r.t. other registrations:
+  // without it, two threads pushing ops with opposite (const, mutate)
+  // var orders can interleave their queue appends and form a wait
+  // cycle (A queued behind B on v2 while B is queued behind A on v1 —
+  // found by the `make tsan` stress harness, mode `dispatch`).  With
+  // the scan serialized, "X waits on Y" implies Y registered first,
+  // so waits-for is acyclic.  Execution is untouched — this is one
+  // uncontended mutex per push, on the dispatch path only.
+  std::mutex sched_mu_;
   std::atomic<bool> stop_{false};
   std::atomic<uint64_t> seq_{0};
   std::atomic<uint64_t> stat_dispatched_{0};

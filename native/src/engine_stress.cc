@@ -1,0 +1,179 @@
+// Deterministic dependency-engine stress driver for the sanitizer
+// builds (`make tsan` / `make asan`).  Each mode hammers one seam that
+// has bitten before (PR 1's WaitForVar rethrow-once race; this PR's
+// ~Engine missed-wakeup and naive-path var races):
+//
+//   dispatch  N threads push ops with overlapping const/mutate sets;
+//             per-var serialization is verified by a plain (unlocked)
+//             counter per var — a lost writer-exclusion WOULD be a
+//             data race TSan flags and a count mismatch we detect.
+//   waitvar   pushers inject periodic failures while waiter threads
+//             spin on WaitForVar; exercises deferred-exception
+//             propagation + rethrow-once clearing under contention.
+//   shutdown  engine create → burst of ops (+DeleteVar) → immediate
+//             destruction, in a loop; exercises the stop_/notify
+//             handshake and delete-behind-pending-ops.
+//   naive     concurrent pushes on a NaiveEngine (synchronous mode is
+//             in-caller-thread, NOT single-threaded).
+//
+// Exit 0 on success; logic failures exit 1; sanitizer reports abort
+// via TSAN_OPTIONS/ASAN_OPTIONS (halt_on_error, exitcode).  The
+// workload is seeded/deterministic so runs are reproducible — only
+// thread interleaving varies, which is the point.
+#include "engine.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+using mxnet_tpu::Engine;
+using mxnet_tpu::EngineVar;
+
+namespace {
+
+int fail(const char* what) {
+  std::fprintf(stderr, "engine_stress: FAIL: %s\n", what);
+  return 1;
+}
+
+int ModeDispatch(int iters) {
+  Engine eng(4);
+  const int kVars = 8, kThreads = 4;
+  std::vector<EngineVar*> vars;
+  for (int i = 0; i < kVars; ++i) vars.push_back(eng.NewVar());
+  // plain ints on purpose: per-var writer exclusion is the thing under
+  // test, and TSan sees straight through a locked cover-up
+  std::vector<int> counters(kVars, 0);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      for (int i = 0; i < iters; ++i) {
+        int w = (t + i) % kVars;          // mutate var w
+        int r = (t + i + 3) % kVars;      // read var r
+        int* slot = &counters[w];
+        eng.PushAsync(
+            [slot](std::string*) {
+              *slot += 1;
+              return 0;
+            },
+            {vars[r]}, {vars[w]}, /*priority=*/i % 3, "stress");
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  std::string err = eng.WaitForAll();
+  if (!err.empty()) return fail(err.c_str());
+  int total = 0;
+  for (int c : counters) total += c;
+  if (total != kThreads * iters) return fail("dispatch count mismatch");
+  for (auto* v : vars) eng.DeleteVar(v);
+  return 0;
+}
+
+int ModeWaitVar(int iters) {
+  Engine eng(4);
+  EngineVar* var = eng.NewVar();
+  EngineVar* other = eng.NewVar();
+  std::atomic<bool> done{false};
+  std::thread pusher([&] {
+    for (int i = 0; i < iters; ++i) {
+      bool poison = (i % 7 == 3);
+      eng.PushAsync(
+          [poison](std::string* err) {
+            if (poison) {
+              *err = "seeded failure";
+              return -1;
+            }
+            return 0;
+          },
+          {}, {var}, 0, "maybe_fail");
+      // a dependent reader that must be skipped while poisoned
+      eng.PushAsync([](std::string*) { return 0; }, {var}, {other},
+                    0, "dependent");
+    }
+    done.store(true);
+  });
+  int rethrows = 0;
+  while (!done.load() || rethrows == 0) {
+    std::string e = eng.WaitForVar(var);
+    if (!e.empty()) ++rethrows;
+    if (done.load() && rethrows > 0) break;
+  }
+  pusher.join();
+  eng.WaitForVar(var);
+  eng.WaitForVar(other);
+  eng.WaitForAll();
+  eng.DeleteVar(var);
+  eng.DeleteVar(other);
+  if (rethrows == 0) return fail("no deferred error ever surfaced");
+  return 0;
+}
+
+int ModeShutdown(int iters) {
+  for (int i = 0; i < iters; ++i) {
+    Engine eng(2 + i % 3);
+    EngineVar* a = eng.NewVar();
+    EngineVar* b = eng.NewVar();
+    std::atomic<int> ran{0};
+    for (int j = 0; j < 16; ++j) {
+      eng.PushAsync(
+          [&ran](std::string*) {
+            ran.fetch_add(1);
+            return 0;
+          },
+          j % 2 ? std::vector<EngineVar*>{a}
+                : std::vector<EngineVar*>{},
+          j % 2 ? std::vector<EngineVar*>{b}
+                : std::vector<EngineVar*>{a},
+          0, "work");
+    }
+    eng.DeleteVar(a);
+    eng.DeleteVar(b);
+    // destructor: WaitForAll + stop_/notify handshake + join — the
+    // missed-wakeup bug hung exactly here
+  }
+  return 0;
+}
+
+int ModeNaive(int iters) {
+  Engine eng(0, /*naive=*/true);
+  EngineVar* var = eng.NewVar();
+  const int kThreads = 4;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&] {
+      for (int i = 0; i < iters; ++i) {
+        eng.PushAsync([](std::string*) { return 0; }, {}, {var}, 0,
+                      "naive_op");
+        if (i % 16 == 5) eng.WaitForVar(var);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  eng.WaitForAll();
+  eng.DeleteVar(var);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* mode = argc > 1 ? argv[1] : "all";
+  int iters = argc > 2 ? std::atoi(argv[2]) : 200;
+  if (iters <= 0) iters = 200;
+  int rc = 0;
+  if (!std::strcmp(mode, "dispatch") || !std::strcmp(mode, "all"))
+    rc |= ModeDispatch(iters);
+  if (!std::strcmp(mode, "waitvar") || !std::strcmp(mode, "all"))
+    rc |= ModeWaitVar(iters);
+  if (!std::strcmp(mode, "shutdown") || !std::strcmp(mode, "all"))
+    rc |= ModeShutdown(iters / 4 > 0 ? iters / 4 : 1);
+  if (!std::strcmp(mode, "naive") || !std::strcmp(mode, "all"))
+    rc |= ModeNaive(iters);
+  if (rc == 0) std::printf("engine_stress: OK (%s, %d iters)\n", mode,
+                           iters);
+  return rc;
+}

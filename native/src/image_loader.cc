@@ -280,21 +280,29 @@ void ImageRecordLoader::StartEpoch() {
                      : order_.size() / p_.batch_size;
   if (num_batches_ == 0 && !order_.empty()) num_batches_ = 1;
   cursor_.store(0);
-  consumed_ = 0;
-  released_ = 0;
-  leased_ = false;
-  has_error_ = false;
-  error_.clear();
-  stop_.store(false);
-  for (auto& b : ring_) {
-    b->remaining.store(0);
-    b->ready = false;
-    b->pad = 0;
+  {
+    // Workers are joined at this point, but resetting the shared state
+    // under mu_ keeps the discipline uniform (and the release pairs
+    // with the new workers' first acquire).
+    std::lock_guard<std::mutex> lk(mu_);
+    consumed_ = 0;
+    released_ = 0;
+    leased_ = false;
+    has_error_ = false;
+    error_.clear();
+    stop_.store(false);
+    for (auto& b : ring_) {
+      b->remaining.store(0);
+      b->ready = false;
+      b->pad = 0;
+    }
+    // Pre-mark per-batch remaining counters lazily: a batch buffer is
+    // claimed when the first worker touches it; remaining counts down
+    // from batch_size.
+    for (size_t b = 0;
+         b < std::min(static_cast<size_t>(kDepth), num_batches_); ++b)
+      ring_[b % kDepth]->remaining.store(p_.batch_size);
   }
-  // Pre-mark per-batch remaining counters lazily: a batch buffer is claimed
-  // when the first worker touches it; remaining counts down from batch_size.
-  for (size_t b = 0; b < std::min(static_cast<size_t>(kDepth), num_batches_); ++b)
-    ring_[b % kDepth]->remaining.store(p_.batch_size);
   epoch_running_ = true;
   int nthreads = std::max(1, p_.num_threads);
   for (int t = 0; t < nthreads; ++t)
@@ -302,7 +310,13 @@ void ImageRecordLoader::StartEpoch() {
 }
 
 void ImageRecordLoader::StopWorkers() {
-  stop_.store(true);
+  {
+    // Predicate store under the cv mutex: a worker between predicate
+    // check and block holds mu_, and a store+notify in that window is
+    // a lost wakeup (same class as the Engine::~Engine fix).
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_.store(true);
+  }
   cv_space_.notify_all();
   cv_ready_.notify_all();
   for (auto& w : workers_) w.join();
@@ -478,6 +492,9 @@ int ImageRecordLoader::Next(const float** data, const float** label, int* pad) {
   }
   *data = buf->data.data();
   *label = buf->label.data();
+  // mxlint: allow(guarded-field) -- read after ready was observed true
+  // under mu_: the workers' pad writes happen-before the ready store,
+  // and nothing writes this buffer again until it is released below
   *pad = buf->pad;
   {
     std::lock_guard<std::mutex> lk(mu_);

@@ -1,0 +1,32 @@
+/* mxlint ABI-checker fixture header — paired with
+ * abi_fixture_bindings.py.  Seeded drift per rule is asserted by
+ * tests/test_static_analysis.py. */
+#ifndef MXLINT_ABI_FIXTURE_H_
+#define MXLINT_ABI_FIXTURE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* EngineVarHandle;
+
+/* bound correctly in the fixture bindings */
+int MXFixGood(const char* name, uint64_t* out);
+/* bound with a wrong argtype (abi-argtypes) */
+int MXFixDrift(uint64_t* out);
+/* bound with a wrong restype (abi-restype) */
+const char* MXFixRet(void);
+/* bound with a wrong arg count (abi-argcount) */
+int MXFixCount(int a, int b);
+/* not bound at all (abi-unbound) + called without a table entry
+ * (abi-missing-argtypes) */
+int MXFixUnbound(EngineVarHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXLINT_ABI_FIXTURE_H_ */

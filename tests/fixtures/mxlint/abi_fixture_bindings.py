@@ -1,0 +1,30 @@
+"""mxlint ABI-checker fixture bindings — deliberate drift per rule
+(see abi_fixture.h; asserted by tests/test_static_analysis.py).
+
+NOT imported by anything: the checker evaluates the _PROTOTYPES table
+and scans call sites from source, exactly as it does for
+mxnet_tpu/native.py.
+"""
+import ctypes
+
+_P = ctypes.POINTER
+
+_PROTOTYPES = {
+    # correct
+    "MXFixGood": (ctypes.c_int, [ctypes.c_char_p, _P(ctypes.c_uint64)]),
+    # abi-argtypes: header says uint64_t*
+    "MXFixDrift": (ctypes.c_int, [_P(ctypes.c_int)]),
+    # abi-restype: header says const char*
+    "MXFixRet": (ctypes.c_int, []),
+    # abi-argcount: header has two ints
+    "MXFixCount": (ctypes.c_int, [ctypes.c_int]),
+    # abi-unknown-symbol: no such header function
+    "MXFixPhantom": (ctypes.c_int, []),
+}
+
+
+def poke(lib):
+    # abi-missing-argtypes: MXFixUnbound has no _PROTOTYPES entry
+    lib.MXFixUnbound(None)
+    # abi-unknown-symbol at a call site
+    lib.MXFixNowhere()

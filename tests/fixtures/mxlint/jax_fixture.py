@@ -1,0 +1,48 @@
+"""mxlint JAX-linter fixture — seeded violations, linted with
+``lint_source(region_re=".*", clock=True)`` by
+tests/test_static_analysis.py.  Each rule fires exactly once plus one
+pragma-suppressed twin.  Never imported.
+"""
+import time
+
+import numpy as np
+
+
+def hot_step(self, x):
+    out = self._step_fn(x)
+    y = np.asarray(out)            # host-sync fires here
+    # mxlint: allow(host-sync) -- fixture: suppressed twin
+    z = np.asarray(self._step_fn(x))
+    return y, z
+
+
+def hot_item(self, x):
+    out = self._step_fn(x)
+    v = float(out[0])              # host-sync via float() on tainted
+    w = np.asarray(x)              # untainted arg: must NOT fire
+    return v, w
+
+
+def rebuild_per_iter(fns, xs):
+    import jax
+    outs = []
+    for f in fns:
+        step = jax.jit(f)          # retrace: jit inside a loop
+        outs.append(step(xs))
+    for f in fns:
+        # mxlint: allow(retrace) -- fixture: suppressed twin
+        outs.append(jax.jit(f)(xs))
+    return outs
+
+
+def scalar_signature(self, xs):
+    out = self._step_fn(xs, 3)     # retrace: literal scalar in jitted sig
+    return out
+
+
+def stamp():
+    t = time.time()                # clock-mix
+    # mxlint: allow(clock-mix) -- fixture: suppressed twin
+    u = time.time()
+    ok = time.perf_counter()       # right clock: must NOT fire
+    return t, u, ok

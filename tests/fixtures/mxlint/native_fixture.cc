@@ -1,0 +1,85 @@
+// mxlint native-lint fixture — seeded violations per rule, linted with
+// an explicit config by tests/test_static_analysis.py.  Never compiled.
+//
+// Config used by the test:
+//   order:    alpha_mu_ (0) < beta_mu_ (1)
+//   guarded:  member {count: alpha_mu_}, self {shared_}: alpha_mu_
+//   cv_preds: {quit_: beta_mu_}
+#include <condition_variable>
+#include <mutex>
+
+struct Box {
+  std::mutex mu;
+  int count = 0;
+};
+
+struct Fixture {
+  std::mutex alpha_mu_;
+  std::mutex beta_mu_;
+  std::condition_variable cv_;
+  bool quit_ = false;
+  int shared_ = 0;
+
+  void LockOrderBad() {
+    std::lock_guard<std::mutex> b(beta_mu_);
+    std::lock_guard<std::mutex> a(alpha_mu_);  // lock-order fires
+    shared_ += 1;
+  }
+
+  void LockOrderGood() {
+    std::lock_guard<std::mutex> a(alpha_mu_);
+    std::lock_guard<std::mutex> b(beta_mu_);   // ascending: clean
+    shared_ += 1;
+  }
+
+  void GuardedBad(Box* box) {
+    box->count += 1;                           // guarded-field fires
+    shared_ += 1;                              // guarded-field fires
+    // mxlint: allow(guarded-field) -- fixture: suppressed twin
+    shared_ += 1;
+  }
+
+  void GuardedGood(Box* box) {
+    std::lock_guard<std::mutex> a(alpha_mu_);
+    box->count += 1;
+    shared_ += 1;
+  }
+
+  // mxlint: requires(alpha_mu_) -- fixture: precondition-held guard
+  void GuardedPrecondition(Box* box) {
+    box->count += 1;                           // clean via requires()
+  }
+
+  void WaitBad(std::unique_lock<std::mutex>& lk) {
+    cv_.wait(lk);                              // cv-wait-predicate fires
+  }
+
+  void WaitGood(std::unique_lock<std::mutex>& lk) {
+    cv_.wait(lk, [&] { return quit_; });
+  }
+
+  void StopBad() {
+    quit_ = true;                              // cv-pred-unlocked fires
+    cv_.notify_all();
+  }
+
+  void StopGood() {
+    {
+      std::lock_guard<std::mutex> b(beta_mu_);
+      quit_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  void AlphaOnly() {
+    std::lock_guard<std::mutex> a(alpha_mu_);
+    shared_ += 1;
+  }
+
+  // transitive: holds beta_mu_ and calls a function that acquires
+  // alpha_mu_ -> lock-order fires through the call graph
+  void TransitiveBad() {
+    std::lock_guard<std::mutex> b(beta_mu_);
+    AlphaOnly();
+  }
+};

@@ -350,6 +350,89 @@ class TestNativeEngine:
         native.NativeEngine()
 
 
+class TestEngineConcurrencyRegressions:
+    """Round-9 regressions, found by mxlint's native pass + the
+    ``make tsan`` stress harness (tests/test_native_sanitize.py runs
+    the sanitizer side; these pin the semantics from Python)."""
+
+    def test_cross_thread_push_no_dependency_cycle(self):
+        """Registration atomicity: two threads pushing ops with
+        OPPOSITE (const, mutate) var orders used to interleave their
+        per-var queue appends and deadlock (A queued behind B on v2,
+        B behind A on v1).  Schedule() now serializes registration
+        (sched_mu_), making waits-for acyclic — pre-fix this test
+        hangs in wait_for_all within a few hundred iterations."""
+        eng = native.NativeEngine(num_workers=4)
+        v = [eng.new_var() for _ in range(4)]
+        counts = [0] * 4
+        n_iters, n_threads = 150, 4
+
+        def pusher(t):
+            for i in range(n_iters):
+                w = (t + i) % 4          # mutate v[w], read v[r]
+                r = (t + i + 1) % 4      # neighbor: rich cycle soup
+
+                def bump(w=w):
+                    counts[w] += 1       # per-var writer exclusion
+
+                eng.push(bump, const_vars=[v[r]], mutate_vars=[v[w]])
+
+        threads = [threading.Thread(target=pusher, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        eng.wait_for_all()
+        assert sum(counts) == n_threads * n_iters
+        for var in v:
+            eng.delete_var(var)
+        eng.wait_for_all()
+
+    def test_naive_engine_concurrent_pushes(self):
+        """NaiveEngine is synchronous-in-caller-thread, NOT
+        single-threaded: ctypes releases the GIL, so concurrent Python
+        pushes race on var version/exception unless the naive path
+        locks v->mu (it now does).  Lost version++ increments made
+        this flaky pre-fix; TSan flags the data race outright."""
+        eng = native.NativeEngine(engine_type="naive")
+        try:
+            var = eng.new_var()
+            n_threads, n_pushes = 4, 200
+
+            def pusher():
+                for _ in range(n_pushes):
+                    eng.push(lambda: None, mutate_vars=[var])
+
+            threads = [threading.Thread(target=pusher)
+                       for _ in range(n_threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert eng.var_version(var) == n_threads * n_pushes
+            eng.delete_var(var)
+        finally:
+            native.NativeEngine(num_workers=4)  # restore threaded
+
+    def test_shutdown_reinit_cycles(self):
+        """Engine destruction with workers parked on the condvar: the
+        stop_ store now happens under pool_mu_ — storing outside it
+        could land in a waiter's predicate-check-to-block window and
+        lose the wakeup (join deadlock; this test then hangs)."""
+        for i in range(8):
+            eng = native.NativeEngine(num_workers=2 + i % 3)
+            var = eng.new_var()
+            done = []
+            for _ in range(8):
+                eng.push(lambda: done.append(1), mutate_vars=[var])
+            eng.wait_for_all()
+            assert len(done) == 8
+            eng.delete_var(var)
+        # leave the default engine in place for the rest of the suite
+        native.NativeEngine(num_workers=4)
+
+
 class TestStorage:
     def test_pool_reuse(self):
         p1 = native.storage_alloc(1000)

@@ -1,0 +1,112 @@
+"""Sanitizer-hardened engine stress harness (ISSUE 4, slow tier).
+
+Builds the standalone stress driver (``native/src/engine_stress.cc``)
+under ThreadSanitizer and AddressSanitizer (``make tsan`` / ``make
+asan``) and hammers dispatch / WaitForVar / shutdown / naive-mode under
+each.  A binary — not the .so — so the sanitizer runtime links into the
+executable and no LD_PRELOAD gymnastics are needed.
+
+This is the dynamic backstop for the static concurrency pass
+(``tools/analysis/native_lint.py``): the lexical checker is
+object-insensitive and lexical-scope-bound; TSan sees the real
+happens-before graph.  The registration-atomicity deadlock fixed this
+round (``Engine::Schedule`` ``sched_mu_``) was found by exactly this
+harness.
+
+Skips with a visible reason when no C++ toolchain or sanitizer runtime
+is available (``make`` absent, or a probe compile fails).
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+_SANITIZERS = {
+    "tsan": {
+        "flag": "-fsanitize=thread",
+        "binary": os.path.join(NATIVE, "bin", "engine_stress_tsan"),
+        "env": {"TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+        "report": "ThreadSanitizer",
+    },
+    "asan": {
+        "flag": "-fsanitize=address",
+        "binary": os.path.join(NATIVE, "bin", "engine_stress_asan"),
+        "env": {"ASAN_OPTIONS":
+                "halt_on_error=1 exitcode=66 detect_leaks=1"},
+        "report": "AddressSanitizer",
+    },
+}
+
+
+def _toolchain_reason(flag):
+    """None when the sanitizer build is expected to work, else a
+    human-readable skip reason."""
+    if shutil.which("make") is None:
+        return "no make on PATH"
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None:
+        return "no C++ compiler (%s) on PATH" % cxx
+    probe = subprocess.run(
+        [cxx, "-x", "c++", flag, "-pthread", "-", "-o", os.devnull],
+        input=b"int main() { return 0; }",
+        capture_output=True)
+    if probe.returncode != 0:
+        return "toolchain lacks %s support: %s" % (
+            flag, probe.stderr.decode(errors="replace").strip()[:200])
+    return None
+
+
+@pytest.fixture(scope="module", params=sorted(_SANITIZERS))
+def san(request):
+    cfg = _SANITIZERS[request.param]
+    reason = _toolchain_reason(cfg["flag"])
+    if reason:
+        pytest.skip("sanitizer build unavailable: " + reason)
+    build = subprocess.run(["make", "-C", NATIVE, request.param],
+                           capture_output=True, timeout=300)
+    if build.returncode != 0:
+        pytest.fail("make %s failed:\n%s" % (
+            request.param, build.stderr.decode(errors="replace")[-2000:]))
+    assert os.path.exists(cfg["binary"])
+    return cfg
+
+
+def _run(cfg, mode, iters, timeout=240):
+    env = dict(os.environ, **cfg["env"])
+    proc = subprocess.run([cfg["binary"], mode, str(iters)],
+                          capture_output=True, env=env, timeout=timeout)
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    assert cfg["report"] not in out, \
+        "%s report in %s mode:\n%s" % (cfg["report"], mode, out[-4000:])
+    assert proc.returncode == 0, \
+        "%s mode rc=%d:\n%s" % (mode, proc.returncode, out[-4000:])
+    assert "engine_stress: OK" in out
+
+
+class TestEngineStress:
+    """Each mode separately (clear attribution on failure), then the
+    combined run at a higher iteration count."""
+
+    def test_dispatch(self, san):
+        # 500 iters crosses the cross-thread registration-cycle
+        # threshold that deadlocked pre-sched_mu_ (hung at ~100)
+        _run(san, "dispatch", 500)
+
+    def test_waitvar(self, san):
+        _run(san, "waitvar", 300)
+
+    def test_shutdown(self, san):
+        _run(san, "shutdown", 60)
+
+    def test_naive(self, san):
+        _run(san, "naive", 400)
+
+    def test_all_combined(self, san):
+        _run(san, "all", 400)

@@ -1,0 +1,19 @@
+"""mxlint — the repo-native static-analysis suite (ISSUE 4 tentpole).
+
+Three analyzers, each a module here, all runnable as tier-1 tests
+(``tests/test_static_analysis.py``) and as a CLI
+(``python -m tools.analysis``):
+
+* :mod:`.abi` — C-ABI consistency between ``c_api.h``, the ctypes
+  ``_PROTOTYPES`` table, and every call site in ``mxnet_tpu/native.py``;
+* :mod:`.jaxlint` — JAX hot-loop hazards (implicit host syncs, retrace
+  churn, trace-clock mixing);
+* :mod:`.native_lint` — locking discipline over ``native/src/*.cc``
+  (lock order, guarded fields, condvar predicates), backstopped by the
+  ``make tsan`` / ``make asan`` stress harness.
+
+Rule catalog, pragma syntax and baseline workflow:
+``docs/static_analysis.md``.
+"""
+from .findings import Finding  # noqa: F401
+from .runner import main, run_all  # noqa: F401

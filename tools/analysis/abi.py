@@ -1,0 +1,249 @@
+"""C-ABI consistency checker (mxlint analyzer 1 of 3).
+
+Cross-checks three sources of truth that historically drifted apart:
+
+1. the C prototypes in ``native/include/mxnet_tpu/c_api.h`` (parsed
+   here with a small declaration grammar — comments stripped, handle
+   typedefs resolved);
+2. the ctypes ``_PROTOTYPES`` table in ``mxnet_tpu/native.py``
+   (extracted by evaluating the module's simple top-level assignments —
+   no package import, no native build);
+3. every ``lib().MX*`` / ``lib.MX*`` call site in ``native.py`` (AST).
+
+Rules
+-----
+``abi-unbound``          header function with no ``_PROTOTYPES`` entry
+``abi-unknown-symbol``   table entry or call site naming no header fn
+``abi-missing-argtypes`` call site whose symbol has no table entry
+``abi-restype``          table restype disagrees with the header return
+``abi-argcount``         table argtypes length disagrees with the header
+``abi-argtypes``         an argtype disagrees with the header parameter
+
+The C→ctypes correspondence is the table below.  Two deliberate
+wideings: ``const uint8_t*`` accepts ``c_char_p`` (Python ``bytes``
+buffers) and ``const char**`` maps to ``POINTER(c_void_p)`` — records
+are binary, and a ``c_char_p`` out-param would NUL-truncate on read.
+"""
+from __future__ import annotations
+
+import ast
+import ctypes
+import re
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+__all__ = ["parse_header", "load_prototypes", "call_sites", "check"]
+
+# void* handle typedefs in c_api.h — resolved before type mapping
+HANDLE_TYPEDEFS = {
+    "RecordIOReaderHandle", "RecordIOWriterHandle", "ImageLoaderHandle",
+    "EngineVarHandle", "ShmHandle",
+}
+
+# normalized C type -> acceptable ctypes types.  Identity comparison,
+# not name comparison: on LP64 Linux c_uint64 IS c_ulong, c_size_t IS
+# c_ulong, c_int64 IS c_long, c_uint8 IS c_ubyte — the platform alias
+# resolution is exactly what makes the table 64-bit-correct, so the
+# checker must honor it.  "CFUNCTYPE" is a wildcard for any ctypes
+# function-pointer class.
+_PTR = ctypes.POINTER
+C_TO_CTYPES: Dict[str, Tuple[object, ...]] = {
+    "void": (None,),
+    "int": (ctypes.c_int,),
+    "float": (ctypes.c_float,),
+    "uint64_t": (ctypes.c_uint64,),
+    "size_t": (ctypes.c_size_t,),
+    "int*": (_PTR(ctypes.c_int),),
+    "int64_t*": (_PTR(ctypes.c_int64),),
+    "uint64_t*": (_PTR(ctypes.c_uint64),),
+    "size_t*": (_PTR(ctypes.c_size_t),),
+    "double*": (_PTR(ctypes.c_double),),
+    "const float*": (_PTR(ctypes.c_float),),
+    "const float**": (_PTR(_PTR(ctypes.c_float)),),
+    "const char*": (ctypes.c_char_p,),
+    # binary-safe out-param: c_char_p would truncate at the first NUL
+    "const char**": (_PTR(ctypes.c_void_p),),
+    "const uint8_t*": (ctypes.c_char_p, _PTR(ctypes.c_uint8)),
+    "uint8_t*": (_PTR(ctypes.c_uint8),),
+    "uint8_t**": (_PTR(_PTR(ctypes.c_uint8)),),
+    "void*": (ctypes.c_void_p,),
+    "void**": (_PTR(ctypes.c_void_p),),
+    "MXEngineFn": ("CFUNCTYPE",),
+    "MXEngineDeleter": ("CFUNCTYPE",),
+}
+
+
+def _matches(got, accepted) -> bool:
+    if got in accepted:
+        return True
+    return "CFUNCTYPE" in accepted and isinstance(got, type) \
+        and issubclass(got, ctypes._CFuncPtr)  # noqa: SLF001
+
+
+def _expect_name(accepted) -> str:
+    first = accepted[0] if accepted else None
+    return first if isinstance(first, str) else _ctype_name(first)
+
+_DECL_RE = re.compile(
+    r"(?:^|\n)\s*(const\s+char\s*\*|int|void)\s+(MX\w+)\s*\(([^)]*)\)\s*;")
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", lambda m: re.sub(r"[^\n]", " ", m.group()),
+                  text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _norm_arg(arg: str) -> str:
+    """'const char* path' -> 'const char*'; 'uint64_t seed' ->
+    'uint64_t'; 'ImageLoaderHandle* out' -> 'void**'."""
+    arg = arg.strip()
+    if arg in ("", "void"):
+        return ""
+    stars = arg.count("*")
+    toks = [t for t in re.split(r"[\s*]+", arg) if t]
+    # trailing identifier is the parameter name iff >1 type-ish token,
+    # or the single token is not itself a known type/typedef
+    known = set(HANDLE_TYPEDEFS) | {"MXEngineFn", "MXEngineDeleter"}
+    base = toks[:-1] if (len(toks) > 1 and toks[-1] not in ("char", "int"))\
+        else toks
+    if len(base) == 1 and base[0] in known and base[0] in HANDLE_TYPEDEFS:
+        base = ["void"]
+        stars += 1
+    elif len(base) >= 2 and base[-1] in HANDLE_TYPEDEFS:
+        base = base[:-1] + ["void"]
+        stars += 1
+    t = " ".join(base) + "*" * stars
+    # normalize 'std_'-style float params: 'const float' handled above
+    return t
+
+
+def parse_header(path: str) -> Dict[str, Tuple[str, List[str]]]:
+    """Return ``{name: (return_ctype_str_set_key, [arg keys])}`` where
+    keys index into C_TO_CTYPES."""
+    with open(path) as f:
+        text = _strip_comments(f.read())
+    out: Dict[str, Tuple[str, List[str]]] = {}
+    for ret, name, args in _DECL_RE.findall(text):
+        ret = "const char*" if "char" in ret else ret.strip()
+        arglist = []
+        for a in args.split(","):
+            n = _norm_arg(a)
+            if n:
+                arglist.append(n)
+        out[name] = (ret, arglist)
+    return out
+
+
+def _ctype_name(obj) -> str:
+    """Canonical spelling for a ctypes type object."""
+    if obj is None:
+        return "None"
+    if isinstance(obj, type):
+        if issubclass(obj, ctypes._Pointer):  # noqa: SLF001
+            return "POINTER(%s)" % _ctype_name(obj._type_)
+        if issubclass(obj, ctypes._CFuncPtr):  # noqa: SLF001
+            return "CFUNCTYPE"
+        return obj.__name__
+    return repr(obj)
+
+
+def load_prototypes(py_path: str) -> Dict[str, Tuple[object, list]]:
+    """Extract ``_PROTOTYPES`` from a bindings module WITHOUT importing
+    it as a package (no jax, no native build): evaluate the module's
+    simple ``NAME = <expr>`` top-level assignments in a namespace
+    seeded with ``ctypes``, skipping any that do not evaluate."""
+    with open(py_path) as f:
+        tree = ast.parse(f.read(), py_path)
+    ns: dict = {"ctypes": ctypes}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        try:
+            val = eval(compile(ast.Expression(node.value), py_path,
+                               "eval"), ns)
+        except Exception:
+            continue
+        ns[node.targets[0].id] = val
+    protos = ns.get("_PROTOTYPES")
+    if not isinstance(protos, dict):
+        raise ValueError("%s: no evaluable _PROTOTYPES table" % py_path)
+    return protos
+
+
+def call_sites(py_path: str) -> List[Tuple[str, int]]:
+    """(symbol, line) for every ``lib().MX*`` / ``lib.MX*`` attribute
+    reference in the bindings module."""
+    with open(py_path) as f:
+        tree = ast.parse(f.read(), py_path)
+    sites: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute)
+                and node.attr.startswith("MX")):
+            continue
+        base = node.value
+        is_lib_call = (isinstance(base, ast.Call)
+                       and isinstance(base.func, ast.Name)
+                       and base.func.id in ("lib", "_load"))
+        is_lib_name = (isinstance(base, ast.Name)
+                       and base.id in ("lib", "l", "_lib"))
+        if is_lib_call or is_lib_name:
+            sites.append((node.attr, node.lineno))
+    return sites
+
+
+def check(header_path: str, bindings_path: str, rel_header: str,
+          rel_bindings: str, prototypes: dict = None) -> List[Finding]:
+    """Run every ABI rule; ``prototypes`` overrides table extraction
+    (fixture tests pass a dict directly)."""
+    header = parse_header(header_path)
+    protos = prototypes if prototypes is not None \
+        else load_prototypes(bindings_path)
+    findings: List[Finding] = []
+
+    def add(rule, symbol, msg, path=rel_bindings, line=0):
+        findings.append(Finding("abi", rule, path, line, symbol, msg))
+
+    for name in sorted(header):
+        if name not in protos:
+            add("abi-unbound", name,
+                "header function has no _PROTOTYPES entry",
+                path=rel_header)
+    for name in sorted(protos):
+        if name not in header:
+            add("abi-unknown-symbol", name,
+                "_PROTOTYPES entry names no header function")
+            continue
+        want_ret, want_args = header[name]
+        got_ret, got_args = protos[name]
+        if not _matches(got_ret, C_TO_CTYPES[want_ret]):
+            add("abi-restype", name,
+                "restype %s != header %r (expect %s)"
+                % (_ctype_name(got_ret), want_ret,
+                   _expect_name(C_TO_CTYPES[want_ret])))
+        if len(got_args) != len(want_args):
+            add("abi-argcount", name,
+                "argtypes has %d entries, header has %d"
+                % (len(got_args), len(want_args)))
+            continue
+        for i, (got, want) in enumerate(zip(got_args, want_args)):
+            accepted = C_TO_CTYPES.get(want, ())
+            if not _matches(got, accepted):
+                add("abi-argtypes", name,
+                    "arg %d: %s != header %r (expect %s)"
+                    % (i, _ctype_name(got), want,
+                       _expect_name(accepted)))
+
+    seen_missing = set()
+    for symbol, line in call_sites(bindings_path):
+        if symbol not in header:
+            add("abi-unknown-symbol", symbol,
+                "call site names no header function", line=line)
+        elif symbol not in protos and symbol not in seen_missing:
+            seen_missing.add(symbol)
+            add("abi-missing-argtypes", symbol,
+                "call site has no _PROTOTYPES entry "
+                "(no argtypes/restype applied)", line=line)
+    return findings

@@ -1,0 +1,120 @@
+"""mxlint core: structured findings, inline pragmas, and the baseline.
+
+Every analyzer in ``tools/analysis`` emits :class:`Finding` records.  A
+finding is suppressed either by an inline pragma at (or in the comment
+block directly above) the offending line::
+
+    # mxlint: allow(host-sync) -- justification          (Python)
+    // mxlint: allow(lock-order) -- justification        (C/C++)
+
+or by an entry in the checked-in baseline (``tools/analysis/baseline.json``)
+keyed on ``rule:path:symbol`` — deliberately *line-independent* so
+unrelated edits do not churn the baseline.  Pragmas are the preferred
+mechanism (auditable at the call site); the baseline exists for
+pre-existing accepted debt.  Anything not suppressed is a NEW violation
+and fails ``tests/test_static_analysis.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+__all__ = ["Finding", "parse_pragmas", "is_allowed", "apply_pragmas",
+           "load_baseline", "split_new"]
+
+PRAGMA_RE = re.compile(
+    r"(?:#|//)\s*mxlint:\s*(allow|requires)\(([^)]*)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    analyzer: str        # "abi" | "jax" | "native"
+    rule: str            # e.g. "host-sync", "abi-argtypes", "lock-order"
+    path: str            # repo-relative path
+    line: int            # 1-based; 0 when the finding is file/symbol level
+    symbol: str          # function / field / MX symbol the rule fired on
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline key — line-independent on purpose."""
+        return "%s:%s:%s" % (self.rule, self.path, self.symbol)
+
+    def __str__(self) -> str:
+        loc = "%s:%d" % (self.path, self.line) if self.line else self.path
+        return "%s: [%s/%s] %s — %s" % (loc, self.analyzer, self.rule,
+                                        self.symbol, self.message)
+
+
+def parse_pragmas(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of rule names allowed on that line
+    (``requires`` pragmas are analyzer-specific and handled separately)."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), 1):
+        for kind, rules in PRAGMA_RE.findall(text):
+            if kind != "allow":
+                continue
+            out.setdefault(i, set()).update(
+                r.strip() for r in rules.split(",") if r.strip())
+    return out
+
+
+def _comment_only(text: str) -> bool:
+    s = text.strip()
+    return not s or s.startswith("#") or s.startswith("//")
+
+
+def is_allowed(source_lines: List[str], pragmas: Dict[int, Set[str]],
+               line: int, rule: str) -> bool:
+    """A pragma suppresses ``rule`` at ``line`` when it sits on the line
+    itself or anywhere in the contiguous comment block directly above."""
+    def hit(ln: int) -> bool:
+        rules = pragmas.get(ln)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+    if hit(line):
+        return True
+    ln = line - 1
+    while ln >= 1 and _comment_only(source_lines[ln - 1]):
+        if hit(ln):
+            return True
+        ln -= 1
+    return False
+
+
+def apply_pragmas(findings: Iterable[Finding],
+                  source: str) -> List[Finding]:
+    """Drop findings suppressed by inline pragmas in ``source``."""
+    lines = source.splitlines()
+    pragmas = parse_pragmas(source)
+    return [f for f in findings
+            if not (f.line and is_allowed(lines, pragmas, f.line, f.rule))]
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Baseline format: ``{"version": 1, "allow": [{"rule":..,
+    "path":.., "symbol":.., "reason":..}, ...]}``; entries may also be
+    raw ``rule:path:symbol`` strings."""
+    with open(path) as f:
+        data = json.load(f)
+    keys: Set[str] = set()
+    for entry in data.get("allow", []):
+        if isinstance(entry, str):
+            keys.add(entry)
+        else:
+            keys.add("%s:%s:%s" % (entry["rule"], entry["path"],
+                                   entry["symbol"]))
+    return keys
+
+
+def split_new(findings: Iterable[Finding],
+              baseline: Set[str]) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into (new, baselined)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.key in baseline else new).append(f)
+    return new, old

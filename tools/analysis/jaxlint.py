@@ -1,0 +1,320 @@
+"""JAX hazard linter (mxlint analyzer 2 of 3) — Python ``ast`` based.
+
+Rules
+-----
+``host-sync``  In a designated hot-loop region, a device→host
+    materialization of a value produced by a compiled step function:
+    ``np.asarray``/``np.array`` on a *device-tainted* expression,
+    ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` on one,
+    ``float()``/``int()``/``bool()`` of one, or ``jax.device_get`` of
+    one.  Taint is a simple intra-region dataflow: results of calls to
+    compiled-step callables (terminal name matching ``*step_fn``, a
+    name bound from ``jax.jit(...)``, or a function defined under
+    ``@jax.jit``) are tainted; taint propagates through subscripts,
+    attributes, arithmetic, and tuple unpacking; a flagged
+    materialization (e.g. ``x = np.asarray(x)``) clears it — the sync
+    happened there, downstream host math is free.  ``jnp.asarray``
+    (host→device) is deliberately NOT a sync.
+
+``retrace``  Retrace/recompile churn: (a) ``jax.jit(...)`` called
+    inside a ``for``/``while`` body — the compile cache is keyed on
+    the function object, so a fresh closure per iteration recompiles
+    every time (the repo idiom is a module-level keyed cache, see
+    ``models/gpt.py``); (b) a known-jitted callable invoked with a
+    bare Python numeric literal or a ``list``/``dict``/``set`` display
+    as an argument — scalars belong in the cache key / static args,
+    not the traced signature.
+
+``clock-mix``  In modules on the profiler's shared trace clock
+    (``time.perf_counter`` — obs/, serving/, profiler, serve_bench),
+    a call to ``time.time``/``time.monotonic``/``time.clock`` or
+    ``datetime.*.now`` — mixing clocks skews every span it touches.
+
+Suppression: ``# mxlint: allow(<rule>)`` on the line or the comment
+block directly above (see ``findings.py``).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import List, Optional, Set, Tuple
+
+from .findings import Finding, apply_pragmas
+
+__all__ = ["HOT_REGIONS", "CLOCK_MODULES", "lint_source", "run"]
+
+# (repo-relative glob, qualname regex) — the designated hot-loop regions
+HOT_REGIONS: List[Tuple[str, str]] = [
+    ("mxnet_tpu/serving/engine.py", r"(?:.*\.)?step$"),
+    ("mxnet_tpu/models/gpt.py", r"generate(?:_speculative)?$"),
+    ("benchmark/serve_bench.py", r".*"),
+    ("benchmark/spec_decode_probe.py", r".*"),
+]
+
+# modules whose timestamps must stay on the shared perf_counter clock
+CLOCK_MODULES: List[str] = [
+    "mxnet_tpu/obs/*.py",
+    "mxnet_tpu/serving/*.py",
+    "mxnet_tpu/profiler.py",
+    "benchmark/serve_bench.py",
+]
+
+STEP_FN_RE = re.compile(r".*step_fn$")
+_NP_ALIASES = {"np", "numpy", "onp"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_WRONG_CLOCKS = {("time", "time"), ("time", "monotonic"),
+                 ("time", "clock")}
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    return _dotted(call.func) in ("jax.jit", "jit")
+
+
+class _RegionLinter(ast.NodeVisitor):
+    """Lints one hot region (a function def and everything nested)."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        self.jitted: Set[str] = set()
+        self.loop_depth = 0
+
+    # -- helpers ------------------------------------------------------
+    def _add(self, rule: str, node: ast.AST, symbol: str, msg: str):
+        self.findings.append(Finding(
+            "jax", rule, self.path, node.lineno, symbol, msg))
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            t = _terminal(node.func)
+            if t and (STEP_FN_RE.match(t) or t in self.jitted):
+                return True
+            return any(self._expr_tainted(a) for a in node.args)
+        for child in ast.iter_child_nodes(node):
+            if self._expr_tainted(child):
+                return True
+        return False
+
+    def _is_step_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        t = _terminal(node.func)
+        return bool(t and (STEP_FN_RE.match(t) or t in self.jitted))
+
+    # -- taint bookkeeping --------------------------------------------
+    def visit_FunctionDef(self, node):
+        for dec in node.decorator_list:
+            if _dotted(dec) in ("jax.jit", "jit") or (
+                    isinstance(dec, ast.Call) and _is_jax_jit(dec)):
+                self.jitted.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)  # flag RHS syncs before retargeting
+        value_tainted = (self._is_step_call(node.value)
+                         or self._expr_tainted(node.value))
+        # a HOST materialization on the RHS *clears* taint: np.asarray
+        # (np alias only — jnp.asarray stays on device and must keep
+        # the taint), .item()/.tolist(), jax.device_get.  The sync
+        # happened there; its result is host memory.
+        if isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Attribute):
+                base = func.value
+                is_np_call = (func.attr in ("asarray", "array")
+                              and isinstance(base, ast.Name)
+                              and base.id in _NP_ALIASES)
+                # NOT block_until_ready: it returns the same device
+                # array — a later float()/np.asarray is still a copy
+                if is_np_call or func.attr in ("item", "tolist",
+                                               "device_get"):
+                    value_tainted = False
+            if _is_jax_jit(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.jitted.add(tgt.id)
+        names: List[str] = []
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in tgt.elts
+                             if isinstance(e, ast.Name))
+        for name in names:
+            if value_tainted:
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+
+    # -- loops (for the jit-in-loop rule) -----------------------------
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    # -- the rules ----------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        func = node.func
+        dotted = _dotted(func)
+
+        # retrace (a): jax.jit built inside a loop
+        if _is_jax_jit(node) and self.loop_depth > 0:
+            self._add("retrace", node, dotted or "jax.jit",
+                      "jax.jit(...) inside a loop recompiles every "
+                      "iteration — build once and cache (gpt.py idiom)")
+
+        # retrace (b): jitted callable fed literals/containers
+        if self._is_step_call(node):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, (int, float)) and not isinstance(
+                        arg.value, bool):
+                    self._add("retrace", node, _terminal(func) or "?",
+                              "Python scalar literal in a jitted call "
+                              "signature — mark static or fold into "
+                              "the cache key")
+                    break
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    self._add("retrace", node, _terminal(func) or "?",
+                              "container display in a jitted call "
+                              "signature — structure changes retrace")
+                    break
+
+        # host-sync
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if (func.attr in ("asarray", "array")
+                    and isinstance(base, ast.Name)
+                    and base.id in _NP_ALIASES
+                    and any(self._expr_tainted(a) for a in node.args)):
+                self._add("host-sync", node, "%s.%s" % (base.id,
+                                                        func.attr),
+                          "implicit device sync: numpy materialization "
+                          "of a step-program result in a hot loop")
+            elif func.attr in _SYNC_METHODS and self._expr_tainted(base):
+                self._add("host-sync", node, "." + func.attr,
+                          "device sync on a step-program result in a "
+                          "hot loop")
+            elif dotted.endswith("device_get") and any(
+                    self._expr_tainted(a) for a in node.args):
+                self._add("host-sync", node, dotted,
+                          "jax.device_get of a step-program result in "
+                          "a hot loop")
+        elif isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                        "bool"):
+            if any(self._expr_tainted(a) for a in node.args):
+                self._add("host-sync", node, func.id,
+                          "%s() of a step-program result forces a "
+                          "device sync in a hot loop" % func.id)
+
+
+class _ClockLinter(ast.NodeVisitor):
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        dotted = _dotted(node.func)
+        parts = tuple(dotted.rsplit(".", 2)[-2:])
+        if parts in _WRONG_CLOCKS:
+            self.findings.append(Finding(
+                "jax", "clock-mix", self.path, node.lineno, dotted,
+                "wrong clock on a trace-clock module — use "
+                "time.perf_counter (profiler.now_us) so spans "
+                "interleave in one dump"))
+        elif dotted.endswith(".now") and "datetime" in dotted:
+            self.findings.append(Finding(
+                "jax", "clock-mix", self.path, node.lineno, dotted,
+                "wall-clock datetime in a trace-clock module — use "
+                "time.perf_counter"))
+
+
+def _qualname_functions(tree: ast.Module):
+    """Yield (qualname, FunctionDef) for every function, with class
+    nesting reflected (``Class.method``)."""
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                yield prefix + child.name, child
+                # nested defs are linted as part of their region root
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, prefix + child.name + ".")
+    yield from walk(tree, "")
+
+
+def lint_source(source: str, rel_path: str,
+                region_re: Optional[str] = None,
+                clock: Optional[bool] = None) -> List[Finding]:
+    """Lint one module.  ``region_re``/``clock`` override the repo
+    config (fixture tests drive this directly)."""
+    tree = ast.parse(source, rel_path)
+    findings: List[Finding] = []
+
+    patterns = []
+    if region_re is not None:
+        patterns.append(re.compile(region_re))
+    else:
+        patterns.extend(re.compile(rx) for glob, rx in HOT_REGIONS
+                        if fnmatch.fnmatch(rel_path, glob))
+    if patterns:
+        for qualname, fn in _qualname_functions(tree):
+            if any(p.match(qualname) for p in patterns):
+                _RegionLinter(rel_path, findings).visit(fn)
+
+    if clock is None:
+        clock = any(fnmatch.fnmatch(rel_path, g) for g in CLOCK_MODULES)
+    if clock:
+        _ClockLinter(rel_path, findings).visit(tree)
+
+    return apply_pragmas(findings, source)
+
+
+def run(root: str) -> List[Finding]:
+    """Lint every configured module under ``root``."""
+    rels = {glob for glob, _ in HOT_REGIONS} | set(CLOCK_MODULES)
+    seen: Set[str] = set()
+    findings: List[Finding] = []
+    for pattern in sorted(rels):
+        dirname = os.path.dirname(pattern)
+        full_dir = os.path.join(root, dirname)
+        if not os.path.isdir(full_dir):
+            continue
+        for name in sorted(os.listdir(full_dir)):
+            rel = os.path.join(dirname, name)
+            if not fnmatch.fnmatch(rel, pattern) or rel in seen:
+                continue
+            seen.add(rel)
+            with open(os.path.join(root, rel)) as f:
+                findings.extend(lint_source(f.read(), rel))
+    return findings

@@ -1,0 +1,422 @@
+"""Native concurrency pass (mxlint analyzer 3 of 3).
+
+A lightweight lexical/structural checker over ``native/src/*.cc`` —
+not a compiler, but enough structure (comment/string stripping, brace
+scoping, lock_guard/unique_lock lifetimes, a per-file call graph with
+transitive lock sets) to machine-check the locking discipline the
+sources document in prose.
+
+Rules
+-----
+``cv-wait-predicate``  every ``cv.wait(lk)`` must use the predicate
+    overload (``wait(lk, pred)``; ``wait_for``/``wait_until`` need the
+    3-arg form) — bare waits are spurious-wakeup bugs.
+
+``cv-pred-unlocked``  a store to a condition-variable predicate
+    variable (config: ``cv_preds``) outside the cv's mutex.  The
+    classic missed-wakeup: a waiter that evaluated the predicate false
+    still holds the mutex until it blocks, so a store+notify in that
+    window is lost (this exact bug lived in ``Engine::~Engine`` and
+    ``ImageRecordLoader::StopWorkers`` until this pass caught it).
+
+``guarded-field``  a shared field (config: ``guarded``) accessed
+    outside its documented mutex.  Fields guarded per-object
+    (``EngineVar::mu``) are checked object-insensitively — any held
+    ``->mu`` satisfies the guard; the engine never holds two vars at
+    once, and TSan (``make tsan``) backstops what this approximation
+    misses.  ``std::atomic`` fields are exempt by not being configured.
+
+``lock-order``  acquiring a ranked mutex while holding a higher-ranked
+    one (config: ``order``, lower rank = acquire first), directly or
+    through a same-file call chain; also re-acquiring a held mutex.
+
+Annotations (in the comment block directly above a function)::
+
+    // mxlint: requires(EngineVar::mu)   -- caller holds it (precondition)
+    // mxlint: allow(<rule>)             -- suppress on the next line
+
+Documented non-rules: ``Opr`` fields are single-owner (the ``wait``
+countdown is the hand-off); ``outstanding_`` uses the safe
+decrement-then-lock-then-notify pattern (the *notify* is under the
+mutex, so the waiter cannot sleep through it).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, PRAGMA_RE, apply_pragmas
+
+__all__ = ["CONFIG", "lint_file", "run"]
+
+# per-file locking discipline — the machine-readable version of the
+# design comments in engine.h / image_loader.h / storage.h
+CONFIG = {
+    "engine.cc": {
+        "order": {"sched_mu_": 0, "EngineVar::mu": 1, "pool_mu_": 2,
+                  "err_mu_": 3},
+        "guarded": {
+            "member": {"version": "EngineVar::mu",
+                       "active_reads": "EngineVar::mu",
+                       "active_write": "EngineVar::mu",
+                       "exception": "EngineVar::mu",
+                       "queue": "EngineVar::mu"},
+            "self": {"ready_": "pool_mu_", "global_err_": "err_mu_"},
+        },
+        "cv_preds": {"stop_": "pool_mu_"},
+    },
+    "image_loader.cc": {
+        "order": {"mu_": 0},
+        "guarded": {
+            "member": {"ready": "mu_", "pad": "mu_"},
+            "self": {"has_error_": "mu_", "error_": "mu_"},
+        },
+        "cv_preds": {"stop_": "mu_"},
+    },
+    "storage.cc": {
+        "order": {"mu_": 0},
+        "guarded": {
+            "member": {},
+            "self": {"live_": "mu_", "free_pool_": "mu_",
+                     "bytes_live_": "mu_", "bytes_pooled_": "mu_",
+                     "num_allocs_": "mu_"},
+        },
+        "cv_preds": {},
+    },
+    "c_api.cc": {
+        "order": {"EngineVar::mu": 0, "g_engine_mu": 0},
+        "guarded": {
+            "member": {"version": "EngineVar::mu"},
+            "self": {"g_engine": "g_engine_mu"},
+        },
+        "cv_preds": {},
+    },
+}
+
+_KEYWORDS = {"if", "for", "while", "switch", "catch", "return", "throw",
+             "sizeof", "new", "delete", "else", "do", "case"}
+
+_LOCK_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"<[^>]*>\s*\w+\s*\(([^)]*)\)")
+_WAIT_RE = re.compile(r"\.\s*wait(_for|_until)?\s*\(")
+_FN_NAME_RE = re.compile(r"\b([A-Za-z_][\w]*(?:::~?[A-Za-z_]\w*)*)\s*\(")
+
+
+def _strip_code(text: str) -> str:
+    """Blank out comments, string and char literals, preserving
+    newlines (line numbers survive)."""
+    def blank(m):
+        return re.sub(r"[^\n]", " ", m.group())
+    text = re.sub(r"/\*.*?\*/", blank, text, flags=re.S)
+    text = re.sub(r"//[^\n]*", blank, text)
+    text = re.sub(r'"(?:\\.|[^"\\\n])*"', blank, text)
+    text = re.sub(r"'(?:\\.|[^'\\\n])'", blank, text)
+    return text
+
+
+def _norm_mutex(expr: str) -> Optional[str]:
+    """Normalize a lock_guard constructor argument to a discipline
+    name; None = unranked local/unknown (ignored)."""
+    expr = expr.split(",")[0].strip()
+    if re.search(r"(?:->|\.)\s*mu$", expr):
+        return "EngineVar::mu"
+    m = re.match(r"^\w+$", expr)
+    if m:
+        name = expr
+        if name.endswith("_mu") or name.endswith("mu_") or \
+                name.endswith("_mu_"):
+            return name
+    return None
+
+
+def _arg_count(code: str, open_idx: int) -> int:
+    """Count top-level comma-separated args of the paren group opening
+    at ``open_idx`` (index of '(')."""
+    depth = 0
+    commas = 0
+    empty = True
+    i = open_idx
+    while i < len(code):
+        ch = code[i]
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                break
+        elif ch == "," and depth == 1:
+            commas += 1
+        elif depth >= 1 and not ch.isspace():
+            empty = False
+        i += 1
+    return 0 if empty else commas + 1
+
+
+class _Scanner:
+    def __init__(self, rel_path: str, text: str, config: dict):
+        self.rel = rel_path
+        self.raw_lines = text.splitlines()
+        self.code = _strip_code(text)
+        self.cfg = config
+        self.order: Dict[str, int] = config.get("order", {})
+        self.findings: List[Finding] = []
+        # events for the transitive pass: (line, fn, callee, held)
+        self.calls: List[Tuple[int, str, str, Tuple[str, ...]]] = []
+        # fn -> set of mutexes it directly acquires
+        self.direct: Dict[str, Set[str]] = {}
+
+    def _add(self, rule, line, symbol, msg):
+        self.findings.append(Finding("native", rule, self.rel, line,
+                                     symbol, msg))
+
+    def _requires_for(self, fn_line: int) -> Set[str]:
+        """``mxlint: requires(M)`` pragmas in the comment block above
+        the function starting at ``fn_line``."""
+        out: Set[str] = set()
+        ln = fn_line - 1
+        while ln >= 1:
+            s = self.raw_lines[ln - 1].strip()
+            if not s or s.startswith("//") or s.startswith("*") or \
+                    s.startswith("/*"):
+                for kind, val in PRAGMA_RE.findall(s):
+                    if kind == "requires":
+                        out.update(v.strip() for v in val.split(","))
+                ln -= 1
+            else:
+                break
+        return out
+
+    # ------------------------------------------------------------------
+    def scan(self) -> List[Finding]:
+        code = self.code
+        lines = code.splitlines(keepends=True)
+        offsets = []
+        pos = 0
+        for ln in lines:
+            offsets.append(pos)
+            pos += len(ln)
+
+        depth = 0
+        fn: Optional[str] = None
+        fn_depth = 0
+        held: List[Tuple[str, int]] = []   # (mutex, acquired-at depth)
+        requires: Set[str] = set()
+        chunk_start = 0
+        fn_names = self._collect_fn_names()
+
+        def line_of(idx: int) -> int:
+            lo, hi = 0, len(offsets) - 1
+            while lo < hi:
+                mid = (lo + hi + 1) // 2
+                if offsets[mid] <= idx:
+                    lo = mid
+                else:
+                    hi = mid - 1
+            return lo + 1
+
+        i = 0
+        while i < len(code):
+            ch = code[i]
+            if ch == "{":
+                chunk = code[chunk_start:i]
+                if fn is None:
+                    name = self._fn_header_name(chunk)
+                    if name is not None:
+                        fn = name
+                        fn_depth = depth
+                        requires = self._requires_for(line_of(i))
+                        self.direct.setdefault(fn, set())
+                else:
+                    # statements headed by this brace (if/for/while
+                    # conditions, wait(lk, [&] {...) calls) carry field
+                    # and wait accesses of their own
+                    self._scan_stmt(chunk, chunk_start, fn, held,
+                                    requires, fn_names, line_of, depth)
+                depth += 1
+                chunk_start = i + 1
+            elif ch == "}":
+                if fn is not None:
+                    self._scan_stmt(code[chunk_start:i], chunk_start,
+                                    fn, held, requires, fn_names,
+                                    line_of, depth)
+                depth -= 1
+                held[:] = [h for h in held if h[1] <= depth]
+                if fn is not None and depth <= fn_depth:
+                    fn = None
+                    requires = set()
+                chunk_start = i + 1
+            elif ch == ";":
+                self._scan_stmt(code[chunk_start:i + 1], chunk_start,
+                                fn, held, requires, fn_names, line_of,
+                                depth)
+                chunk_start = i + 1
+            i += 1
+        self._transitive_pass()
+        return self.findings
+
+    def _collect_fn_names(self) -> Set[str]:
+        names = set()
+        for m in _FN_NAME_RE.finditer(self.code):
+            base = m.group(1).split("::")[-1]
+            if base not in _KEYWORDS:
+                names.add(base)
+        return names
+
+    def _fn_header_name(self, chunk: str) -> Optional[str]:
+        """Function name if ``chunk`` (text between the previous
+        ``;{}`` and this ``{``) reads like a function header."""
+        m = _FN_NAME_RE.search(chunk)
+        if not m:
+            return None
+        base = m.group(1).split("::")[-1]
+        if base in _KEYWORDS:
+            return None
+        return base
+
+    # ------------------------------------------------------------------
+    def _scan_stmt(self, stmt: str, start: int, fn, held, requires,
+                   fn_names, line_of, depth):
+        if fn is None:
+            # namespace-scope declarations (e.g. the g_engine definition
+            # itself) are not accesses
+            return
+        cfg = self.cfg
+        held_names = {h[0] for h in held} | requires
+
+        # lock acquisition
+        for m in _LOCK_RE.finditer(stmt):
+            norm = _norm_mutex(m.group(1))
+            line = line_of(start + m.start())
+            if norm is None:
+                continue
+            if fn is not None:
+                self.direct.setdefault(fn, set()).add(norm)
+            rank = self.order.get(norm)
+            if norm in held_names:
+                self._add("lock-order", line, norm,
+                          "re-acquiring %s already held "
+                          "(self-deadlock)" % norm)
+            elif rank is not None:
+                for h, _ in held:
+                    hr = self.order.get(h)
+                    if hr is not None and hr > rank:
+                        self._add("lock-order", line, norm,
+                                  "acquiring %s (rank %d) while "
+                                  "holding %s (rank %d) — documented "
+                                  "order violated" % (norm, rank, h,
+                                                      hr))
+            held.append((norm, depth))
+            held_names.add(norm)
+
+        # condvar waits need the predicate overload
+        for m in _WAIT_RE.finditer(stmt):
+            suffix = m.group(1) or ""
+            open_idx = start + m.end() - 1
+            n = _arg_count(self.code, open_idx)
+            need = 1 if suffix == "" else 2
+            if n <= need:
+                self._add("cv-wait-predicate", line_of(open_idx),
+                          "wait" + suffix,
+                          "condition_variable %s without a predicate "
+                          "— spurious wakeups break the protocol"
+                          % ("wait" + suffix))
+
+        # predicate stores outside the cv mutex
+        for var, mu in cfg.get("cv_preds", {}).items():
+            for m in re.finditer(
+                    r"\b%s\s*(?:\.\s*(?:store|fetch_\w+)\s*\(|=[^=]|"
+                    r"\+\+|--)" % re.escape(var), stmt):
+                if mu not in held_names:
+                    self._add("cv-pred-unlocked",
+                              line_of(start + m.start()), var,
+                              "store to cv predicate %r outside %s — "
+                              "missed-wakeup window (waiter holds the "
+                              "mutex between predicate check and "
+                              "block)" % (var, mu))
+
+        # guarded fields
+        guarded = cfg.get("guarded", {})
+        for field, mu in guarded.get("member", {}).items():
+            for m in re.finditer(r"(?:->|\.)\s*%s\b(?!\s*\()"
+                                 % re.escape(field), stmt):
+                if mu not in held_names:
+                    self._add("guarded-field",
+                              line_of(start + m.start()), field,
+                              "%r accessed outside its documented "
+                              "mutex %s" % (field, mu))
+        for field, mu in guarded.get("self", {}).items():
+            for m in re.finditer(r"(?<![\w>.])%s\b" % re.escape(field),
+                                 stmt):
+                if mu not in held_names:
+                    self._add("guarded-field",
+                              line_of(start + m.start()), field,
+                              "%r accessed outside its documented "
+                              "mutex %s" % (field, mu))
+
+        # call sites — ALL edges feed the transitive closure; only the
+        # ones made while holding a lock are checked in the report pass
+        for m in _FN_NAME_RE.finditer(stmt):
+            base = m.group(1).split("::")[-1]
+            if base in fn_names and base != fn and \
+                    base not in _KEYWORDS:
+                self.calls.append((line_of(start + m.start()), fn,
+                                   base, tuple(sorted(held_names))))
+
+    # ------------------------------------------------------------------
+    def _transitive_pass(self):
+        trans: Dict[str, Set[str]] = {f: set(s)
+                                      for f, s in self.direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for line, caller, callee, _ in self.calls:
+                if callee in trans and caller in trans:
+                    before = len(trans[caller])
+                    trans[caller] |= trans[callee]
+                    if len(trans[caller]) != before:
+                        changed = True
+        for line, caller, callee, held in self.calls:
+            if not held:
+                continue
+            for m in trans.get(callee, ()):
+                rank = self.order.get(m)
+                if rank is None:
+                    continue
+                if m in held:
+                    self._add("lock-order", line, m,
+                              "call to %s() may re-acquire held %s"
+                              % (callee, m))
+                    continue
+                for h in held:
+                    hr = self.order.get(h)
+                    if hr is not None and hr > rank:
+                        self._add("lock-order", line, m,
+                                  "call to %s() may acquire %s (rank "
+                                  "%d) while %s (rank %d) is held"
+                                  % (callee, m, rank, h, hr))
+
+
+def lint_file(path: str, rel_path: str,
+              config: Optional[dict] = None) -> List[Finding]:
+    if config is None:
+        config = CONFIG.get(os.path.basename(rel_path))
+        if config is None:
+            config = {"order": {}, "guarded": {}, "cv_preds": {}}
+    with open(path) as f:
+        text = f.read()
+    findings = _Scanner(rel_path, text, config).scan()
+    return apply_pragmas(findings, text)
+
+
+def run(root: str) -> List[Finding]:
+    src = os.path.join(root, "native", "src")
+    findings: List[Finding] = []
+    if not os.path.isdir(src):
+        return findings
+    for name in sorted(os.listdir(src)):
+        if name.endswith(".cc"):
+            findings.extend(lint_file(os.path.join(src, name),
+                                      "native/src/" + name))
+    return findings

@@ -1,0 +1,92 @@
+"""mxlint entry point — run all three analyzers against the live repo.
+
+Usage (from the repo root)::
+
+    python -m tools.analysis                 # human-readable, exit 1 on
+                                             # new violations
+    python -m tools.analysis --json          # machine-readable report
+    python -m tools.analysis --write-baseline  # accept current findings
+
+Tier-1 wiring: ``tests/test_static_analysis.py`` calls :func:`run_all`
+directly; ``tools/run_static_analysis.sh`` is the CLI wrapper that also
+smokes the sanitizer builds.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+from . import abi, jaxlint, native_lint
+from .findings import Finding, load_baseline, split_new
+
+__all__ = ["REPO_ROOT", "run_all", "main"]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), "baseline.json")
+
+HEADER = "native/include/mxnet_tpu/c_api.h"
+BINDINGS = "mxnet_tpu/native.py"
+
+
+def run_all(root: str = None, baseline_path: str = None) -> Dict:
+    """Run every analyzer; returns ``{"findings": [...],
+    "new": [...], "baselined": [...]}`` (Finding objects)."""
+    root = root or REPO_ROOT
+    findings: List[Finding] = []
+    findings += abi.check(os.path.join(root, HEADER),
+                          os.path.join(root, BINDINGS),
+                          HEADER, BINDINGS)
+    findings += jaxlint.run(root)
+    findings += native_lint.run(root)
+    baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
+    new, old = split_new(findings, baseline)
+    return {"findings": findings, "new": new, "baselined": old}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description="repo static-analysis suite "
+        "(C-ABI / JAX hazards / native concurrency)")
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline (review the diff!)")
+    args = ap.parse_args(argv)
+
+    report = run_all(args.root, args.baseline)
+    if args.write_baseline:
+        entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
+                    "reason": "accepted by --write-baseline"}
+                   for f in report["findings"]]
+        with open(args.baseline, "w") as f:
+            json.dump({"version": 1, "allow": entries}, f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        print("mxlint: baselined %d finding(s) -> %s"
+              % (len(entries), args.baseline))
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "new": [vars(f) for f in report["new"]],
+            "baselined": [vars(f) for f in report["baselined"]],
+        }, indent=2))
+    else:
+        for f in report["new"]:
+            print("NEW  %s" % f)
+        for f in report["baselined"]:
+            print("old  %s" % f)
+        print("mxlint: %d new violation(s), %d baselined"
+              % (len(report["new"]), len(report["baselined"])))
+    return 1 if report["new"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

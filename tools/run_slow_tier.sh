@@ -4,6 +4,7 @@
 # Usage: tools/run_slow_tier.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
+sh tools/run_static_analysis.sh
 for g in a b c d e; do
     echo "== slow group $g =="
     python -m pytest tests/ -q -m "slow_$g" -p no:cacheprovider "$@"

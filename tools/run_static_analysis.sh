@@ -1,0 +1,21 @@
+#!/bin/sh
+# Single entry point for the mxlint static-analysis suite (ISSUE 4):
+#   1. the three analyzers (C-ABI / JAX hazards / native concurrency)
+#      — pure parsing, fails on any NEW violation vs baseline/pragmas;
+#   2. sanitizer smoke, delegated to tests/test_native_sanitize.py so
+#      the sanitizer matrix (flags, env, binaries, toolchain probe,
+#      skip reasons) lives in exactly one place — the test module
+#      skips with a visible reason when the toolchain lacks make, a
+#      C++ compiler, or sanitizer support.
+# Wired into tools/run_slow_tier.sh; tier-1 coverage lives in
+# tests/test_static_analysis.py.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== mxlint analyzers =="
+python -m tools.analysis --baseline tools/analysis/baseline.json
+
+echo "== sanitizer smoke (tests/test_native_sanitize.py) =="
+python -m pytest tests/test_native_sanitize.py -q -p no:cacheprovider \
+    -k "test_all_combined" -rs
+echo "== static analysis: OK =="
