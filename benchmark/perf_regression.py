@@ -248,6 +248,18 @@ def bench_gpt_serve_metrics_overhead():
     return serve_bench.run_gate_telemetry("full")["overhead_pct"]
 
 
+def bench_gpt_serve_prefix_hit():
+    """Shared-prefix KV reuse gate (round 10): TTFT (ms) of a request
+    whose whole prompt sits in the prefix cache — the engine maps the
+    cached pages, COWs the tail page, and re-feeds one token instead
+    of running 12 chunked-prefill steps.  Direction "lower" (v <= hi);
+    the cold-vs-hit speedup rides along in the serve_bench ``prefix``
+    row and docs/perf.md "Serving cluster"."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    return serve_bench.run_gate_prefix("full")["ttft_hit_ms"]
+
+
 def bench_gpt_spec_decode():
     """Speculative decode gate (round 6): batch 8, w8 target, ngram
     (prompt-lookup) drafter at K=4 on the structured ("loop") workload
@@ -307,6 +319,8 @@ BENCHES = {
     "gpt_serve_p99_ms": (bench_gpt_serve_p99, "lower"),
     "gpt_serve_metrics_overhead_pct": (bench_gpt_serve_metrics_overhead,
                                        "lower"),
+    "gpt_serve_prefix_hit_ttft_ms": (bench_gpt_serve_prefix_hit,
+                                     "lower"),
 }
 
 BAR = 0.15

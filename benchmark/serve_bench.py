@@ -7,6 +7,8 @@ HBM budget.
     python benchmark/serve_bench.py --preset full   # chip gate config
     python benchmark/serve_bench.py --quick         # CI smoke
     python benchmark/serve_bench.py --sweep         # + occupancy/page-size
+    python benchmark/serve_bench.py --replicas 2 --shared-prefix-frac 0.8
+                                    # + round-10 cluster + prefix rows
 
 Sections (rows carry {"section": ...} in the JSON):
 
@@ -44,10 +46,20 @@ construction — that is the point being measured).  All timestamps are
 ``time.perf_counter()`` — the engine's telemetry clock — so internal
 and external measurements subtract cleanly.
 
+* ``prefix`` / ``cluster`` (round 10, ``--replicas N
+  --shared-prefix-frac F``) — the ``ServingCluster`` front end over N
+  replicas on a workload where fraction F of requests share one
+  system-prompt prefix: a prefix-cache on/off pair (cluster-side TTFT,
+  hit tokens, affinity routing), the single-engine prefix-hit-vs-cold
+  TTFT measurement behind the ``gpt_serve_prefix_hit_ttft_ms`` gate,
+  and a forced mid-run replica failover in which every request must
+  still complete (recompute-exact resubmission).
+
 The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
-``gpt_serve_metrics_overhead_pct`` gates (benchmark/perf_regression.py)
-run ``run_gate()`` / ``run_gate_telemetry()`` below on the full-size
-preset.
+``gpt_serve_metrics_overhead_pct`` / ``gpt_serve_prefix_hit_ttft_ms``
+gates (benchmark/perf_regression.py) run ``run_gate()`` /
+``run_gate_telemetry()`` / ``run_gate_prefix()`` below on the
+full-size preset.
 """
 import argparse
 import dataclasses
@@ -117,16 +129,31 @@ def _model(p):
     return params, cfg
 
 
-def _workload(p, seed=0):
-    """[(arrival_s, prompt (P,) int32, n_new)] sorted by arrival."""
+def _workload(p, seed=0, shared_prefix_frac=0.0, page_size=None):
+    """[(arrival_s, prompt (P,) int32, n_new)] sorted by arrival.
+
+    ``shared_prefix_frac`` F makes a fraction F of requests open with
+    one fixed prefix (a "system prompt" of full pages, half the max
+    prompt length rounded down to the page grid) followed by a random
+    tail — the traffic shape the round-10 prefix cache exists for."""
     rng = np.random.RandomState(seed)
+    ps = page_size or p.page_size
+    pre_len = (max(p.prompt_lens) // 2 // ps) * ps
+    shared_pre = rng.randint(1, p.vocab, max(pre_len, 1)) \
+        .astype(np.int32)
     t = 0.0
     out = []
     for _ in range(p.n_requests):
         t += rng.exponential(1.0 / p.rate)
         P = int(rng.choice(p.prompt_lens))
         N = int(rng.choice(p.out_lens))
-        prompt = rng.randint(1, p.vocab, P).astype(np.int32)
+        if shared_prefix_frac > 0.0 and rng.rand() < shared_prefix_frac:
+            head = shared_pre[:min(P - 1, pre_len)]
+            tail = rng.randint(1, p.vocab, P - head.size) \
+                .astype(np.int32)
+            prompt = np.concatenate([head, tail])
+        else:
+            prompt = rng.randint(1, p.vocab, P).astype(np.int32)
         out.append((t, prompt, N))
     return out
 
@@ -411,6 +438,152 @@ def _equal_hbm_pages(cfg, p, workload, batch):
     return max(2, budget // probe.bytes_per_page)
 
 
+# --------------------------------------------------------------- cluster ---
+
+def run_cluster(params, cfg, p, workload, replicas, prefix=True,
+                fail_after_steps=None):
+    """Round-10 cluster section: the ``ServingCluster`` front end over
+    ``replicas`` engine replicas on the (optionally shared-prefix)
+    Poisson workload.  ``fail_after_steps=k`` kills replica 0's engine
+    after k steps mid-run — the failover row asserts every request
+    still completes (recompute-exact resubmission to survivors).
+
+    TTFT here is CLUSTER-side (submit() → first committed token on
+    whichever replica ran it, failovers included) — the number a
+    client sees, admission queueing and routing included."""
+    from mxnet_tpu.serving import ServingCluster
+    max_total = max(len(pr) + n for _, pr, n in workload)
+    pps = -(-max_total // p.page_size)
+    cl = ServingCluster(params, cfg, replicas=replicas,
+                        num_slots=p.num_slots, page_size=p.page_size,
+                        pages_per_slot=pps,
+                        prefill_chunk=p.prefill_chunk,
+                        prefix_cache=prefix, metrics=True,
+                        max_queue=10 ** 6, watchdog_s=60.0)
+    try:
+        # pre-warm the (shared) step program outside the clock; the
+        # warm prefix-cache state it leaves is the steady-state a
+        # long-running cluster serves from
+        wid = cl.submit(workload[0][1], workload[0][2])
+        cl.result(wid, timeout=600)
+        if fail_after_steps is not None:
+            eng0 = cl.replicas[0].engine
+            orig_step = eng0.step
+            calls = [0]
+
+            def bomb():
+                # count only steps with real work: the idle worker
+                # loop polls step() ~50x/s, and counting those would
+                # fire the bomb before any request reaches this
+                # replica — a failover row that never exercises the
+                # in-flight resume path it exists to measure
+                busy = eng0._queue or \
+                    any(s is not None for s in eng0._slots)
+                if busy:
+                    calls[0] += 1
+                    if calls[0] == fail_after_steps:
+                        raise RuntimeError(
+                            "serve_bench injected failure")
+                return orig_step()
+
+            eng0.step = bomb
+
+        useful = sum(n for _, _, n in workload)
+        rids = []
+        t0 = time.perf_counter()
+        for at, prompt, n in workload:
+            now = time.perf_counter() - t0
+            if now < at:
+                time.sleep(at - now)
+            rids.append((cl.submit(prompt, n), at))
+        for rid, _ in rids:
+            cl.result(rid, timeout=600)
+        wall = time.perf_counter() - t0
+
+        ttft = []
+        for rid, at in rids:
+            cr = cl.requests[rid]
+            if cr.first_token_t is not None:
+                ttft.append((cr.first_token_t - t0 - at) * 1e3)
+        ttft_p50, ttft_p99 = _lat_stats(ttft)
+        c = cl.metrics()["counters"]
+        hit_tokens = sum(r.engine.stats["prefix_hit_tokens"]
+                         for r in cl.replicas)
+        out = {"tok_s": useful / wall, "wall_s": wall,
+               "replicas": replicas, "prefix_cache": bool(prefix),
+               "ttft_p50_ms": ttft_p50, "ttft_p99_ms": ttft_p99,
+               "completed": int(c["cluster_requests_completed_total"])
+               - 1,                      # minus the warmup request
+               "failovers": int(c["cluster_failovers_total"]),
+               "resubmitted": int(
+                   c["cluster_requests_resubmitted_total"]),
+               "routed_affinity": int(
+                   c["cluster_routed_affinity_total"]),
+               "prefix_hit_tokens": int(hit_tokens),
+               "cow_copies": sum(r.engine.stats["cow_copies"]
+                                 for r in cl.replicas)}
+        if out["completed"] != len(workload):
+            raise RuntimeError(
+                "serve_bench cluster: %d/%d requests completed"
+                % (out["completed"], len(workload)))
+        return out
+    finally:
+        cl.close(timeout=120)
+
+
+_prefix_gate_cache = {}
+
+
+def run_gate_prefix(preset="full"):
+    """The ``gpt_serve_prefix_hit_ttft_ms`` gate: TTFT of a request
+    whose whole prompt sits in the prefix cache (hit, COW re-feed of
+    the final token) vs a cold same-length prompt, measured on one
+    engine so the number is scheduling-deterministic.  Gate value =
+    hit TTFT in ms (direction "lower"); the cold TTFT and speedup
+    ride along for the docs."""
+    if preset in _prefix_gate_cache:
+        return _prefix_gate_cache[preset]
+    from mxnet_tpu.serving import ServingEngine
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    rng = np.random.RandomState(0)
+    P = max(p.prompt_lens)
+    N = 4
+    eng = ServingEngine(params, cfg, num_slots=p.num_slots,
+                        page_size=p.page_size,
+                        prefill_chunk=p.prefill_chunk,
+                        prefix_cache=True)
+    # compile outside the clock
+    wid = eng.submit(rng.randint(1, p.vocab, P).astype(np.int32), N)
+    eng.run()
+    del eng.requests[wid]
+
+    def ttft_ms(prompt):
+        t0 = time.perf_counter()
+        rid = eng.submit(prompt, N)
+        req = eng.requests[rid]
+        while not req.generated:
+            eng.step()
+        dt = (time.perf_counter() - t0) * 1e3
+        eng.run()                        # drain the rest
+        return dt
+
+    shared = rng.randint(1, p.vocab, P).astype(np.int32)
+    # cold reps use FRESH prompts (same shape) so nothing is cached;
+    # hit reps replay the shared prompt — best-of-3 each side, the
+    # same jitter-stripping the other serving gates use
+    cold = min(ttft_ms(rng.randint(1, p.vocab, P).astype(np.int32))
+               for _ in range(3))
+    ttft_ms(shared)                      # populate the cache
+    hit = min(ttft_ms(shared) for _ in range(3))
+    out = {"ttft_cold_ms": cold, "ttft_hit_ms": hit,
+           "speedup": cold / max(hit, 1e-9),
+           "hit_tokens": int(eng.stats["prefix_hit_tokens"]),
+           "prompt_len": P}
+    _prefix_gate_cache[preset] = out
+    return out
+
+
 # ------------------------------------------------------------------ main ---
 
 def run_gate(preset="full"):
@@ -477,6 +650,15 @@ def main(argv=None):
                     help="alias for --preset quick")
     ap.add_argument("--sweep", action="store_true",
                     help="also run the occupancy + page-size sweeps")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="run the round-10 cluster section over N "
+                         "ServingEngine replicas (prefix-cache on/off "
+                         "pair + a forced mid-run failover)")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    metavar="F",
+                    help="fraction of cluster-workload requests that "
+                         "open with one shared system-prompt prefix "
+                         "(full pages, half the max prompt length)")
     ap.add_argument("--no-telemetry", action="store_true",
                     help="skip the metrics-enabled telemetry section")
     ap.add_argument("--trace", default=None, metavar="FILE",
@@ -573,6 +755,52 @@ def main(argv=None):
             r.update(section="pagesize", config="ps%d" % ps)
             rows.append(r)
             print(json.dumps(r), flush=True)
+
+    if args.replicas > 0:
+        wl_c = _workload(p, seed=args.seed,
+                         shared_prefix_frac=args.shared_prefix_frac)
+        # prefix-hit TTFT vs cold prefill, isolated on one engine
+        # (the gpt_serve_prefix_hit_ttft_ms gate measurement)
+        pg = run_gate_prefix(p.name)
+        pg = dict(pg, section="prefix", config="prefix_hit_gate")
+        rows.append(pg)
+        print(json.dumps(pg), flush=True)
+        print("prefix cache: hit TTFT %.2f ms vs cold %.2f ms "
+              "(%.2fx) on a %d-token prompt"
+              % (pg["ttft_hit_ms"], pg["ttft_cold_ms"],
+                 pg["speedup"], pg["prompt_len"]), flush=True)
+
+        pair = {}
+        for prefix in (True, False):
+            r = run_cluster(params, cfg, p, wl_c, args.replicas,
+                            prefix=prefix)
+            r.update(section="cluster",
+                     config="cluster_r%d_%s"
+                     % (args.replicas,
+                        "prefix" if prefix else "cold"))
+            pair[prefix] = r
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+        print("cluster r%d (shared-prefix frac %.2f): prefix-cache "
+              "TTFT p50 %.2f ms vs cold %.2f ms; hit tokens %d; "
+              "affinity-routed %d" % (
+                  args.replicas, args.shared_prefix_frac,
+                  pair[True]["ttft_p50_ms"], pair[False]["ttft_p50_ms"],
+                  pair[True]["prefix_hit_tokens"],
+                  pair[True]["routed_affinity"]), flush=True)
+
+        # failover: replica 0 dies mid-run; EVERY request must still
+        # complete (run_cluster raises otherwise)
+        f = run_cluster(params, cfg, p, wl_c, args.replicas,
+                        prefix=True, fail_after_steps=10)
+        f.update(section="cluster",
+                 config="cluster_r%d_failover" % args.replicas)
+        rows.append(f)
+        print(json.dumps(f), flush=True)
+        print("failover: %d/%d completed after %d failover(s), %d "
+              "resubmitted" % (f["completed"], len(wl_c),
+                               f["failovers"], f["resubmitted"]),
+              flush=True)
 
     if args.json:
         with open(args.json, "w") as f:

@@ -404,24 +404,45 @@ class _PrefetchState:
         self.epoch = 0
 
 
+class _PrefetchError(Exception):
+    """A worker-side failure tagged with the epoch captured at decode
+    START.  Tagging at failure time instead (re-reading ``st.epoch``
+    after the stack unwound) would let a concurrent ``reset()`` — which
+    can win the lock the moment the failing decode releases it —
+    re-tag a stale failure into the NEW epoch, and the consumer would
+    rethrow an old epoch's error after a clean reset."""
+
+    def __init__(self, epoch, error):
+        super().__init__(error)
+        self.epoch = epoch
+        self.error = error
+
+
 def _prefetch_decode_super(st):
     """Decode S batches under the lock; returns (epoch, host) — the
     epoch is read under the SAME lock so a concurrent reset() cannot
-    tag a fresh-epoch superbatch with the old epoch."""
+    tag a fresh-epoch superbatch with the old epoch.  Failures raise
+    :class:`_PrefetchError` carrying that same decode-start epoch."""
     with st.lock:
         epoch = st.epoch
-        ds, ls, pad = [], [], 0
-        for _ in range(st.S):
-            try:
-                b = st.iter.next()
-            except StopIteration:
-                return epoch, None   # end of epoch (partial S dropped)
-            ds.append([d.asnumpy() for d in b.data])
-            ls.append([l.asnumpy() for l in b.label])
-            pad += int(b.pad or 0)
-    n_d, n_l = len(ds[0]), len(ls[0])
-    data = [_np.stack([row[i] for row in ds]) for i in range(n_d)]
-    label = [_np.stack([row[i] for row in ls]) for i in range(n_l)]
+        try:
+            ds, ls, pad = [], [], 0
+            for _ in range(st.S):
+                try:
+                    b = st.iter.next()
+                except StopIteration:
+                    return epoch, None  # end of epoch (partial S dropped)
+                ds.append([d.asnumpy() for d in b.data])
+                ls.append([l.asnumpy() for l in b.label])
+                pad += int(b.pad or 0)
+        except Exception as e:
+            raise _PrefetchError(epoch, e) from e
+    try:
+        n_d, n_l = len(ds[0]), len(ls[0])
+        data = [_np.stack([row[i] for row in ds]) for i in range(n_d)]
+        label = [_np.stack([row[i] for row in ls]) for i in range(n_l)]
+    except Exception as e:
+        raise _PrefetchError(epoch, e) from e
     return epoch, (data, label, pad)
 
 
@@ -440,25 +461,33 @@ def _prefetch_worker(st):
     while not st.stop:
         try:
             epoch, host = _prefetch_decode_super(st)
+        except _PrefetchError as pe:
+            # deferred-exception contract: the consumer rethrows in
+            # next().  The tag is the epoch captured at DECODE START —
+            # a reset() racing this handler cannot re-tag the stale
+            # failure into its fresh epoch (see _PrefetchError)
+            epoch, item = pe.epoch, pe.error
+        else:
             if host is None:
                 item = None
             else:
                 data, label, pad = host
-                # the upload happens HERE, in the prefetch thread:
-                # nd.array device_puts the numpy buffer directly
-                # (round-4 fix), and PjRt async dispatch lets it
-                # proceed under the consumer's in-flight run_steps.
-                # pad = total padded (wrapped-duplicate) samples
-                # across the S stacked batches, so consumers can
-                # down-weight them as with any padded DataBatch.
-                item = DataBatch(
-                    data=[nd.array(d, ctx=st.ctx) for d in data],
-                    label=[nd.array(l, ctx=st.ctx) for l in label],
-                    pad=pad, index=None)
-        except Exception as e:       # deferred-exception contract: the
-            item = e                 # consumer rethrows in next()
-            with st.lock:
-                epoch = st.epoch
+                try:
+                    # the upload happens HERE, in the prefetch thread:
+                    # nd.array device_puts the numpy buffer directly
+                    # (round-4 fix), and PjRt async dispatch lets it
+                    # proceed under the consumer's in-flight run_steps.
+                    # pad = total padded (wrapped-duplicate) samples
+                    # across the S stacked batches, so consumers can
+                    # down-weight them as with any padded DataBatch.
+                    item = DataBatch(
+                        data=[nd.array(d, ctx=st.ctx) for d in data],
+                        label=[nd.array(l, ctx=st.ctx) for l in label],
+                        pad=pad, index=None)
+                except Exception as e:
+                    # upload failure: the decode's epoch tag still
+                    # applies (captured before the failure)
+                    item = e
         if item is None or isinstance(item, Exception):
             # park until reset() re-arms the epoch.  clear() BEFORE the
             # put: if it came after, a consumer that sees the item and
@@ -546,6 +575,7 @@ class DevicePrefetchIter(DataIter):
 
     # -- consumer -----------------------------------------------------------
     def next(self):
+        import queue
         st = self._st
         # an exhausted (or closed / worker-failed) iterator keeps
         # raising StopIteration until reset() — the worker is parked
@@ -553,7 +583,16 @@ class DevicePrefetchIter(DataIter):
         if self._exhausted or st.stop:
             raise StopIteration
         while True:
-            epoch, item = st.q.get()
+            # timed get re-checking st.stop (mirrors _prefetch_put): a
+            # consumer blocked here while another thread close()s the
+            # iterator must wake up and stop, not hang forever on a
+            # queue no parked/joined worker will ever feed again
+            try:
+                epoch, item = st.q.get(timeout=0.2)
+            except queue.Empty:
+                if st.stop:
+                    raise StopIteration
+                continue
             if epoch != st.epoch:
                 continue             # stale item decoded before reset()
             if item is None:

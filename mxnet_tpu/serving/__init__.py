@@ -17,12 +17,35 @@ batching with a vLLM-style paged KV cache.
   program (padded to static slot/page shapes: exactly one compilation
   per config), retires finished sequences, and recycles their pages.
 
+Round 10 adds the cluster layer above the engine:
+
+- ``prefix_cache.PrefixCache`` — refcounted shared-prefix page reuse
+  inside the paged pool: prompt pages are content-keyed per prefix
+  chain, matching requests map them read-only (copy-on-write at the
+  first divergent token), refcount-0 chains are LRU-evicted under
+  pool pressure.  ``ServingEngine(prefix_cache=True)``.
+- ``cluster.ServingCluster`` — N engine replicas (threads
+  in-process) behind one async ``submit()/result()`` API:
+  least-loaded routing with prefix affinity, bounded admission queue
+  with backpressure + per-request TTL, health checks, watchdog
+  failover with recompute-exact resubmission, graceful
+  drain/scale-down.
+
 Benchmark: ``benchmark/serve_bench.py`` (Poisson arrivals over a mixed
-prompt/output-length distribution); gate ``gpt_serve_mixed_tok_s``.
+prompt/output-length distribution; ``--replicas N
+--shared-prefix-frac F`` for the cluster section); gates
+``gpt_serve_mixed_tok_s`` / ``gpt_serve_prefix_hit_ttft_ms``.
 Exactness: paged greedy decode is token-identical to ``generate``
-under f32 (``tests/test_serving.py``).
+under f32, through the cluster as well — prefix hits, COW divergence
+and mid-flight replica failure included (``tests/test_serving.py``,
+``tests/test_serving_cluster.py``).
 """
 from .paged_kv import PagedKVCache
+from .prefix_cache import PrefixCache
 from .engine import Request, ServingEngine
+from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
+                      RequestExpired, ClusterClosed, ClusterFailed)
 
-__all__ = ["PagedKVCache", "Request", "ServingEngine"]
+__all__ = ["PagedKVCache", "PrefixCache", "Request", "ServingEngine",
+           "ServingCluster", "ClusterRequest", "ClusterOverloaded",
+           "RequestExpired", "ClusterClosed", "ClusterFailed"]

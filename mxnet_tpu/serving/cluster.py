@@ -1,0 +1,692 @@
+"""Multi-replica serving cluster: SLO-aware router over N engines.
+
+After round 7 the serving stack topped out at ONE ``ServingEngine``
+fed directly by a benchmark loop.  This module is the cluster/front-end
+layer the Orca/vLLM lineage assumes above the engine: it owns N
+replicas (threads in-process, one engine + one prefix cache each) and
+gives clients a single async ``submit()/result()`` API.
+
+* **Routing** — least-loaded, with **prefix affinity**: the router
+  keys each prompt's full-page prefix chains
+  (``prefix_cache.chain_keys``) and sends a request whose prefix was
+  recently routed somewhere back to that replica, as long as that
+  replica's load is within ``affinity_slack`` of the minimum — so a
+  shared system prompt is prefetched once per replica it actually
+  lands on, not once per request.  Affinity never overrides health or
+  a drained replica.
+* **Admission** — the waiting set (router inboxes + engine queues) is
+  bounded by ``max_queue``; ``submit()`` raises
+  :class:`ClusterOverloaded` past it (backpressure, not buffering).
+  A per-request ``ttl_s`` expires requests still WAITING past their
+  deadline (:class:`RequestExpired` from ``result()``); requests that
+  started decoding are never expired mid-flight.
+* **Failover** — a replica whose worker raises fails itself over; a
+  replica that stalls past ``watchdog_s`` while holding work is
+  failed over by the monitor thread.  Either way its waiting and
+  in-flight requests are resubmitted to survivors with their
+  committed tokens as prompt extension — the engine's
+  recompute-exact resume path, so under f32 greedy the final output
+  is token-identical to an undisturbed run (pinned by
+  ``tests/test_serving_cluster.py``).  The zombie worker of a stalled
+  replica is fenced: completions are matched against the request's
+  current (replica, engine-rid) assignment under the cluster lock,
+  so a late step can never deliver into a resubmitted request.
+* **Drain / scale-down** — ``drain_replica(i)`` stops routing to a
+  replica, reroutes its waiting requests, lets in-flight requests
+  finish, and parks the worker; ``close()`` drains everything.
+
+Clock: ``time.perf_counter`` throughout — the serving trace clock
+(mxlint ``clock-mix`` enforces this for the whole package).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import profiler
+from .engine import ServingEngine
+from .prefix_cache import chain_keys
+
+__all__ = ["ServingCluster", "ClusterRequest", "ClusterOverloaded",
+           "RequestExpired", "ClusterClosed", "ClusterFailed"]
+
+# rid blocks: replica i assigns engine rids in [i*RID_BLOCK, ...), so
+# request ids and trace swimlanes stay unique across the cluster
+RID_BLOCK = 1 << 20
+
+
+class ClusterOverloaded(RuntimeError):
+    """submit() refused: the bounded admission queue is full."""
+
+
+class RequestExpired(RuntimeError):
+    """The request's TTL elapsed before it started decoding."""
+
+
+class ClusterClosed(RuntimeError):
+    """The cluster is closed (or lost every replica)."""
+
+
+class ClusterFailed(RuntimeError):
+    """No healthy replica remained to finish the request."""
+
+
+class ClusterRequest:
+    """Front-end request record.  ``committed`` accumulates tokens
+    from failed-over incarnations; the live incarnation's engine
+    request holds the rest."""
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id",
+                 "deadline", "state", "replica", "engine_rid",
+                 "committed", "output", "error", "done_evt",
+                 "submit_t", "first_token_t", "affinity_keys",
+                 "failovers", "delivered")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_id, deadline,
+                 affinity_keys):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.state = "queued"   # queued|running|done|expired|failed
+        self.replica: Optional[int] = None
+        self.engine_rid: Optional[int] = None
+        self.committed: List[int] = []
+        self.output: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.done_evt = threading.Event()
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.affinity_keys = affinity_keys
+        self.failovers = 0
+        self.delivered = False
+
+
+class _Replica:
+    __slots__ = ("idx", "engine", "thread", "inbox", "wake", "lock",
+                 "in_flight", "heartbeat", "alive", "draining", "dead",
+                 "error", "drained_evt")
+
+    def __init__(self, idx, engine):
+        self.idx = idx
+        self.engine = engine
+        self.thread: Optional[threading.Thread] = None
+        self.inbox: "collections.deque[ClusterRequest]" = \
+            collections.deque()
+        self.wake = threading.Event()
+        self.in_flight: Dict[int, ClusterRequest] = {}
+        self.heartbeat = time.perf_counter()
+        self.alive = True
+        self.draining = False
+        self.dead = False
+        self.error: Optional[BaseException] = None
+        self.drained_evt = threading.Event()
+
+    @property
+    def load(self):
+        return len(self.inbox) + len(self.in_flight)
+
+    @property
+    def waiting(self):
+        # inbox + engine-queued (len() reads are GIL-atomic; the value
+        # is advisory — admission control, not correctness).  A dead
+        # replica's abandoned engine queue must not count against the
+        # cluster's admission budget.
+        if self.dead:
+            return 0
+        return len(self.inbox) + len(self.engine._queue)
+
+
+class _ClusterObs:
+    """Router-level instrument bundle (mirrors ``_EngineObs``)."""
+
+    _seq = [0]
+
+    def __init__(self, registry=None):
+        from .. import obs as O
+        if registry is None:
+            registry = O.MetricsRegistry(
+                labels={"cluster": str(self._seq[0])})
+            self._seq[0] += 1
+            O.register_engine_registry(registry)
+        self.registry = registry
+        c, g, h = registry.counter, registry.gauge, registry.histogram
+        self.submitted = c("cluster_requests_submitted_total",
+                           "requests accepted by cluster submit()")
+        self.rejected = c("cluster_requests_rejected_total",
+                          "submissions refused by backpressure")
+        self.expired = c("cluster_requests_expired_total",
+                         "requests whose TTL elapsed while waiting")
+        self.completed = c("cluster_requests_completed_total",
+                           "requests finished across all replicas")
+        self.failovers = c("cluster_failovers_total",
+                           "replica failures (raise or watchdog "
+                           "stall) drained to survivors")
+        self.resubmitted = c("cluster_requests_resubmitted_total",
+                             "requests resubmitted after a replica "
+                             "failure (recompute-exact resume)")
+        self.routed_affinity = c("cluster_routed_affinity_total",
+                                 "routing decisions won by prefix "
+                                 "affinity")
+        self.routed_least = c("cluster_routed_least_loaded_total",
+                              "routing decisions by least-loaded")
+        self.g_healthy = g("cluster_replicas_healthy",
+                           "replicas accepting traffic")
+        self.g_waiting = g("cluster_queue_depth",
+                           "waiting requests (inboxes + engine "
+                           "queues)")
+        self.g_in_flight = g("cluster_in_flight",
+                             "requests holding an engine slot or "
+                             "engine queue entry")
+        self.h_ttft = h("cluster_ttft_ms",
+                        help="cluster submit() -> first committed "
+                             "token (any incarnation)")
+        from ..obs import RequestTraceEmitter
+        self.trace = RequestTraceEmitter()
+
+
+class ServingCluster:
+    """N in-process ``ServingEngine`` replicas behind one router.
+
+    Engine sizing kwargs (``num_slots``, ``page_size`` …) apply to
+    EVERY replica.  ``prefix_cache`` defaults ON here (it is what
+    prefix-affinity routing exists for); each replica has its own
+    cache, so shared-prefix prefill is paid once per replica.
+    """
+
+    def __init__(self, params, cfg, *, replicas=2, num_slots,
+                 page_size=16, num_pages=None, pages_per_slot=None,
+                 prefill_chunk=8, kv_int8=False, prefix_cache=True,
+                 metrics=None, registry=None, max_queue=256,
+                 watchdog_s=30.0, affinity_slack=None,
+                 affinity_capacity=4096, retain_results=4096):
+        if replicas < 1:
+            raise ValueError("ServingCluster: replicas must be >= 1")
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.max_queue = int(max_queue)
+        self.watchdog_s = float(watchdog_s)
+        self.prefix_enabled = bool(prefix_cache)
+        # affinity may leave the favored replica at most this many
+        # WAITING requests deeper than the shallowest queue: the cache
+        # hit saves prefill steps, but letting a hot prefix build an
+        # unbounded queue behind one replica while others idle trades
+        # TTFT SLO for hit ratio — exactly the wrong direction
+        self.affinity_slack = (max(1, num_slots // 4)
+                               if affinity_slack is None
+                               else int(affinity_slack))
+        self._lock = threading.RLock()
+        self._closed = False
+        self._next_rid = 0
+        self.requests: Dict[int, ClusterRequest] = {}
+        # terminal requests are retained (rid order) up to this many,
+        # then dropped — a long-running cluster must not grow its
+        # request table with total traffic served
+        self._retain = int(retain_results)
+        self._terminal: "collections.deque[int]" = collections.deque()
+        # prefix-chain key -> replica idx (LRU-capped)
+        self._affinity: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._affinity_cap = int(affinity_capacity)
+        if metrics is None:
+            import os
+            metrics = registry is not None or \
+                os.environ.get("MXNET_SERVING_METRICS", "0") == "1"
+        self._obs = _ClusterObs(registry) if metrics else None
+        self.replicas: List[_Replica] = []
+        for i in range(replicas):
+            eng = ServingEngine(
+                params, cfg, num_slots=num_slots, page_size=page_size,
+                num_pages=num_pages, pages_per_slot=pages_per_slot,
+                prefill_chunk=prefill_chunk, kv_int8=kv_int8,
+                prefix_cache=prefix_cache, metrics=bool(metrics),
+                rid_start=i * RID_BLOCK)
+            self.replicas.append(_Replica(i, eng))
+        # pre-warm the (shared) step program BEFORE workers and the
+        # watchdog start: a first-step compile longer than watchdog_s
+        # would otherwise read as a stall and cascade failovers across
+        # equally-cold survivors.  One compile covers every replica —
+        # the step cache keys on config, not engine.
+        eng0 = self.replicas[0].engine
+        wid = eng0.submit(np.ones(1, np.int32), 1)
+        eng0.run()
+        del eng0.requests[wid]
+        for k in eng0.stats:
+            eng0.stats[k] = type(eng0.stats[k])()
+        if metrics:
+            eng0.reset_metrics()
+        for rep in self.replicas:
+            rep.thread = threading.Thread(
+                target=self._worker, args=(rep,), daemon=True,
+                name="serving-replica-%d" % rep.idx)
+            rep.thread.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="serving-cluster-monitor")
+        self._monitor.start()
+
+    # ------------------------------------------------------- intake --
+    def submit(self, prompt, max_new_tokens, eos_id=None, ttl_s=None):
+        """Queue a request; returns its cluster rid immediately.
+        Raises :class:`ClusterOverloaded` when the bounded admission
+        queue is full and :class:`ClusterClosed` after close()."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # validate NOW, in the caller's thread, with the engine's own
+        # rules: a request the engines would reject must fail the
+        # submit() call, not poison a replica worker later
+        eng0 = self.replicas[0].engine
+        if prompt.size < 1:
+            raise ValueError("submit: empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("submit: max_new_tokens must be >= 1")
+        total = prompt.size + int(max_new_tokens)
+        if total > eng0.max_seq:
+            raise ValueError(
+                "submit: %d tokens > replica max_seq %d"
+                % (total, eng0.max_seq))
+        if total > eng0.cfg.max_len:
+            raise ValueError("submit: %d tokens > cfg.max_len=%d"
+                             % (total, eng0.cfg.max_len))
+        keys = chain_keys(prompt, self.page_size) \
+            if self.prefix_enabled else []
+        with self._lock:
+            if self._closed:
+                raise ClusterClosed("submit() after close()")
+            if not self._healthy():
+                raise ClusterClosed("no healthy replicas")
+            if sum(r.waiting for r in self.replicas) >= self.max_queue:
+                if self._obs is not None:
+                    self._obs.rejected.inc()
+                raise ClusterOverloaded(
+                    "admission queue full (%d waiting >= max_queue "
+                    "%d)" % (sum(r.waiting for r in self.replicas),
+                             self.max_queue))
+            deadline = None if ttl_s is None \
+                else time.perf_counter() + float(ttl_s)
+            cr = ClusterRequest(self._next_rid, prompt,
+                                int(max_new_tokens), eos_id, deadline,
+                                keys)
+            self._next_rid += 1
+            self.requests[cr.rid] = cr
+            rep = self._route_locked(cr)
+            rep.inbox.append(cr)
+            cr.replica = rep.idx
+            if self._obs is not None:
+                self._obs.submitted.inc()
+                self._sync_gauges_locked()
+            rep.wake.set()
+        return cr.rid
+
+    def result(self, rid, timeout=None):
+        """Block until the request finishes; returns the full token
+        array (prompt + generated).  Raises :class:`RequestExpired` /
+        :class:`ClusterFailed` per the terminal state, TimeoutError
+        on timeout."""
+        cr = self.requests.get(rid)
+        if cr is None:
+            raise KeyError(
+                "result(%d): unknown rid (already collected and "
+                "purged past retain_results?)" % rid)
+        if not cr.done_evt.wait(timeout):
+            raise TimeoutError("result(%d): still running" % rid)
+        with self._lock:
+            cr.delivered = True
+            self._purge_locked()
+        if cr.state == "done":
+            return cr.output
+        if cr.state == "expired":
+            raise RequestExpired("request %d expired before "
+                                 "admission" % rid)
+        raise ClusterFailed("request %d: %r" % (rid, cr.error))
+
+    def drain(self, timeout=None):
+        """Wait until every submitted request reaches a terminal
+        state.  Returns True if fully drained."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        for cr in list(self.requests.values()):
+            left = None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+            if not cr.done_evt.wait(left):
+                return False
+        return True
+
+    # ------------------------------------------------------ routing --
+    def _healthy(self):
+        return [r for r in self.replicas
+                if r.alive and not r.draining]
+
+    def _route_locked(self, cr):
+        healthy = self._healthy()
+        if not healthy:
+            raise ClusterClosed("no healthy replicas")
+        min_wait = min(r.waiting for r in healthy)
+        target = None
+        # longest registered prefix wins (iterate deepest-first)
+        for key in reversed(cr.affinity_keys):
+            idx = self._affinity.get(key)
+            if idx is None:
+                continue
+            rep = self.replicas[idx]
+            if rep.alive and not rep.draining \
+                    and rep.waiting <= min_wait + self.affinity_slack:
+                target = rep
+                self._affinity.move_to_end(key)
+                if self._obs is not None:
+                    self._obs.routed_affinity.inc()
+                break
+        if target is None:
+            target = min(healthy, key=lambda r: (r.load, r.idx))
+            if self._obs is not None:
+                self._obs.routed_least.inc()
+        for key in cr.affinity_keys:
+            self._affinity[key] = target.idx
+            self._affinity.move_to_end(key)
+        while len(self._affinity) > self._affinity_cap:
+            self._affinity.popitem(last=False)
+        return target
+
+    def _retire_locked(self, cr):
+        """Bound the request table: remember terminal rids in order
+        and drop the oldest DELIVERED ones past ``retain_results`` — a
+        long-running cluster must not grow memory with total traffic
+        served, but a finished result the client has not yet collected
+        is never purged out from under its pending result() call."""
+        self._terminal.append(cr.rid)
+        self._purge_locked()
+
+    def _purge_locked(self):
+        excess = len(self._terminal) - self._retain
+        if excess <= 0:
+            return
+        kept: "collections.deque[int]" = collections.deque()
+        for rid in self._terminal:
+            req = self.requests.get(rid)
+            if excess > 0 and (req is None or req.delivered):
+                excess -= 1
+                if req is not None:
+                    del self.requests[rid]
+            else:
+                kept.append(rid)
+        self._terminal = kept
+
+    def _sync_gauges_locked(self):
+        obs = self._obs
+        if obs is None:
+            return
+        obs.g_healthy.set(len(self._healthy()))
+        obs.g_waiting.set(sum(r.waiting for r in self.replicas))
+        obs.g_in_flight.set(
+            sum(len(r.in_flight) for r in self.replicas))
+
+    # ------------------------------------------------------- worker --
+    def _worker(self, rep):
+        eng = rep.engine
+        while True:
+            rep.heartbeat = time.perf_counter()
+            if rep.dead:
+                return
+            try:
+                self._pump_inbox(rep)
+                finished = eng.step()
+            except Exception as e:                  # replica death
+                self._fail_replica(rep, e)
+                return
+            rep.heartbeat = time.perf_counter()
+            if finished is False:
+                with self._lock:
+                    idle = not rep.inbox and not rep.in_flight
+                    if idle and (rep.draining or self._closed):
+                        rep.alive = False
+                        rep.drained_evt.set()
+                        self._sync_gauges_locked()
+                        return
+                rep.wake.wait(timeout=0.02)
+                rep.wake.clear()
+            elif finished:
+                for erid in finished:
+                    self._complete(rep, erid)
+
+    def _pump_inbox(self, rep):
+        """Move waiting requests into the engine, bounded to one
+        engine-queue's worth of backlog so TTL expiry keeps meaning
+        (a request buried in an unbounded engine queue could never be
+        expired — the engine queue is this thread's, the inbox is the
+        cluster's)."""
+        eng = rep.engine
+        while True:
+            with self._lock:
+                if not rep.inbox or rep.dead:
+                    return
+                if len(eng._queue) >= self.num_slots:
+                    return
+                cr = rep.inbox.popleft()
+                now = time.perf_counter()
+                if cr.deadline is not None and now > cr.deadline \
+                        and not cr.committed:
+                    cr.state = "expired"
+                    self._retire_locked(cr)
+                    if self._obs is not None:
+                        self._obs.expired.inc()
+                        self._sync_gauges_locked()
+                    cr.done_evt.set()
+                    continue
+                prompt = cr.prompt if not cr.committed else \
+                    np.concatenate([cr.prompt,
+                                    np.asarray(cr.committed,
+                                               np.int32)])
+                try:
+                    erid = eng.submit(
+                        prompt, cr.max_new_tokens - len(cr.committed),
+                        eos_id=cr.eos_id)
+                except Exception as e:
+                    # a request THIS engine rejects (submit() already
+                    # pre-validated, so this is belt-and-braces) fails
+                    # alone — it must not take the worker down
+                    cr.state = "failed"
+                    cr.error = e
+                    self._retire_locked(cr)
+                    cr.done_evt.set()
+                    continue
+                cr.state = "running"
+                cr.replica = rep.idx
+                cr.engine_rid = erid
+                rep.in_flight[erid] = cr
+                if self._obs is not None:
+                    self._sync_gauges_locked()
+
+    def _complete(self, rep, erid):
+        with self._lock:
+            cr = rep.in_flight.pop(erid, None)
+            if cr is None or rep.dead:
+                return                      # fenced zombie completion
+            if cr.state != "running" or cr.replica != rep.idx \
+                    or cr.engine_rid != erid:
+                return
+            ereq = rep.engine.requests[erid]
+            cr.output = ereq.output
+            cr.state = "done"
+            if cr.first_token_t is None and ereq.token_times:
+                cr.first_token_t = ereq.token_times[0]
+            # the engine-side record (prompt/generated/output arrays)
+            # is fully copied out — drop it so a long-running replica
+            # does not accumulate one Request per request ever served
+            del rep.engine.requests[erid]
+            self._retire_locked(cr)
+            if self._obs is not None:
+                self._obs.completed.inc()
+                if cr.first_token_t is not None:
+                    self._obs.h_ttft.observe(
+                        (cr.first_token_t - cr.submit_t) * 1e3)
+                self._sync_gauges_locked()
+            cr.done_evt.set()
+
+    # ----------------------------------------------------- failover --
+    def _fail_replica(self, rep, error):
+        """Drain a dead/stalled replica: mark it out of rotation and
+        resubmit its waiting + in-flight requests to survivors via the
+        recompute-exact resume path.  Idempotent under the lock (the
+        worker's own exception path and the monitor's watchdog can
+        race here)."""
+        with self._lock:
+            if rep.dead:
+                return
+            rep.dead = True
+            rep.alive = False
+            rep.error = error
+            strays = list(rep.inbox)
+            rep.inbox.clear()
+            in_flight = list(rep.in_flight.items())
+            rep.in_flight.clear()
+            obs = self._obs
+            if obs is not None:
+                obs.failovers.inc()
+            tracing = obs is not None and profiler.is_recording()
+            now = time.perf_counter()
+            survivors = self._healthy()
+            for erid, cr in in_flight:
+                # snapshot committed tokens (greedy determinism makes
+                # any snapshot point exact: the resumed run regenerates
+                # the continuation identically)
+                ereq = rep.engine.requests.get(erid)
+                if ereq is not None:
+                    cr.committed.extend(int(t)
+                                        for t in list(ereq.generated))
+                    if cr.first_token_t is None and ereq.token_times:
+                        cr.first_token_t = ereq.token_times[0]
+                cr.failovers += 1
+                if tracing:
+                    obs.trace.add_instant(
+                        cr.rid, "failover", now,
+                        args={"replica": rep.idx,
+                              "committed": len(cr.committed)})
+            for cr in strays + [cr for _, cr in in_flight]:
+                if cr.state not in ("queued", "running"):
+                    continue
+                done = (cr.eos_id is not None
+                        and cr.eos_id in cr.committed) or \
+                    len(cr.committed) >= cr.max_new_tokens
+                if done:
+                    cr.output = np.concatenate(
+                        [cr.prompt,
+                         np.asarray(cr.committed, np.int32)])
+                    cr.state = "done"
+                    self._retire_locked(cr)
+                    if obs is not None:
+                        obs.completed.inc()
+                    cr.done_evt.set()
+                    continue
+                cr.state = "queued"
+                cr.engine_rid = None
+                if not survivors:
+                    cr.state = "failed"
+                    cr.error = error
+                    self._retire_locked(cr)
+                    cr.done_evt.set()
+                    continue
+                target = self._route_locked(cr)
+                target.inbox.append(cr)
+                cr.replica = target.idx
+                target.wake.set()
+                if obs is not None:
+                    obs.resubmitted.inc()
+                    if tracing:
+                        obs.trace.add_instant(
+                            cr.rid, "resubmit", now,
+                            args={"replica": target.idx})
+            if tracing:
+                obs.trace.flush()
+            if obs is not None:
+                self._sync_gauges_locked()
+
+    def _monitor_loop(self):
+        period = max(0.01, min(0.25, self.watchdog_s / 4.0))
+        while True:
+            time.sleep(period)
+            with self._lock:
+                if self._closed and all(not r.alive
+                                        for r in self.replicas):
+                    return
+                now = time.perf_counter()
+                stalled = [
+                    r for r in self.replicas
+                    if r.alive and not r.dead
+                    and (r.in_flight or r.inbox)
+                    and now - r.heartbeat > self.watchdog_s]
+            for rep in stalled:
+                self._fail_replica(
+                    rep, RuntimeError(
+                        "replica %d stalled past watchdog %.3fs"
+                        % (rep.idx, self.watchdog_s)))
+
+    # ---------------------------------------------- drain/scale-down --
+    def drain_replica(self, idx, timeout=None):
+        """Graceful scale-down of one replica: stop routing to it,
+        reroute its waiting requests, let in-flight requests finish,
+        park the worker.  Returns True once drained."""
+        rep = self.replicas[idx]
+        with self._lock:
+            rep.draining = True
+            strays = list(rep.inbox)
+            rep.inbox.clear()
+            for cr in strays:
+                if cr.state != "queued":
+                    continue
+                target = self._route_locked(cr)
+                target.inbox.append(cr)
+                cr.replica = target.idx
+                target.wake.set()
+            if self._obs is not None:
+                self._sync_gauges_locked()
+        rep.wake.set()
+        return rep.drained_evt.wait(timeout)
+
+    def close(self, timeout=None):
+        """Drain every replica and stop the monitor.  In-flight work
+        finishes first (the watchdog still covers a replica that
+        stalls during shutdown)."""
+        with self._lock:
+            self._closed = True
+        for rep in self.replicas:
+            rep.wake.set()
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout)
+        self._monitor.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # --------------------------------------------------- accounting --
+    def health(self):
+        """Per-replica health snapshot (the health-check surface)."""
+        now = time.perf_counter()
+        with self._lock:
+            return [{"replica": r.idx, "alive": r.alive,
+                     "draining": r.draining, "dead": r.dead,
+                     "load": r.load, "waiting": r.waiting,
+                     "in_flight": len(r.in_flight),
+                     "heartbeat_age_s": now - r.heartbeat,
+                     "error": repr(r.error) if r.error else None}
+                    for r in self.replicas]
+
+    @property
+    def registry(self):
+        return self._obs.registry if self._obs is not None else None
+
+    def metrics(self):
+        """JSON-able snapshot: router counters + per-replica engine
+        snapshots."""
+        if self._obs is None:
+            return {"enabled": False}
+        snap = self._obs.registry.snapshot()
+        snap["enabled"] = True
+        snap["replicas"] = [r.engine.metrics() for r in self.replicas]
+        return snap

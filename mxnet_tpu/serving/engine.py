@@ -58,6 +58,7 @@ from .. import profiler
 from ..engine import Engine as _HostEngine
 from ..models import gpt as G
 from .paged_kv import PagedKVCache
+from .prefix_cache import PrefixCache
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -77,6 +78,12 @@ class Request:
     n_prefilled: int = 0                  # input rows already fed
     n_cached: int = 0                     # positions written to cache
     pending: Optional[int] = None         # sampled, not yet in cache
+    # shared-prefix bookkeeping (round 10; empty when the engine runs
+    # without a prefix cache)
+    prefix_entries: List[Any] = dataclasses.field(default_factory=list)
+    shared_pages: set = dataclasses.field(default_factory=set)
+    chain_upto: int = 0                   # leading pages known to cache
+    prefix_hit_tokens: int = 0            # prefill rows skipped via hits
     # timestamps are time.perf_counter() seconds — the profiler's trace
     # clock (profiler.now_us() / 1e6), so lifecycle spans and op events
     # interleave in one dump
@@ -254,6 +261,29 @@ class _EngineObs:
         self.alloc_failures = c("serving_page_alloc_failures_total",
                                 "allocations refused by a dry pool "
                                 "(caller stalls or preempts)")
+        # shared-prefix cache (round 10; all-zero when disabled)
+        self.prefix_hit_tokens = c("serving_prefix_hit_tokens_total",
+                                   "prefill tokens skipped via "
+                                   "prefix-cache hits")
+        self.prefix_lookup_tokens = c(
+            "serving_prefix_lookup_tokens_total",
+            "prefill tokens eligible for prefix reuse (admissions)")
+        self.prefix_pages_hit = c("serving_prefix_pages_hit_total",
+                                  "cached pages mapped read-only into "
+                                  "block tables")
+        self.prefix_pages_inserted = c(
+            "serving_prefix_pages_inserted_total",
+            "prompt pages donated to the prefix cache")
+        self.prefix_pages_evicted = c(
+            "serving_prefix_pages_evicted_total",
+            "refcount-0 chains evicted under pool pressure")
+        self.prefix_cows = c("serving_prefix_cow_total",
+                             "copy-on-write page copies at divergence")
+        self.g_prefix_cached = g("serving_prefix_cached_pages",
+                                 "pages owned by the prefix cache")
+        self.g_prefix_hit_ratio = g(
+            "serving_prefix_hit_ratio",
+            "cumulative hit tokens / lookup tokens")
         self.g_running = g("serving_running", "requests holding a slot")
         self.g_queued = g("serving_queued", "requests waiting for a "
                           "slot (incl. preempted)")
@@ -285,6 +315,7 @@ class _EngineObs:
         # competing cumulative values and the counters would go
         # backwards (a Prometheus rate() reads that as a reset)
         self._cache_seen = [0, 0, 0, 0]
+        self._prefix_seen = [0, 0, 0, 0, 0, 0]
 
     def sync_cache(self, cache):
         """Fold the allocator's plain-int telemetry into the registry
@@ -308,6 +339,28 @@ class _EngineObs:
         self.g_pages_in_use.set(cache.pages_in_use)
         self.g_hbm_held.set(cache.bytes_held)
 
+    def sync_prefix(self, prefix):
+        """Fold the prefix cache's host ints in, delta-wise like
+        sync_cache (same shared-registry aggregation argument)."""
+        vals = (prefix.hit_tokens_total, prefix.lookup_tokens_total,
+                prefix.pages_hit_total, prefix.pages_inserted_total,
+                prefix.pages_evicted_total, prefix.cow_total)
+        ctrs = (self.prefix_hit_tokens, self.prefix_lookup_tokens,
+                self.prefix_pages_hit, self.prefix_pages_inserted,
+                self.prefix_pages_evicted, self.prefix_cows)
+        seen = self._prefix_seen
+        for i, (ctr, v) in enumerate(zip(ctrs, vals)):
+            d = v - seen[i]
+            if d < 0:
+                d = v
+            if d > 0:
+                ctr.inc(d)
+            seen[i] = v
+        self.g_prefix_cached.set(prefix.cached_pages)
+        self.g_prefix_hit_ratio.set(
+            prefix.hit_tokens_total
+            / max(1, prefix.lookup_tokens_total))
+
 
 class ServingEngine:
     """Continuous-batching greedy decode over a ``PagedKVCache``.
@@ -329,6 +382,15 @@ class ServingEngine:
         rides the same step program; bigger chunks prefill faster but
         make every iteration's compiled batch wider).
     kv_int8 : paged int8-KV cache (the round-4 scale layout).
+    prefix_cache : enable refcounted shared-prefix page reuse
+        (``serving/prefix_cache.py``): prompts matching cached chains
+        map those pages read-only and skip their prefill rows;
+        completed prompt pages are donated back; refcount-0 chains are
+        LRU-evicted under pool pressure.  Off by default — the
+        ``ServingCluster`` turns it on per replica.
+    rid_start : first request id this engine assigns (a cluster gives
+        each replica a disjoint block so rids — and their trace
+        swimlanes — are unique cluster-wide).
     metrics : True/False enables/disables the obs layer; None (the
         default) reads ``MXNET_SERVING_METRICS`` (off unless "1").
         Disabled means NO instruments exist — the hot path pays one
@@ -341,7 +403,8 @@ class ServingEngine:
 
     def __init__(self, params, cfg, *, num_slots, page_size=16,
                  num_pages=None, pages_per_slot=None, prefill_chunk=8,
-                 kv_int8=False, metrics=None, registry=None):
+                 kv_int8=False, prefix_cache=False, metrics=None,
+                 registry=None, rid_start=0):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -372,16 +435,33 @@ class ServingEngine:
         self.n_rows = num_slots + prefill_chunk
         self.cache = PagedKVCache(cfg, num_pages, page_size,
                                   kv_int8=self.kv_int8)
+        # shared-prefix page reuse (round 10): content-keyed trie over
+        # the pool; the allocator's pressure callback evicts
+        # refcount-0 chains before ever refusing a live request
+        self.prefix = PrefixCache(self.cache) if prefix_cache else None
+        if self.prefix is not None:
+            self.cache.pressure_cb = self.prefix.evict
+        self._copy_fn = None              # jitted COW page copy
+        if self.prefix is not None:
+            # pre-compile the COW program now (scratch-onto-scratch is
+            # a no-op copy): the first real divergence must not stall
+            # the serving loop for a compile — page ids are traced
+            # scalars, so this one compilation covers every (src, dst)
+            self._cow_page(0, 0)
         self._step_fn = _make_step(cfg, num_slots, self.n_rows,
                                    pages_per_slot, page_size,
                                    self.kv_int8)
         self._queue: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * num_slots
-        self._next_rid = 0
+        # rid_start: a ServingCluster gives each replica a disjoint
+        # rid block so request ids (and their trace swimlanes) stay
+        # unique across the whole cluster
+        self._next_rid = int(rid_start)
         self.requests: Dict[int, Request] = {}
         self.stats = {"steps": 0, "preemptions": 0, "admitted": 0,
                       "decode_rows": 0, "prefill_rows": 0,
                       "dead_rows": 0, "peak_pages": 0,
+                      "prefix_hit_tokens": 0, "cow_copies": 0,
                       "slot_occupancy_sum": 0.0}
         if metrics is None:
             # an explicitly supplied registry is a request for
@@ -452,8 +532,19 @@ class ServingEngine:
     # ----------------------------------------------------- plumbing --
     def _release(self, req):
         if req.pages:
-            self.cache.free(req.pages)
+            if req.shared_pages:
+                # cache-owned pages stay cached (their refs drop
+                # below); only privately-owned pages return to the pool
+                self.cache.free([p for p in req.pages
+                                 if p not in req.shared_pages])
+            else:
+                self.cache.free(req.pages)
             req.pages = []
+        if req.prefix_entries:
+            self.prefix.release(req.prefix_entries)
+            req.prefix_entries = []
+        req.shared_pages = set()
+        req.chain_upto = 0
         if req.slot is not None:
             self._slots[req.slot] = None
             req.slot = None
@@ -483,6 +574,42 @@ class ServingEngine:
                     args={"committed": len(victim.generated)})
         return True
 
+    def _cow_page(self, src, dst):
+        """Device-copy page ``src`` into ``dst`` across every layer
+        pool (copy-on-write at a shared-prefix divergence).  One jitted
+        program per engine — page ids are traced scalars, so every
+        (src, dst) pair reuses the same compilation; pools are donated
+        and update in place like the step program's."""
+        if self._copy_fn is None:
+            import jax
+
+            def copy(pools, s, d):
+                out = []
+                for pool in pools:
+                    new = {"kv": pool["kv"].at[d].set(pool["kv"][s])}
+                    if "s" in pool:
+                        new["s"] = pool["s"].at[d].set(pool["s"][s])
+                    out.append(new)
+                return out
+
+            self._copy_fn = jax.jit(copy, donate_argnums=(0,))
+        self.cache.pools = self._copy_fn(self.cache.pools, src, dst)
+
+    def _insert_prefix(self, req):
+        """Donate req's freshly-completed, fully-prompt-covered pages
+        to the prefix cache (so later requests sharing the prefix skip
+        their prefill).  Pages past ``chain_upto`` whose every position
+        is both written (n_cached) and prompt-derived qualify."""
+        upto = min(req.prompt.size, req.n_cached) // self.page_size
+        if upto <= req.chain_upto:
+            return
+        new = self.prefix.insert_chain(req.prompt, req.pages, upto,
+                                       from_page=req.chain_upto)
+        for j, entry in new:
+            req.shared_pages.add(req.pages[j])
+            req.prefix_entries.append(entry)
+        req.chain_upto = upto
+
     def _ensure_page(self, req, pos):
         """Make req's block table cover position pos (allocating, or
         preempting another request when the pool is dry)."""
@@ -506,17 +633,52 @@ class ServingEngine:
                 return
             req = self._queue[0]
             inp = req.resume_input
-            need = -(-min(inp.size + 1, self.max_seq)
-                     // self.page_size)
-            got = self.cache.alloc(need)
+            total = -(-min(inp.size + 1, self.max_seq)
+                      // self.page_size)
+            # shared-prefix match: map cached pages read-only, skip
+            # their prefill rows.  Always re-feed at least the final
+            # input token — the step program needs one live row at the
+            # end of the input to produce this request's logits.
+            entries, hit_pages, m_tok = ([], [], 0) \
+                if self.prefix is None else self.prefix.match(inp)
+            skip = min(m_tok, inp.size - 1)
+            cow_idx = skip // self.page_size
+            cow = cow_idx < len(hit_pages)
+            got = self.cache.alloc(total - len(hit_pages)
+                                   + (1 if cow else 0))
             if got is None:
+                if entries:
+                    self.prefix.release(entries)
                 return                     # stall admission, not decode
             self._queue.pop(0)
-            req.pages = got
+            req.pages = list(hit_pages)
+            req.shared_pages = set(hit_pages)
+            req.prefix_entries = entries
+            if cow:
+                # the first position this request writes falls inside
+                # the last mapped page (partial-page match, or a
+                # whole-input match re-feeding its final token):
+                # copy-on-write it into a private page before any row
+                # targets it — the shared page is never written
+                assert cow_idx == len(hit_pages) - 1
+                priv = got.pop()
+                self._cow_page(hit_pages[cow_idx], priv)
+                req.pages[cow_idx] = priv
+                req.shared_pages.discard(hit_pages[cow_idx])
+                self.prefix.release([req.prefix_entries.pop()])
+                self.prefix.note_cow()
+                self.stats["cow_copies"] += 1
+            req.chain_upto = len(req.prefix_entries)
+            req.pages.extend(got)
+            if self.prefix is not None:
+                self.prefix.note_admit(skip, inp.size,
+                                       len(req.shared_pages))
+                self.stats["prefix_hit_tokens"] += skip
+                req.prefix_hit_tokens = skip
             req.slot = free_slots[0]
             req.state = "running"
-            req.n_prefilled = 0
-            req.n_cached = 0
+            req.n_prefilled = skip
+            req.n_cached = skip
             req.pending = None
             self._slots[req.slot] = req
             self.stats["admitted"] += 1
@@ -656,6 +818,10 @@ class ServingEngine:
                 req.n_cached += 1
             else:
                 req.n_cached = req.n_prefilled
+            if self.prefix is not None:
+                # donate completed prompt pages BEFORE a possible
+                # same-step retire releases them
+                self._insert_prefix(req)
             tok = int(next_tok[req.slot])
             if obs is not None:
                 obs.tokens.inc()
@@ -687,6 +853,8 @@ class ServingEngine:
         for req in self._slots:
             if req is not None and req.pending is None:
                 req.n_cached = req.n_prefilled
+                if self.prefix is not None:
+                    self._insert_prefix(req)
 
         if obs is not None:
             obs.steps.inc()
@@ -707,6 +875,8 @@ class ServingEngine:
                                   for r_ in self._slots))
             obs.g_queued.set(len(self._queue))
             obs.sync_cache(self.cache)
+            if self.prefix is not None:
+                obs.sync_prefix(self.prefix)
             if tracing:
                 for rid in decode_rids:
                     obs.trace.add_span(rid, "decode", t_step0, now)
@@ -749,6 +919,15 @@ class ServingEngine:
         self._obs.registry.reset_values()
         self.cache.reset_telemetry()
         self._obs._cache_seen = [0, 0, 0, 0]
+        if self.prefix is not None:
+            self.prefix.lookups_total = 0
+            self.prefix.lookup_tokens_total = 0
+            self.prefix.hit_tokens_total = 0
+            self.prefix.pages_hit_total = 0
+            self.prefix.pages_inserted_total = 0
+            self.prefix.pages_evicted_total = 0
+            self.prefix.cow_total = 0
+            self._obs._prefix_seen = [0, 0, 0, 0, 0, 0]
 
     def metrics(self):
         """JSON-able telemetry snapshot: this engine's counters/gauges,

@@ -97,6 +97,11 @@ class PagedKVCache:
         # page 0 is scratch — never allocated
         self._free = deque(range(1, num_pages))
         self._in_use = 0
+        # optional pool-pressure callback (round 10): when alloc()
+        # would fail, the callback is asked to surrender pages first —
+        # the PrefixCache frees LRU refcount-0 shared chains here, so
+        # cached-but-unreferenced prefixes never starve live requests
+        self.pressure_cb = None
         # allocator telemetry (round 8): plain ints bumped on the
         # host-side alloc/free path — the serving engine exports them
         # through its MetricsRegistry.  alloc_failures counts returns
@@ -122,6 +127,8 @@ class PagedKVCache:
         if n < 0:
             raise ValueError("alloc: n must be >= 0")
         self.alloc_calls += 1
+        if n > len(self._free) and self.pressure_cb is not None:
+            self.pressure_cb(n - len(self._free))
         if n > len(self._free):
             self.alloc_failures += 1
             return None

@@ -53,6 +53,10 @@ _SLOW_GROUPS = {
     # group e: ~4min — the collective-matrix pins compile 6 parallel
     # configs' steady-state train steps; too heavy to share a group
     "test_collective_matrix": "e",
+    # group f: ~1min — the round-10 serving cluster (multi-replica
+    # worker threads + watchdog timing); its own group so thread-
+    # scheduling jitter never stretches group d past its budget
+    "test_serving_cluster": "f",
 }
 
 

@@ -1,6 +1,7 @@
 """Data pipeline tests (reference models: ``tests/python/unittest/test_io.py``,
 ``test_recordio.py``, ``test_image.py``, ``test_gluon_data.py``)."""
 import os
+import time
 
 import numpy as np
 import pytest
@@ -358,3 +359,102 @@ def test_device_prefetch_iter_close_and_gc():
     assert ref() is None, "iterator not collectable (thread holds it)"
     t2.join(timeout=5)
     assert not t2.is_alive()
+
+
+def test_device_prefetch_next_unblocks_on_concurrent_close():
+    """Round-10 satellite: a consumer blocked inside next() while
+    another thread close()s the iterator must wake up (timed queue get
+    re-checking st.stop, mirroring _prefetch_put) instead of hanging
+    forever on a queue the stopped worker will never feed."""
+    import threading
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch, DataIter, DevicePrefetchIter
+
+    release = threading.Event()
+
+    class _BlockingIter(DataIter):
+        """next() blocks until released — the worker can never
+        produce, so the consumer starves inside q.get()."""
+        batch_size = 2
+
+        def next(self):
+            if not release.wait(timeout=20):
+                raise StopIteration
+            raise StopIteration
+
+        def reset(self):
+            pass
+
+    it = DevicePrefetchIter(_BlockingIter(), super_size=1)
+    outcome = []
+
+    def consume():
+        try:
+            it.next()
+            outcome.append("batch")
+        except StopIteration:
+            outcome.append("stop")
+        except Exception as e:          # pragma: no cover
+            outcome.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)                     # consumer is blocked in next()
+    assert not outcome
+    it.close()                          # worker may be stuck; consumer
+    t.join(timeout=5)                   # must still unblock via stop
+    release.set()                       # let the worker thread die
+    assert not t.is_alive(), "consumer hung in next() across close()"
+    assert outcome == ["stop"]
+
+
+def test_device_prefetch_decode_error_not_retagged_by_reset():
+    """Round-10 satellite: a decode failure racing reset() must carry
+    the epoch captured at decode START — after the reset the consumer
+    sees fresh data, never the stale epoch's rethrown error."""
+    import threading
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch, DataIter, DevicePrefetchIter
+
+    in_decode = threading.Event()
+    go_raise = threading.Event()
+
+    class _FailOnceIter(DataIter):
+        batch_size = 2
+
+        def __init__(self):
+            super().__init__(2)
+            self.fail_mode = True
+            self.served = 0
+
+        def next(self):
+            if self.fail_mode:
+                in_decode.set()         # worker holds st.lock HERE
+                go_raise.wait(timeout=20)
+                raise RuntimeError("boom in epoch 0")
+            if self.served >= 3:
+                raise StopIteration
+            self.served += 1
+            return DataBatch(data=[nd.array(np.full((2, 2), 7.0))],
+                             label=[nd.array(np.zeros(2))], pad=0)
+
+        def reset(self):
+            self.fail_mode = False
+            self.served = 0
+
+    it = DevicePrefetchIter(_FailOnceIter(), super_size=1)
+    assert in_decode.wait(timeout=10)
+    # reset() queues on st.lock while the failing decode is still in
+    # flight; when the decode unwinds, reset can win the lock BEFORE
+    # the worker's exception handler runs — the exact re-tag window
+    resetter = threading.Thread(target=it.reset, daemon=True)
+    resetter.start()
+    time.sleep(0.2)
+    go_raise.set()
+    resetter.join(timeout=10)
+    assert not resetter.is_alive()
+    # the stale epoch-0 failure must be discarded, not rethrown
+    batch = it.next()
+    np.testing.assert_array_equal(batch.data[0].asnumpy(),
+                                  np.full((1, 2, 2), 7.0))
+    it.close()

@@ -87,9 +87,12 @@ def test_tracer_inputs_bypass_cache():
     jax.jit(f)(np.ones(3, "float32"))
 
 
-def test_blacklist_falls_back_to_direct_path():
+def test_blacklist_falls_back_to_direct_path(caplog):
     """An impl that cannot trace gets blacklisted on first use and keeps
-    working through the retracing path."""
+    working through the retracing path — announced by EXACTLY one log
+    line (round-10 satellite: silent eager-path slowdowns were
+    undiagnosable), repeat calls stay quiet."""
+    import logging
     from mxnet_tpu.ops.registry import register, get_op, invoke
 
     name = "_test_untraceable_op"
@@ -99,12 +102,25 @@ def test_blacklist_falls_back_to_direct_path():
             import numpy as _o
             return _o.asarray(x) * 2.0     # concretizes → untraceable
 
+    # fresh state so the single-shot property is observable even when
+    # another test already tripped this op
+    R._EAGER_BLACKLIST.discard(name)
+    R._EAGER_LOGGED.discard((name, "blacklisted"))
     op = get_op(name)
-    out = invoke(op, [nd.ones((3,))])
-    np.testing.assert_allclose(np.asarray(out._data), 2.0)
-    assert name in R._EAGER_BLACKLIST
-    out2 = invoke(op, [nd.ones((3,))])     # stays on the direct path
-    np.testing.assert_allclose(np.asarray(out2._data), 2.0)
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.ops.registry"):
+        out = invoke(op, [nd.ones((3,))])
+        np.testing.assert_allclose(np.asarray(out._data), 2.0)
+        assert name in R._EAGER_BLACKLIST
+        out2 = invoke(op, [nd.ones((3,))])     # stays on direct path
+        np.testing.assert_allclose(np.asarray(out2._data), 2.0)
+        out3 = invoke(op, [nd.ones((3,))])
+        np.testing.assert_allclose(np.asarray(out3._data), 2.0)
+    recs = [r for r in caplog.records
+            if name in r.getMessage() and "pinned" in r.getMessage()]
+    assert len(recs) == 1, \
+        "blacklist must log exactly once, got %d" % len(recs)
+    assert (name, "blacklisted") in R._EAGER_LOGGED
 
 
 def test_autograd_and_cache_agree():

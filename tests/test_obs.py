@@ -578,3 +578,95 @@ def test_serve_bench_telemetry_smoke(tmp_path):
         max(0.10 * t["ext_tbt_mean_ms"], 0.2)
     assert t["tbt_p50_ms"] <= t["tbt_p95_ms"] <= t["tbt_p99_ms"]
     assert t["ttft_p99_ms"] > 0
+
+
+@pytest.mark.slow
+def test_cluster_router_prefix_metrics_scrape_and_trace(tmp_path):
+    """Round-10 surface pin: router counters, prefix-cache hit
+    counters/gauges, and failover events all land on the EXISTING
+    observability surface — cluster + per-replica prefix families in
+    one Prometheus scrape, failover/resubmit instants in the chrome
+    trace on the request's swimlane."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingCluster
+
+    cfg = _tiny()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, 90, 8).astype(np.int32)
+    fname = str(tmp_path / "cluster_trace.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    try:
+        cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                            page_size=4, prefill_chunk=6,
+                            metrics=True, watchdog_s=10.0)
+        eng0 = cl.replicas[0].engine
+        orig_step = eng0.step
+        calls = [0]
+
+        def bomb():
+            calls[0] += 1
+            if calls[0] == 4:
+                raise RuntimeError("injected failure")
+            return orig_step()
+
+        eng0.step = bomb
+        rids = []
+        for i in range(6):
+            p = np.concatenate([shared, rng.randint(1, 90, 2 + i)
+                                .astype(np.int32)])
+            rids.append(cl.submit(p, 6))
+        for rid in rids:
+            cl.result(rid, timeout=300)
+        scrape = obs.prometheus_text()
+        # numeric checks below are scoped to THIS cluster's
+        # registries: the default scrape aggregates every live
+        # registry in the process, so earlier tests' engines/clusters
+        # (alive until GC) would skew the summed values
+        scoped = obs.prometheus_text(
+            registries=[cl.registry]
+            + [r.engine.registry for r in cl.replicas],
+            include_native=False)
+        cl.close(timeout=60)
+    finally:
+        profiler.set_state("stop")
+
+    # router families, labeled per cluster, on the shared scrape
+    assert "# TYPE cluster_requests_submitted_total counter" in scrape
+    assert 'cluster_requests_submitted_total{cluster="' in scrape
+    for fam in ("cluster_failovers_total",
+                "cluster_requests_resubmitted_total",
+                "cluster_routed_affinity_total",
+                "cluster_replicas_healthy", "cluster_ttft_ms_count"):
+        assert fam in scrape, fam
+    # prefix-cache families from the replica engines
+    for fam in ("serving_prefix_hit_tokens_total",
+                "serving_prefix_pages_inserted_total",
+                "serving_prefix_cached_pages",
+                "serving_prefix_hit_ratio"):
+        assert fam in scrape, fam
+
+    def _fam_value(name):
+        tot = 0.0
+        for line in scoped.splitlines():
+            if line.startswith(name + "{") or \
+                    line.startswith(name + " "):
+                tot += float(line.rsplit(" ", 1)[1])
+        return tot
+
+    assert _fam_value("cluster_failovers_total") == 1
+    assert _fam_value("cluster_requests_completed_total") == 6
+    assert _fam_value("serving_prefix_hit_tokens_total") > 0
+
+    # failover + resubmit instants on the request swimlanes, same
+    # trace/clock as everything else
+    with open(profiler.dump()) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    inst = {e["name"] for e in evs
+            if e.get("cat") == "serving" and e["ph"] == "i"}
+    assert "failover" in inst and "resubmit" in inst
+    fo = [e for e in evs if e.get("name") == "failover"]
+    assert all(e["tid"] >= REQ_TID_BASE for e in fo)

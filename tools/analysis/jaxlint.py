@@ -48,6 +48,13 @@ __all__ = ["HOT_REGIONS", "CLOCK_MODULES", "lint_source", "run"]
 # (repo-relative glob, qualname regex) — the designated hot-loop regions
 HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/serving/engine.py", r"(?:.*\.)?step$"),
+    # round 10: the cluster router loop (per-replica worker + routing
+    # + completion) and the prefix-cache match/insert/evict paths run
+    # once per step / per admission — no host syncs may sneak in
+    ("mxnet_tpu/serving/cluster.py",
+     r"(?:.*\.)?(_worker|_pump_inbox|_complete|_route_locked)$"),
+    ("mxnet_tpu/serving/prefix_cache.py",
+     r"(?:.*\.)?(match|insert_chain|evict)$"),
     ("mxnet_tpu/models/gpt.py", r"generate(?:_speculative)?$"),
     ("benchmark/serve_bench.py", r".*"),
     ("benchmark/spec_decode_probe.py", r".*"),
