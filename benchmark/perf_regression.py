@@ -248,6 +248,20 @@ def bench_gpt_serve_metrics_overhead():
     return serve_bench.run_gate_telemetry("full")["overhead_pct"]
 
 
+def bench_gpt_serve_decode_step():
+    """Decode-step-time gate (round 11): engine-internal step-time p50
+    (ms, ``serving_step_ms``) of a closed-loop decode-heavy run with
+    the fused Pallas paged-attention kernel (``kernel="pallas"``) on
+    the full preset, best-of-3 — the direct pin on the block-table-
+    walk fusion.  The tok/s gates blend occupancy/accept effects; a
+    kernel regression (lost fusion, bad pipelining) moves THIS number
+    first.  Direction "lower": v <= hi.  Only meaningful on chip —
+    off-TPU the kernel interprets."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    return serve_bench.run_gate_decode_step("full")
+
+
 def bench_gpt_serve_prefix_hit():
     """Shared-prefix KV reuse gate (round 10): TTFT (ms) of a request
     whose whole prompt sits in the prefix cache — the engine maps the
@@ -321,6 +335,7 @@ BENCHES = {
                                        "lower"),
     "gpt_serve_prefix_hit_ttft_ms": (bench_gpt_serve_prefix_hit,
                                      "lower"),
+    "gpt_serve_decode_step_ms": (bench_gpt_serve_decode_step, "lower"),
 }
 
 BAR = 0.15

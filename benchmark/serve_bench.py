@@ -54,12 +54,24 @@ and external measurements subtract cleanly.
   TTFT measurement behind the ``gpt_serve_prefix_hit_ttft_ms`` gate,
   and a forced mid-run replica failover in which every request must
   still complete (recompute-exact resubmission).
+* ``kernel`` (round 11, ``--kernel-ablation``) — the fused Pallas
+  paged-attention kernel vs the XLA block-table-gather path: one
+  closed-loop decode-heavy run per kernel, step time from the
+  engine's ``serving_step_ms`` histogram.  Off-TPU the kernel runs in
+  interpreter mode (correctness path, not a perf claim — the printout
+  says so); the chip number is the ``gpt_serve_decode_step_ms``
+  gate's to pin.  ``--kernel pallas`` additionally routes the
+  headline e2e engine runs through the kernel.
+* ``spec`` (round 11, ``--spec-sweep``) — in-engine speculative
+  decode accept×K sweep on the mixed Poisson workload (spec_K =
+  0/2/4, tok/s + accept rate + tokens/step per row); ``--spec-K N``
+  arms speculation on the headline e2e engine run instead.
 
 The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
 ``gpt_serve_metrics_overhead_pct`` / ``gpt_serve_prefix_hit_ttft_ms``
-gates (benchmark/perf_regression.py) run ``run_gate()`` /
-``run_gate_telemetry()`` / ``run_gate_prefix()`` below on the
-full-size preset.
+/ ``gpt_serve_decode_step_ms`` gates (benchmark/perf_regression.py)
+run ``run_gate()`` / ``run_gate_telemetry()`` / ``run_gate_prefix()``
+/ ``run_gate_decode_step()`` below on the full-size preset.
 """
 import argparse
 import dataclasses
@@ -190,7 +202,8 @@ def _bucket_width_at(v, bounds):
 
 def run_engine(params, cfg, p, workload, num_pages=None,
                page_size=None, closed_loop_k=None, metrics=False,
-               cross_check=True):
+               cross_check=True, kernel="xla", spec_K=0,
+               spec_drafter="ngram"):
     """Open-loop (Poisson ``workload``) or closed-loop (``k`` always in
     flight, workload gives the request shapes) engine run.
 
@@ -200,7 +213,13 @@ def run_engine(params, cfg, p, workload, num_pages=None,
     measurement — >10% divergence raises.  ``cross_check=False`` skips
     the external measurement entirely: the overhead gate compares
     metrics-off vs metrics-on ENGINE cost, so the harness's own
-    per-step observation work must not ride along on one side."""
+    per-step observation work must not ride along on one side.
+
+    ``kernel``/``spec_K`` (round 11) select the engine's attention
+    path and arm in-engine speculation; spec rows report the accept
+    rate and tokens/step alongside tok/s (the benchmark-definition
+    note from round 6 applies: committed tokens per wall second moves
+    with the accept rate as well as the step time)."""
     from mxnet_tpu.serving import ServingEngine
     page_size = page_size or p.page_size
     # size the per-slot cap to the workload, not cfg.max_len — the
@@ -213,7 +232,8 @@ def run_engine(params, cfg, p, workload, num_pages=None,
                         page_size=page_size, num_pages=num_pages,
                         pages_per_slot=pps,
                         prefill_chunk=p.prefill_chunk,
-                        metrics=bool(metrics))
+                        metrics=bool(metrics), kernel=kernel,
+                        spec_K=spec_K, spec_drafter=spec_drafter)
     # pre-warm the step program outside the clock (and drop the
     # warmup's footprint from the reported stats/registry — the
     # compile time would otherwise own the TTFT tail)
@@ -239,9 +259,13 @@ def run_engine(params, cfg, p, workload, num_pages=None,
 
     def _ext_collect():
         """The external wall-clock measurement point: called after each
-        step() return (the engine commits <= 1 token/request/step).
-        Finished requests drop out of the scan so the per-step cost
-        tracks in-flight count, not total submissions."""
+        step() return.  The engine commits ONE burst per request per
+        step — a single token, or up to spec_K+1 under speculation —
+        and the engine-internal TBT histogram likewise records once
+        per burst, so both sides of the cross-check measure the same
+        per-burst intervals.  Finished requests drop out of the scan
+        so the per-step cost tracks in-flight count, not total
+        submissions."""
         now_pc = time.perf_counter()
         retired = []
         for rid, st in ext_seen.items():
@@ -312,7 +336,14 @@ def run_engine(params, cfg, p, workload, num_pages=None,
            "occupancy": eng.stats["slot_occupancy_sum"]
            / max(1, eng.stats["steps"]),
            "preemptions": eng.stats["preemptions"],
-           "steps": eng.stats["steps"]}
+           "steps": eng.stats["steps"], "kernel": kernel}
+    if spec_K:
+        out.update({
+            "spec_K": spec_K,
+            "spec_drafted": eng.stats["spec_drafted"],
+            "spec_accept_rate": eng.stats["spec_accepted"]
+            / max(1, eng.stats["spec_drafted"]),
+            "tokens_per_step": useful / max(1, eng.stats["steps"])})
     if metrics:
         reg = eng.registry
         h_ttft = reg.histogram("serving_ttft_ms")
@@ -584,6 +615,143 @@ def run_gate_prefix(preset="full"):
     return out
 
 
+# ------------------------------------------------- round-11 decode levers ---
+
+def _decode_heavy_workload(p, n=None, seed=0):
+    """Closed-loop request shapes that spend their steps DECODING:
+    minimum prompt, maximum output.  The kernel ablation and the
+    decode-step gate measure step time on this mix so the number is a
+    decode-step pin, not a prefill/chunking blend."""
+    rng = np.random.RandomState(seed)
+    P, N = min(p.prompt_lens), max(p.out_lens)
+    n = 2 * p.num_slots if n is None else n
+    return [(0.0, rng.randint(1, p.vocab, P).astype(np.int32), N)
+            for _ in range(n)]
+
+
+def run_kernel_ablation(params, cfg, p, spec_K=0):
+    """The kernel-vs-XLA decode-step-time comparison: one closed-loop
+    decode-heavy run per kernel (k = num_slots, metrics on, external
+    cross-check off), step time from the engine's own
+    ``serving_step_ms`` histogram.  NOTE off-TPU the pallas kernel
+    runs in INTERPRETER mode — correct, but the step time measures
+    the interpreter, not the fusion (docs/perf.md 'Paged attention
+    kernel'); the chip-side number is the ``gpt_serve_decode_step_ms``
+    gate's to pin."""
+    wl = _decode_heavy_workload(p)
+    rows = []
+    for kern in ("xla", "pallas"):
+        r = run_engine(params, cfg, p, wl,
+                       closed_loop_k=p.num_slots, metrics=True,
+                       cross_check=False, kernel=kern, spec_K=spec_K)
+        r.update(section="kernel", config="kernel_%s" % kern)
+        rows.append(r)
+    return rows
+
+
+def _oracle_drafter(params, cfg, p, workload, accept, seed=0):
+    """Controlled-accept drafter for the spec sweep: precompute every
+    request's true greedy continuation (grouped by prompt length so
+    one batched ``generate`` compile covers each length), then propose
+    the true next token with probability ``accept`` and a deliberately
+    wrong one otherwise.  This turns the accept axis into a KNOB — the
+    natural ngram rate on random traffic against a random-init
+    checkpoint is ~0 (the round-6 floor), which measures the
+    speculation OVERHEAD but says nothing about where the economics
+    flip.  The engine verifies every proposal, so the knob cannot
+    break exactness — only the accept rate."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+
+    n_max = max(n for _, _, n in workload)
+    by_len = {}
+    for _, prompt, _ in workload:
+        by_len.setdefault(len(prompt), []).append(prompt)
+    # prompt-keyed index (O(1) per draft call — the drafter runs per
+    # decode row per step INSIDE the timed window, so a linear scan
+    # over requests would bias the measured tok/s with workload size)
+    by_prompt = {}
+    lens = sorted(by_len, reverse=True)
+    for P, prompts in sorted(by_len.items()):
+        out = gpt.generate(params, cfg, jnp.asarray(np.stack(prompts)),
+                           n_max)
+        for prompt, s in zip(prompts, np.asarray(out).astype(np.int32)):
+            by_prompt[prompt.tobytes()] = s
+    rng = np.random.RandomState(seed)
+    vmax = cfg.vocab_size - 1
+
+    def drafter(tokens, K):
+        true = np.zeros(0, np.int32)
+        n = tokens.size
+        for P in lens:                    # a few known prompt lengths
+            if P > n:
+                continue
+            s = by_prompt.get(tokens[:P].tobytes())
+            # greedy determinism: prompt match + generated-prefix match
+            # identifies the request's true continuation
+            if s is not None and np.array_equal(s[:n], tokens):
+                true = s[n:n + K]
+                break
+        out = np.empty(K, np.int32)
+        for i in range(K):
+            t = int(true[i]) if i < true.size else 1
+            hit = i < true.size and rng.rand() < accept
+            out[i] = t if hit else (t + 1) % (vmax + 1)
+        return out
+
+    return drafter
+
+
+def run_spec_sweep(params, cfg, p, workload, num_pages=None,
+                   Ks=(0, 2, 4), oracle_accept=None):
+    """accept×K sweep under the mixed Poisson traffic: the e2e engine
+    run repeated at each spec_K, reporting tok/s, accept rate, and
+    tokens/step.  K=0 is the no-speculation control on the identical
+    workload.  ``oracle_accept=A`` swaps the ngram drafter for the
+    controlled-accept oracle (see ``_oracle_drafter``) — the
+    break-even instrument: commits/step grows with A while step cost
+    is fixed by K, so sweeping A at fixed K locates the accept rate
+    where in-engine speculation pays on this backend."""
+    rows = []
+    drafter = "ngram" if oracle_accept is None else \
+        _oracle_drafter(params, cfg, p, workload, oracle_accept)
+    tag = "" if oracle_accept is None else \
+        "_oracle%02d" % round(100 * oracle_accept)
+    for K in Ks:
+        r = run_engine(params, cfg, p, workload, num_pages=num_pages,
+                       spec_K=K, spec_drafter=drafter)
+        r.update(section="spec", config="spec%s_K%d" % (tag, K))
+        if oracle_accept is not None:
+            r["oracle_accept"] = oracle_accept
+        rows.append(r)
+    return rows
+
+
+_decode_step_gate_cache = {}
+
+
+def run_gate_decode_step(preset="full"):
+    """The ``gpt_serve_decode_step_ms`` gate: engine-internal step-time
+    p50 (``serving_step_ms``) of a closed-loop decode-heavy run with
+    ``kernel="pallas"`` on the full preset — the direct pin on the
+    fused paged-attention lever (a lost fusion or a kernel regression
+    moves THIS number; tok/s gates also move with occupancy and
+    accept rates).  Direction "lower": v <= hi."""
+    if preset in _decode_step_gate_cache:
+        return _decode_step_gate_cache[preset]
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    wl = _decode_heavy_workload(p)
+    best = min(
+        run_engine(params, cfg, p, wl, closed_loop_k=p.num_slots,
+                   metrics=True, cross_check=False,
+                   kernel="pallas")["step_p50_ms"]
+        for _ in range(3))
+    _decode_step_gate_cache[preset] = best
+    return best
+
+
 # ------------------------------------------------------------------ main ---
 
 def run_gate(preset="full"):
@@ -650,6 +818,30 @@ def main(argv=None):
                     help="alias for --preset quick")
     ap.add_argument("--sweep", action="store_true",
                     help="also run the occupancy + page-size sweeps")
+    ap.add_argument("--kernel", default="xla",
+                    choices=("xla", "pallas"),
+                    help="attention path for the e2e engine runs: the "
+                         "block-table-gather XLA path or the fused "
+                         "Pallas paged-attention kernel (interpreter "
+                         "mode off-TPU)")
+    ap.add_argument("--spec-K", type=int, default=0, metavar="N",
+                    help="arm in-engine speculative decode (N drafts "
+                         "per decode row per step) on the e2e engine "
+                         "runs; rows then carry accept-rate and "
+                         "tokens/step columns")
+    ap.add_argument("--kernel-ablation", action="store_true",
+                    help="run the kernel-vs-XLA decode-step-time "
+                         "ablation section (closed loop, decode-heavy "
+                         "shapes)")
+    ap.add_argument("--spec-sweep", action="store_true",
+                    help="run the accept-rate x K sweep section "
+                         "(e2e Poisson workload at spec_K = 0/2/4)")
+    ap.add_argument("--spec-oracle", type=float, default=None,
+                    metavar="A",
+                    help="with --spec-sweep: replace the ngram "
+                         "drafter by a controlled-accept oracle "
+                         "(propose the true greedy continuation with "
+                         "probability A) — the break-even instrument")
     ap.add_argument("--replicas", type=int, default=0, metavar="N",
                     help="run the round-10 cluster section over N "
                          "ServingEngine replicas (prefix-cache on/off "
@@ -687,7 +879,8 @@ def main(argv=None):
     rows.append(base)
     print(json.dumps(base), flush=True)
 
-    e = run_engine(params, cfg, p, wl, num_pages=pages)
+    e = run_engine(params, cfg, p, wl, num_pages=pages,
+                   kernel=args.kernel, spec_K=args.spec_K)
     e.update(section="e2e", config="engine_s%d_ps%d"
              % (p.num_slots, p.page_size))
     rows.append(e)
@@ -736,6 +929,34 @@ def main(argv=None):
                  t["ext_tbt_p99_ms"], 100 * t["tbt_p99_divergence"],
                  t["ttft_p99_ms"], t["overhead_incl_harness_pct"]),
               flush=True)
+
+    if args.kernel_ablation:
+        ab = run_kernel_ablation(params, cfg, p, spec_K=args.spec_K)
+        rows.extend(ab)
+        for r in ab:
+            print(json.dumps(r), flush=True)
+        import jax
+        interp_note = "" if jax.devices()[0].platform == "tpu" else \
+            " (pallas in INTERPRETER mode off-TPU: a correctness " \
+            "path, not a perf claim)"
+        print("kernel ablation: step p50 xla %.2f ms vs pallas "
+              "%.2f ms%s" % (ab[0]["step_p50_ms"],
+                             ab[1]["step_p50_ms"], interp_note),
+              flush=True)
+
+    if args.spec_sweep:
+        sp = run_spec_sweep(params, cfg, p, wl, num_pages=pages,
+                            oracle_accept=args.spec_oracle)
+        rows.extend(sp)
+        for r in sp:
+            print(json.dumps(r), flush=True)
+        base_t = sp[0]["tok_s"]
+        print("spec sweep: " + "; ".join(
+            "K=%d %.0f tok/s (%.2fx)%s"
+            % (r.get("spec_K", 0), r["tok_s"], r["tok_s"] / base_t,
+               "" if "spec_accept_rate" not in r else
+               " accept %.2f" % r["spec_accept_rate"])
+            for r in sp), flush=True)
 
     if args.sweep:
         for k in sorted({max(1, p.num_slots // 4),

@@ -34,6 +34,16 @@ Three sections:
               (< ~0.7) is an accept-plumbing bug, not a workload
               property; rollback correctness is unaffected either way
               (the rejected-is-replayed path is the gated one).
+``--engine``  round 11: the same accept×K ablation measured THROUGH
+              the continuous-batching ``ServingEngine`` (spec_K=K,
+              closed loop, one request per former batch row).  The
+              engine drafts with ``serving/drafters.ngram_draft`` —
+              the host twin of the ``_draft_ngram`` rule this probe's
+              e2e rows use (parity-pinned), so probe accept-rates and
+              engine accept-rates come from ONE drafting
+              implementation and any divergence between the two
+              sections is accept-economics (per-row vs batch-min
+              commits), never drafter drift.
 
 Usage::
 
@@ -231,11 +241,54 @@ def e2e(cfg, params, batch, Ks, n_lo, n_hi, calib=False, sweep=True):
     return rows
 
 
+def engine_accept(cfg, params, batch, Ks, n, page_size=16):
+    """Accept×K through the serving engine (round 11): ``batch``
+    closed-loop requests per workload, each decoding ``n`` tokens with
+    in-engine speculation at spec_K=K.  Accept rates come from the
+    engine's own ledger (``stats['spec_accepted']/['spec_drafted']``)
+    — the same numbers the ``serving_spec_*`` counters export — and
+    the drafting rule is ``serving/drafters.ngram_draft``, the
+    parity-pinned host twin of this probe's ``_draft_ngram``."""
+    import numpy as np
+    from mxnet_tpu.serving import ServingEngine
+
+    rows = []
+    for workload in ("random", "loop"):
+        prompts = np.asarray(_prompts(cfg, batch, workload))
+        for K in Ks:
+            eng = ServingEngine(params, cfg, num_slots=min(batch, 8),
+                                page_size=page_size, spec_K=K)
+            t0 = time.perf_counter()
+            rids = [eng.submit(pr, n) for pr in prompts]
+            outs = eng.run()
+            wall = time.perf_counter() - t0
+            drafted = eng.stats["spec_drafted"]
+            acc = eng.stats["spec_accepted"] / max(1, drafted)
+            tot = sum(len(eng.requests[r].generated) for r in rids)
+            assert len(outs) == len(rids)
+            row = {"section": "engine", "config": "engine_K%d" % K,
+                   "batch": batch, "K": K, "workload": workload,
+                   "tok_s": round(tot / wall, 1),
+                   "accept_rate": round(acc, 3),
+                   "tokens_per_step": round(
+                       tot / max(1, eng.stats["steps"]), 3),
+                   "steps": eng.stats["steps"]}
+            rows.append(row)
+            print("  engine   b%-3d K=%d %-6s  %8.1f tok/s   accept "
+                  "%.2f  tokens/step %.2f"
+                  % (batch, K, workload, row["tok_s"], acc,
+                     row["tokens_per_step"]), flush=True)
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="speculative decode probe")
     p.add_argument("--micro", action="store_true")
     p.add_argument("--e2e", action="store_true")
     p.add_argument("--calib", action="store_true")
+    p.add_argument("--engine", action="store_true",
+                   help="accept x K measured through the serving "
+                        "engine (spec_K, shared drafter impl)")
     p.add_argument("--quick", action="store_true",
                    help="tiny model (smoke test of the harness itself)")
     p.add_argument("--batches", default="1,8")
@@ -244,8 +297,8 @@ def main(argv=None):
     p.add_argument("--n-hi", type=int, default=448)
     p.add_argument("--json", default=None)
     args = p.parse_args(argv)
-    if not (args.micro or args.e2e or args.calib):
-        args.micro = args.e2e = args.calib = True
+    if not (args.micro or args.e2e or args.calib or args.engine):
+        args.micro = args.e2e = args.calib = args.engine = True
 
     import jax
     from mxnet_tpu.models import gpt
@@ -270,6 +323,12 @@ def main(argv=None):
                   flush=True)
             all_rows += e2e(cfg, params, batch, Ks, n_lo, n_hi,
                             calib=args.calib, sweep=args.e2e)
+        if args.engine:
+            print("== engine (b%d): in-engine speculation accept "
+                  "rate ==" % batch, flush=True)
+            all_rows += engine_accept(
+                cfg, params, batch, Ks, n_hi if not args.quick else 32,
+                page_size=4 if args.quick else 16)
 
     if args.json:
         with open(args.json, "w") as f:

@@ -1,0 +1,244 @@
+"""Fused paged-attention decode kernel — Pallas TPU (round 11).
+
+The serving engine's decode attention (``serving/engine.py _make_step``)
+previously materialized a block-table gather in HBM every step:
+``pool[row_pages]`` builds a dense (T*H, L, 2*dh) view — T·H·L·2·dh
+elements copied through HBM per layer per step — and only then runs the
+two attention dots (``models/gpt.py _attend_rows``).  For decode that
+gather IS the step cost: the dots read each element once, so the copy
+doubles the dominant HBM stream and adds a full intermediate buffer.
+
+This kernel walks each row's block table directly: grid (T, PP) with
+the block table scalar-prefetched (``pltpu.PrefetchScalarGridSpec``),
+so the BlockSpec index map streams page ``bt[t, j]`` HBM→VMEM per grid
+step (Pallas double-buffers consecutive pages automatically), and the
+kernel body folds that page into an **online-softmax accumulation**
+(running max / denominator / weighted-V accumulator in VMEM scratch,
+the FlashAttention recurrence over pages instead of k-blocks).  The
+ragged last page is masked by absolute position (``k_pos <= pos`` —
+the same per-row mask ``_attend_rows`` applies), pages past the row's
+length are skipped (``pl.when``), and int8-KV pages dequantize inside
+the loop using the round-4 per-(row, token) scale layout: the k scale
+multiplies the scores, the v scale folds into the softmax weights —
+exactly where ``_attend_rows`` folds them.
+
+Numerics: online softmax normalizes ONCE at the end (acc / l) where
+the jnp reference normalizes the probabilities before the V dot, and
+the page-sequential accumulation orders the L-length reductions
+differently from one batched dot — both are 1–2 ulp effects in f32
+(measured max |diff| ~2e-7 on randn inputs; same caveat class as the
+paged-vs-contiguous reduction-order note in ``tests/test_serving.py``).
+``tests/test_paged_attention.py`` pins the kernel against the
+``_attend_rows`` reference at a few-ulp tolerance across page-boundary
+cases in interpreter mode, and the serving tests pin full greedy
+TOKEN-identity of the pallas engine against ``generate`` — the
+exactness bar the serving stack actually guarantees.
+
+Chip status: NOT chip-measured this round (no TPU session).  The
+interpreter path is the tier-1 correctness oracle; on CPU it runs the
+grid as a compiled loop (~10x slower than the XLA gather at mid-preset
+shapes — the fusion win is an HBM-traffic argument that only a chip
+can price).  Refresh ``gpt_serve_decode_step_ms`` with
+``perf_regression.py --update`` at the next chip session.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+# test hook (mirrors kernels/flash_attention.py): force interpreter
+# mode regardless of platform.  paged_attention() also auto-interprets
+# whenever the default device is not a TPU, so tier-1 CPU tests and the
+# serving engine's kernel="pallas" path need no explicit flag.
+_INTERPRET = False
+
+
+def _use_interpret():
+    import jax
+    return _INTERPRET or jax.devices()[0].platform != "tpu"
+
+
+def _kernel(bt_ref, pos_ref, q_ref, kv_ref, *rest, page_size, dh,
+            int8):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if int8:
+        s_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        s_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
+
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    pos = pos_ref[pl.program_id(0)]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # pages whose first slot is past the row's position hold nothing
+    # this row may attend to — skip the whole page (the scalar-prefetch
+    # index map still aims their prefetch at whatever bt says, which
+    # for unallocated tail entries is the scratch page 0)
+    @pl.when(j * page_size <= pos)
+    def _page():
+        kv = kv_ref[0]                       # (ps, H, 2*dh) cdt|int8
+        q = q_ref[0]                         # (H, dh) cdt
+        cdt = q.dtype
+        k = kv[:, :, :dh].astype(cdt)
+        v = kv[:, :, dh:].astype(cdt)
+        # scores: contraction over dh, batched over heads → (H, ps)
+        s = jax.lax.dot_general(
+            k, q, (((2,), (1,)), ((1,), (0,))),
+            preferred_element_type=jnp.float32)
+        if int8:
+            # k scale multiplies the scores (round-4 layout, the same
+            # fold point as _attend_rows)
+            s = s * s_ref[0][:, :, 0].T
+        s = s / jnp.sqrt(jnp.float32(dh))
+        k_pos = j * page_size + jnp.arange(page_size)
+        s = jnp.where(k_pos[None, :] <= pos, s, -1e30)
+
+        m_prev = m_ref[:, :1]                # (H, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)               # (H, ps) f32
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = l_ref[:, :1] * alpha + \
+            jnp.sum(p, axis=-1, keepdims=True)
+        if int8:
+            # v scale folds into the softmax weights before the V dot
+            p = p * s_ref[0][:, :, 1].T
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(cdt), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # (H, dh)
+        m_ref[:, :1] = m_new
+
+    @pl.when(j == nj - 1)
+    def _out():
+        o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+# bounded cache of built pallas_call closures, keyed on every
+# shape/dtype the call specializes on (jit would re-trace through a
+# fresh closure each step otherwise — the gpt.py cache idiom)
+_call_cache = {}
+_CALL_CACHE_MAX = 32
+
+
+def _build(T, H, dh, PP, page_size, num_pages, kv_dtype, q_dtype,
+           int8, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    key = (T, H, dh, PP, page_size, num_pages, str(kv_dtype),
+           str(q_dtype), int8, interpret)
+    fn = _call_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def page_map(t, j, bt, pos):
+        return (bt[t * PP + j], 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H, dh), lambda t, j, bt, pos: (t, 0, 0)),
+        pl.BlockSpec((1, page_size, H, 2 * dh), page_map),
+    ]
+    scratch = [pltpu.VMEM((H, 1), jnp.float32),
+               pltpu.VMEM((H, 1), jnp.float32),
+               pltpu.VMEM((H, dh), jnp.float32)]
+    if int8:
+        in_specs.append(pl.BlockSpec((1, page_size, H, 2), page_map))
+    body = functools.partial(_kernel, page_size=page_size, dh=dh,
+                             int8=int8)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, PP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, dh),
+                               lambda t, j, bt, pos: (t, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    fn = pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((T, H, dh), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+    if len(_call_cache) >= _CALL_CACHE_MAX:
+        _call_cache.pop(next(iter(_call_cache)))
+    _call_cache[key] = fn
+    return fn
+
+
+def paged_attention(q, pool_kv, pool_s, block_tables, row_pos, *,
+                    page_size, interpret=None):
+    """Single-token attention over paged K/V via block-table walk.
+
+    Parameters
+    ----------
+    q : (T, H, dh) compute-dtype queries, one per decode row.
+    pool_kv : (num_pages, page_size, H, 2*dh) page pool — the
+        ``PagedKVCache`` layout (k and v halves fused on the last
+        axis); cfg dtype, or int8 when ``pool_s`` is given.
+    pool_s : (num_pages, page_size, H, 2) f32 dequant scales for the
+        int8-KV pool (``models/gpt.py _kv_quantize`` layout), or None.
+    block_tables : (T, PP) int32 per-ROW page ids; entry j covers
+        positions [j*page_size, (j+1)*page_size).  Unused tail entries
+        should point at the scratch page 0.
+    row_pos : (T,) int32 per-row absolute positions — each row attends
+        to positions <= its own (the continuous-batching mask).
+
+    Returns (T, H, dh) f32.  ``interpret=None`` auto-selects
+    interpreter mode off-TPU (the tier-1 CPU path).
+    """
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = _use_interpret()
+    T, H, dh = q.shape
+    num_pages = pool_kv.shape[0]
+    PP = block_tables.shape[1]
+    if pool_kv.shape[1] != page_size:
+        raise ValueError("paged_attention: pool page_size %d != %d"
+                         % (pool_kv.shape[1], page_size))
+    int8 = pool_s is not None
+    fn = _build(T, H, dh, PP, page_size, num_pages, pool_kv.dtype,
+                q.dtype, int8, bool(interpret))
+    bt = block_tables.reshape(-1).astype(jnp.int32)
+    pos = row_pos.astype(jnp.int32)
+    if int8:
+        return fn(bt, pos, q, pool_kv, pool_s)
+    return fn(bt, pos, q, pool_kv)
+
+
+def paged_attention_reference(q, pool_kv, pool_s, block_tables,
+                              row_pos, *, page_size):
+    """The jnp path: block-table gather + ``_attend_rows``.  This IS
+    the serving engine's ``kernel="xla"`` attention (the step program
+    calls it directly — one copy, so the engine path and the tests'
+    oracle cannot drift), and the reference the Pallas kernel is
+    pinned against at a few-ulp f32 tolerance (the online-softmax
+    normalization-order caveat in the module docstring)."""
+    import jax.numpy as jnp
+
+    from ..models.gpt import _attend_rows
+
+    T, H, dh = q.shape
+    PP = block_tables.shape[1]
+    L = PP * page_size
+    ckv = pool_kv[block_tables].transpose(0, 3, 1, 2, 4) \
+        .reshape(T * H, L, 2 * dh)
+    cs = None
+    if pool_s is not None:
+        cs = pool_s[block_tables].transpose(0, 3, 1, 2, 4) \
+            .reshape(T * H, L, 2)
+    pos_r = jnp.repeat(row_pos, H)
+    out = _attend_rows(q.reshape(T * H, dh), ckv, cs, pos_r, dh)
+    return out.reshape(T, H, dh)

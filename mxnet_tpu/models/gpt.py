@@ -565,7 +565,13 @@ def _draft_ngram(token_buf, n_next, K, g):
     (prompt-lookup / n-gram speculation).  Pure vectorized compares —
     no model forward.  token_buf (B, BUF) with positions [0, n_next)
     committed; falls back to repeating the last token when no match.
-    Returns (B, K) int32 proposals for positions [n_next, n_next+K)."""
+    Returns (B, K) int32 proposals for positions [n_next, n_next+K).
+
+    This is the IN-XLA twin of ``serving/drafters.py ngram_draft``
+    (the host-side drafter the continuous-batching engine uses for
+    in-engine speculation, round 11) — semantic parity between the
+    two is pinned by ``tests/test_paged_attention.py``, so accept
+    rates measured through either path come from one drafting rule."""
     import jax
     import jax.numpy as jnp
 

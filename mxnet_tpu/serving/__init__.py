@@ -31,21 +31,39 @@ Round 10 adds the cluster layer above the engine:
   failover with recompute-exact resubmission, graceful
   drain/scale-down.
 
+Round 11 adds the raw-decode-speed levers (ROADMAP item 2):
+
+- ``ServingEngine(kernel="pallas")`` — the step program attends via
+  the fused block-table-walk Pallas kernel
+  (``kernels/paged_attention.py``: online-softmax over pages, int8
+  dequant in the inner loop, no materialized gather); ``"xla"`` keeps
+  the gather + ``_attend_rows`` path, cross-checked by tests.
+- ``ServingEngine(spec_K=K)`` — in-engine speculative decode:
+  host-side drafting (``drafters.ngram_draft``) feeds K extra rows
+  per decode slot into the SAME step program, which verifies every
+  row's drafts in one batched forward; accepts commit by pointer
+  advance, rejections roll back exactly.
+
 Benchmark: ``benchmark/serve_bench.py`` (Poisson arrivals over a mixed
 prompt/output-length distribution; ``--replicas N
---shared-prefix-frac F`` for the cluster section); gates
-``gpt_serve_mixed_tok_s`` / ``gpt_serve_prefix_hit_ttft_ms``.
+--shared-prefix-frac F`` for the cluster section; ``--kernel`` /
+``--spec-K`` / ``--kernel-ablation`` / ``--spec-sweep`` for the
+round-11 levers); gates ``gpt_serve_mixed_tok_s`` /
+``gpt_serve_prefix_hit_ttft_ms`` / ``gpt_serve_decode_step_ms``.
 Exactness: paged greedy decode is token-identical to ``generate``
-under f32, through the cluster as well — prefix hits, COW divergence
-and mid-flight replica failure included (``tests/test_serving.py``,
+under f32, through the cluster as well — prefix hits, COW divergence,
+mid-flight replica failure, either attention kernel, and speculation
+with arbitrary drafters included (``tests/test_serving.py``,
 ``tests/test_serving_cluster.py``).
 """
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache
+from .drafters import ngram_draft
 from .engine import Request, ServingEngine
 from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
                       RequestExpired, ClusterClosed, ClusterFailed)
 
 __all__ = ["PagedKVCache", "PrefixCache", "Request", "ServingEngine",
            "ServingCluster", "ClusterRequest", "ClusterOverloaded",
-           "RequestExpired", "ClusterClosed", "ClusterFailed"]
+           "RequestExpired", "ClusterClosed", "ClusterFailed",
+           "ngram_draft"]

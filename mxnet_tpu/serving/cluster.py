@@ -195,7 +195,14 @@ class ServingCluster:
     Engine sizing kwargs (``num_slots``, ``page_size`` …) apply to
     EVERY replica.  ``prefix_cache`` defaults ON here (it is what
     prefix-affinity routing exists for); each replica has its own
-    cache, so shared-prefix prefill is paid once per replica.
+    cache, so shared-prefix prefill is paid once per replica.  The
+    round-11 decode levers pass straight through: ``kernel`` selects
+    each replica's attention path (xla gather vs fused pallas walk)
+    and ``spec_K``/``spec_drafter``/``spec_ngram`` arm in-engine
+    speculative decode per replica — failover/resubmit semantics are
+    unchanged because committed tokens are committed tokens however
+    many a step produced (recompute-exact resume replays them as
+    prompt extension, pinned by ``tests/test_serving_cluster.py``).
     """
 
     def __init__(self, params, cfg, *, replicas=2, num_slots,
@@ -203,7 +210,9 @@ class ServingCluster:
                  prefill_chunk=8, kv_int8=False, prefix_cache=True,
                  metrics=None, registry=None, max_queue=256,
                  watchdog_s=30.0, affinity_slack=None,
-                 affinity_capacity=4096, retain_results=4096):
+                 affinity_capacity=4096, retain_results=4096,
+                 kernel="xla", spec_K=0, spec_drafter="ngram",
+                 spec_ngram=2):
         if replicas < 1:
             raise ValueError("ServingCluster: replicas must be >= 1")
         self.num_slots = num_slots
@@ -244,7 +253,8 @@ class ServingCluster:
                 num_pages=num_pages, pages_per_slot=pages_per_slot,
                 prefill_chunk=prefill_chunk, kv_int8=kv_int8,
                 prefix_cache=prefix_cache, metrics=bool(metrics),
-                rid_start=i * RID_BLOCK)
+                rid_start=i * RID_BLOCK, kernel=kernel, spec_K=spec_K,
+                spec_drafter=spec_drafter, spec_ngram=spec_ngram)
             self.replicas.append(_Replica(i, eng))
         # pre-warm the (shared) step program BEFORE workers and the
         # watchdog start: a first-step compile longer than watchdog_s

@@ -1,0 +1,69 @@
+"""Draft-token proposers for in-engine speculative decode (round 11).
+
+One implementation serves every accept-rate number in the repo: the
+``ServingEngine`` drafts with :func:`ngram_draft` (host-side numpy —
+the engine's scheduler is host Python, so drafting joins the per-step
+scheduling work it already does; the compare is vectorized because
+this runs once per decode row per step), and
+``benchmark/spec_decode_probe.py``'s engine section measures accept
+rates through the engine itself, so probe and engine rates cannot
+drift apart.  ``models/gpt.py _draft_ngram`` is the in-XLA twin used
+by the stand-alone ``generate_speculative`` loop (drafting there must
+live inside the compiled program); semantic parity between the two is
+pinned by ``tests/test_paged_attention.py::test_ngram_draft_parity``.
+
+The drafter contract the engine accepts (``spec_drafter=``):
+
+    drafter(tokens: np.ndarray (n,), K: int) -> np.ndarray (K,)
+
+``tokens`` is the row's committed sequence (prompt + generated, the
+last element being the not-yet-cached pending token); the return is K
+proposals for the positions after it.  Proposal quality only affects
+the accept rate — the batched verify forward gates correctness, so an
+adversarial drafter degrades to plain decode (pinned by the
+forced-rejection test in ``tests/test_serving.py``).
+
+Self-drafting (a small model proposing tokens) stays a
+``generate_speculative`` feature for now: inside the engine it would
+cost K sequential extra program dispatches per step, which is the
+c_S-amortization the in-engine design exists to avoid.  ``ngram`` is
+the zero-cost drafter whose economics the round-6 probe showed flip
+positive once verify is batched across rows.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ngram_draft"]
+
+
+def ngram_draft(tokens, K, g=2):
+    """Prompt-lookup (n-gram) draft: propose the K tokens that followed
+    the most recent earlier occurrence of the final ``g`` committed
+    tokens; fall back to repeating the last token for the positions no
+    match covers (or when no match exists / the row is shorter than
+    ``g``).  Semantically identical to ``models/gpt.py _draft_ngram``
+    restricted to one row's committed region (parity-pinned)."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    n = tokens.size
+    if n < 1:
+        raise ValueError("ngram_draft: empty token row")
+    if K < 1:
+        raise ValueError("ngram_draft: K must be >= 1")
+    out = np.full(K, tokens[n - 1], np.int32)
+    if n <= g:
+        return out
+    key = tokens[n - g:]
+    # most recent usable match: the continuation must start inside the
+    # committed region (s + g < n), same bound as _draft_ngram.  One
+    # vectorized sliding-window compare — this runs once per decode
+    # row per engine step (a jaxlint hot region), so no Python loop
+    # over offsets: stride-tricks windows cost no copy.
+    win = np.lib.stride_tricks.sliding_window_view(tokens[:n - 1], g)
+    hits = np.nonzero((win == key).all(axis=1))[0]
+    if hits.size:
+        s = int(hits[-1])
+        idx = s + g + np.arange(K)
+        ok = idx < n
+        out[ok] = tokens[idx[ok]]
+    return out

@@ -27,10 +27,28 @@ shapes):
 4. build the row batch, run the step program, commit sampled tokens,
    check stop conditions.
 
+Round 11 adds the two raw-decode-speed levers from ROADMAP item 2:
+
+* ``kernel="pallas"`` routes the step program's attention through the
+  fused block-table-walk kernel (``kernels/paged_attention.py``):
+  online-softmax over pages streamed HBM→VMEM, int8 dequant in the
+  inner loop, no materialized gather.  ``"xla"`` (default) keeps the
+  gather + ``_attend_rows`` path; both are cross-checked by tests.
+* ``spec_K=K`` folds speculative decode INTO the step program: each
+  running decode slot feeds its pending token plus K host-drafted
+  rows (``serving/drafters.py`` ngram by default), the ONE program
+  verifies every row's drafts against its own per-position argmaxes
+  (the batched-verify amortization that flips round-6's stand-alone
+  negative result), accepted tokens commit by advancing ``n_cached``
+  over k/v already written this step, and rejections roll back by
+  POINTER only — stale slots are overwritten at the committed
+  position before any mask exposes them (the ``_decode_block``
+  argument, serving edition).
+
 Exactness: under f32 greedy, engine outputs are token-identical to
 ``models/gpt.py generate`` per request, whatever the batch mix,
-admission order, page reuse, or preemptions — pinned by
-``tests/test_serving.py``.
+admission order, page reuse, preemptions, kernel choice, or drafter
+quality — pinned by ``tests/test_serving.py``.
 
 Telemetry (round 8, ``mxnet_tpu/obs``): with ``metrics=True`` (or
 ``MXNET_SERVING_METRICS=1``) the engine feeds a per-engine
@@ -57,6 +75,7 @@ import numpy as np
 from .. import profiler
 from ..engine import Engine as _HostEngine
 from ..models import gpt as G
+from . import drafters
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache
 
@@ -112,13 +131,24 @@ _STEP_CACHE_MAX = 8
 
 
 def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
-               kv_int8):
-    """Build (and cache) the jitted unified prefill+decode step."""
+               kv_int8, kernel="xla", n_sample=1):
+    """Build (and cache) the jitted unified prefill+decode step.
+
+    ``kernel`` selects the decode-attention implementation: ``"xla"``
+    is the block-table gather + ``_attend_rows`` path (materializes
+    the gathered (T*H, L, 2*dh) view), ``"pallas"`` the fused
+    ``kernels/paged_attention.py`` walk (online softmax over pages,
+    no gather materialization; interpreter mode off-TPU).
+
+    ``n_sample`` is how many argmax rows each slot reads back per step
+    (1 + spec_K): with in-engine speculation every decode slot feeds
+    its pending token plus K draft rows and the host verifies the
+    drafts against the returned per-row argmaxes."""
     import jax
     import jax.numpy as jnp
 
     key = (cfg, num_slots, n_rows, pages_per_slot, page_size,
-           bool(kv_int8))
+           bool(kv_int8), kernel, n_sample)
     fn = _step_cache.get(key)
     if fn is not None:
         return fn
@@ -127,10 +157,9 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     D, H = cfg.d_model, cfg.n_heads
     dh = D // H
     T = n_rows
-    L = pages_per_slot * page_size
 
     def step(params, pools, tokens, row_slot, row_pos, row_live, bt,
-             slot_last_row):
+             slot_rows):
         x = G._embed(params, tokens, cdt)              # (T, D)
         x = x + params["pos_emb"][row_pos].astype(cdt)
         x = G.T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
@@ -146,7 +175,6 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                          bt[row_slot, page_idx], 0)    # (T,)
         off = row_pos % page_size
         row_pages = bt[row_slot]                       # (T, PP)
-        pos_r = jnp.repeat(row_pos, H)                 # (T*H,)
 
         new_pools = []
         for layer, pool in zip(params["layers"], pools):
@@ -162,22 +190,32 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                 pool_kv = pool["kv"].at[page, off].set(kvq)
                 pool_s = pool["s"].at[page, off].set(skv)
                 new_pools.append({"kv": pool_kv, "s": pool_s})
-                cs = pool_s[row_pages] \
-                    .transpose(0, 3, 1, 2, 4) \
-                    .reshape(T * H, L, 2)
             else:
                 newkv = jnp.concatenate([k, v], axis=-1).astype(cdt)
                 pool_kv = pool["kv"].at[page, off].set(newkv)
+                pool_s = None
                 new_pools.append({"kv": pool_kv})
-                cs = None
-            # block-table gather → the (R, L, 2*dh) view the shared
-            # attention code consumes (scatter-before-gather so every
-            # row sees its own k/v, same as the contiguous DUS order)
-            ckv = pool_kv[row_pages] \
-                .transpose(0, 3, 1, 2, 4) \
-                .reshape(T * H, L, 2 * dh)
-            attn = G._attend_rows(q.reshape(T * H, dh), ckv, cs,
-                                  pos_r, dh)           # (T*H, dh) f32
+            if kernel == "pallas":
+                # fused block-table walk (kernels/paged_attention.py):
+                # pages stream HBM->VMEM per grid step, online-softmax
+                # accumulation, int8 dequant in the inner loop — no
+                # gathered view is ever materialized
+                from ..kernels.paged_attention import paged_attention
+                attn = paged_attention(q, pool_kv, pool_s, row_pages,
+                                       row_pos, page_size=page_size)
+            else:
+                # block-table gather + _attend_rows — ONE copy of the
+                # gather lives in kernels/paged_attention.py, shared
+                # with the tests' oracle, so the engine path and the
+                # kernel's comparison reference cannot drift apart
+                # (scatter-before-gather so every row sees its own
+                # k/v, same as the contiguous DUS order)
+                from ..kernels.paged_attention import \
+                    paged_attention_reference
+                attn = paged_attention_reference(
+                    q, pool_kv, pool_s, row_pages, row_pos,
+                    page_size=page_size)
+            attn = attn.reshape(T * H, dh)             # (T*H, dh) f32
             attn = attn.astype(cdt)
             attn = G._wmm(attn.reshape(T, D), layer["wo"], cdt) + \
                 dn(layer["bo"])
@@ -200,7 +238,11 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                                 dn(layer["ln2"]["b"]))
 
         logits = G._lm_head(params, x, cdt)            # (T, V) f32
-        slot_logits = logits[slot_last_row]            # (S, V)
+        # (S, n_sample) argmaxes: column 0 is the slot's sampling row
+        # (the old slot_last_row), columns 1.. are its draft-verify
+        # rows; dead columns point at row 0 and the host never reads
+        # them
+        slot_logits = logits[slot_rows]                # (S, n_s, V)
         next_tok = jnp.argmax(slot_logits, axis=-1).astype(jnp.int32)
         return next_tok, new_pools
 
@@ -261,6 +303,19 @@ class _EngineObs:
         self.alloc_failures = c("serving_page_alloc_failures_total",
                                 "allocations refused by a dry pool "
                                 "(caller stalls or preempts)")
+        # in-engine speculative decode (round 11; all-zero at spec_K=0)
+        self.spec_drafted = c("serving_spec_drafted_tokens_total",
+                              "draft tokens fed to the batched "
+                              "verify forward")
+        self.spec_accepted = c("serving_spec_accepted_tokens_total",
+                               "draft tokens committed (matched the "
+                               "verify argmax)")
+        self.spec_rejected = c("serving_spec_rejected_tokens_total",
+                               "draft tokens rolled back by pointer "
+                               "(drafted - accepted)")
+        self.g_spec_accept_rate = g(
+            "serving_spec_accept_rate",
+            "cumulative accepted / drafted draft tokens")
         # shared-prefix cache (round 10; all-zero when disabled)
         self.prefix_hit_tokens = c("serving_prefix_hit_tokens_total",
                                    "prefill tokens skipped via "
@@ -388,6 +443,27 @@ class ServingEngine:
         completed prompt pages are donated back; refcount-0 chains are
         LRU-evicted under pool pressure.  Off by default — the
         ``ServingCluster`` turns it on per replica.
+    kernel : ``"xla"`` (default) attends via the block-table gather +
+        ``_attend_rows``; ``"pallas"`` runs the fused
+        ``kernels/paged_attention.py`` block-table walk (interpreter
+        mode off-TPU, so tier-1 CPU tests cover the kernel path).
+        Outputs differ by 1–2 f32 ulps (online-softmax normalization
+        order — the kernel module docstring); greedy token-identity
+        vs ``generate`` is pinned for both by ``tests/test_serving``.
+    spec_K : in-engine speculative decode — each running decode slot
+        drafts K tokens per step, the step program verifies all rows'
+        drafts in ONE batched forward over the paged cache, accepted
+        tokens commit by pointer-only page advances and rejections
+        roll back exactly (stale slots are overwritten before any
+        mask exposes them — the ``_decode_block`` argument).  0 (the
+        default) disables speculation; the step program then has the
+        round-7 shape.  Greedy output stays token-identical to plain
+        decode whatever the drafter proposes.
+    spec_drafter : ``"ngram"`` (prompt-lookup over the row's committed
+        tokens, zero cost — ``serving/drafters.py``) or a callable
+        ``f(tokens (n,), K) -> (K,)`` proposing the next K tokens
+        (tests use adversarial/oracle callables).
+    spec_ngram : n-gram length for the ngram drafter.
     rid_start : first request id this engine assigns (a cluster gives
         each replica a disjoint block so rids — and their trace
         swimlanes — are unique cluster-wide).
@@ -404,7 +480,8 @@ class ServingEngine:
     def __init__(self, params, cfg, *, num_slots, page_size=16,
                  num_pages=None, pages_per_slot=None, prefill_chunk=8,
                  kv_int8=False, prefix_cache=False, metrics=None,
-                 registry=None, rid_start=0):
+                 registry=None, rid_start=0, kernel="xla", spec_K=0,
+                 spec_drafter="ngram", spec_ngram=2):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -412,6 +489,14 @@ class ServingEngine:
         if prefill_chunk < 1:
             raise ValueError("ServingEngine: prefill_chunk must be "
                              ">= 1")
+        if kernel not in ("xla", "pallas"):
+            raise ValueError("ServingEngine: kernel must be 'xla' or "
+                             "'pallas', got %r" % (kernel,))
+        if spec_K < 0:
+            raise ValueError("ServingEngine: spec_K must be >= 0")
+        if spec_drafter != "ngram" and not callable(spec_drafter):
+            raise ValueError("ServingEngine: spec_drafter must be "
+                             "'ngram' or a callable")
         if pages_per_slot is None:
             pages_per_slot = -(-cfg.max_len // page_size)
         # the attention view may be wider than cfg.max_len (its tail
@@ -431,8 +516,15 @@ class ServingEngine:
         self.pages_per_slot = pages_per_slot
         self.prefill_chunk = prefill_chunk
         self.kv_int8 = bool(kv_int8)
+        self.kernel = kernel
+        self.spec_K = int(spec_K)
+        self.spec_drafter = spec_drafter
+        self.spec_ngram = int(spec_ngram)
         self.max_seq = pages_per_slot * page_size
-        self.n_rows = num_slots + prefill_chunk
+        # with speculation every decode slot may feed 1 + K rows
+        # (pending + drafts); the program shape stays fixed, unused
+        # draft rows are dead padding like everything else
+        self.n_rows = num_slots * (1 + self.spec_K) + prefill_chunk
         self.cache = PagedKVCache(cfg, num_pages, page_size,
                                   kv_int8=self.kv_int8)
         # shared-prefix page reuse (round 10): content-keyed trie over
@@ -450,7 +542,8 @@ class ServingEngine:
             self._cow_page(0, 0)
         self._step_fn = _make_step(cfg, num_slots, self.n_rows,
                                    pages_per_slot, page_size,
-                                   self.kv_int8)
+                                   self.kv_int8, kernel=self.kernel,
+                                   n_sample=1 + self.spec_K)
         self._queue: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * num_slots
         # rid_start: a ServingCluster gives each replica a disjoint
@@ -462,6 +555,7 @@ class ServingEngine:
                       "decode_rows": 0, "prefill_rows": 0,
                       "dead_rows": 0, "peak_pages": 0,
                       "prefix_hit_tokens": 0, "cow_copies": 0,
+                      "spec_drafted": 0, "spec_accepted": 0,
                       "slot_occupancy_sum": 0.0}
         if metrics is None:
             # an explicitly supplied registry is a request for
@@ -694,6 +788,52 @@ class ServingEngine:
                         self._obs.trace.add_instant(req.rid, "resume",
                                                     now)
 
+    def _plan_speculation(self):
+        """Phase-A speculation planning: for every running decode row
+        propose K_eff draft tokens (host-side — the drafters are
+        vectorized so this prices like the rest of the per-step host
+        scheduling) and secure pages through the deepest draft write
+        position.  K_eff = min(spec_K, tokens this request may still
+        commit) keeps every draft's cache position within the
+        request's admitted budget (positions top out at
+        prompt+max_new-1, the same bound submit() enforced), so no
+        extra headroom is ever needed.  Returns {rid: drafts (K_eff,)
+        np.int32}.  MUST run before any row is built — _ensure_page
+        may preempt (the phase-A contract in step())."""
+        plan = {}
+        if self.spec_K < 1:
+            return plan
+        vmax = self.cfg.vocab_size - 1
+        for req in list(self._slots):
+            if req is None or req.pending is None:
+                continue
+            k_eff = min(self.spec_K,
+                        req.max_new_tokens - len(req.generated))
+            if k_eff < 1:
+                continue
+            buf = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            if callable(self.spec_drafter):
+                d = np.asarray(self.spec_drafter(buf, k_eff),
+                               np.int32).reshape(-1)
+                if d.size != k_eff:
+                    raise ValueError(
+                        "spec_drafter returned %d proposals, wanted "
+                        "%d" % (d.size, k_eff))
+                # clamp into the vocab: an out-of-range proposal would
+                # index-clamp inside the program and silently verify
+                # as a different token
+                d = np.clip(d, 0, vmax)
+            else:
+                d = drafters.ngram_draft(buf, k_eff, self.spec_ngram)
+            self._ensure_page(req, req.n_cached + k_eff)
+            # _ensure_page never preempts req itself, but it may have
+            # preempted a LATER slot this loop already planned — the
+            # build phase skips slot-less requests, so a stale plan
+            # entry is never fed
+            plan[req.rid] = d
+        return plan
+
     # --------------------------------------------------------- step --
     def step(self):
         """One engine iteration.  Returns the list of request ids that
@@ -716,6 +856,9 @@ class ServingEngine:
         for req in list(self._slots):
             if req is not None and req.pending is not None:
                 self._ensure_page(req, req.n_cached)
+        # speculation planning (drafting + draft-depth pages) is part
+        # of phase A for the same reason
+        spec_plan = self._plan_speculation()
         budget = self.prefill_chunk
         plan = {}                          # rid -> prefill rows planned
         for req in list(self._slots):
@@ -724,7 +867,8 @@ class ServingEngine:
             n = min(budget, req.resume_input.size - req.n_prefilled)
             # _admit allocated ceil((input+1)/page_size) pages, so
             # every prefill position is already covered — only the
-            # decode-row loop above can allocate (and thus preempt)
+            # decode-row loop and _plan_speculation above can allocate
+            # (and thus preempt); keep BOTH before this point
             assert (req.n_prefilled + n - 1) // self.page_size \
                 < len(req.pages)
             plan[req.rid] = n
@@ -736,26 +880,42 @@ class ServingEngine:
         row_slot = np.full(T, S, np.int32)     # dead → all-scratch bt row
         row_pos = np.zeros(T, np.int32)
         row_live = np.zeros(T, bool)
-        slot_last_row = np.zeros(S, np.int32)
+        # (S, 1+K) sampling-row matrix: column 0 is the slot's pending
+        # (or last-prefill) row, columns 1.. its draft-verify rows.
+        # Unused entries stay 0 — the program gathers row 0's argmax
+        # there and the host never reads it.
+        slot_rows = np.zeros((S, 1 + self.spec_K), np.int32)
         samplers = []                      # requests that sample a token
         decode_rids = []                   # trace: decode-row requests
         prefill_spans = []                 # trace: (rid, row_lo, row_hi)
         n_dec_rows = 0
         r = 0
-        for req in list(self._slots):      # decode rows
+        for req in list(self._slots):      # decode (+ draft) rows
             if req is None or req.pending is None:
                 continue
             tokens[r] = req.pending
             row_slot[r] = req.slot
             row_pos[r] = req.n_cached
             row_live[r] = True
-            slot_last_row[req.slot] = r
+            slot_rows[req.slot, 0] = r
             samplers.append(req)
             self.stats["decode_rows"] += 1
             n_dec_rows += 1
             if tracing:
                 decode_rids.append(req.rid)
             r += 1
+            # draft rows: positions n_cached+1 .. n_cached+K_eff, one
+            # verify argmax read back per row.  Their k/v lands in the
+            # cache like any row's; rejected tails are overwritten at
+            # the committed position before any mask exposes them
+            # (pointer-only rollback, the _decode_block argument).
+            for i, d in enumerate(spec_plan.get(req.rid, ())):
+                tokens[r] = d
+                row_slot[r] = req.slot
+                row_pos[r] = req.n_cached + 1 + i
+                row_live[r] = True
+                slot_rows[req.slot, 1 + i] = r
+                r += 1
         for req in list(self._slots):      # chunked prefill rows
             if req is None or req.pending is not None:
                 continue
@@ -770,7 +930,7 @@ class ServingEngine:
                 req.n_prefilled += 1
                 self.stats["prefill_rows"] += 1
                 if req.n_prefilled == inp.size:
-                    slot_last_row[req.slot] = r
+                    slot_rows[req.slot, 0] = r
                     samplers.append(req)
                 r += 1
             if tracing and req.n_prefilled > p0:
@@ -798,7 +958,7 @@ class ServingEngine:
                 self.params, self.cache.pools,
                 jnp.asarray(tokens), jnp.asarray(row_slot),
                 jnp.asarray(row_pos), jnp.asarray(row_live),
-                jnp.asarray(bt), jnp.asarray(slot_last_row))
+                jnp.asarray(bt), jnp.asarray(slot_rows))
             # mxlint: allow(host-sync) -- intentional: the ONE device
             # sync per step; the host scheduler branches on the sampled
             # tokens (stop conditions, commits) before the next step
@@ -810,11 +970,13 @@ class ServingEngine:
         now = time.perf_counter()
 
         finished = []
+        spec_spans = []                    # trace: (rid, drafted, accepted)
         for req in samplers:
             if req.slot is None:           # preempted this step
                 continue
+            was_decode = req.pending is not None
             # rows written this step are now cached
-            if req.pending is not None:
+            if was_decode:
                 req.n_cached += 1
             else:
                 req.n_cached = req.n_prefilled
@@ -822,9 +984,33 @@ class ServingEngine:
                 # donate completed prompt pages BEFORE a possible
                 # same-step retire releases them
                 self._insert_prefix(req)
-            tok = int(next_tok[req.slot])
+            row = next_tok[req.slot]       # (1 + spec_K,) argmaxes
+            drafts = spec_plan.get(req.rid) if was_decode else None
+            if drafts is not None and drafts.size:
+                # greedy verify: row[i] is the target's own argmax
+                # after pending + drafts[:i]; accept the longest
+                # matching draft prefix plus the target token at the
+                # first mismatch — exactly generate_speculative's
+                # greedy accept rule, per row instead of batch-min
+                k_eff = drafts.size
+                a = 0
+                while a < k_eff and int(drafts[a]) == int(row[a]):
+                    a += 1
+                commit = [int(row[i]) for i in range(a + 1)]
+                # accepted drafts are ALREADY in the cache at
+                # n_cached..n_cached+a-1 (their rows wrote this step)
+                req.n_cached += a
+                self.stats["spec_drafted"] += k_eff
+                self.stats["spec_accepted"] += a
+                if obs is not None:
+                    obs.spec_drafted.inc(k_eff)
+                    obs.spec_accepted.inc(a)
+                    obs.spec_rejected.inc(k_eff - a)
+                if tracing:
+                    spec_spans.append((req.rid, k_eff, a))
+            else:
+                commit = [int(row[0])]
             if obs is not None:
-                obs.tokens.inc()
                 if req.token_times:
                     obs.h_tbt.observe(
                         (now - req.token_times[-1]) * 1e3)
@@ -833,12 +1019,19 @@ class ServingEngine:
                     if tracing:
                         obs.trace.add_instant(req.rid, "first_token",
                                               now)
-            req.generated.append(tok)
-            req.token_times.append(now)
-            req.pending = tok
-            if (len(req.generated) >= req.max_new_tokens
-                    or (req.eos_id is not None
-                        and tok == req.eos_id)):
+            done = False
+            for tok in commit:
+                req.generated.append(tok)
+                req.token_times.append(now)
+                req.pending = tok
+                if obs is not None:
+                    obs.tokens.inc()
+                if (len(req.generated) >= req.max_new_tokens
+                        or (req.eos_id is not None
+                            and tok == req.eos_id)):
+                    done = True
+                    break
+            if done:
                 req.state = "done"
                 self._release(req)
                 finished.append(req.rid)
@@ -874,12 +1067,20 @@ class ServingEngine:
             obs.g_running.set(sum(r_ is not None
                                   for r_ in self._slots))
             obs.g_queued.set(len(self._queue))
+            if self.stats["spec_drafted"]:
+                obs.g_spec_accept_rate.set(
+                    self.stats["spec_accepted"]
+                    / self.stats["spec_drafted"])
             obs.sync_cache(self.cache)
             if self.prefix is not None:
                 obs.sync_prefix(self.prefix)
             if tracing:
                 for rid in decode_rids:
                     obs.trace.add_span(rid, "decode", t_step0, now)
+                for rid, k_eff, a in spec_spans:
+                    obs.trace.add_span(rid, "spec_verify", t_step0,
+                                       now, args={"drafted": k_eff,
+                                                  "accepted": a})
                 for rid, p0, p1 in prefill_spans:
                     obs.trace.add_span(rid, "prefill[%d:%d)"
                                        % (p0, p1), t_step0, now,

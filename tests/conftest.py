@@ -57,6 +57,10 @@ _SLOW_GROUPS = {
     # worker threads + watchdog timing); its own group so thread-
     # scheduling jitter never stretches group d past its budget
     "test_serving_cluster": "f",
+    # group g: ~2min — round-11 in-engine speculation + paged-
+    # attention kernel combos (every (kernel, spec_K) pair compiles a
+    # fresh step program; isolated for the same budget reason as f)
+    "test_serving_spec": "g",
 }
 
 

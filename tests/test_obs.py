@@ -405,6 +405,75 @@ def test_serving_counters_forced_preemption():
 
 
 @pytest.mark.slow
+def test_serving_spec_counters_reconcile():
+    """Round-11 speculation ledger, reconciled exactly: drafted =
+    accepted + rejected, counters equal the engine's own stats dict,
+    the accept-rate gauge equals their ratio, and tokens_total still
+    equals the tokens actually delivered (multi-commit steps change
+    the per-step count, never the ledger)."""
+    rng = np.random.RandomState(0)
+    eng = _mk_engine(num_slots=2, page_size=4, prefill_chunk=6,
+                     spec_K=3)
+    rids = [eng.submit(rng.randint(1, 90, P).astype(np.int32), N)
+            for P, N in [(5, 8), (3, 12), (9, 4)]]
+    eng.run()
+    m = eng.metrics()
+    c, g, h = m["counters"], m["gauges"], m["histograms"]
+    drafted = c["serving_spec_drafted_tokens_total"]
+    accepted = c["serving_spec_accepted_tokens_total"]
+    rejected = c["serving_spec_rejected_tokens_total"]
+    assert drafted == eng.stats["spec_drafted"] > 0
+    assert accepted == eng.stats["spec_accepted"]
+    assert drafted == accepted + rejected
+    assert g["serving_spec_accept_rate"] == accepted / drafted
+    n_tokens = sum(len(eng.requests[r].generated) for r in rids)
+    assert c["serving_tokens_total"] == n_tokens == 8 + 12 + 4
+    # TBT records once per STEP per request (a verify step delivers
+    # its commits as one burst), so ttft+tbt counts the sampling
+    # steps, bounded by tokens when speculation commits multiples
+    assert h["serving_ttft_ms"]["count"] == 3
+    assert h["serving_tbt_ms"]["count"] <= n_tokens - 3
+    # a spec engine with nothing accepted still reconciles: the
+    # oracle-free drafter on random prompts may accept ~0 — the
+    # ledger, not the rate, is the invariant here
+    assert 0 <= accepted <= drafted
+
+
+@pytest.mark.slow
+def test_serving_spec_verify_trace_span(tmp_path):
+    """The ``spec_verify`` span on the round-8 trace surface: emitted
+    per speculating request per step while the profiler records, with
+    drafted/accepted args, on the request's swimlane."""
+    fname = str(tmp_path / "spec_trace.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    try:
+        rng = np.random.RandomState(1)
+        eng = _mk_engine(num_slots=2, page_size=4, prefill_chunk=4,
+                         spec_K=2)
+        eng.submit(rng.randint(1, 90, 5).astype(np.int32), 6)
+        eng.run()
+    finally:
+        profiler.set_state("stop")
+    with open(profiler.dump()) as f:
+        trace = json.load(f)
+    spans = [e for e in trace["traceEvents"]
+             if e.get("cat") == "serving" and e["ph"] == "X"
+             and e["name"] == "spec_verify"]
+    assert spans, "no spec_verify spans in the dump"
+    for e in spans:
+        assert e["tid"] >= REQ_TID_BASE
+        assert e["args"]["drafted"] >= 1
+        assert 0 <= e["args"]["accepted"] <= e["args"]["drafted"]
+    # exactly one span per draft-feeding decode step: the prefill-
+    # finish step samples the first token with no drafts (TTFT), every
+    # later sampling step is a decode step with drafts (TBT) — so
+    # spans == TBT observations
+    assert len(spans) == eng.registry.snapshot()["histograms"][
+        "serving_tbt_ms"]["count"]
+
+
+@pytest.mark.slow
 def test_serving_cancel_counts():
     eng = _mk_engine(num_slots=1, page_size=4)
     r1 = eng.submit(np.arange(1, 6, dtype=np.int32), 6)

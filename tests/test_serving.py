@@ -150,6 +150,39 @@ def test_preemption_recompute_exact():
 
 
 @pytest.mark.slow
+def test_pallas_kernel_token_identical():
+    """Round-11 acceptance pin, engine level: the fused Pallas
+    paged-attention step (``kernel="pallas"``, interpreter mode on
+    CPU) decodes token-identically to plain ``generate`` through a
+    mixed-length batch with admission waves — the 1–2 ulp
+    online-softmax difference (kernels/paged_attention.py docstring)
+    never flips an argmax on this pinned workload.  The broader
+    kernel-vs-reference sweep is tier-1
+    (tests/test_paged_attention.py); speculation × kernel combos are
+    group g (tests/test_serving_spec.py)."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(0)
+    shapes = [(5, 8), (3, 12), (9, 4), (2, 6)]
+    eng = ServingEngine(params, cfg, num_slots=3, page_size=4,
+                        prefill_chunk=6, kernel="pallas")
+    reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32), N), N)
+            for P, N in shapes]
+    outs = eng.run()
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+    assert eng.cache.pages_in_use == 0
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, num_slots=1, page_size=4,
+                      kernel="mosaic")
+
+
+@pytest.mark.slow
 def test_paged_int8_kv_agreement():
     """Paged int8-KV (per-(row, token) s8 pages + f32 scale pages)
     tracks contiguous ``generate(kv_int8=True)`` the same way the
@@ -209,7 +242,8 @@ def test_serve_bench_smoke():
 
     with tempfile.TemporaryDirectory() as td:
         out = os.path.join(td, "serve.json")
-        rc = serve_bench.main(["--quick", "--json", out])
+        rc = serve_bench.main(["--quick", "--kernel-ablation",
+                               "--spec-sweep", "--json", out])
         assert rc == 0
         rows = json.load(open(out))
     e2e = {r["config"].split("_")[0]: r for r in rows
@@ -222,6 +256,18 @@ def test_serve_bench_smoke():
     # equal-HBM comparison: the page pool must not exceed the
     # baseline's contiguous allocation
     assert eng["hbm_pool"] <= base["hbm_held"]
+    # round-11 sections: the kernel ablation carries a step-time pair
+    # (xla + pallas) and the spec sweep carries accept accounting
+    kern = {r["config"]: r for r in rows if r["section"] == "kernel"}
+    assert set(kern) == {"kernel_xla", "kernel_pallas"}
+    assert all(r["step_p50_ms"] > 0 for r in kern.values())
+    spec = {r["config"]: r for r in rows if r["section"] == "spec"}
+    assert set(spec) == {"spec_K0", "spec_K2", "spec_K4"}
+    for name, r in spec.items():
+        if r["config"] != "spec_K0":
+            assert r["spec_drafted"] > 0
+            assert 0.0 <= r["spec_accept_rate"] <= 1.0
+            assert r["tokens_per_step"] >= 1.0
 
 
 @pytest.mark.slow

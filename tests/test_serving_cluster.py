@@ -284,6 +284,51 @@ def test_cluster_failover_resubmit_exact():
 
 
 @pytest.mark.slow
+def test_cluster_failover_with_speculation_exact():
+    """Round-11 acceptance pin: mid-flight replica failure with
+    in-engine speculation armed (spec_K on every replica).  A verify
+    step may have committed SEVERAL tokens before the failure; the
+    snapshot-and-resubmit path replays them as prompt extension and
+    the resumed engine (also speculating) must still produce
+    token-identical output — committed tokens are committed tokens
+    regardless of how many a step produced."""
+    from mxnet_tpu.serving import ServingCluster
+
+    params, cfg = _setup()
+    rng = np.random.RandomState(11)
+    shared = rng.randint(1, 90, 8).astype(np.int32)
+    cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                        page_size=4, prefill_chunk=6, metrics=True,
+                        watchdog_s=10.0, spec_K=2)
+    try:
+        assert all(r.engine.spec_K == 2 for r in cl.replicas)
+        eng0 = cl.replicas[0].engine
+        orig_step = eng0.step
+        calls = [0]
+
+        def bomb():
+            calls[0] += 1
+            if calls[0] == 4:
+                raise RuntimeError("injected replica failure")
+            return orig_step()
+
+        eng0.step = bomb
+        wl = _mixed_workload(rng, shared, 6)
+        rids = [cl.submit(p, n) for p, n in wl]
+        for rid, (p, n) in zip(rids, wl):
+            np.testing.assert_array_equal(cl.result(rid, timeout=300),
+                                          _ref(params, cfg, p, n))
+        c = cl.metrics()["counters"]
+        assert c["cluster_failovers_total"] == 1
+        assert c["cluster_requests_completed_total"] == len(wl)
+        # speculation really ran on the replicas
+        assert sum(r.engine.stats["spec_drafted"]
+                   for r in cl.replicas) > 0
+    finally:
+        cl.close(timeout=60)
+
+
+@pytest.mark.slow
 def test_cluster_watchdog_stall_failover():
     """A replica that stalls past the watchdog (step blocked, no
     raise) is drained by the monitor; its requests complete exactly on

@@ -1,0 +1,226 @@
+"""In-engine speculative decode + fused-kernel combos (round 11).
+
+The acceptance bar is the round-7 one, extended: whatever the drafter
+proposes, whichever attention kernel runs, and however many tokens a
+verify step commits, f32 greedy engine outputs are TOKEN-IDENTICAL to
+``models/gpt.py generate`` — through admission waves, preemption/
+recompute, eos-mid-commit, and forced rejections.  Slow tier, group g
+(its own group so the extra step-program compiles never stretch group
+d past its budget — the round-10 group-f precedent).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
+
+
+def _cfg(**kw):
+    from mxnet_tpu.models import gpt
+    base = dict(use_flash=False, remat=False, dropout=0.0,
+                dtype="float32", vocab_size=128, max_len=64)
+    base.update(kw)
+    return gpt.gpt_tiny(**base)
+
+
+def _ref(params, cfg, prompt, n, **kw):
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    return np.asarray(
+        gpt.generate(params, cfg, jnp.asarray(prompt)[None], n,
+                     **kw))[0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_spec_token_identical_mixed_lengths(kernel):
+    """Speculation on (ngram drafter) × both attention kernels: a
+    mixed prompt/output-length batch with admission waves decodes
+    token-identically to plain generate, rejected drafts roll back by
+    pointer, and the drafted/accepted ledger stays consistent."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(0)
+    shapes = [(5, 8), (3, 12), (9, 4), (2, 6)]
+    eng = ServingEngine(params, cfg, num_slots=3, page_size=4,
+                        prefill_chunk=6, spec_K=3, kernel=kernel)
+    reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32), N), N)
+            for P, N in shapes]
+    outs = eng.run()
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+    assert eng.stats["spec_drafted"] > 0
+    assert 0 <= eng.stats["spec_accepted"] <= eng.stats["spec_drafted"]
+    assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_spec_forced_rejection_rollback():
+    """An ADVERSARIAL drafter (constant proposals) must degrade to
+    plain decode, never corrupt it: rejected draft k/v sits in cache
+    slots past the committed pointer and is overwritten before any
+    mask exposes it.  An ORACLE drafter (replays the reference
+    continuation) must accept everything and cut the step count —
+    proving the accept path actually commits multiple tokens."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, 90, 6).astype(np.int32)
+    N = 20
+    ref = _ref(params, cfg, prompt, N)
+
+    # adversarial: always propose token 1 — (essentially) always wrong
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        spec_K=4,
+                        spec_drafter=lambda toks, K: np.ones(K,
+                                                             np.int32))
+    rid = eng.submit(prompt, N)
+    outs = eng.run()
+    np.testing.assert_array_equal(outs[rid], ref)
+    assert eng.stats["spec_drafted"] > 0
+    assert eng.stats["spec_accepted"] < eng.stats["spec_drafted"]
+
+    # oracle: replay the reference continuation — all drafts accepted,
+    # steps shrink accordingly (the batched-verify commit machinery)
+    full = ref
+
+    def oracle(tokens, K):
+        n = tokens.size
+        out = np.ones(K, np.int32)
+        avail = full[n:n + K]
+        out[:avail.size] = avail
+        return out
+
+    eng2 = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                         spec_K=4, spec_drafter=oracle)
+    rid2 = eng2.submit(prompt, N)
+    outs2 = eng2.run()
+    np.testing.assert_array_equal(outs2[rid2], ref)
+    assert eng2.stats["spec_accepted"] == eng2.stats["spec_drafted"]
+    # N tokens in ceil(N / (K+1)) decode steps + prefill
+    assert eng2.stats["steps"] < eng.stats["steps"]
+
+
+@pytest.mark.slow
+def test_spec_preemption_recompute_exact():
+    """The acceptance criterion's preemption/resume path with
+    speculation armed: an over-committed pool (draft rows deepen page
+    demand, so preemptions fire) still yields token-identical outputs
+    for every request after recompute."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(9), cfg)
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(params, cfg, num_slots=4, page_size=4,
+                        pages_per_slot=8, num_pages=12,
+                        prefill_chunk=4, spec_K=2)
+    reqs = []
+    for P, N in [(6, 20), (4, 24), (8, 16), (3, 22), (5, 18)]:
+        rid = eng.submit(rng.randint(1, 90, P).astype(np.int32), N)
+        reqs.append((rid, N))
+    outs = eng.run()
+    assert eng.stats["preemptions"] > 0, \
+        "pool was sized to force preemption"
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+    assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_spec_eos_mid_commit():
+    """eos inside an accepted draft run truncates the commit exactly
+    where plain decode would have stopped — tokens past the eos in
+    the same verify step are dropped, not delivered."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(13), cfg)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = _ref(params, cfg, prompt, 12)
+    eos = int(ref[8])                     # a token greedy WILL emit
+    full = ref
+
+    def oracle(tokens, K):
+        n = tokens.size
+        out = np.ones(K, np.int32)
+        avail = full[n:n + K]
+        out[:avail.size] = avail
+        return out
+
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=4,
+                        spec_K=4, spec_drafter=oracle)
+    rid = eng.submit(prompt, 12, eos_id=eos)
+    outs = eng.run()
+    assert outs[rid].size <= ref.size
+    assert outs[rid][-1] == eos
+    np.testing.assert_array_equal(outs[rid], ref[:outs[rid].size])
+
+
+@pytest.mark.slow
+def test_spec_int8_kv_agreement():
+    """Speculation over the paged int8-KV cache: greedy agreement with
+    contiguous ``generate(kv_int8=True)`` at the round-7 tolerance
+    (page-view gathers reduce in a different order — bit equality is
+    not the int8 contract)."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg(vocab_size=512, d_model=128, n_heads=4, n_layers=3,
+               d_ff=256)
+    params = T.init_params(jax.random.PRNGKey(11), cfg)
+    rng = np.random.RandomState(4)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        kv_int8=True, prefill_chunk=8, spec_K=2)
+    reqs = [eng.submit(rng.randint(1, 500, P).astype(np.int32), 12)
+            for P in (5, 7)]
+    outs = eng.run()
+    for rid in reqs:
+        ref = _ref(params, cfg, eng.requests[rid].prompt, 12,
+                   kv_int8=True)
+        assert (outs[rid] == ref).mean() >= 0.9, (outs[rid], ref)
+
+
+@pytest.mark.slow
+def test_spec_counters_and_validation():
+    """spec_K=0 must be byte-for-byte the round-7 engine (no draft
+    rows, zero spec counters); bad spec args raise; a drafter
+    returning the wrong shape raises at plan time."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving import ServingEngine
+
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(1), cfg)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4)
+    assert eng.n_rows == 2 + 8            # num_slots + prefill_chunk
+    rid = eng.submit(np.arange(1, 6, dtype=np.int32), 6)
+    eng.run()
+    assert eng.stats["spec_drafted"] == 0
+    assert eng.requests[rid].state == "done"
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, num_slots=1, page_size=4, spec_K=-1)
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, num_slots=1, page_size=4,
+                      spec_drafter=3)
+    bad = ServingEngine(params, cfg, num_slots=1, page_size=4,
+                        spec_K=2,
+                        spec_drafter=lambda t, K: np.ones(K + 1,
+                                                          np.int32))
+    bad.submit(np.arange(1, 6, dtype=np.int32), 6)
+    with pytest.raises(ValueError):
+        bad.run()

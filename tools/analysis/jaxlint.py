@@ -47,7 +47,11 @@ __all__ = ["HOT_REGIONS", "CLOCK_MODULES", "lint_source", "run"]
 
 # (repo-relative glob, qualname regex) — the designated hot-loop regions
 HOT_REGIONS: List[Tuple[str, str]] = [
-    ("mxnet_tpu/serving/engine.py", r"(?:.*\.)?step$"),
+    # round 11: the speculation plan/draft path runs once per engine
+    # step on the host — it must stay pure host work (no device syncs
+    # beyond step()'s one pragma'd token read-back)
+    ("mxnet_tpu/serving/engine.py",
+     r"(?:.*\.)?(step|_plan_speculation)$"),
     # round 10: the cluster router loop (per-replica worker + routing
     # + completion) and the prefix-cache match/insert/evict paths run
     # once per step / per admission — no host syncs may sneak in
@@ -55,6 +59,13 @@ HOT_REGIONS: List[Tuple[str, str]] = [
      r"(?:.*\.)?(_worker|_pump_inbox|_complete|_route_locked)$"),
     ("mxnet_tpu/serving/prefix_cache.py",
      r"(?:.*\.)?(match|insert_chain|evict)$"),
+    # round 11: the host-side drafters feed the step builder — same
+    # once-per-step budget as the engine scheduler
+    ("mxnet_tpu/serving/drafters.py", r".*"),
+    # round 11: the paged-attention kernel call path (builder + entry
+    # point) is traced inside the step program — a stray host sync or
+    # an in-loop jit here retraces/stalls every serving step
+    ("mxnet_tpu/kernels/paged_attention.py", r".*"),
     ("mxnet_tpu/models/gpt.py", r"generate(?:_speculative)?$"),
     ("benchmark/serve_bench.py", r".*"),
     ("benchmark/spec_decode_probe.py", r".*"),
