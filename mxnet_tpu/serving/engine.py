@@ -129,6 +129,40 @@ class Request:
 _step_cache: Dict[Any, Any] = {}
 _STEP_CACHE_MAX = 8
 
+# one compiled COW page-copy per pool config — shared across engines
+# (round 12: the interleaving explorer builds hundreds of short-lived
+# clusters; a per-engine jit here recompiled the same trivial program
+# for every replica of every schedule)
+_copy_cache: Dict[Any, Any] = {}
+
+
+def _make_copy(cfg, kv_int8):
+    """Jitted whole-page pool copy (COW at a shared-prefix
+    divergence).  Page ids are traced scalars, so one compilation per
+    pool config covers every (src, dst) pair and every engine whose
+    pools share that config."""
+    import jax
+
+    key = (cfg, bool(kv_int8))
+    fn = _copy_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def copy(pools, s, d):
+        out = []
+        for pool in pools:
+            new = {"kv": pool["kv"].at[d].set(pool["kv"][s])}
+            if "s" in pool:
+                new["s"] = pool["s"].at[d].set(pool["s"][s])
+            out.append(new)
+        return out
+
+    fn = jax.jit(copy, donate_argnums=(0,))
+    if len(_copy_cache) >= _STEP_CACHE_MAX:
+        _copy_cache.pop(next(iter(_copy_cache)))
+    _copy_cache[key] = fn
+    return fn
+
 
 def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                kv_int8, kernel="xla", n_sample=1):
@@ -670,23 +704,11 @@ class ServingEngine:
 
     def _cow_page(self, src, dst):
         """Device-copy page ``src`` into ``dst`` across every layer
-        pool (copy-on-write at a shared-prefix divergence).  One jitted
-        program per engine — page ids are traced scalars, so every
-        (src, dst) pair reuses the same compilation; pools are donated
-        and update in place like the step program's."""
+        pool (copy-on-write at a shared-prefix divergence) via the
+        module-level keyed-cache program (``_make_copy``); pools are
+        donated and update in place like the step program's."""
         if self._copy_fn is None:
-            import jax
-
-            def copy(pools, s, d):
-                out = []
-                for pool in pools:
-                    new = {"kv": pool["kv"].at[d].set(pool["kv"][s])}
-                    if "s" in pool:
-                        new["s"] = pool["s"].at[d].set(pool["s"][s])
-                    out.append(new)
-                return out
-
-            self._copy_fn = jax.jit(copy, donate_argnums=(0,))
+            self._copy_fn = _make_copy(self.cfg, self.kv_int8)
         self.cache.pools = self._copy_fn(self.cache.pools, src, dst)
 
     def _insert_prefix(self, req):
@@ -738,8 +760,17 @@ class ServingEngine:
             skip = min(m_tok, inp.size - 1)
             cow_idx = skip // self.page_size
             cow = cow_idx < len(hit_pages)
-            got = self.cache.alloc(total - len(hit_pages)
-                                   + (1 if cow else 0))
+            try:
+                got = self.cache.alloc(total - len(hit_pages)
+                                       + (1 if cow else 0))
+            except BaseException:
+                # pylocklint py-ref-leak (round 12): alloc can raise
+                # through the pressure callback — the refs match()
+                # just took must not leak on that edge, or the chain
+                # stays pinned unevictable for the engine's lifetime
+                if entries:
+                    self.prefix.release(entries)
+                raise
             if got is None:
                 if entries:
                     self.prefix.release(entries)

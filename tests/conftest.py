@@ -61,6 +61,10 @@ _SLOW_GROUPS = {
     # attention kernel combos (every (kernel, spec_K) pair compiles a
     # fresh step program; isolated for the same budget reason as f)
     "test_serving_spec": "g",
+    # group h: ~2min — round-12 interleaving explorer (>=200 seeded
+    # schedules through the cluster; its own group so the sweep's
+    # schedule count can grow without squeezing group f's budget)
+    "test_interleave": "h",
 }
 
 

@@ -1,0 +1,180 @@
+"""Seeded violations for every pylocklint rule (tests/test_static_analysis).
+
+Each rule has exactly the seeded firing sites asserted by
+``TestPylockFixtures`` plus one pragma-suppressed twin — the twin lines
+carry the string "suppressed twin" so the test can assert nothing on
+or directly below them surfaced.
+"""
+import queue
+import threading
+import time  # noqa: F401  (time.sleep is a seeded blocking op)
+
+
+class Guarded:
+    """py-guarded-field: ``count`` is written under ``_mu`` in good()
+    so the inference demands the lock at every write site."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.count = 0          # __init__ writes are exempt
+
+    def good(self):
+        with self._mu:
+            self.count += 1
+
+    def bad(self):
+        self.count -= 1         # fires: write without Guarded._mu
+
+    def bad_twin(self):
+        # mxlint: allow(py-guarded-field) -- suppressed twin
+        self.count -= 1
+
+    def helper_locked(self):
+        # *_locked naming convention: caller holds the class lock
+        self.count += 1
+
+
+class Order:
+    """py-lock-order: a->b established in ab(); ba() closes the cycle.
+    re() re-acquires a non-reentrant Lock through a call chain."""
+
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def ab(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def ba(self):
+        with self.lock_b:
+            with self.lock_a:   # fires: closes the a->b / b->a cycle
+                pass
+
+    def ba_twin(self):
+        with self.lock_b:
+            # mxlint: allow(py-lock-order) -- suppressed twin
+            with self.lock_a:
+                pass
+
+    def re(self):
+        with self.lock_a:
+            self._re_helper()   # fires: may re-acquire held lock_a
+
+    def _re_helper(self):
+        with self.lock_a:
+            pass
+
+
+class CV:
+    """py-cv-wait-predicate + py-notify-unlocked."""
+
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.ready = False
+
+    def bare_wait(self):
+        with self.cv:
+            self.cv.wait()      # fires: no predicate
+
+    def bare_wait_twin(self):
+        with self.cv:
+            # mxlint: allow(py-cv-wait-predicate) -- suppressed twin
+            self.cv.wait()
+
+    def good_wait(self):
+        with self.cv:
+            self.cv.wait_for(lambda: self.ready)
+
+    def bad_notify(self):
+        self.cv.notify_all()    # fires: outside `with self.cv:`
+
+    def bad_notify_twin(self):
+        # mxlint: allow(py-notify-unlocked) -- suppressed twin
+        self.cv.notify_all()
+
+    def good_notify(self):
+        with self.cv:
+            self.cv.notify_all()
+
+
+class Block:
+    """py-blocking-under-lock: direct queue get + transitive
+    Event.wait, both inside a critical section."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.q = queue.Queue()
+        self.evt = threading.Event()
+
+    def direct(self):
+        with self.mu:
+            return self.q.get()   # fires: queue.get under Block.mu
+
+    def direct_twin(self):
+        with self.mu:
+            # mxlint: allow(py-blocking-under-lock) -- suppressed twin
+            return self.q.get()
+
+    def transitive(self):
+        with self.mu:
+            self._slow()          # fires: callee blocks on Event.wait
+
+    def _slow(self):
+        self.evt.wait()
+
+    def fine(self):
+        item = self.q.get()       # no lock held — clean
+        with self.mu:
+            return item
+
+
+def leak_on_return(prefix, toks):
+    """py-ref-leak: the early return drops the matched refs."""
+    entries, pages, m = prefix.match(toks)
+    if m == 0:
+        return None               # fires: exit without release/escape
+    prefix.release(entries)
+    return m
+
+
+def leak_on_exception(prefix, cache, toks):
+    """py-ref-leak: alloc may raise before the release runs."""
+    entries, pages, m = prefix.match(toks)
+    got = cache.alloc(3)          # fires: exception edge leaks refs
+    prefix.release(entries)
+    return got
+
+
+def leak_twin(prefix, cache, toks):
+    entries, pages, m = prefix.match(toks)
+    # mxlint: allow(py-ref-leak) -- suppressed twin
+    got = cache.alloc(3)
+    prefix.release(entries)
+    return got
+
+
+def guarded_exception(prefix, cache, toks):
+    """Clean: the handler releases, so the raise edge is covered."""
+    entries, pages, m = prefix.match(toks)
+    try:
+        got = cache.alloc(3)
+    except Exception:
+        prefix.release(entries)
+        raise
+    prefix.release(entries)
+    return got
+
+
+class Escape:
+    def ok_escape(self, prefix, toks):
+        """Clean: refs escape into owned state (released elsewhere)."""
+        entries, pages, m = prefix.match(toks)
+        self.prefix_entries = entries
+        return pages
+
+
+def refs_outside(entry):
+    entry.refs += 1               # fires: refcount mutated outside
+    return entry                  # prefix_cache.py

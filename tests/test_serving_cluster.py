@@ -428,6 +428,127 @@ def test_cluster_drain_replica_scale_down():
 
 
 @pytest.mark.slow
+def test_cluster_drain_while_submitting_race():
+    """Round-12 race pin (normal OS scheduler): drain_replica(0)
+    concurrent with a burst of submit().  Strays are rerouted, new
+    submissions never land on the draining replica, and every output
+    is exact."""
+    import threading
+    from mxnet_tpu.serving import ServingCluster
+
+    params, cfg = _setup()
+    rng = np.random.RandomState(12)
+    shared = rng.randint(1, 90, 8).astype(np.int32)
+    wl = _mixed_workload(rng, shared, 8)
+    cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                        page_size=4, prefill_chunk=6, metrics=True)
+    try:
+        rids = []
+
+        def submitter():
+            for p, n in wl:
+                rids.append(cl.submit(p, n))
+
+        th = threading.Thread(target=submitter)
+        th.start()
+        assert cl.drain_replica(0, timeout=300)
+        th.join(300)
+        assert len(rids) == len(wl)
+        for rid, (p, n) in zip(rids, wl):
+            np.testing.assert_array_equal(cl.result(rid, timeout=300),
+                                          _ref(params, cfg, p, n))
+        # post-drain, every terminal home is the survivor or the
+        # request finished on replica 0 BEFORE it drained — but no
+        # request may still be assigned to a drained, parked worker
+        health = {h["replica"]: h for h in cl.health()}
+        assert health[0]["draining"] and health[0]["in_flight"] == 0
+        assert health[0]["waiting"] == 0
+    finally:
+        cl.close(timeout=60)
+
+
+@pytest.mark.slow
+def test_cluster_drain_while_submitting_interleaved():
+    """The same race under the deterministic interleaving explorer:
+    10 seeded schedules x 2 strategies, every interleaving of the
+    drain against the submit burst stays exact (the slow-tier sweep in
+    test_interleave.py runs the full 200-schedule matrix)."""
+    from tools.analysis.interleave import run_schedule
+    from mxnet_tpu.serving import ServingCluster
+    from mxnet_tpu.serving import cluster as cluster_mod
+
+    params, cfg = _setup()
+    rng = np.random.RandomState(13)
+    wl = _mixed_workload(rng, rng.randint(1, 90, 8).astype(np.int32),
+                         5)
+    refs = [_ref(params, cfg, p, n) for p, n in wl]
+    # warm the step/copy caches outside the scheduler
+    warm = ServingCluster(params, cfg, replicas=1, num_slots=2,
+                          page_size=4, prefill_chunk=6)
+    warm.result(warm.submit(wl[0][0], 2), timeout=300)
+    warm.close(timeout=60)
+
+    def workload():
+        cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                            page_size=4, prefill_chunk=6)
+        try:
+            rids = []
+
+            def submitter():
+                for p, n in wl:
+                    rids.append(cl.submit(p, n))
+
+            th = cluster_mod.threading.Thread(target=submitter)
+            th.start()
+            assert cl.drain_replica(0, timeout=300)
+            th.join(300)
+            for rid, ref in zip(rids, refs):
+                np.testing.assert_array_equal(
+                    cl.result(rid, timeout=300), ref)
+        finally:
+            cl.close(timeout=60)
+
+    for mode in ("random", "preempt"):
+        for seed in range(10):
+            stats = run_schedule(workload, seed, mode=mode)
+            assert stats.switches > 0, (mode, seed)
+
+
+@pytest.mark.slow
+def test_prefix_refs_released_when_alloc_raises():
+    """Round-12 pylocklint regression (py-ref-leak): if the allocator
+    raises mid-admission — the pressure callback can — the refs
+    match() just took must be released, not leaked (a leaked ref pins
+    its chain unevictable for the engine's lifetime)."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup()
+    rng = np.random.RandomState(4)
+    pa = rng.randint(1, 90, 8).astype(np.int32)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        prefill_chunk=8, prefix_cache=True)
+    ra = eng.submit(pa, 6)
+    eng.run()
+    assert eng.prefix.cached_pages > 0
+    assert eng.prefix.refs_total == 0
+
+    orig_alloc = eng.cache.alloc
+    def bomb(n):
+        raise RuntimeError("injected alloc failure")
+    eng.cache.alloc = bomb
+    rb = eng.submit(pa, 6)            # matches the cached chain
+    with pytest.raises(RuntimeError, match="injected alloc"):
+        eng.step()
+    assert eng.prefix.refs_total == 0, \
+        "alloc-raise admission leaked prefix refs"
+    # engine recovers once the allocator does
+    eng.cache.alloc = orig_alloc
+    outs = eng.run()
+    np.testing.assert_array_equal(outs[rb], _ref(params, cfg, pa, 6))
+    assert eng.prefix.refs_total == 0
+
+
+@pytest.mark.slow
 def test_cluster_prefix_affinity_routing():
     """Requests sharing a prompt prefix stick to the replica that
     cached it (while load allows): the router's affinity counter moves

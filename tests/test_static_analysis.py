@@ -21,10 +21,11 @@ import time
 
 import pytest
 
-from tools.analysis import abi, jaxlint, native_lint
+from tools.analysis import abi, jaxlint, native_lint, pylocklint
 from tools.analysis.findings import (Finding, apply_pragmas,
                                      load_baseline, split_new)
-from tools.analysis.runner import BINDINGS, HEADER, REPO_ROOT, run_all
+from tools.analysis.runner import (BINDINGS, HEADER, REPO_ROOT,
+                                   changed_files, run_all)
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "mxlint")
 
@@ -74,6 +75,47 @@ class TestLiveRepo:
             pytest.skip("native library unavailable")
         missing = native._apply_prototypes(native.lib())
         assert missing == []
+
+    def test_pylocklint_zero_findings_even_baselined(self):
+        """ISSUE 7 acceptance criterion: pylocklint reports ZERO
+        findings with an EMPTY baseline over serving/, obs/, io/ —
+        nothing grandfathered."""
+        fs = pylocklint.run(REPO_ROOT)
+        assert fs == [], "\n".join(str(f) for f in fs)
+
+    def test_pylocklint_guards_the_admit_ref_leak_fix(self):
+        """Deleting the round-12 try/except in ServingEngine._admit
+        reintroduces the py-ref-leak finding — the pass genuinely
+        guards the fix shipped in this PR (PR-4 pattern)."""
+        path = os.path.join(REPO_ROOT, "mxnet_tpu/serving/engine.py")
+        src = open(path).read()
+        guarded = ("            except BaseException:\n")
+        assert guarded in src
+        # strip the handler body's release (keep it syntactically
+        # valid: the handler just re-raises)
+        broken = src.replace(
+            "                if entries:\n"
+            "                    self.prefix.release(entries)\n"
+            "                raise\n",
+            "                raise\n", 1)
+        assert broken != src
+        fs = pylocklint.lint_source(broken,
+                                    "mxnet_tpu/serving/engine.py")
+        assert collections.Counter(
+            f.rule for f in fs)["py-ref-leak"] >= 1
+
+    def test_changed_only_scopes_the_run(self):
+        """--changed-only reports only changed files (the full parse
+        still happens, so this is a reporting scope, not a soundness
+        hole in tier-1 — which always runs full)."""
+        cf = changed_files(REPO_ROOT)
+        if cf is None:
+            pytest.skip("git unavailable")
+        report = run_all(changed_only=True)
+        assert report["changed"] is not None
+        allowed = set(report["changed"])
+        for f in report["findings"]:
+            assert f.path in allowed or f.path in (HEADER, BINDINGS)
 
     def test_known_intentional_sync_is_pragmad(self):
         """The serving step's one intended device sync stays auditable:
@@ -241,6 +283,241 @@ class TestNativeFixtures:
             assert _rules(fs)["cv-pred-unlocked"] >= 1
         finally:
             os.unlink(tf.name)
+
+
+class TestPylockFixtures:
+    """Every pylocklint rule fires exactly as seeded in
+    fixtures/mxlint/pylock_fixture.py, pragma twins stay suppressed,
+    and the baseline suppresses by key (ISSUE 7 satellite)."""
+
+    @pytest.fixture(scope="class")
+    def findings(self):
+        src = open(os.path.join(FIXTURES, "pylock_fixture.py")).read()
+        return pylocklint.lint_source(src, "pylock_fixture.py")
+
+    def test_counts(self, findings):
+        assert _rules(findings) == {
+            "py-guarded-field": 1,        # Guarded.bad
+            "py-lock-order": 2,           # cycle + transitive re-acq
+            "py-cv-wait-predicate": 1,    # CV.bare_wait
+            "py-notify-unlocked": 1,      # CV.bad_notify
+            "py-blocking-under-lock": 2,  # direct q.get + transitive
+            "py-ref-leak": 3,             # return + exception + .refs
+        }
+
+    def test_lock_order_variants(self, findings):
+        msgs = [f.message for f in findings
+                if f.rule == "py-lock-order"]
+        assert any("closes a lock-order cycle" in m for m in msgs)
+        assert any("may re-acquire held non-reentrant" in m
+                   for m in msgs)
+
+    def test_blocking_variants(self, findings):
+        msgs = [f.message for f in findings
+                if f.rule == "py-blocking-under-lock"]
+        assert any("queue.get" in m for m in msgs)
+        assert any("call to _slow()" in m for m in msgs)
+
+    def test_ref_leak_variants(self, findings):
+        msgs = [f.message for f in findings if f.rule == "py-ref-leak"]
+        assert any("exit without releasing" in m for m in msgs)
+        assert any("exception edge leaks" in m for m in msgs)
+        assert any("outside" in m for m in msgs)
+
+    def test_pragma_suppressed_twins(self, findings):
+        src = open(os.path.join(FIXTURES, "pylock_fixture.py")).read()
+        lines = {(f.rule, f.line) for f in findings}
+        for i, text in enumerate(src.splitlines(), 1):
+            if "suppressed twin" in text:
+                assert not any(ln in (i, i + 1, i + 2)
+                               for _, ln in lines), \
+                    "twin at line %d surfaced" % i
+
+    def test_locked_convention_and_clean_shapes(self, findings):
+        """helper_locked / guarded_exception / ok_escape / good_wait /
+        good_notify / fine seeded NO findings."""
+        import ast
+        src = open(os.path.join(FIXTURES, "pylock_fixture.py")).read()
+        spans = {}
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.FunctionDef):
+                spans[node.name] = (node.lineno, node.end_lineno)
+        clean = {"helper_locked", "guarded_exception", "ok_escape",
+                 "good_wait", "good_notify", "fine"}
+        for f in findings:
+            for name in clean:
+                lo, hi = spans[name]
+                assert not (lo <= f.line <= hi), \
+                    "%s seeded clean but got %s" % (name, f)
+
+    def test_baseline_suppresses(self, findings):
+        baseline = {f.key for f in findings
+                    if f.rule == "py-guarded-field"}
+        new, old = split_new(findings, baseline)
+        assert _rules(old) == {"py-guarded-field": 1}
+        assert "py-guarded-field" not in _rules(new)
+
+
+class TestBenchSyncFixtures:
+    """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
+    unsynced-jit pattern fires once, the pragma'd twin is suppressed,
+    proper syncs (direct or via a local hard_sync-style helper) stay
+    clean."""
+
+    SRC = (
+        "import time\n"
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def hard_sync(r):\n"
+        "    jax.block_until_ready(r)\n"
+        "\n"
+        "\n"
+        "def bad(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    t0 = time.perf_counter()\n"
+        "    r = g(x)\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return r, dt\n"
+        "\n"
+        "\n"
+        "def bad_bare_close(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    t0 = time.perf_counter()\n"
+        "    r = g(x)\n"
+        "    t1 = time.perf_counter()\n"
+        "    return r, t1 - t0\n"
+        "\n"
+        "\n"
+        "def bad_twin(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    t0 = time.perf_counter()\n"
+        "    r = g(x)\n"
+        "    # mxlint: allow(bench-no-sync) -- suppressed twin\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return r, dt\n"
+        "\n"
+        "\n"
+        "def good_direct(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    t0 = time.perf_counter()\n"
+        "    r = g(x)\n"
+        "    jax.block_until_ready(r)\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return dt\n"
+        "\n"
+        "\n"
+        "def good_helper(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    t0 = time.perf_counter()\n"
+        "    hard_sync(g(x))\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return dt\n"
+        "\n"
+        "\n"
+        "def good_loop(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    best = 1e9\n"
+        "    for _ in range(3):\n"
+        "        t0 = time.perf_counter()\n"
+        "        r = g(x)\n"
+        "        r = np.asarray(r)\n"
+        "        best = min(best, time.perf_counter() - t0)\n"
+        "    return best\n"
+        "\n"
+        "\n"
+        "def untimed(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    return g(x)\n")
+
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return jaxlint.lint_source(self.SRC, "bench_fixture.py",
+                                   region_re="$^", clock=False,
+                                   bench=True)
+
+    def test_fires_exactly_once_per_seed(self, findings):
+        """One finding per seeded region: the subtraction close (bad)
+        and the bare `t1 = perf_counter()` close (bad_bare_close —
+        the canonical two-read idiom, a review-pass fix)."""
+        assert _rules(findings) == {"bench-no-sync": 2}
+        assert "line 13" in findings[0].message
+
+    def test_engine_methods_do_not_alias_jitted_names(self):
+        """`eng.run()` must not match a local `@jax.jit def run` —
+        the spec_decode_probe false positive fixed in this PR."""
+        src = ("import time\nimport jax\n"
+               "@jax.jit\n"
+               "def run(x):\n"
+               "    return x\n"
+               "def bench(eng, x):\n"
+               "    t0 = time.perf_counter()\n"
+               "    outs = eng.run()\n"
+               "    return time.perf_counter() - t0\n")
+        fs = jaxlint.lint_source(src, "b.py", region_re="$^",
+                                 clock=False, bench=True)
+        assert fs == []
+
+    def test_live_benchmarks_clean(self):
+        """Every benchmark driver syncs what it times (or pragmas the
+        dispatch measurement) — zero live findings."""
+        bench_dir = os.path.join(REPO_ROOT, "benchmark")
+        bad = []
+        for name in sorted(os.listdir(bench_dir)):
+            if not name.endswith(".py"):
+                continue
+            src = open(os.path.join(bench_dir, name)).read()
+            bad += [f for f in jaxlint.lint_source(
+                src, "benchmark/" + name)
+                if f.rule == "bench-no-sync"]
+        assert bad == [], "\n".join(str(f) for f in bad)
+
+
+class TestHotRegionAdditions:
+    """ISSUE 7 satellite: the round-12 hot regions — cluster
+    watchdog/failover, prefix-cache eviction/COW leaf, metrics
+    registry mutation — each trip on a planted violation exactly once,
+    and a violation OUTSIDE the region stays silent."""
+
+    PLANT = ("    import jax\n"
+             "    for _ in range(2):\n"
+             "        f = jax.jit(lambda x: x)\n")
+
+    CASES = [
+        ("mxnet_tpu/serving/cluster.py",
+         "class ServingCluster:\n"
+         " def _fail_replica(self, rep, error):\n%s"),
+        ("mxnet_tpu/serving/cluster.py",
+         "class ServingCluster:\n"
+         " def _monitor_loop(self):\n%s"),
+        ("mxnet_tpu/serving/cluster.py",
+         "class ServingCluster:\n"
+         " def drain_replica(self, idx):\n%s"),
+        ("mxnet_tpu/serving/prefix_cache.py",
+         "class PrefixCache:\n"
+         " def _drop(self, e):\n%s"),
+        ("mxnet_tpu/obs/metrics.py",
+         "class MetricsRegistry:\n"
+         " def _get(self, cls, name):\n%s"),
+    ]
+
+    @pytest.mark.parametrize("rel,template", CASES)
+    def test_planted_violation_fires_once(self, rel, template):
+        src = template % self.PLANT.replace("    ", "  ")
+        fs = jaxlint.lint_source(src, rel, clock=False)
+        assert _rules(fs) == {"retrace": 1}, \
+            "%s: %r" % (rel, [str(f) for f in fs])
+
+    def test_outside_region_is_silent(self):
+        src = ("class ServingCluster:\n"
+               " def some_cold_path(self):\n"
+               "  import jax\n"
+               "  for _ in range(2):\n"
+               "   f = jax.jit(lambda x: x)\n")
+        fs = jaxlint.lint_source(src, "mxnet_tpu/serving/cluster.py",
+                                 clock=False)
+        assert fs == []
 
 
 # ---------------------------------------------------------------------------

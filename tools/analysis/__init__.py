@@ -1,16 +1,25 @@
-"""mxlint — the repo-native static-analysis suite (ISSUE 4 tentpole).
+"""mxlint — the repo-native static-analysis suite (ISSUE 4 + 7).
 
-Three analyzers, each a module here, all runnable as tier-1 tests
+Four analyzers, each a module here, all runnable as tier-1 tests
 (``tests/test_static_analysis.py``) and as a CLI
-(``python -m tools.analysis``):
+(``python -m tools.analysis``, ``--changed-only`` for the seconds-fast
+iteration scope):
 
 * :mod:`.abi` — C-ABI consistency between ``c_api.h``, the ctypes
   ``_PROTOTYPES`` table, and every call site in ``mxnet_tpu/native.py``;
 * :mod:`.jaxlint` — JAX hot-loop hazards (implicit host syncs, retrace
-  churn, trace-clock mixing);
+  churn, trace-clock mixing, unsynced benchmark clocks);
 * :mod:`.native_lint` — locking discipline over ``native/src/*.cc``
   (lock order, guarded fields, condvar predicates), backstopped by the
-  ``make tsan`` / ``make asan`` stress harness.
+  ``make tsan`` / ``make asan`` stress harness;
+* :mod:`.pylocklint` — Python concurrency over ``mxnet_tpu/serving``,
+  ``obs`` and ``io`` (inferred guarded-by, cross-module lock-order
+  cycles, cv protocol, blocking-under-lock, PrefixCache refcount
+  balance), backstopped by the :mod:`.interleave` explorer.
+
+The dynamic half of ISSUE 7 lives in :mod:`.interleave`: a loom-lite
+deterministic scheduler that serializes the serving cluster's threads
+and explores seeded interleavings (``tests/test_interleave.py``).
 
 Rule catalog, pragma syntax and baseline workflow:
 ``docs/static_analysis.md``.

@@ -30,6 +30,16 @@ Rules
     a call to ``time.time``/``time.monotonic``/``time.clock`` or
     ``datetime.*.now`` — mixing clocks skews every span it touches.
 
+``bench-no-sync``  (round 12) In benchmark modules, a timed region —
+    opened by ``t0 = time.perf_counter()``, closed by any other
+    ``perf_counter()`` read — containing a call to a recognized
+    jitted/step callable whose result is never synced
+    (``block_until_ready`` / ``jax.device_get`` / ``np.asarray`` /
+    ``float()``/``.item()``) before the closing read.  That clock
+    measures DISPATCH, not execution — the hazard class that bit
+    ``serve_bench._fixed_batch`` in round 9.  Dispatch-timing on
+    purpose?  Pragma it with the justification.
+
 Suppression: ``# mxlint: allow(<rule>)`` on the line or the comment
 block directly above (see ``findings.py``).
 """
@@ -54,11 +64,21 @@ HOT_REGIONS: List[Tuple[str, str]] = [
      r"(?:.*\.)?(step|_plan_speculation)$"),
     # round 10: the cluster router loop (per-replica worker + routing
     # + completion) and the prefix-cache match/insert/evict paths run
-    # once per step / per admission — no host syncs may sneak in
+    # once per step / per admission — no host syncs may sneak in.
+    # round 12 widens both: the watchdog/failover path (a host sync
+    # inside _fail_replica stalls EVERY waiter under the cluster lock)
+    # and the eviction/COW leaf (_drop runs inside the allocator's
+    # pressure callback, mid-admission)
     ("mxnet_tpu/serving/cluster.py",
-     r"(?:.*\.)?(_worker|_pump_inbox|_complete|_route_locked)$"),
+     r"(?:.*\.)?(_worker|_pump_inbox|_complete|_route_locked"
+     r"|_monitor_loop|_fail_replica|drain_replica)$"),
     ("mxnet_tpu/serving/prefix_cache.py",
-     r"(?:.*\.)?(match|insert_chain|evict)$"),
+     r"(?:.*\.)?(match|insert_chain|evict|_drop)$"),
+    # round 12: the metrics-registry mutation path — instrument
+    # creation and reset run under the registry lock; a device sync or
+    # in-loop jit there blocks every scrape and engine step behind it
+    ("mxnet_tpu/obs/metrics.py",
+     r"(?:.*\.)?(_get|counter|gauge|histogram|reset|reset_values)$"),
     # round 11: the host-side drafters feed the step builder — same
     # once-per-step budget as the engine scheduler
     ("mxnet_tpu/serving/drafters.py", r".*"),
@@ -77,6 +97,12 @@ CLOCK_MODULES: List[str] = [
     "mxnet_tpu/serving/*.py",
     "mxnet_tpu/profiler.py",
     "benchmark/serve_bench.py",
+]
+
+# modules whose perf_counter regions must sync their jitted work
+# (bench-no-sync — every benchmark driver times compiled programs)
+BENCH_MODULES: List[str] = [
+    "benchmark/*.py",
 ]
 
 STEP_FN_RE = re.compile(r".*step_fn$")
@@ -277,6 +303,139 @@ class _ClockLinter(ast.NodeVisitor):
                 "time.perf_counter"))
 
 
+class _BenchSyncLinter:
+    """bench-no-sync: linear scan of each function for timed regions
+    whose jitted work is never synced before the closing clock read.
+
+    Recognized jitted callables: names bound from ``jax.jit(...)``
+    anywhere in the module, ``@jax.jit`` defs, and ``*step_fn`` names
+    (the same vocabulary as the taint linter).  Unknown callables
+    (``eng.step()``, host loops) never flag — the rule is deliberately
+    precise rather than complete."""
+
+    _SYNCS = {"block_until_ready", "device_get", "item", "tolist"}
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self.jitted: Set[str] = set()
+        self.sync_helpers: Set[str] = set()
+
+    def collect_jitted(self, tree: ast.Module):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _dotted(dec) in ("jax.jit", "jit") or (
+                            isinstance(dec, ast.Call)
+                            and _is_jax_jit(dec)):
+                        self.jitted.add(node.name)
+                        break
+                else:
+                    # a plain function whose body syncs (the repo's
+                    # hard_sync-style helpers) is itself a sync
+                    if any(isinstance(n, ast.Call) and self._is_sync(n)
+                           for n in ast.walk(node)):
+                        self.sync_helpers.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and _is_jax_jit(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.jitted.add(tgt.id)
+
+    def _is_clock(self, call: ast.Call) -> bool:
+        d = _dotted(call.func)
+        # any wall-clock read opens/closes a timed region — clock-mix
+        # separately polices WHICH clock trace-clock modules may use
+        return d.endswith("perf_counter") or d in ("time.time",
+                                                   "time.monotonic")
+
+    def _is_sync(self, call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in self._SYNCS:
+                return True
+            if func.attr in ("asarray", "array") and isinstance(
+                    func.value, ast.Name) and \
+                    func.value.id in _NP_ALIASES:
+                return True
+        return isinstance(func, ast.Name) and (
+            func.id in ("float", "int")
+            or func.id in self.sync_helpers)
+
+    def _is_jit_call(self, call: ast.Call) -> bool:
+        t = _terminal(call.func)
+        if not t:
+            return False
+        if STEP_FN_RE.match(t):
+            return True
+        # only BARE names match the jitted set: `eng.run()` must not
+        # alias an unrelated local `@jax.jit def run` (the engine
+        # drain loop syncs internally every step)
+        return isinstance(call.func, ast.Name) and t in self.jitted
+
+    def lint_function(self, fn):
+        self.timing_open = False
+        self.unsynced = None
+        self._walk(fn.body)
+
+    def _walk(self, stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            self._stmt(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._walk(sub)
+            for h in getattr(stmt, "handlers", ()):
+                self._walk(h.body)
+
+    def _stmt(self, stmt):
+        # calls of THIS statement only (compound bodies walk
+        # separately), outermost-first in source order
+        sub = {id(s) for attr in ("body", "orelse", "finalbody")
+               for s in getattr(stmt, attr, ()) or ()}
+        sub |= {id(s) for h in getattr(stmt, "handlers", ())
+                for s in h.body}
+        calls = [n for n in ast.walk(stmt)
+                 if isinstance(n, ast.Call) and id(n) not in sub]
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        consumed: Set[int] = set()
+        opener = (isinstance(stmt, ast.Assign)
+                  and isinstance(stmt.value, ast.Call)
+                  and self._is_clock(stmt.value))
+        for call in calls:
+            if id(call) in consumed:
+                continue
+            if self._is_clock(call):
+                if self.timing_open and self.unsynced is not None:
+                    # ANY later clock read closes the region — a bare
+                    # `t1 = perf_counter()` assignment both closes the
+                    # old region and opens the next one
+                    self.findings.append(Finding(
+                        "jax", "bench-no-sync", self.path,
+                        call.lineno, "perf_counter",
+                        "timed region closes without syncing the "
+                        "jitted call at line %d — this clock measures "
+                        "dispatch, not execution (block_until_ready "
+                        "the result; round-9 _fixed_batch hazard)"
+                        % self.unsynced))
+                    self.unsynced = None
+                if opener and call is stmt.value:
+                    self.timing_open = True
+                    self.unsynced = None
+            elif self._is_sync(call):
+                self.unsynced = None
+                for inner in ast.walk(call):
+                    if isinstance(inner, ast.Call) and inner is not \
+                            call:
+                        consumed.add(id(inner))
+            elif self._is_jit_call(call) and self.timing_open:
+                self.unsynced = call.lineno
+
+
 def _qualname_functions(tree: ast.Module):
     """Yield (qualname, FunctionDef) for every function, with class
     nesting reflected (``Class.method``)."""
@@ -293,9 +452,10 @@ def _qualname_functions(tree: ast.Module):
 
 def lint_source(source: str, rel_path: str,
                 region_re: Optional[str] = None,
-                clock: Optional[bool] = None) -> List[Finding]:
-    """Lint one module.  ``region_re``/``clock`` override the repo
-    config (fixture tests drive this directly)."""
+                clock: Optional[bool] = None,
+                bench: Optional[bool] = None) -> List[Finding]:
+    """Lint one module.  ``region_re``/``clock``/``bench`` override
+    the repo config (fixture tests drive this directly)."""
     tree = ast.parse(source, rel_path)
     findings: List[Finding] = []
 
@@ -315,12 +475,22 @@ def lint_source(source: str, rel_path: str,
     if clock:
         _ClockLinter(rel_path, findings).visit(tree)
 
+    if bench is None:
+        bench = any(fnmatch.fnmatch(rel_path, g) for g in BENCH_MODULES)
+    if bench:
+        linter = _BenchSyncLinter(rel_path, findings)
+        linter.collect_jitted(tree)
+        for _, fn in _qualname_functions(tree):
+            linter.lint_function(fn)
+
     return apply_pragmas(findings, source)
 
 
-def run(root: str) -> List[Finding]:
-    """Lint every configured module under ``root``."""
-    rels = {glob for glob, _ in HOT_REGIONS} | set(CLOCK_MODULES)
+def run(root: str, only: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint every configured module under ``root``.  ``only``: optional
+    set of repo-relative paths (--changed-only)."""
+    rels = {glob for glob, _ in HOT_REGIONS} | set(CLOCK_MODULES) \
+        | set(BENCH_MODULES)
     seen: Set[str] = set()
     findings: List[Finding] = []
     for pattern in sorted(rels):
@@ -331,6 +501,8 @@ def run(root: str) -> List[Finding]:
         for name in sorted(os.listdir(full_dir)):
             rel = os.path.join(dirname, name)
             if not fnmatch.fnmatch(rel, pattern) or rel in seen:
+                continue
+            if only is not None and rel not in only:
                 continue
             seen.add(rel)
             with open(os.path.join(root, rel)) as f:

@@ -410,13 +410,14 @@ def lint_file(path: str, rel_path: str,
     return apply_pragmas(findings, text)
 
 
-def run(root: str) -> List[Finding]:
+def run(root: str, only=None) -> List[Finding]:
+    """``only``: optional set of repo-relative paths (--changed-only)."""
     src = os.path.join(root, "native", "src")
     findings: List[Finding] = []
     if not os.path.isdir(src):
         return findings
     for name in sorted(os.listdir(src)):
-        if name.endswith(".cc"):
-            findings.extend(lint_file(os.path.join(src, name),
-                                      "native/src/" + name))
+        rel = "native/src/" + name
+        if name.endswith(".cc") and (only is None or rel in only):
+            findings.extend(lint_file(os.path.join(src, name), rel))
     return findings

@@ -1,0 +1,944 @@
+"""Python concurrency pass (mxlint analyzer 4 — ISSUE 7 tentpole).
+
+The serving layer is ~1.5k lines of threaded Python (cluster router /
+watchdog / failover, prefix-cache refcounts, prefetch workers) whose
+shared-state discipline was previously enforced only by prose comments
+and the slow-tier tests not hanging.  This pass machine-checks it the
+way ``native_lint`` checks the C++ engine: AST + a cross-module call
+graph over ``mxnet_tpu/serving/``, ``mxnet_tpu/obs/`` and
+``mxnet_tpu/io/``.
+
+Rules
+-----
+``py-guarded-field``  **Inferred** guarded-by: a field written under
+    ``with self._mu:`` in at least one site must be written under that
+    same lock at every site.  No configuration table — the guard set is
+    inferred per field from the code itself (writes in ``__init__`` are
+    exempt: the object is not yet published).  Mutating container
+    calls (``x.items.append(...)`` etc.) count as writes.  Reads are
+    deliberately NOT checked: the repo leans on GIL-atomic advisory
+    reads (e.g. ``_Replica.waiting``) and flagging them would drown
+    the signal.
+
+``py-lock-order``  Lock-order cycles across cluster ↔ engine ↔
+    prefix_cache ↔ obs: every ``with lock:`` nesting — direct or
+    through the transitive call graph — contributes an ordered edge
+    (A held → B acquired); a cycle in that digraph is a deadlock two
+    threads can reach by arriving from opposite ends.  Also flags
+    re-acquiring a held non-reentrant ``threading.Lock`` (RLocks are
+    reentrant and exempt from self-reacquisition).
+
+``py-cv-wait-predicate``  ``cv.wait()`` on a ``threading.Condition``
+    without the predicate overload — spurious wakeups break the
+    protocol; use ``wait_for(pred)``.
+
+``py-notify-unlocked``  ``cv.notify()`` / ``cv.notify_all()`` outside
+    the condition's ``with cv:`` block.  At runtime this raises
+    RuntimeError only if the lock is genuinely unheld at that instant;
+    statically it is a missed-wakeup (or crash) waiting to happen.
+
+``py-blocking-under-lock``  A blocking call while holding a lock,
+    directly or through the call graph: ``queue.Queue`` get/put,
+    ``Event.wait`` / ``Condition.wait``, ``Future.result()`` (names
+    bound from ``.submit(...)``), ``time.sleep``, and jitted-step
+    dispatch (``*step_fn(...)``, ``.step()`` / ``.run()`` methods,
+    ``block_until_ready``) — a device dispatch inside a critical
+    section serializes every other thread behind the compiled program.
+
+``py-ref-leak``  PrefixCache refcount balance: entries returned by
+    ``prefix.match(...)`` hold one ref each, so on **every** exit of
+    the acquiring function they must either be released
+    (``prefix.release(entries)``) or escape into owned state
+    (``req.prefix_entries = entries`` — released later by
+    ``_release``).  Exception edges count: a call that can raise
+    between the ``match`` and the release/escape leaks the refs unless
+    a surrounding ``try`` releases them in a handler or ``finally``.
+    Direct ``.refs`` mutation outside ``prefix_cache.py`` also flags —
+    the count is the cache's private invariant.
+
+Conventions honored (mirroring the native pass):
+
+* ``# mxlint: allow(<rule>)`` on the line or the comment block above —
+  the shared pragma machinery in ``findings.py``.
+* ``# mxlint: requires(<Class._lock>)`` in the comment block above a
+  ``def`` — the caller holds that lock (precondition).
+* A method whose name ends in ``_locked`` implicitly requires its
+  class's lock when the class defines exactly one — the
+  ``ServingCluster._route_locked`` naming convention, machine-checked.
+
+Approximations (documented, TSan-free Python edition): method calls
+resolve through ``self`` exactly, through typed attributes
+(``self.prefix = PrefixCache(...)``) exactly, and otherwise only when
+the method name is **unique** across the analyzed modules — ambiguous
+names contribute no call edge rather than false ones.  Locks on
+non-``self`` receivers are identified by (module, attribute) — good
+enough while each module spells its locks distinctly.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, PRAGMA_RE, apply_pragmas
+
+__all__ = ["PACKAGES", "lint_source", "analyze", "run"]
+
+# repo-relative package roots the pass analyzes as ONE program (the
+# cross-module call graph spans all of them)
+PACKAGES = ["mxnet_tpu/serving", "mxnet_tpu/obs", "mxnet_tpu/io"]
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+_BLOCKING_QUEUE = {"get", "put"}
+# container mutators that count as writes to the attribute they live on
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "remove", "discard", "clear",
+             "update", "setdefault", "add"}
+# calls treated as non-raising for the ref-leak exception-edge check
+_SAFE_CALLS = {"len", "min", "max", "int", "float", "bool", "list",
+               "tuple", "set", "dict", "isinstance", "range", "id",
+               "repr", "str", "sorted", "enumerate", "zip", "abs"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Lock:
+    """One lock identity in the analyzed program."""
+    __slots__ = ("key", "kind", "cls")
+
+    def __init__(self, key: str, kind: str, cls: Optional[str]):
+        self.key = key          # "Class.attr" | "module::attr"
+        self.kind = kind        # "lock" | "rlock" | "cond"
+        self.cls = cls
+
+
+class _Fn:
+    """Per-function facts for the cross-module passes."""
+    __slots__ = ("qual", "mod", "cls", "name", "node", "acquires",
+                 "calls", "blocks", "requires")
+
+    def __init__(self, qual, mod, cls, name, node):
+        self.qual = qual
+        self.mod = mod
+        self.cls = cls
+        self.name = name
+        self.node = node
+        # direct acquisitions: set of lock keys
+        self.acquires: Set[str] = set()
+        # (line, callee_key_or_name, resolved: bool, held locks)
+        self.calls: List[Tuple[int, str, bool, Tuple[str, ...]]] = []
+        # blocking ops performed directly: (line, kind-label)
+        self.blocks: List[Tuple[int, str]] = []
+        self.requires: Set[str] = set()
+
+
+class _Module:
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, rel)
+
+
+class _Program:
+    """Whole-program model over every analyzed module."""
+
+    def __init__(self, modules: Dict[str, str]):
+        self.modules = {rel: _Module(rel, src)
+                        for rel, src in sorted(modules.items())}
+        self.locks: Dict[str, _Lock] = {}
+        # (module, attr) -> lock key, for non-self receivers
+        self.attr_locks: Dict[Tuple[str, str], str] = {}
+        # Class -> [lock keys]
+        self.class_locks: Dict[str, List[str]] = {}
+        self.fns: Dict[str, _Fn] = {}          # qualname -> _Fn
+        self.by_name: Dict[str, List[str]] = {}  # bare name -> quals
+        self.findings: List[Finding] = []
+        # write sites: (mod, group) -> [(line, held, in_init, fnqual)]
+        self.writes: Dict[Tuple[str, str], List] = {}
+        # lock-order edges: (held, acquired, fn qual, line)
+        self.order_edges: List[Tuple[str, str, str, int]] = []
+        self._collect_locks()
+        self._collect_fns()
+
+    # ---------------------------------------------------- discovery --
+    def _register_lock(self, mod: str, cls: Optional[str], attr: str,
+                       kind: str):
+        key = "%s.%s" % (cls, attr) if cls else "%s::%s" % (
+            os.path.basename(mod), attr)
+        if key not in self.locks:
+            self.locks[key] = _Lock(key, kind, cls)
+        self.attr_locks.setdefault((mod, attr), key)
+        if cls:
+            self.class_locks.setdefault(cls, []).append(key)
+
+    def _collect_locks(self):
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if not (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr in _LOCK_CTORS
+                        and isinstance(value.func.value, ast.Name)
+                        and value.func.value.id == "threading"):
+                    continue
+                kind = _LOCK_CTORS[value.func.attr]
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name):
+                        cls = self._enclosing_class(mod, node) \
+                            if tgt.value.id == "self" else None
+                        self._register_lock(mod.rel, cls, tgt.attr,
+                                            kind)
+                    elif isinstance(tgt, ast.Name):
+                        # module-level lock global
+                        self._register_lock(mod.rel, None, tgt.id,
+                                            kind)
+
+    def _enclosing_class(self, mod: _Module,
+                         target: ast.AST) -> Optional[str]:
+        hit = [None]
+
+        def walk(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if child is target:
+                    hit[0] = cls
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                else:
+                    walk(child, cls)
+        walk(mod.tree, None)
+        return hit[0]
+
+    def _collect_fns(self):
+        for mod in self.modules.values():
+            def walk(node, cls, outer):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        qual = "%s::%s%s" % (
+                            mod.rel, cls + "." if cls else "",
+                            child.name)
+                        if outer is not None:
+                            # nested defs are analyzed inline as part
+                            # of their parent (closures share state)
+                            continue
+                        fn = _Fn(qual, mod.rel, cls, child.name, child)
+                        fn.requires = self._requires_for(mod, child)
+                        self.fns[qual] = fn
+                        self.by_name.setdefault(child.name,
+                                                []).append(qual)
+                        walk(child, cls, qual)
+                    elif isinstance(child, ast.ClassDef):
+                        walk(child, child.name, outer)
+                    else:
+                        walk(child, cls, outer)
+            walk(mod.tree, None, None)
+
+    def _requires_for(self, mod: _Module, fndef) -> Set[str]:
+        """requires() pragmas above the def + the ``*_locked`` naming
+        convention (implicit requires of the class's sole lock)."""
+        out: Set[str] = set()
+        ln = fndef.lineno - 1
+        # skip decorators upward
+        while ln >= 1 and mod.lines[ln - 1].strip().startswith("@"):
+            ln -= 1
+        while ln >= 1:
+            s = mod.lines[ln - 1].strip()
+            if s.startswith("#"):
+                for kind, val in PRAGMA_RE.findall(s):
+                    if kind == "requires":
+                        out.update(v.strip() for v in val.split(","))
+                ln -= 1
+            elif not s:
+                ln -= 1
+            else:
+                break
+        return out
+
+    # ------------------------------------------------------ helpers --
+    def lock_for_expr(self, mod: str, cls: Optional[str],
+                      expr: ast.AST) -> Optional[str]:
+        """Resolve a with-context / receiver expression to a lock key."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            if expr.value.id == "self" and cls:
+                key = "%s.%s" % (cls, expr.attr)
+                if key in self.locks:
+                    return key
+            return self.attr_locks.get((mod, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.attr_locks.get((mod, expr.id))
+        return None
+
+    def implicit_requires(self, fn: _Fn) -> Set[str]:
+        out = set(fn.requires)
+        if fn.name.endswith("_locked") and fn.cls:
+            keys = self.class_locks.get(fn.cls, [])
+            if len(keys) == 1:
+                out.add(keys[0])
+        return out
+
+    def resolve_call(self, fn: _Fn, call: ast.Call) -> Tuple[
+            Optional[str], str]:
+        """Return (qualname or None, bare name) for a call site."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and fn.cls:
+                qual = "%s::%s.%s" % (fn.mod, fn.cls, name)
+                if qual in self.fns:
+                    return qual, name
+                return None, name
+        else:
+            return None, ""
+        quals = self.by_name.get(name, [])
+        if len(quals) == 1:
+            return quals[0], name
+        return None, name
+
+
+# ---------------------------------------------------------------------------
+# per-function scan
+# ---------------------------------------------------------------------------
+class _TypeEnv:
+    """Names/attrs known to be Events, Conditions, Queues (for the
+    blocking + cv rules).  Collected program-wide: ``self.q =
+    queue.Queue()`` in one method types ``self.q`` everywhere."""
+
+    def __init__(self, prog: _Program):
+        self.events: Set[Tuple[str, str]] = set()   # (mod-or-*, attr)
+        self.queues: Set[Tuple[str, str]] = set()
+        self.futures: Set[str] = set()              # local fut names
+        for mod in prog.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                if not (isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Attribute)):
+                    continue
+                base = v.func.value
+                ctor = v.func.attr
+                is_thr = isinstance(base, ast.Name) and \
+                    base.id == "threading"
+                is_q = isinstance(base, ast.Name) and base.id == "queue"
+                for tgt in node.targets:
+                    attr = None
+                    if isinstance(tgt, ast.Attribute):
+                        attr = tgt.attr
+                    elif isinstance(tgt, ast.Name):
+                        attr = tgt.id
+                    if attr is None:
+                        continue
+                    if is_thr and ctor == "Event":
+                        self.events.add((mod.rel, attr))
+                    elif is_q and ctor == "Queue":
+                        self.queues.add((mod.rel, attr))
+
+    def is_event(self, mod: str, expr: ast.AST) -> bool:
+        attr = expr.attr if isinstance(expr, ast.Attribute) else (
+            expr.id if isinstance(expr, ast.Name) else None)
+        return attr is not None and (mod, attr) in self.events
+
+    def is_queue(self, mod: str, expr: ast.AST) -> bool:
+        attr = expr.attr if isinstance(expr, ast.Attribute) else (
+            expr.id if isinstance(expr, ast.Name) else None)
+        return attr is not None and (mod, attr) in self.queues
+
+
+class _FnScanner:
+    """Walks one function body tracking held locks statement-wise."""
+
+    def __init__(self, prog: _Program, types: _TypeEnv, fn: _Fn):
+        self.prog = prog
+        self.types = types
+        self.fn = fn
+        self.held_init = tuple(sorted(prog.implicit_requires(fn)))
+        self.futures: Set[str] = set()
+        self.in_init = fn.name in ("__init__", "__new__")
+
+    def _add(self, rule, line, symbol, msg):
+        self.prog.findings.append(Finding(
+            "pylock", rule, self.fn.mod, line, symbol, msg))
+
+    # -- entry ---------------------------------------------------------
+    def scan(self):
+        self.walk(self.fn.node.body, set(self.held_init),
+                  nested=False)
+
+    def walk(self, stmts, held: Set[str], nested: bool):
+        """``nested`` marks code inside a def nested in this function
+        (e.g. a worker closure): it runs later, on another thread, so
+        ``__init__``'s publication exemption does not apply there."""
+        for stmt in stmts:
+            self.stmt(stmt, held, nested)
+
+    def stmt(self, stmt, held: Set[str], nested: bool):
+        fn = self.fn
+        if isinstance(stmt, ast.With):
+            add = []
+            for item in stmt.items:
+                key = self.prog.lock_for_expr(fn.mod, fn.cls,
+                                              item.context_expr)
+                if key is not None:
+                    self.on_acquire(key, stmt.lineno, held)
+                    add.append(key)
+                else:
+                    self.scan_expr(item.context_expr, held, nested)
+            inner = set(held) | set(add)
+            self.walk(stmt.body, inner, nested)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def (closure): body executes later — scan it with
+            # no inherited locks, and without the __init__ exemption
+            self.walk(stmt.body, set(self.held_init), nested=True)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.scan_expr(stmt.test, held, nested)
+            self.walk(stmt.body, set(held), nested)
+            self.walk(stmt.orelse, set(held), nested)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.scan_expr(stmt.iter, held, nested)
+            self.walk(stmt.body, set(held), nested)
+            self.walk(stmt.orelse, set(held), nested)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body, set(held), nested)
+            for h in stmt.handlers:
+                self.walk(h.body, set(held), nested)
+            self.walk(stmt.orelse, set(held), nested)
+            self.walk(stmt.finalbody, set(held), nested)
+            return
+        # leaf statements: track future bindings, record writes, then
+        # scan expressions
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call) and isinstance(
+                stmt.value.func, ast.Attribute) and \
+                stmt.value.func.attr == "submit":
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.futures.add(tgt.id)
+        self.record_writes(stmt, held, nested)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self.on_call(node, held)
+
+    # -- lock events ---------------------------------------------------
+    def on_acquire(self, key: str, line: int, held: Set[str]):
+        self.fn.acquires.add(key)
+        lock = self.prog.locks[key]
+        if key in held and lock.kind == "lock":
+            self._add("py-lock-order", line, key,
+                      "re-acquiring non-reentrant %s already held "
+                      "(self-deadlock)" % key)
+        # ordered edges are collected program-wide (the cycle check
+        # runs after every function is scanned)
+        for h in held:
+            if h != key:
+                self.prog.order_edges.append((h, key, self.fn.qual,
+                                              line))
+
+    # -- calls ---------------------------------------------------------
+    def on_call(self, call: ast.Call, held: Set[str]):
+        fn = self.fn
+        func = call.func
+        dotted = _dotted(func)
+        line = call.lineno
+
+        # cv rules -----------------------------------------------------
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            recv_lock = self.prog.lock_for_expr(fn.mod, fn.cls, recv)
+            is_cond = recv_lock is not None and \
+                self.prog.locks[recv_lock].kind == "cond"
+            if is_cond:
+                if func.attr == "wait":
+                    self._add("py-cv-wait-predicate", line, recv_lock,
+                              "Condition.wait() without a predicate — "
+                              "use wait_for(pred); spurious wakeups "
+                              "break the protocol")
+                elif func.attr in ("notify", "notify_all") and \
+                        recv_lock not in held:
+                    self._add("py-notify-unlocked", line, recv_lock,
+                              "%s() outside `with %s:` — notify must "
+                              "run under the condition's lock"
+                              % (func.attr, recv_lock))
+
+        # blocking ops -------------------------------------------------
+        blocked = None
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if func.attr in _BLOCKING_QUEUE and \
+                    self.types.is_queue(fn.mod, recv):
+                blocked = "queue.%s" % func.attr
+            elif func.attr == "wait" and (
+                    self.types.is_event(fn.mod, recv)
+                    or (self.prog.lock_for_expr(fn.mod, fn.cls, recv)
+                        is not None)):
+                blocked = "wait"
+            elif func.attr == "result" and isinstance(
+                    recv, ast.Name) and recv.id in self.futures:
+                blocked = "Future.result"
+            elif func.attr == "block_until_ready":
+                blocked = "block_until_ready"
+            elif func.attr in ("step", "run") and not call.args \
+                    and not call.keywords and isinstance(
+                        recv, (ast.Name, ast.Attribute)):
+                # jitted-step dispatch through an engine handle
+                blocked = ".%s()" % func.attr
+            elif dotted == "time.sleep":
+                blocked = "time.sleep"
+        elif isinstance(func, ast.Name) and func.id.endswith(
+                "step_fn"):
+            blocked = func.id
+        if isinstance(func, ast.Attribute) and \
+                func.attr.endswith("step_fn"):
+            blocked = func.attr
+        if blocked is not None:
+            held_eff = set(held)
+            if blocked == "wait" and isinstance(func, ast.Attribute):
+                # Condition.wait releases ITS OWN lock while waiting —
+                # only OTHER held locks make the wait a stall
+                rl = self.prog.lock_for_expr(fn.mod, fn.cls,
+                                             func.value)
+                if rl is not None and \
+                        self.prog.locks[rl].kind == "cond":
+                    held_eff.discard(rl)
+            self.fn.blocks.append((line, blocked))
+            if held_eff:
+                self._add("py-blocking-under-lock", line, blocked,
+                          "blocking %s while holding %s — the "
+                          "critical section stalls every waiter"
+                          % (blocked, "+".join(sorted(held_eff))))
+
+        # future-producing submits ------------------------------------
+        # (tracked so fut.result() under a lock is recognizable)
+
+        # call-graph edge ---------------------------------------------
+        qual, name = self.prog.resolve_call(fn, call)
+        if qual is not None and qual != fn.qual:
+            fn.calls.append((line, qual, True, tuple(sorted(held))))
+
+    # -- writes --------------------------------------------------------
+    def record_writes(self, stmt, held: Set[str], nested: bool):
+        fn = self.fn
+        in_init = self.in_init and not nested
+        sites: List[Tuple[str, str, int]] = []  # (recv, attr, line)
+
+        def target_site(tgt):
+            # recv.attr = ... | recv.attr[i] = ... | del recv.attr[i]
+            node = tgt
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name):
+                sites.append((node.value.id, node.attr, tgt.lineno))
+            elif isinstance(node, ast.Name) and fn.cls is None:
+                # module-level global written inside a function
+                g = [n for n in ast.walk(fn.node)
+                     if isinstance(n, ast.Global)
+                     and node.id in n.names]
+                if g:
+                    sites.append(("<module>", node.id, tgt.lineno))
+
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)):
+                    for e in tgt.elts:
+                        target_site(e)
+                else:
+                    target_site(tgt)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            target_site(stmt.target)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                target_site(tgt)
+        elif isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call):
+            func = stmt.value.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _MUTATORS:
+                node = func.value
+                if isinstance(node, ast.Attribute) and isinstance(
+                        node.value, ast.Name):
+                    sites.append((node.value.id, node.attr,
+                                  stmt.lineno))
+
+        for recv, attr, line in sites:
+            if attr.endswith("_mu") or attr.endswith("lock"):
+                continue
+            if recv == "self" and fn.cls:
+                group = "%s.%s" % (fn.cls, attr)
+            else:
+                group = "::%s" % attr
+            self.prog.writes.setdefault((fn.mod, group), []).append(
+                (line, tuple(sorted(held)), in_init, fn.qual, attr))
+
+    # -- expressions reached from non-leaf statements ------------------
+    def scan_expr(self, expr, held, nested):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self.on_call(node, held)
+
+
+# ---------------------------------------------------------------------------
+# ref-leak rule (separate focused walker)
+# ---------------------------------------------------------------------------
+def _is_prefix_match(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "match"
+            and "prefix" in _dotted(f.value).lower())
+
+
+def _find_match_call(node: ast.AST) -> Optional[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _is_prefix_match(n):
+            return n
+    return None
+
+
+def _name_in(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _releases(stmt: ast.AST, name: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and n.func.attr == "release" \
+                and any(_name_in(a, name) for a in n.args):
+            return True
+    return False
+
+
+def _escapes(stmt: ast.AST, name: str) -> bool:
+    """entries stored into object state (an attribute/subscript) or
+    returned — ownership transferred, the later release path owns it."""
+    if isinstance(stmt, ast.Return) and stmt.value is not None \
+            and _name_in(stmt.value, name):
+        return True
+    if isinstance(stmt, ast.Assign) and _name_in(stmt.value, name):
+        for tgt in stmt.targets:
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr in ("append",
+                                                       "extend"):
+            if any(_name_in(a, name) for a in stmt.value.args):
+                return True
+    return False
+
+
+def _may_raise(stmt: ast.AST, name: str) -> Optional[int]:
+    """Line of the first call in ``stmt`` that can raise (excluding the
+    release itself and whitelisted builtins)."""
+    for n in ast.walk(stmt):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name) and f.id in _SAFE_CALLS:
+            continue
+        if isinstance(f, ast.Attribute) and f.attr in (
+                "release", "get", "items", "values", "keys",
+                "tobytes", "reshape", "discard", "add"):
+            continue
+        return n.lineno
+    return None
+
+
+class _RefLeakScanner:
+    def __init__(self, prog: _Program, fn: _Fn):
+        self.prog = prog
+        self.fn = fn
+
+    def _add(self, line, msg):
+        self.prog.findings.append(Finding(
+            "pylock", "py-ref-leak", self.fn.mod, line, "match",
+            msg))
+
+    def scan(self):
+        # one acquisition tracked per function covers the repo idiom
+        # (an _admit-style loop re-matches per iteration, but every
+        # iteration has the same shape)
+        self._scan_block(self.fn.node.body)
+
+    def _scan_block(self, body) -> bool:
+        for i, stmt in enumerate(body):
+            name = self.acquire_name(stmt)
+            if name is not None:
+                self.track(body[i + 1:], name, stmt.lineno,
+                           protected=False)
+                return True
+            for sub in (getattr(stmt, "body", None),
+                        getattr(stmt, "orelse", None),
+                        getattr(stmt, "finalbody", None)):
+                if sub and self._scan_block(sub):
+                    return True
+            for h in getattr(stmt, "handlers", ()):
+                if self._scan_block(h.body):
+                    return True
+        return False
+
+    def acquire_name(self, stmt) -> Optional[str]:
+        if not isinstance(stmt, ast.Assign):
+            return None
+        if _find_match_call(stmt.value) is None:
+            return None
+        tgt = stmt.targets[0]
+        if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts and \
+                isinstance(tgt.elts[0], ast.Name):
+            return tgt.elts[0].id
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        return None
+
+    def try_protects(self, stmt: ast.Try, name: str) -> bool:
+        return any(_releases(s, name)
+                   for h in stmt.handlers for s in h.body) or \
+            any(_releases(s, name) for s in stmt.finalbody)
+
+    def track(self, stmts, name: str, acq_line: int,
+              protected: bool) -> bool:
+        """Walk forward; returns True once the refs are settled
+        (released or escaped) on this path."""
+        for stmt in stmts:
+            if _releases(stmt, name) or _escapes(stmt, name):
+                return True
+            if isinstance(stmt, ast.Try):
+                prot = protected or self.try_protects(stmt, name)
+                if self.track(stmt.body, name, acq_line, prot):
+                    return True
+                continue
+            if isinstance(stmt, ast.If):
+                then_done = self.track(stmt.body, name, acq_line,
+                                       protected)
+                else_done = self.track(stmt.orelse, name, acq_line,
+                                       protected)
+                # a branch that ends in return/continue without
+                # settling already reported inside track(); if both
+                # branches settled, we are done
+                if then_done and (stmt.orelse and else_done):
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                if self.track(stmt.body, name, acq_line, protected):
+                    return True
+                continue
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break,
+                                 ast.Raise)):
+                self._add(stmt.lineno,
+                          "exit without releasing the refs taken by "
+                          "match() at line %d (entries %r neither "
+                          "released nor stored)" % (acq_line, name))
+                return True     # reported; stop tracking this path
+            if not protected:
+                line = _may_raise(stmt, name)
+                if line is not None:
+                    self._add(line,
+                              "call may raise between match() (line "
+                              "%d) and release/escape of %r — the "
+                              "exception edge leaks the refs; wrap in "
+                              "try/except that releases"
+                              % (acq_line, name))
+                    return True
+        return False
+
+
+def _scan_refs_attr(prog: _Program):
+    """Direct ``.refs`` mutation outside prefix_cache.py."""
+    for mod in prog.modules.values():
+        if mod.rel.endswith("prefix_cache.py"):
+            continue
+        for node in ast.walk(mod.tree):
+            tgt = None
+            if isinstance(node, ast.AugAssign):
+                tgt = node.target
+            elif isinstance(node, ast.Assign):
+                tgt = node.targets[0]
+            if tgt is not None and isinstance(tgt, ast.Attribute) \
+                    and tgt.attr == "refs":
+                prog.findings.append(Finding(
+                    "pylock", "py-ref-leak", mod.rel, node.lineno,
+                    "refs", "PrefixCache refcounts mutated outside "
+                    "prefix_cache.py — use match()/release()"))
+
+
+# ---------------------------------------------------------------------------
+# program-level passes
+# ---------------------------------------------------------------------------
+def _guarded_pass(prog: _Program):
+    for (mod, group), sites in sorted(prog.writes.items()):
+        guards: Dict[str, int] = {}
+        for line, held, in_init, fnqual, attr in sites:
+            if in_init:
+                continue
+            for h in held:
+                guards[h] = guards.get(h, 0) + 1
+        if not guards:
+            continue
+        guard = sorted(guards.items(), key=lambda kv: (-kv[1],
+                                                       kv[0]))[0][0]
+        for line, held, in_init, fnqual, attr in sites:
+            if in_init or guard in held:
+                continue
+            prog.findings.append(Finding(
+                "pylock", "py-guarded-field", mod, line, attr,
+                "%r written under %s elsewhere but not here — "
+                "guarded-by inference says every write site needs "
+                "the lock (writes in __init__ are exempt)"
+                % (attr, guard)))
+
+
+def _transitive_pass(prog: _Program):
+    """Propagate acquired-lock sets through the call graph, then (a)
+    emit transitive blocking/ordering findings and (b) detect cycles
+    in the lock-order digraph."""
+    trans: Dict[str, Set[str]] = {q: set(f.acquires)
+                                  for q, f in prog.fns.items()}
+    tblocks: Dict[str, List[Tuple[int, str]]] = {
+        q: list(f.blocks) for q, f in prog.fns.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, f in prog.fns.items():
+            for line, callee, _, _ in f.calls:
+                if callee not in trans:
+                    continue
+                before = len(trans[q])
+                trans[q] |= trans[callee]
+                if len(trans[q]) != before:
+                    changed = True
+                if tblocks[callee] and not tblocks[q]:
+                    tblocks[q] = [(line, "%s (via %s)" % (
+                        tblocks[callee][0][1],
+                        callee.split("::")[-1]))]
+                    changed = True
+
+    for q, f in sorted(prog.fns.items()):
+        for line, callee, _, held in f.calls:
+            if not held or callee not in trans:
+                continue
+            cfn = prog.fns[callee]
+            # transitive blocking
+            for bline, kind in tblocks.get(callee, []):
+                prog.findings.append(Finding(
+                    "pylock", "py-blocking-under-lock", f.mod, line,
+                    callee.split("::")[-1],
+                    "call to %s() may block on %s while holding %s"
+                    % (cfn.name, kind, "+".join(sorted(held)))))
+                break
+            # transitive ordering edges + re-acquisition
+            callee_requires = prog.implicit_requires(cfn)
+            for m in sorted(trans[callee]):
+                if m in callee_requires:
+                    continue
+                if m in held and prog.locks[m].kind == "lock":
+                    prog.findings.append(Finding(
+                        "pylock", "py-lock-order", f.mod, line, m,
+                        "call to %s() may re-acquire held "
+                        "non-reentrant %s" % (cfn.name, m)))
+                    continue
+                for h in held:
+                    if h != m:
+                        prog.order_edges.append((h, m, q, line))
+
+    # cycle detection over the order digraph
+    edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    reported: Set[Tuple[str, str]] = set()
+    for a, b, qual, line in prog.order_edges:
+        fwd = edges.setdefault(a, {})
+        if b not in fwd:
+            fwd[b] = (qual, line)
+
+    def reachable(src, dst, seen):
+        if src == dst:
+            return True
+        for nxt in edges.get(src, {}):
+            if nxt not in seen:
+                seen.add(nxt)
+                if reachable(nxt, dst, seen):
+                    return True
+        return False
+
+    for a, b, qual, line in prog.order_edges:
+        if (b, a) in reported or (a, b) in reported:
+            continue
+        if reachable(b, a, {b}) and a != b:
+            # report at the LATER edge in scan order (the one closing
+            # the cycle), once per lock pair
+            reported.add((a, b))
+            fn = prog.fns[qual]
+            prog.findings.append(Finding(
+                "pylock", "py-lock-order", fn.mod, line, b,
+                "acquiring %s while holding %s closes a lock-order "
+                "cycle (%s -> %s also exists) — two threads arriving "
+                "from opposite ends deadlock" % (b, a, b, a)))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def analyze(modules: Dict[str, str]) -> List[Finding]:
+    """Analyze ``{rel_path: source}`` as one program; findings are
+    pragma-filtered per module."""
+    prog = _Program(modules)
+    types = _TypeEnv(prog)
+    for qual in sorted(prog.fns):
+        fn = prog.fns[qual]
+        _FnScanner(prog, types, fn).scan()
+        _RefLeakScanner(prog, fn).scan()
+    _scan_refs_attr(prog)
+    _guarded_pass(prog)
+    _transitive_pass(prog)
+    out: List[Finding] = []
+    for rel, mod in prog.modules.items():
+        fs = [f for f in prog.findings if f.path == rel]
+        out.extend(apply_pragmas(fs, mod.source))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_source(source: str, rel_path: str) -> List[Finding]:
+    """Single-module entry (fixtures drive this directly)."""
+    return analyze({rel_path: source})
+
+
+def run(root: str, only: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint every Python module under :data:`PACKAGES`.  ``only``
+    restricts the *reported* modules (``--changed-only``) — the whole
+    program is still parsed so cross-module lock-order stays sound."""
+    modules: Dict[str, str] = {}
+    for pkg in PACKAGES:
+        d = os.path.join(root, pkg)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            rel = "%s/%s" % (pkg, name)
+            with open(os.path.join(root, rel)) as f:
+                modules[rel] = f.read()
+    findings = analyze(modules)
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    return findings

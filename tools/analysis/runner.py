@@ -1,28 +1,34 @@
-"""mxlint entry point — run all three analyzers against the live repo.
+"""mxlint entry point — run all four analyzers against the live repo.
 
 Usage (from the repo root)::
 
     python -m tools.analysis                 # human-readable, exit 1 on
                                              # new violations
+    python -m tools.analysis --changed-only  # only files changed vs the
+                                             # merge-base (seconds, the
+                                             # iteration default in
+                                             # tools/run_static_analysis.sh)
+    python -m tools.analysis --all           # full run (tier-1 scope)
     python -m tools.analysis --json          # machine-readable report
     python -m tools.analysis --write-baseline  # accept current findings
 
 Tier-1 wiring: ``tests/test_static_analysis.py`` calls :func:`run_all`
-directly; ``tools/run_static_analysis.sh`` is the CLI wrapper that also
-smokes the sanitizer builds.
+directly (always full scope); ``tools/run_static_analysis.sh`` is the
+CLI wrapper that also smokes the sanitizer builds.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional, Set
 
-from . import abi, jaxlint, native_lint
+from . import abi, jaxlint, native_lint, pylocklint
 from .findings import Finding, load_baseline, split_new
 
-__all__ = ["REPO_ROOT", "run_all", "main"]
+__all__ = ["REPO_ROOT", "changed_files", "run_all", "main"]
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -33,34 +39,98 @@ HEADER = "native/include/mxnet_tpu/c_api.h"
 BINDINGS = "mxnet_tpu/native.py"
 
 
-def run_all(root: str = None, baseline_path: str = None) -> Dict:
+def _git(root: str, *args: str) -> Optional[str]:
+    try:
+        out = subprocess.run(["git"] + list(args), cwd=root,
+                             capture_output=True, text=True, timeout=30)
+    except Exception:
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths changed vs the merge-base (committed on this
+    branch since the base, staged, unstaged, and untracked).  None when
+    git is unavailable — the caller falls back to a full run."""
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        mb = _git(root, "merge-base", "HEAD", ref)
+        if mb is not None:
+            base = mb.strip()
+            break
+    out: Set[str] = set()
+    probes = [("diff", "--name-only", "HEAD")]
+    if base:
+        probes.append(("diff", "--name-only", base, "HEAD"))
+    for probe in probes:
+        got = _git(root, *probe)
+        if got is None:
+            return None
+        out.update(p.strip() for p in got.splitlines() if p.strip())
+    untracked = _git(root, "ls-files", "-o", "--exclude-standard")
+    if untracked is not None:
+        out.update(p.strip() for p in untracked.splitlines()
+                   if p.strip())
+    return out
+
+
+def run_all(root: str = None, baseline_path: str = None,
+            changed_only: bool = False) -> Dict:
     """Run every analyzer; returns ``{"findings": [...],
-    "new": [...], "baselined": [...]}`` (Finding objects)."""
+    "new": [...], "baselined": [...]}`` (Finding objects).
+
+    ``changed_only`` restricts reporting to files changed vs the
+    merge-base (plus the working tree) so iteration costs seconds; the
+    cross-module passes still parse their whole scope, so a change in
+    one module that breaks an invariant ANCHORED in another is only
+    guaranteed to surface on a full run — which is why tier-1 always
+    runs full scope."""
     root = root or REPO_ROOT
+    # changed_files() returning None (git unavailable) degrades to a
+    # full run — `only is None` means unscoped everywhere below
+    only = changed_files(root) if changed_only else None
     findings: List[Finding] = []
-    findings += abi.check(os.path.join(root, HEADER),
-                          os.path.join(root, BINDINGS),
-                          HEADER, BINDINGS)
-    findings += jaxlint.run(root)
-    findings += native_lint.run(root)
+    if only is None or HEADER in only or BINDINGS in only:
+        findings += abi.check(os.path.join(root, HEADER),
+                              os.path.join(root, BINDINGS),
+                              HEADER, BINDINGS)
+    findings += jaxlint.run(root, only=only)
+    findings += native_lint.run(root, only=only)
+    findings += pylocklint.run(root, only=only)
     baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
     new, old = split_new(findings, baseline)
-    return {"findings": findings, "new": new, "baselined": old}
+    return {"findings": findings, "new": new, "baselined": old,
+            "changed": sorted(only) if only is not None else None}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mxlint", description="repo static-analysis suite "
-        "(C-ABI / JAX hazards / native concurrency)")
+        "(C-ABI / JAX hazards / native + Python concurrency)")
     ap.add_argument("--root", default=REPO_ROOT)
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only files changed vs the merge-base "
+                         "(iteration mode — seconds, not the full "
+                         "sweep)")
+    ap.add_argument("--all", action="store_true",
+                    help="full scope (the tier-1 default; overrides "
+                         "--changed-only)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept every current finding into the "
                          "baseline (review the diff!)")
     args = ap.parse_args(argv)
 
-    report = run_all(args.root, args.baseline)
+    # --write-baseline must see the FULL finding set: writing from a
+    # changed-only scope would silently drop baseline entries for
+    # every unchanged file
+    report = run_all(args.root, args.baseline,
+                     changed_only=args.changed_only and not args.all
+                     and not args.write_baseline)
+    if report.get("changed") is not None and not args.json:
+        print("mxlint: --changed-only over %d changed file(s)"
+              % len(report["changed"]))
     if args.write_baseline:
         entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
                     "reason": "accepted by --write-baseline"}

@@ -1,19 +1,28 @@
 #!/bin/sh
-# Single entry point for the mxlint static-analysis suite (ISSUE 4):
-#   1. the three analyzers (C-ABI / JAX hazards / native concurrency)
-#      — pure parsing, fails on any NEW violation vs baseline/pragmas;
+# Single entry point for the mxlint static-analysis suite (ISSUE 4/7):
+#   1. the four analyzers (C-ABI / JAX hazards / native concurrency /
+#      Python concurrency) — pure parsing, fails on any NEW violation
+#      vs baseline/pragmas.  DEFAULT SCOPE: --changed-only (files
+#      changed vs the merge-base + working tree), so iteration costs
+#      seconds; pass --all for the full tier-1 sweep (what
+#      tests/test_static_analysis.py always runs).
 #   2. sanitizer smoke, delegated to tests/test_native_sanitize.py so
 #      the sanitizer matrix (flags, env, binaries, toolchain probe,
 #      skip reasons) lives in exactly one place — the test module
 #      skips with a visible reason when the toolchain lacks make, a
 #      C++ compiler, or sanitizer support.
-# Wired into tools/run_slow_tier.sh; tier-1 coverage lives in
-# tests/test_static_analysis.py.
+# Wired into tools/run_slow_tier.sh (with --all); tier-1 coverage
+# lives in tests/test_static_analysis.py.
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== mxlint analyzers =="
-python -m tools.analysis --baseline tools/analysis/baseline.json
+SCOPE="--changed-only"
+for arg in "$@"; do
+    [ "$arg" = "--all" ] && SCOPE="--all"
+done
+
+echo "== mxlint analyzers ($SCOPE) =="
+python -m tools.analysis --baseline tools/analysis/baseline.json $SCOPE
 
 echo "== sanitizer smoke (tests/test_native_sanitize.py) =="
 python -m pytest tests/test_native_sanitize.py -q -p no:cacheprovider \
