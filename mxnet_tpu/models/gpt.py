@@ -185,16 +185,26 @@ def _kv_quantize(k, v):
     an f32 scale pair per (row, token).  Rank-agnostic (k/v may be
     (R, dh) or (R, S, dh)); returns (kv_q int8 (..., 2*dh),
     scales f32 (..., 2)).  Shared by prefill, both contiguous decode
-    steps, and the paged serving step."""
+    steps, and the paged serving step.
+
+    The quantization accumulates in f32 (round 13, graphlint
+    ``graph-dtype-drift``): k/v upcast ONCE at entry — the declared
+    accumulation point, last dim = head_dim — so the scale and the
+    quantization grid are f32-exact.  The previous version divided in
+    bf16 and only upcast the stacked result, leaving the stored "f32"
+    scales with bf16 mantissas (up to ~0.4% grid error) — the late
+    cosmetic upcast graphlint now flags."""
     import jax.numpy as jnp
-    sk = jnp.maximum(jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
-    sv = jnp.maximum(jnp.max(jnp.abs(v), axis=-1) / 127.0, 1e-8)
-    kq = jnp.clip(jnp.round(k / sk[..., None]), -127, 127
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    sk = jnp.maximum(jnp.max(jnp.abs(kf), axis=-1) / 127.0, 1e-8)
+    sv = jnp.maximum(jnp.max(jnp.abs(vf), axis=-1) / 127.0, 1e-8)
+    kq = jnp.clip(jnp.round(kf / sk[..., None]), -127, 127
                   ).astype(jnp.int8)
-    vq = jnp.clip(jnp.round(v / sv[..., None]), -127, 127
+    vq = jnp.clip(jnp.round(vf / sv[..., None]), -127, 127
                   ).astype(jnp.int8)
     return (jnp.concatenate([kq, vq], axis=-1),
-            jnp.stack([sk, sv], axis=-1).astype(jnp.float32))
+            jnp.stack([sk, sv], axis=-1))
 
 
 def _attend_rows(q, ckv, cs, pos, dh):

@@ -177,7 +177,14 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     ``n_sample`` is how many argmax rows each slot reads back per step
     (1 + spec_K): with in-engine speculation every decode slot feeds
     its pending token plus K draft rows and the host verifies the
-    drafts against the returned per-row argmaxes."""
+    drafts against the returned per-row argmaxes.
+
+    The compiled program is audited by graphlint
+    (``tools/analysis/graphlint.py``, tier-1): pool donation is
+    verified against the lowering (dropping ``donate_argnums=(1,)``
+    here fails ``tests/test_static_analysis.py``), peak live bytes are
+    gated by ``tools/analysis/hbm_budgets.json``, and bf16/int8→f32
+    upcasts must be declared accumulation points."""
     import jax
     import jax.numpy as jnp
 

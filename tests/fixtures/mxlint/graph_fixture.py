@@ -1,0 +1,127 @@
+"""Seeded graphlint violations (ISSUE 8) — every rule fires exactly
+once over :data:`PROGRAMS` + :data:`BUDGETS`, pragma twins stay
+suppressed, and the baseline suppresses by key
+(``tests/test_static_analysis.py TestGraphFixtures``).
+
+Toy jitted programs, one per rule:
+
+* ``fix_dropped_donation`` — the spec declares arg 0 donated but the
+  jit carries no ``donate_argnums`` → ``graph-donation``.
+* ``fix_f32_upcast`` — an int8 region upcasts a (8, 32) tensor to f32
+  with no declared accumulation point → ``graph-dtype-drift``
+  (anchored at the ``.astype`` line below).
+* ``fix_over_budget`` — :data:`BUDGETS` pins its budget at 1 byte →
+  ``graph-hbm-budget``.
+* ``fix_host_callback`` — ``jax.debug.print`` inside a hot program →
+  ``graph-host-sync``.
+
+Each has a pragma twin (same violation, ``# mxlint: allow(...)`` at
+the anchor line) proving suppression; the clean ``fine_*`` programs
+prove the rules are not over-broad (donation honored, declared
+accumulation points accepted, callbacks absent).
+"""
+import jax
+import jax.numpy as jnp
+
+from tools.analysis import graphlint
+
+
+def _f32(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _i8(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.int8)
+
+
+# --------------------------------------------------------------- bad --
+def build_dropped_donation():
+    # donate_argnums MISSING — the spec below still declares arg 0
+    fn = jax.jit(lambda pool, x: (pool + x[None, :], x * 2.0))
+    return fn, (_f32((8, 16)), _f32((16,)))
+
+
+def build_f32_upcast():
+    def f(kv, q):
+        big = kv.astype(jnp.float32)          # undeclared upcast
+        return big.sum(axis=-1) * q
+    return jax.jit(f), (_i8((8, 32)), _f32((8,)))
+
+
+def build_over_budget():
+    def f(x):
+        return (x @ x.T).sum()
+    return jax.jit(f), (_f32((32, 32)),)
+
+
+def build_host_callback():
+    def f(x):
+        jax.debug.print("sum={s}", s=x.sum())
+        return x * 2.0
+    return jax.jit(f), (_f32((16,)),)
+
+
+# ------------------------------------------------------- pragma twins --
+def build_f32_upcast_twin():
+    def f(kv, q):
+        # mxlint: allow(graph-dtype-drift) -- suppressed twin
+        big = kv.astype(jnp.float32)
+        return big.sum(axis=-1) * q
+    return jax.jit(f), (_i8((8, 32)), _f32((8,)))
+
+
+def build_host_callback_twin():
+    def f(x):
+        # mxlint: allow(graph-host-sync) -- suppressed twin
+        jax.debug.print("sum={s}", s=x.sum())
+        return x * 2.0
+    return jax.jit(f), (_f32((16,)),)
+
+
+# -------------------------------------------------------------- clean --
+def build_fine_donated():
+    fn = jax.jit(lambda pool, x: (pool + x[None, :], x * 2.0),
+                 donate_argnums=(0,))
+    return fn, (_f32((8, 16)), _f32((16,)))
+
+
+def build_fine_declared_acc():
+    def f(kv, q):
+        # the (8,) max-abs IS the declared accumulation point (the
+        # allowance keys on the convert OPERAND's last dim: 8)
+        scale = jnp.max(jnp.abs(kv), axis=-1).astype(jnp.float32)
+        return scale * q
+    return jax.jit(f), (_i8((8, 32)), _f32((8,)))
+
+
+PROGRAMS = [
+    graphlint.spec("fix_dropped_donation", build_dropped_donation,
+                   donate=(0,)),
+    graphlint.spec("fix_f32_upcast", build_f32_upcast,
+                   dtype_region="int8", f32_allow={}),
+    graphlint.spec("fix_over_budget", build_over_budget),
+    graphlint.spec("fix_host_callback", build_host_callback),
+    graphlint.spec("twin_f32_upcast", build_f32_upcast_twin,
+                   dtype_region="int8", f32_allow={}),
+    graphlint.spec("twin_host_callback", build_host_callback_twin),
+    # pragma twins anchored at the spec line (registry-level rules):
+    # mxlint: allow(graph-donation) -- suppressed twin
+    graphlint.spec("twin_dropped_donation", build_dropped_donation,
+                   donate=(0,)),
+    # mxlint: allow(graph-hbm-budget) -- suppressed twin
+    graphlint.spec("twin_over_budget", build_over_budget),
+    graphlint.spec("fine_donated", build_fine_donated, donate=(0,)),
+    graphlint.spec("fine_declared_acc", build_fine_declared_acc,
+                   dtype_region="int8", f32_allow={8: "scale-acc"}),
+]
+
+# generous entries for everything except the seeded over-budget pair —
+# missing entries would otherwise add graph-hbm-budget noise
+_GEN = {"peak_bytes": 10 ** 9, "budget_bytes": 10 ** 9}
+BUDGETS = {"version": 1, "programs": {
+    sp.name: dict(_GEN) for sp in PROGRAMS
+}}
+BUDGETS["programs"]["fix_over_budget"] = {"peak_bytes": 1,
+                                          "budget_bytes": 1}
+BUDGETS["programs"]["twin_over_budget"] = {"peak_bytes": 1,
+                                           "budget_bytes": 1}

@@ -169,6 +169,37 @@ def test_int8_weight_only_decode_parity():
     assert (of == oq).mean() >= 0.8, (of, oq)
 
 
+def test_kv_quantize_accumulates_in_f32():
+    """Round 13 (graphlint graph-dtype-drift fix): ``_kv_quantize``
+    upcasts k/v ONCE at entry and computes scale + quantization grid
+    in f32 — the stored scales are exactly ``max|x| / 127`` in f32,
+    not a bf16-rounded value cosmetically upcast (the old late
+    ``.astype(f32)`` on the stacked scales), and the int8 round-trip
+    error stays within half a (correct) quantization step."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+
+    rng = np.random.RandomState(0)
+    k = jnp.asarray(rng.randn(6, 4, 16), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(6, 4, 16), jnp.bfloat16)
+    kv, s = gpt._kv_quantize(k, v)
+    assert kv.dtype == jnp.int8 and s.dtype == jnp.float32
+
+    kf = np.asarray(k, np.float32)
+    vf = np.asarray(v, np.float32)
+    exp_sk = np.maximum(
+        np.abs(kf).max(-1) / np.float32(127.0), np.float32(1e-8))
+    exp_sv = np.maximum(
+        np.abs(vf).max(-1) / np.float32(127.0), np.float32(1e-8))
+    np.testing.assert_array_equal(np.asarray(s[..., 0]), exp_sk)
+    np.testing.assert_array_equal(np.asarray(s[..., 1]), exp_sv)
+
+    deq_k = np.asarray(kv[..., :16], np.float32) * exp_sk[..., None]
+    deq_v = np.asarray(kv[..., 16:], np.float32) * exp_sv[..., None]
+    assert np.abs(deq_k - kf).max() <= exp_sk.max() * 0.5 + 1e-7
+    assert np.abs(deq_v - vf).max() <= exp_sv.max() * 0.5 + 1e-7
+
+
 # ---------------------------------------------------------------------------
 # speculative decode (fast tier: the distribution-exactness gates)
 # ---------------------------------------------------------------------------

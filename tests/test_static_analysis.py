@@ -1,37 +1,52 @@
-"""Tier-1 gate for the mxlint static-analysis suite (ISSUE 4).
+"""Tier-1 gate for the mxlint static-analysis suite (ISSUE 4/7/8).
 
 Three layers of assertion:
 
 1. **Live repo is clean** — every analyzer runs over the working tree
    and reports ZERO new violations (pragma- and baseline-filtered).
-   This is the gate that keeps ABI drift, hot-loop host syncs, and
-   locking-discipline regressions out of future PRs.
+   This is the gate that keeps ABI drift, hot-loop host syncs,
+   locking-discipline regressions, dropped step-program donation, and
+   HBM-footprint creep out of future PRs.
 2. **Rules actually fire** — seeded-violation fixtures under
    ``tests/fixtures/mxlint/`` prove each rule detects its target
    exactly as often as seeded, and that the pragma / requires() /
    baseline suppression paths work.
 3. **Coverage invariants** — every ``MX*`` function in ``c_api.h`` has
    an explicit argtypes/restype entry (zero baselined ABI findings —
-   acceptance criterion), and the runner end-to-end stays under the
-   tier-1 time budget (pure parsing, no native build, no jax tracing).
+   acceptance criterion), graphlint's budget manifest and sharding
+   audit stay current, and the runner end-to-end stays under the
+   tier-1 time budget (parsing + abstract tracing only: no native
+   build, no compilation, no program execution).
 """
 import collections
+import importlib.util
+import json
 import os
 import time
 
 import pytest
 
-from tools.analysis import abi, jaxlint, native_lint, pylocklint
+from tools.analysis import (abi, graphlint, jaxlint, native_lint,
+                            pylocklint)
 from tools.analysis.findings import (Finding, apply_pragmas,
                                      load_baseline, split_new)
 from tools.analysis.runner import (BINDINGS, HEADER, REPO_ROOT,
-                                   changed_files, run_all)
+                                   changed_files, findings_json,
+                                   run_all)
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "mxlint")
 
 
 def _rules(findings):
     return collections.Counter(f.rule for f in findings)
+
+
+def _load_graph_fixture():
+    path = os.path.join(FIXTURES, "graph_fixture.py")
+    spec = importlib.util.spec_from_file_location("graph_fixture", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 # ---------------------------------------------------------------------------
@@ -521,6 +536,256 @@ class TestHotRegionAdditions:
 
 
 # ---------------------------------------------------------------------------
+# graphlint (ISSUE 8): live repo, fixtures, manifest + audit workflow
+# ---------------------------------------------------------------------------
+class TestGraphlintLiveRepo:
+    def test_graphlint_zero_findings_even_baselined(self):
+        """Acceptance criterion: the compiled-program audit reports
+        ZERO findings with an EMPTY baseline — donation verified,
+        budgets met, no undeclared f32 upcasts, no host callbacks."""
+        fs = graphlint.run(REPO_ROOT)
+        assert fs == [], "\n".join(str(f) for f in fs)
+
+    def test_budget_manifest_covers_required_programs(self):
+        """The committed hbm_budgets.json covers the serving step, GPT
+        generate, and the train steps (acceptance criterion), agrees
+        exactly with the registry, and records a trace closure for
+        every program (the --changed-only scope)."""
+        budgets = graphlint.load_budgets()
+        progs = set(budgets["programs"])
+        assert {"serving_step", "serving_step_pallas", "cow_page_copy",
+                "gpt_generate", "gpt_spec_block",
+                "transformer_train_step", "gpt_train_step",
+                "paged_attention_kernel"} <= progs
+        assert progs == {sp.name for sp in graphlint.live_programs()}
+        for name, e in budgets["programs"].items():
+            assert e["budget_bytes"] >= e["peak_bytes"], name
+            assert e["closure"], name
+        ss = budgets["programs"]["serving_step"]["closure"]
+        assert "mxnet_tpu/serving/engine.py" in ss
+        assert "mxnet_tpu/models/gpt.py" in ss
+
+    def test_sharding_audit_checked_in_and_current(self):
+        """The ServingEngine step-program sharding-readiness table is
+        committed (acceptance criterion) and regenerates identically —
+        the ROADMAP-1 work-list cannot silently go stale."""
+        path = os.path.join(REPO_ROOT, graphlint.AUDIT_PATH)
+        committed = open(path).read()
+        assert committed == graphlint.sharding_audit_md(REPO_ROOT)
+        assert "pools[*]['kv']" in committed
+        assert "UNCOVERED" in committed
+        assert "covered: P(None, 'tp')" in committed
+
+    def test_graphlint_guards_the_kv_quantize_fix(self, monkeypatch):
+        """Reverting _kv_quantize to the round-4 bf16-accumulation
+        version (bf16 max/divide, cosmetic f32 upcast of the stacked
+        scales) re-fires graph-dtype-drift on the serving step — the
+        pass genuinely guards the fix shipped in this PR (PR-4/7
+        convention)."""
+        import jax.numpy as jnp
+        from mxnet_tpu.models import gpt as G
+        src = open(os.path.join(REPO_ROOT,
+                                "mxnet_tpu/models/gpt.py")).read()
+        assert "kf = k.astype(jnp.float32)" in src   # the fix is live
+
+        def old_kv_quantize(k, v):
+            sk = jnp.maximum(jnp.max(jnp.abs(k), axis=-1) / 127.0,
+                             1e-8)
+            sv = jnp.maximum(jnp.max(jnp.abs(v), axis=-1) / 127.0,
+                             1e-8)
+            kq = jnp.clip(jnp.round(k / sk[..., None]), -127, 127
+                          ).astype(jnp.int8)
+            vq = jnp.clip(jnp.round(v / sv[..., None]), -127, 127
+                          ).astype(jnp.int8)
+            return (jnp.concatenate([kq, vq], axis=-1),
+                    jnp.stack([sk, sv], axis=-1).astype(jnp.float32))
+
+        monkeypatch.setattr(G, "_kv_quantize", old_kv_quantize)
+        # pjit caches the traced jaxpr per (fn, avals) — drop it so
+        # the re-trace actually sees the monkeypatched quantizer, and
+        # drop it AGAIN on the way out so later tests re-tracing the
+        # _step_cache'd fn do not read the poisoned bf16 jaxpr back
+        import jax
+        from mxnet_tpu.serving import engine as E
+        jax.clear_caches()
+        try:
+            sp = {s.name: s for s in graphlint.live_programs()}[
+                "serving_step"]
+            fs = graphlint.check_program(
+                sp, REPO_ROOT, budgets=graphlint.load_budgets())
+        finally:
+            E._step_cache.clear()
+            jax.clear_caches()
+        assert _rules(fs)["graph-dtype-drift"] >= 1, \
+            [str(f) for f in fs]
+
+    def test_dropping_donation_refires(self, monkeypatch):
+        """Rebuilding the serving step with donate_argnums stripped
+        (what a careless _make_step refactor would do) fires
+        graph-donation — the registry audits the LIVE builder."""
+        import jax
+        from mxnet_tpu.serving import engine as E
+        real_jit = jax.jit
+
+        def nodonate_jit(*a, **kw):
+            kw.pop("donate_argnums", None)
+            return real_jit(*a, **kw)
+
+        monkeypatch.setattr(jax, "jit", nodonate_jit)
+        E._step_cache.clear()
+        try:
+            sp = {s.name: s for s in graphlint.live_programs()}[
+                "serving_step"]
+            fs = graphlint.check_program(
+                sp, REPO_ROOT, budgets=graphlint.load_budgets())
+        finally:
+            E._step_cache.clear()    # never leak the undonated step
+        assert _rules(fs)["graph-donation"] == 1, [str(f) for f in fs]
+
+    def test_changed_only_traces_by_closure(self, monkeypatch):
+        """--changed-only re-traces a program iff a file in its
+        recorded trace closure changed (analysis-infra changes always
+        re-trace; --all / tier-1 ignores the scope entirely)."""
+        budgets = graphlint.load_budgets()
+        sp = {s.name: s for s in graphlint.live_programs()}[
+            "serving_step"]
+        assert graphlint._needs_trace(
+            sp, budgets, {"mxnet_tpu/serving/engine.py"})
+        assert graphlint._needs_trace(
+            sp, budgets, {"tools/analysis/graphlint.py"})
+        assert not graphlint._needs_trace(sp, budgets, {"README.md"})
+
+        # nothing changed -> NO program traced at all
+        def no_trace(*a, **kw):
+            raise AssertionError("traced despite empty change set")
+
+        monkeypatch.setattr(graphlint, "check_program", no_trace)
+        assert graphlint.run(REPO_ROOT, only=set()) == []
+
+    def test_update_budgets_never_relaxes(self, tmp_path):
+        """--update-budgets re-records peak_bytes and closures but a
+        committed budget only ever ratchets DOWN (perf-gate
+        semantics); a program over its budget stays a finding until
+        the budget is hand-edited with justification."""
+        gf = _load_graph_fixture()
+        sp = {s.name: s for s in gf.PROGRAMS}["fix_over_budget"]
+        p = tmp_path / "budgets.json"
+        p.write_text(json.dumps({"version": 1, "programs": {
+            "fix_over_budget": {"peak_bytes": 5, "budget_bytes": 5,
+                                "closure": []}}}))
+        data = graphlint.update_budgets(REPO_ROOT, path=str(p),
+                                        specs=[sp])
+        e = data["programs"]["fix_over_budget"]
+        assert e["peak_bytes"] > 5          # measurement re-recorded
+        assert e["budget_bytes"] == 5       # budget NOT relaxed
+        # ...and a generous budget tightens to ceil(peak * HEADROOM)
+        p.write_text(json.dumps({"version": 1, "programs": {
+            "fix_over_budget": {"peak_bytes": 10 ** 9,
+                                "budget_bytes": 10 ** 9,
+                                "closure": []}}}))
+        data = graphlint.update_budgets(REPO_ROOT, path=str(p),
+                                        specs=[sp])
+        e = data["programs"]["fix_over_budget"]
+        import math
+        assert e["budget_bytes"] == int(math.ceil(
+            e["peak_bytes"] * graphlint.HEADROOM))
+
+    def test_estimator_is_deterministic_and_scales(self):
+        """peak_live_bytes: bit-stable across runs, and a program that
+        materializes an extra full-size temporary estimates strictly
+        higher (the property the budget gate rides on)."""
+        import jax
+        import jax.numpy as jnp
+        s = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+
+        def lean(x):
+            return (x * 2.0).sum()
+
+        def fat(x):
+            a = x * 2.0
+            b = x * 3.0
+            c = x * 4.0
+            return (a + b + c).sum()
+
+        j1 = jax.make_jaxpr(lean)(s)
+        p1 = graphlint.peak_live_bytes(j1)
+        assert p1 == graphlint.peak_live_bytes(jax.make_jaxpr(lean)(s))
+        assert graphlint.peak_live_bytes(jax.make_jaxpr(fat)(s)) > p1
+
+
+class TestGraphFixtures:
+    """Every graphlint rule fires exactly once over the seeded toy
+    registry in fixtures/mxlint/graph_fixture.py, pragma twins stay
+    suppressed, clean programs stay silent, and the baseline
+    suppresses by key (ISSUE 8 satellite)."""
+
+    @pytest.fixture(scope="class")
+    def fixture(self):
+        return _load_graph_fixture()
+
+    @pytest.fixture(scope="class")
+    def findings(self, fixture):
+        return graphlint.run(REPO_ROOT, specs=fixture.PROGRAMS,
+                             budgets=fixture.BUDGETS)
+
+    def test_each_rule_fires_exactly_once(self, findings):
+        assert _rules(findings) == {
+            "graph-donation": 1,      # fix_dropped_donation
+            "graph-dtype-drift": 1,   # fix_f32_upcast
+            "graph-hbm-budget": 1,    # fix_over_budget
+            "graph-host-sync": 1,     # fix_host_callback
+        }, [str(f) for f in findings]
+
+    def test_findings_name_their_programs(self, findings):
+        by_rule = {f.rule: f for f in findings}
+        assert "fix_dropped_donation" in \
+            by_rule["graph-donation"].symbol
+        assert "fix_f32_upcast" in by_rule["graph-dtype-drift"].symbol
+        assert by_rule["graph-hbm-budget"].symbol == "fix_over_budget"
+        assert "debug_callback" in by_rule["graph-host-sync"].symbol
+
+    def test_dtype_finding_anchors_at_the_upcast_line(self, findings):
+        f = [x for x in findings if x.rule == "graph-dtype-drift"][0]
+        src = open(os.path.join(FIXTURES,
+                                "graph_fixture.py")).read()
+        line = src.splitlines()[f.line - 1]
+        assert "astype(jnp.float32)" in line
+
+    def test_pragma_suppressed_twins(self, findings):
+        for f in findings:
+            assert "twin" not in f.symbol, str(f)
+
+    def test_clean_programs_silent(self, findings):
+        for f in findings:
+            assert "fine_" not in f.symbol, str(f)
+
+    def test_baseline_suppresses(self, findings):
+        baseline = {f.key for f in findings
+                    if f.rule == "graph-donation"}
+        new, old = split_new(findings, baseline)
+        assert _rules(old) == {"graph-donation": 1}
+        assert "graph-donation" not in _rules(new)
+
+    def test_missing_budget_entry_is_a_finding(self, fixture):
+        sp = {s.name: s for s in fixture.PROGRAMS}["fix_over_budget"]
+        fs = graphlint.check_program(sp, REPO_ROOT,
+                                     budgets={"programs": {}})
+        assert _rules(fs)["graph-hbm-budget"] == 1
+        assert "--update-budgets" in fs[0].message
+
+    def test_growth_over_manifest_is_a_finding(self, fixture):
+        """Within budget but >10% over the recorded peak still fires
+        (the trajectory half of the gate)."""
+        sp = {s.name: s for s in fixture.PROGRAMS}["fix_over_budget"]
+        fs = graphlint.check_program(
+            sp, REPO_ROOT,
+            budgets={"programs": {"fix_over_budget": {
+                "peak_bytes": 100, "budget_bytes": 10 ** 9}}})
+        assert _rules(fs) == {"graph-hbm-budget": 1}
+        assert "grew" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
 # 3. infra behaviors
 # ---------------------------------------------------------------------------
 class TestInfra:
@@ -550,3 +815,45 @@ class TestInfra:
         keys = load_baseline(os.path.join(
             REPO_ROOT, "tools", "analysis", "baseline.json"))
         assert keys == set()
+
+    def test_findings_json_schema(self):
+        """--format json (ISSUE 8 satellite): the stable CI schema —
+        every finding carries rule/file/line/message/fingerprint, the
+        fingerprint is the sha1 of the line-independent baseline key
+        (stable under unrelated edits), statuses partition
+        new/baselined."""
+        f1 = Finding("jax", "host-sync", "m.py", 7, "np.asarray", "m1")
+        f2 = Finding("jax", "host-sync", "m.py", 9, "np.asarray", "m2")
+        old = Finding("abi", "abi-argtypes", "n.py", 0, "MXFoo", "m3")
+        data = findings_json({"new": [f1], "baselined": [old]})
+        assert data["version"] == 1
+        assert data["new"] == 1 and data["baselined"] == 1
+        entry = data["findings"][0]
+        assert set(entry) == {"rule", "file", "line", "message",
+                              "fingerprint", "analyzer", "symbol",
+                              "status"}
+        assert entry == {"rule": "host-sync", "file": "m.py",
+                         "line": 7, "message": "m1",
+                         "analyzer": "jax", "symbol": "np.asarray",
+                         "status": "new",
+                         "fingerprint": entry["fingerprint"]}
+        # line-independent: same key -> same fingerprint; 12 hex chars
+        fp1 = findings_json({"new": [f1], "baselined": []})
+        fp2 = findings_json({"new": [f2], "baselined": []})
+        assert fp1["findings"][0]["fingerprint"] == \
+            fp2["findings"][0]["fingerprint"]
+        assert len(entry["fingerprint"]) == 12
+        int(entry["fingerprint"], 16)
+        assert data["findings"][1]["status"] == "baselined"
+
+    def test_cli_format_json_round_trips(self, capsys):
+        """`python -m tools.analysis --format json` emits parseable
+        JSON with zero new findings on the live repo (what
+        tools/run_static_analysis.sh passes through for CI)."""
+        from tools.analysis import runner
+        rc = runner.main(["--format", "json", "--changed-only"])
+        out = capsys.readouterr().out
+        data = json.loads(out)
+        assert rc == 0
+        assert data["version"] == 1
+        assert data["new"] == 0

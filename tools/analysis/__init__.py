@@ -1,9 +1,9 @@
-"""mxlint — the repo-native static-analysis suite (ISSUE 4 + 7).
+"""mxlint — the repo-native static-analysis suite (ISSUE 4 + 7 + 8).
 
-Four analyzers, each a module here, all runnable as tier-1 tests
+Five analyzers, each a module here, all runnable as tier-1 tests
 (``tests/test_static_analysis.py``) and as a CLI
 (``python -m tools.analysis``, ``--changed-only`` for the seconds-fast
-iteration scope):
+iteration scope, ``--format json`` for CI annotation):
 
 * :mod:`.abi` — C-ABI consistency between ``c_api.h``, the ctypes
   ``_PROTOTYPES`` table, and every call site in ``mxnet_tpu/native.py``;
@@ -15,7 +15,14 @@ iteration scope):
 * :mod:`.pylocklint` — Python concurrency over ``mxnet_tpu/serving``,
   ``obs`` and ``io`` (inferred guarded-by, cross-module lock-order
   cycles, cv protocol, blocking-under-lock, PrefixCache refcount
-  balance), backstopped by the :mod:`.interleave` explorer.
+  balance), backstopped by the :mod:`.interleave` explorer;
+* :mod:`.graphlint` — jaxpr-level audit of the hot COMPILED programs
+  (serving step, COW page copy, GPT generate/speculative, the train
+  steps, the Pallas paged-attention wrapper): donation verified
+  against the lowering, peak-live-bytes vs the committed
+  ``hbm_budgets.json`` manifest, bf16/int8→f32 dtype drift, host
+  callbacks in hot programs, plus the report-mode sharding-readiness
+  audit (``docs/sharding_readiness.md``).
 
 The dynamic half of ISSUE 7 lives in :mod:`.interleave`: a loom-lite
 deterministic scheduler that serializes the serving cluster's threads

@@ -1,0 +1,856 @@
+"""graphlint (mxlint analyzer 5) — jaxpr-level audit of the repo's hot
+compiled programs.
+
+Analyzers 1–4 check *source*; nothing checked the *compiled programs*
+the perf story rides on.  Donation of the paged KV pools, bf16/int8
+dtype discipline in the attention paths, and per-program HBM footprints
+were enforced only by convention — one refactor that silently drops
+``donate_argnums`` doubles serving HBM and no test notices.  graphlint
+closes that hole: a **registry** of the repo's hot compiled programs
+(:func:`live_programs` — serving step in both kernels, the COW page
+copy, GPT ``generate`` and the speculative block, the transformer /
+GPT train steps, the Pallas paged-attention wrapper) is traced via
+``jax.make_jaxpr`` / ``jax.eval_shape`` on checked-in abstract shapes
+(tiny configs, declared right next to each builder — no weights ever
+materialize, no program ever compiles or runs), and jaxpr-walk rules
+audit the result.
+
+Rules
+-----
+``graph-donation``  Every arg a :class:`ProgramSpec` declares donated
+    must actually be donated AND be in-place-updatable: the lowering
+    must carry ``tf.aliasing_output`` on each of its flattened leaves
+    (jax only aliases a donated buffer that is shape/dtype-matched to
+    an output).  A refactor that drops ``donate_argnums`` — or breaks
+    the output match so donation silently stops applying — is a
+    finding.
+
+``graph-hbm-budget``  Peak live bytes from a linear-scan live-range
+    estimator over the jaxpr (:func:`peak_live_bytes`: inputs live
+    from entry to last use, each equation allocates its outputs, a
+    value dies after its last consumer; nested jaxprs — pjit / scan /
+    while / cond / remat — contribute their own internal peak at their
+    program point; ``pallas_call`` bodies are VMEM scratch and are not
+    recursed into).  The estimate is compared against the committed
+    manifest ``tools/analysis/hbm_budgets.json``: exceeding a
+    program's ``budget_bytes``, or growing >10% over its recorded
+    ``peak_bytes``, is a finding.  ``--update-budgets`` re-records
+    measurements but NEVER relaxes a budget (the perf-gate semantics:
+    widening takes a hand edit with justification in review).  The
+    numbers are estimates on the registry's tiny abstract shapes — a
+    trajectory gate, not a chip measurement.
+
+``graph-dtype-drift``  In a program whose ``dtype_region`` is declared
+    (the bf16-compute / int8-KV serving and decode programs), every
+    ``convert_element_type`` from bf16/int8 **to f32** must land on a
+    declared accumulation point: ``f32_allow`` maps allowed last-dim
+    sizes to labels (layer-norm statistics over ``d_model``, the
+    f32 logits over ``vocab``, the KV-quantization accumulation over
+    ``head_dim``, softmax statistics over the sequence dim).  An
+    undeclared upcast — e.g. a refactor that casts the KV pool or a
+    gathered page view to f32, materializing a double-width copy every
+    step — is a finding, anchored at the offending source line.
+    Scalar (rank-0) converts are always allowed; downcasts are not
+    policed (they are the intended compute direction).  Known
+    boundary: the allowance is a last-dim filter, so an upcast that
+    SHARES an accumulation point's last dim — e.g. an f32 copy of the
+    (T, d_model) residual stream, indistinguishable by aval from the
+    layer-norm statistics upcast and feeding the same mixed consumer
+    sets — passes; the rule's target class is the KV/pool/page-view
+    upcasts, whose last dims (2·dh, 2, page dims) are distinct from
+    every declared point.
+
+``graph-host-sync``  Hot programs must stay host-free: any callback /
+    infeed / outfeed / debug-print primitive in the jaxpr (at any
+    nesting depth) is a finding — a host round-trip inside the serving
+    step or a train step serializes the device on the host every
+    iteration.
+
+Sharding readiness (report, not a rule): :func:`sharding_audit_md`
+emits the audit table for the ServingEngine step program — for every
+program input leaf, whether the megatron partition rules
+(``models/transformer.py param_shardings`` over a ``parallel/mesh.py``
+mesh) already cover it, cover it derivably (int8 ``{"q","s"}`` leaves
+inherit their float weight's rule), or leave it UNCOVERED.  The
+checked-in ``docs/sharding_readiness.md`` is the work-list the
+tensor-parallel-serving issue (ROADMAP item 1) starts from.
+
+Scope / suppression: findings go through the shared pragma + baseline
+machinery (``findings.py``).  ``--changed-only`` re-traces a program
+only when a file in its *recorded trace closure* (the source files its
+jaxpr's tracebacks touched on the last ``--update-budgets``, stored in
+the manifest) changed; ``--all``, ``--write-baseline`` and
+``--update-budgets`` always trace everything.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import re
+import sys
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_pragmas
+
+__all__ = ["ProgramSpec", "spec", "live_programs", "peak_live_bytes",
+           "check_program", "run", "update_budgets", "load_budgets",
+           "sharding_audit_md", "BUDGETS_PATH", "AUDIT_PATH",
+           "GROWTH", "HEADROOM"]
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "hbm_budgets.json")
+AUDIT_PATH = "docs/sharding_readiness.md"
+
+# graphlint audits the IMPORTED mxnet_tpu checkout — the one this file
+# lives in — whatever --root the caller passes (imports do not follow
+# root).  Trace closures are always resolved against this root so a
+# foreign --root cannot wipe the recorded closures; runner.main()
+# rejects foreign roots for the graphlint write modes outright.
+OWN_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GROWTH = 0.10       # >10% live-bytes growth vs the manifest = finding
+HEADROOM = 1.15     # initial budget = ceil(peak * HEADROOM)
+
+# kernel bodies are VMEM-scratch programs (their f32 online-softmax
+# accumulators are the declared-by-design accumulation points) — never
+# recursed into by any rule
+_SKIP_SUBJAXPR = {"pallas_call"}
+
+_CALLBACK_RE = re.compile(r"callback|infeed|outfeed|debug_print")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One registered hot program.
+
+    ``build()`` returns ``(fn, args)``: ``fn`` the LIVE callable from
+    the repo module (so a refactor there is what gets audited) and
+    ``args`` a tuple of abstract ``ShapeDtypeStruct`` pytrees — the
+    checked-in shapes.  ``donate`` lists the positional args the repo
+    declares donated (``fn`` must be jitted for the check to run).
+    ``dtype_region`` ("bf16"/"int8") turns on drift checking with the
+    ``f32_allow`` {last_dim: label} accumulation points.  ``hot``
+    enforces host-sync-freedom.  ``path``/``line`` anchor registry-
+    level findings (captured at :func:`spec` call sites)."""
+    name: str
+    build: Callable[[], Tuple[Any, tuple]]
+    donate: Tuple[int, ...] = ()
+    dtype_region: Optional[str] = None
+    f32_allow: Any = None          # {last_dim: label}
+    hot: bool = True
+    path: str = ""
+    line: int = 0
+
+
+def spec(name, build, *, donate=(), dtype_region=None, f32_allow=None,
+         hot=True):
+    """Register a program, anchoring findings at the caller's line."""
+    frame = sys._getframe(1)
+    return ProgramSpec(name=name, build=build, donate=tuple(donate),
+                       dtype_region=dtype_region,
+                       f32_allow=dict(f32_allow or {}), hot=hot,
+                       path=frame.f_code.co_filename,
+                       line=frame.f_lineno)
+
+
+# ---------------------------------------------------------------------------
+# the live registry — the repo's hot compiled programs, on the
+# checked-in abstract shapes below (tiny configs: tracing is abstract,
+# nothing allocates or compiles)
+# ---------------------------------------------------------------------------
+
+# serving-step registry shapes (the paper's serving config: bf16
+# compute, weight-only-int8 params, int8-KV pages, one draft row)
+_SLOTS, _PAGE, _CHUNK, _SPEC_K = 2, 4, 4, 1
+_GEN_B, _GEN_P, _GEN_NEW = 1, 8, 8
+
+
+def _gpt_cfg():
+    from mxnet_tpu.models import gpt as G
+    return G.gpt_tiny(dtype="bfloat16")
+
+
+def _serve_geometry(cfg):
+    pps = -(-cfg.max_len // _PAGE)
+    n_rows = _SLOTS * (1 + _SPEC_K) + _CHUNK
+    num_pages = _SLOTS * pps + 1
+    return pps, n_rows, num_pages
+
+
+def _abstract_pools(cfg, num_pages):
+    import jax
+    import jax.numpy as jnp
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    return [{"kv": jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2 * dh),
+                                        jnp.int8),
+             "s": jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2),
+                                       jnp.float32)}
+            for _ in range(cfg.n_layers)]
+
+
+def _abstract_qparams(cfg):
+    import jax
+    from mxnet_tpu.models import gpt as G
+    return jax.eval_shape(lambda: G.quantize_decode_params(
+        G.init_params(jax.random.PRNGKey(0), cfg)))
+
+
+def _serving_step_args(cfg):
+    import jax
+    import jax.numpy as jnp
+    pps, n_rows, num_pages = _serve_geometry(cfg)
+    i32 = jnp.int32
+    return (_abstract_qparams(cfg), _abstract_pools(cfg, num_pages),
+            jax.ShapeDtypeStruct((n_rows,), i32),
+            jax.ShapeDtypeStruct((n_rows,), i32),
+            jax.ShapeDtypeStruct((n_rows,), i32),
+            jax.ShapeDtypeStruct((n_rows,), jnp.bool_),
+            jax.ShapeDtypeStruct((_SLOTS + 1, pps), i32),
+            jax.ShapeDtypeStruct((_SLOTS, 1 + _SPEC_K), i32))
+
+
+def _build_serving_step(kernel):
+    from mxnet_tpu.serving.engine import _make_step
+    cfg = _gpt_cfg()
+    pps, n_rows, _ = _serve_geometry(cfg)
+    fn = _make_step(cfg, _SLOTS, n_rows, pps, _PAGE, True,
+                    kernel=kernel, n_sample=1 + _SPEC_K)
+    return fn, _serving_step_args(cfg)
+
+
+def build_serving_step_xla():
+    return _build_serving_step("xla")
+
+
+def build_serving_step_pallas():
+    return _build_serving_step("pallas")
+
+
+def build_cow_page_copy():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.engine import _make_copy
+    cfg = _gpt_cfg()
+    _, _, num_pages = _serve_geometry(cfg)
+    fn = _make_copy(cfg, True)
+    scalar = jax.ShapeDtypeStruct((), jnp.int32)
+    return fn, (_abstract_pools(cfg, num_pages), scalar, scalar)
+
+
+def build_gpt_generate():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt as G
+    cfg = _gpt_cfg()
+    params = jax.eval_shape(
+        lambda: G.init_params(jax.random.PRNGKey(0), cfg))
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    def gen(params, prompt, rng):
+        return G.generate(params, cfg, prompt, _GEN_NEW, rng=rng,
+                          kv_int8=True)
+    return gen, (params,
+                 jax.ShapeDtypeStruct((_GEN_B, _GEN_P), jnp.int32), key)
+
+
+def build_gpt_spec_block():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt as G
+    cfg = _gpt_cfg()
+    params = jax.eval_shape(
+        lambda: G.init_params(jax.random.PRNGKey(0), cfg))
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    def gen(params, prompt, rng):
+        return G.generate_speculative(params, cfg, prompt, _GEN_NEW,
+                                      K=2, rng=rng, kv_int8=True)
+    return gen, (params,
+                 jax.ShapeDtypeStruct((_GEN_B, _GEN_P), jnp.int32), key)
+
+
+def _train_batch(with_labels):
+    import jax
+    import jax.numpy as jnp
+    batch = {"tokens": jax.ShapeDtypeStruct((2, 16), jnp.int32)}
+    if with_labels:
+        batch["labels"] = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+    return batch
+
+
+def build_transformer_train_step():
+    import jax
+    from mxnet_tpu.models import transformer as T
+    init_state, step = T.make_train_step(T.bert_tiny())
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    state = jax.eval_shape(init_state, key)
+    return step, (state, _train_batch(True), key)
+
+
+def build_gpt_train_step():
+    import jax
+    from mxnet_tpu.models import gpt as G
+    init_state, step = G.make_train_step(G.gpt_tiny())
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    state = jax.eval_shape(init_state, key)
+    return step, (state, _train_batch(False), key)
+
+
+def build_paged_attention_kernel():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels.paged_attention import paged_attention
+    cfg = _gpt_cfg()
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    pps, n_rows, num_pages = _serve_geometry(cfg)
+
+    def attend(q, kv, s, bt, pos):
+        return paged_attention(q, kv, s, bt, pos, page_size=_PAGE)
+    fn = jax.jit(attend)
+    return fn, (jax.ShapeDtypeStruct((n_rows, H, dh), jnp.bfloat16),
+                jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2 * dh),
+                                     jnp.int8),
+                jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((n_rows, pps), jnp.int32),
+                jax.ShapeDtypeStruct((n_rows,), jnp.int32))
+
+
+def live_programs() -> List[ProgramSpec]:
+    """The audited registry.  Declared accumulation points
+    (``f32_allow`` last dims, gpt_tiny geometry): 64 = ``d_model``
+    (layer-norm statistics), 1024 = ``vocab`` (f32 logits), 16 =
+    ``head_dim`` (KV-quantization accumulation — ``models/gpt.py
+    _kv_quantize`` upcasts k/v once and computes scale + grid in f32),
+    8 = the prompt/sequence dim (softmax statistics on the prefill's
+    jnp attention reference)."""
+    cfg = _gpt_cfg()
+    dh = cfg.d_model // cfg.n_heads
+    acc = {cfg.d_model: "ln-stats", cfg.vocab_size: "logits",
+           dh: "quant-acc"}
+    gen_acc = dict(acc)
+    gen_acc[_GEN_P] = "softmax-stats"
+    return [
+        spec("serving_step", build_serving_step_xla, donate=(1,),
+             dtype_region="int8", f32_allow=acc),
+        spec("serving_step_pallas", build_serving_step_pallas,
+             donate=(1,), dtype_region="int8", f32_allow=acc),
+        spec("cow_page_copy", build_cow_page_copy, donate=(0,),
+             dtype_region="int8", f32_allow={}),
+        spec("gpt_generate", build_gpt_generate,
+             dtype_region="int8", f32_allow=gen_acc),
+        spec("gpt_spec_block", build_gpt_spec_block,
+             dtype_region="int8", f32_allow=gen_acc),
+        spec("paged_attention_kernel", build_paged_attention_kernel,
+             dtype_region="int8", f32_allow={}),
+        # train steps deliberately carry no dtype_region: the AMP
+        # master-weight pattern (bf16 compute, f32 params/optimizer)
+        # upcasts at every param boundary by design
+        spec("transformer_train_step", build_transformer_train_step,
+             donate=(0,)),
+        spec("gpt_train_step", build_gpt_train_step),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(eqn):
+    """Yield nested (Closed)Jaxprs of an equation — pjit / scan /
+    while / cond / remat / custom_* bodies; ``pallas_call`` is
+    deliberately opaque (VMEM-scratch kernel internals)."""
+    from jax import core
+    if eqn.primitive.name in _SKIP_SUBJAXPR:
+        return
+    for v in eqn.params.values():
+        if isinstance(v, core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, core.Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, core.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, core.Jaxpr):
+                    yield x
+
+
+def _walk_eqns(jaxpr):
+    """Depth-first over every equation at every nesting level."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Linear-scan live-range estimate of a jaxpr's peak live bytes.
+
+    Inputs/consts are live from entry to their last use, each equation
+    allocates its outputs, and a value dies after its last consumer
+    (program outputs live to the end).  An equation with nested
+    jaxprs contributes the nested peak *beyond its own operands* at
+    that program point (for ``cond``/``while``/``scan`` that is the
+    worst branch / one iteration — per-iteration temporaries do not
+    accumulate).  Donation is not modeled: a donated buffer counts on
+    both sides of its update for the one equation where old and new
+    overlap, which XLA aliases away — a deliberate, deterministic
+    overestimate.  The point is the trajectory, not the absolute
+    number."""
+    from jax import core
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    n = len(jaxpr.eqns)
+    last: Dict[Any, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, core.Var):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if isinstance(v, core.Var):
+            last[v] = n
+    live = 0
+    seen: Set[Any] = set()
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if v not in seen:
+            seen.add(v)
+            live += _aval_bytes(v.aval)
+    peak = live
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        for sub in _sub_jaxprs(eqn):
+            operand = sum(_aval_bytes(v.aval) for v in sub.invars)
+            inner = max(inner, max(0, peak_live_bytes(sub) - operand))
+        alloc = 0
+        for v in eqn.outvars:
+            if not isinstance(v, core.DropVar):
+                alloc += _aval_bytes(v.aval)
+        live += alloc
+        peak = max(peak, live + inner)
+        freed = 0
+        dead: Set[Any] = set()
+        for v in eqn.invars:
+            if isinstance(v, core.Var) and v not in dead \
+                    and last.get(v) == i:
+                dead.add(v)
+                freed += _aval_bytes(v.aval)
+        for v in eqn.outvars:
+            if not isinstance(v, core.DropVar) and v not in last:
+                freed += _aval_bytes(v.aval)   # produced, never read
+        live -= freed
+    return peak
+
+
+def _repo_frame(eqn, root) -> Optional[Tuple[str, int]]:
+    """Innermost traceback frame inside the repo, as (relpath, line)."""
+    tb = eqn.source_info.traceback
+    if tb is None:
+        return None
+    root = os.path.abspath(root) + os.sep
+    for f in tb.frames:
+        name = f.file_name
+        if name.startswith(root) and "site-packages" not in name:
+            return os.path.relpath(name, root[:-1]), f.line_num
+    return None
+
+
+def _trace_closure(jaxpr, root) -> Set[str]:
+    """Repo-relative LIBRARY files the trace touched (the program's
+    recorded trace closure, for ``--changed-only`` scoping).  Only
+    ``mxnet_tpu/`` files qualify: traceback frames also carry the
+    driver stack (the CLI runner, a test file, whatever invoked the
+    trace), which would make the closure depend on who ran the update.
+    Changes under ``tools/analysis`` always re-trace everything via
+    :func:`_needs_trace`, so the infra needs no closure entry."""
+    root = OWN_ROOT          # the traced modules live HERE (imports
+    root_abs = os.path.abspath(root) + os.sep   # ignore --root)
+    files: Set[str] = set()
+    for eqn in _walk_eqns(getattr(jaxpr, "jaxpr", jaxpr)):
+        tb = eqn.source_info.traceback
+        if tb is None:
+            continue
+        for f in tb.frames:
+            name = f.file_name
+            if name.startswith(root_abs) and "site-packages" not in name:
+                rel = os.path.relpath(name, root)
+                if rel.startswith("mxnet_tpu" + os.sep):
+                    files.add(rel)
+    return files
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+def _rel(path, root) -> str:
+    path = os.path.abspath(path)
+    root = os.path.abspath(root)
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def _check_donation(sp, fn, args, jaxpr, root, findings):
+    import jax
+    from collections import Counter
+    if not sp.donate:
+        return
+    low = fn.lower(*args)
+    info_args, _ = low.args_info
+    n_aliased = low.as_text().count("tf.aliasing_output")
+    # output avals come from the jaxpr check_program already traced —
+    # no third abstract trace
+    out_count = Counter((tuple(a.shape), str(a.dtype))
+                        for a in jaxpr.out_avals)
+    n_before = len(findings)
+    for argnum in sp.donate:
+        infos = jax.tree_util.tree_leaves(info_args[argnum])
+        dropped = [i for i in infos if not i.donated]
+        if dropped:
+            findings.append(Finding(
+                "graph", "graph-donation", _rel(sp.path, root),
+                sp.line, "%s.arg%d" % (sp.name, argnum),
+                "declared donated arg %d is NOT donated (%d/%d leaves "
+                "undonated) — donate_argnums dropped?  Serving HBM "
+                "doubles when the pools stop updating in place"
+                % (argnum, len(dropped), len(infos))))
+            continue
+        unmatched = [i for i in infos
+                     if out_count[(tuple(i.shape),
+                                   str(i.dtype))] == 0]
+        if unmatched:
+            findings.append(Finding(
+                "graph", "graph-donation", _rel(sp.path, root),
+                sp.line, "%s.arg%d" % (sp.name, argnum),
+                "declared donated arg %d is not in-place-updatable: "
+                "%d/%d leaves have no shape/dtype-matched output, so "
+                "donation silently stops applying"
+                % (argnum, len(unmatched), len(infos))))
+            continue
+    # aliasing backstop: expected count spans EVERY donated leaf in
+    # the lowering (not just registry-declared args) — otherwise an
+    # alias newly established on some other donated arg could mask a
+    # lost alias on a declared one
+    expect_alias = sum(
+        1 for arg in info_args
+        for i in jax.tree_util.tree_leaves(arg)
+        if i.donated and out_count[(tuple(i.shape),
+                                    str(i.dtype))] > 0)
+    if len(findings) == n_before and n_aliased < expect_alias:
+        findings.append(Finding(
+            "graph", "graph-donation", _rel(sp.path, root), sp.line,
+            sp.name,
+            "donation declared and output-matched but the lowering "
+            "established only %d/%d input-output aliases — an unused "
+            "donated input or an aliasing regression"
+            % (n_aliased, expect_alias)))
+
+
+def _check_budget(sp, jaxpr, budgets, root, findings) -> int:
+    peak = peak_live_bytes(jaxpr)
+    entry = (budgets or {}).get("programs", {}).get(sp.name)
+    sym = sp.name
+    if entry is None:
+        findings.append(Finding(
+            "graph", "graph-hbm-budget", _rel(sp.path, root), sp.line,
+            sym, "no hbm_budgets.json entry (peak-live estimate %d "
+            "bytes) — run python -m tools.analysis --update-budgets"
+            % peak))
+    elif peak > entry["budget_bytes"]:
+        findings.append(Finding(
+            "graph", "graph-hbm-budget", _rel(sp.path, root), sp.line,
+            sym, "peak live bytes %d exceed the committed budget %d "
+            "(manifest peak %d) — shrink the program or justify a "
+            "hand-edited budget" % (peak, entry["budget_bytes"],
+                                    entry["peak_bytes"])))
+    elif peak > int(entry["peak_bytes"] * (1 + GROWTH)):
+        findings.append(Finding(
+            "graph", "graph-hbm-budget", _rel(sp.path, root), sp.line,
+            sym, "peak live bytes %d grew >%d%% over the manifest's %d "
+            "— re-record with --update-budgets if intended"
+            % (peak, int(GROWTH * 100), entry["peak_bytes"])))
+    return peak
+
+
+def _check_dtype_drift(sp, jaxpr, root, findings):
+    if sp.dtype_region is None:
+        return
+    allow = sp.f32_allow or {}
+    for eqn in _walk_eqns(getattr(jaxpr, "jaxpr", jaxpr)):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        if str(getattr(dst, "dtype", "")) != "float32":
+            continue
+        if str(getattr(src, "dtype", "")) not in ("bfloat16", "int8"):
+            continue
+        shape = src.shape
+        if len(shape) == 0 or shape[-1] in allow:
+            continue
+        loc = _repo_frame(eqn, root) or (_rel(sp.path, root), sp.line)
+        findings.append(Finding(
+            "graph", "graph-dtype-drift", loc[0], loc[1],
+            "%s:%s->f32:last=%d" % (sp.name, src.dtype, shape[-1]),
+            "undeclared f32 upcast of a %s %s tensor inside the %s "
+            "region of %s (declared accumulation last-dims: %s) — pin "
+            "the accumulation dtype or declare the point in the "
+            "registry" % (src.dtype, "x".join(map(str, shape)),
+                          sp.dtype_region, sp.name,
+                          sorted(allow) or "none")))
+
+
+def _check_host_sync(sp, jaxpr, root, findings):
+    if not sp.hot:
+        return
+    for eqn in _walk_eqns(getattr(jaxpr, "jaxpr", jaxpr)):
+        name = eqn.primitive.name
+        if _CALLBACK_RE.search(name):
+            loc = _repo_frame(eqn, root) or (_rel(sp.path, root),
+                                             sp.line)
+            findings.append(Finding(
+                "graph", "graph-host-sync", loc[0], loc[1],
+                "%s:%s" % (sp.name, name),
+                "host primitive `%s` inside hot program %s — a host "
+                "round-trip per step serializes the device on the "
+                "host" % (name, sp.name)))
+
+
+def check_program(sp: ProgramSpec, root: str,
+                  budgets: Optional[Dict] = None) -> List[Finding]:
+    """Trace one registered program and run every rule over it."""
+    import jax
+    fn, args = sp.build()
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    findings: List[Finding] = []
+    _check_donation(sp, fn, args, jaxpr, root, findings)
+    _check_budget(sp, jaxpr, budgets, root, findings)
+    _check_dtype_drift(sp, jaxpr, root, findings)
+    _check_host_sync(sp, jaxpr, root, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# manifest + runner entry points
+# ---------------------------------------------------------------------------
+
+def load_budgets(path: str = None) -> Dict:
+    path = path or BUDGETS_PATH
+    if not os.path.exists(path):
+        return {"version": 1, "programs": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _needs_trace(sp, budgets, only: Set[str]) -> bool:
+    """--changed-only: a program re-traces when any file in its
+    recorded trace closure changed (no recorded closure, or an
+    analysis-infra change, always re-traces)."""
+    if any(p.startswith("tools/analysis") for p in only):
+        return True
+    entry = (budgets or {}).get("programs", {}).get(sp.name)
+    closure = (entry or {}).get("closure")
+    if not closure:
+        return True
+    return bool(set(closure) & only)
+
+
+def run(root: str, only: Optional[Set[str]] = None,
+        budgets_path: Optional[str] = None,
+        specs: Optional[List[ProgramSpec]] = None,
+        budgets: Optional[Dict] = None) -> List[Finding]:
+    """Audit every registered program; pragma-filtered findings."""
+    if budgets is None:
+        budgets = load_budgets(budgets_path)
+    if specs is None:
+        specs = live_programs()
+    findings: List[Finding] = []
+    for sp in specs:
+        if only is not None and not _needs_trace(sp, budgets, only):
+            continue
+        findings.extend(check_program(sp, root, budgets))
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path, fs in sorted(by_path.items()):
+        full = os.path.join(root, path)
+        if os.path.exists(full):
+            with open(full) as fh:
+                fs = apply_pragmas(fs, fh.read())
+        out.extend(fs)
+    return out
+
+
+def update_budgets(root: str, path: Optional[str] = None,
+                   specs: Optional[List[ProgramSpec]] = None) -> Dict:
+    """Re-measure every program (ALWAYS full scope) and rewrite the
+    manifest.  ``peak_bytes`` and the trace closure re-record;
+    ``budget_bytes`` only ever ratchets DOWN (min of the old budget
+    and ceil(peak * HEADROOM)) — the perf-gate never-relax rule.  A
+    program whose peak now exceeds its committed budget stays a
+    finding until the budget is hand-edited with justification."""
+    import jax
+    path = path or BUDGETS_PATH
+    old = load_budgets(path).get("programs", {})
+    programs: Dict[str, Dict] = {}
+    for sp in (specs if specs is not None else live_programs()):
+        fn, args = sp.build()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        peak = peak_live_bytes(jaxpr)
+        cand = int(math.ceil(peak * HEADROOM))
+        prev = old.get(sp.name)
+        budget = cand if prev is None else min(prev["budget_bytes"],
+                                               cand)
+        programs[sp.name] = {
+            "peak_bytes": peak,
+            "budget_bytes": budget,
+            "closure": sorted(_trace_closure(jaxpr, root)),
+        }
+    data = {"version": 1, "programs": programs}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# sharding-readiness audit (report mode)
+# ---------------------------------------------------------------------------
+
+def _partition_rules(cfg):
+    """Megatron param rules as {tree-path: spec-string}, from
+    ``models/transformer.py param_shardings`` over a mesh built by
+    ``parallel/mesh.py`` (tp axis present; size irrelevant for the
+    rule table)."""
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.parallel.mesh import make_mesh
+    import jax
+    # dp absorbs whatever devices the host exposes (tier-1 runs with
+    # a virtual 8-device CPU mesh); only the axis NAMES matter here
+    mesh = make_mesh({"dp": -1, "tp": 1})
+    shardings = T.param_shardings(cfg, mesh)
+    rules: Dict[str, str] = {}
+    for path, ns in jax.tree_util.tree_flatten_with_path(shardings)[0]:
+        rules[jax.tree_util.keystr(path)] = "P%s" % (tuple(ns.spec),)
+    return rules
+
+
+def _agg_path(keystr_path: str) -> str:
+    """Collapse per-layer indices so the table lists each rule once."""
+    return re.sub(r"\[(\d+)\]", "[*]", keystr_path)
+
+
+def sharding_audit_md(root: str) -> str:
+    """The ServingEngine step-program input audit: every input leaf,
+    and whether the existing megatron rules cover it."""
+    import jax
+    cfg = _gpt_cfg()
+    rules = _partition_rules(cfg)
+    args = _serving_step_args(cfg)
+    names = ["params", "pools", "tokens", "row_slot", "row_pos",
+             "row_live", "bt", "slot_rows"]
+    notes = {
+        "pools": "UNCOVERED — ROADMAP 1: partition the heads axis "
+                 "over tp (heads-partitioned pages); block tables "
+                 "stay host-side",
+        "tokens": "UNCOVERED — replicate (host-built row batch)",
+        "row_slot": "UNCOVERED — replicate",
+        "row_pos": "UNCOVERED — replicate",
+        "row_live": "UNCOVERED — replicate",
+        "bt": "UNCOVERED — replicate (block tables are host state)",
+        "slot_rows": "UNCOVERED — replicate",
+    }
+    rows: List[Tuple[str, str, str, int, str]] = []
+    seen: Set[Tuple[str, str]] = set()
+    covered = derived = uncovered = 0
+    for name, arg in zip(names, args):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+            ks = jax.tree_util.keystr(path)
+            agg = name + _agg_path(ks)
+            shape = "x".join(map(str, leaf.shape)) or "scalar"
+            if (agg, shape) in seen:
+                continue
+            seen.add((agg, shape))
+            nbytes = _aval_bytes(leaf)
+            if name == "params":
+                base = ks
+                status = None
+                if base in rules:
+                    status = "covered: %s" % rules[base]
+                    covered += 1
+                else:
+                    # int8 {"q","s"} leaves inherit the float
+                    # weight's megatron rule (q: the rule itself;
+                    # s: its per-channel 1-D slice)
+                    m = re.match(r"(.*)\['([qs])'\]$", base)
+                    if m and m.group(1) in rules:
+                        status = "derived(%s): from %s" % (
+                            m.group(2), rules[m.group(1)])
+                        derived += 1
+                if status is None:
+                    status = "UNCOVERED — no megatron rule"
+                    uncovered += 1
+            else:
+                status = notes[name]
+                uncovered += 1
+            rows.append((agg, shape, str(leaf.dtype), nbytes, status))
+    lines = [
+        "# Sharding readiness — ServingEngine step program",
+        "",
+        "Report-mode output of graphlint's sharding-readiness audit: "
+        "for every",
+        "input of the serving step program (registry shapes: gpt_tiny, "
+        "%d slots," % _SLOTS,
+        "page_size %d, spec_K %d, int8 weights + int8-KV), whether the"
+        % (_PAGE, _SPEC_K),
+        "megatron partition rules (`models/transformer.py "
+        "param_shardings` over a",
+        "`parallel/mesh.py` mesh) already cover it.  UNCOVERED rows "
+        "are the",
+        "work-list for lowering the engine through pjit — ROADMAP "
+        "item 1",
+        "(tensor-parallel serving) starts here.",
+        "",
+        "Regenerate: `python -m tools.analysis "
+        "--write-sharding-audit`",
+        "(`tests/test_static_analysis.py` pins this file current).",
+        "",
+        "| input | shape | dtype | bytes | partition rule |",
+        "|---|---|---|---|---|",
+    ]
+    for agg, shape, dtype, nbytes, status in rows:
+        lines.append("| `%s` | %s | %s | %d | %s |"
+                     % (agg, shape, dtype, nbytes, status))
+    lines += [
+        "",
+        "**Summary:** %d covered, %d derived (int8 q/s from the float "
+        "rule), %d" % (covered, derived, uncovered),
+        "uncovered input groups.  Params are fully covered by the "
+        "existing",
+        "megatron rules; the paged KV pools are the one genuinely "
+        "sharded",
+        "tensor left (heads axis over tp), and the row/table int32 "
+        "vectors",
+        "replicate.",
+        "",
+    ]
+    return "\n".join(lines)

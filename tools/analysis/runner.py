@@ -1,4 +1,4 @@
-"""mxlint entry point — run all four analyzers against the live repo.
+"""mxlint entry point — run all five analyzers against the live repo.
 
 Usage (from the repo root)::
 
@@ -9,8 +9,15 @@ Usage (from the repo root)::
                                              # iteration default in
                                              # tools/run_static_analysis.sh)
     python -m tools.analysis --all           # full run (tier-1 scope)
-    python -m tools.analysis --json          # machine-readable report
-    python -m tools.analysis --write-baseline  # accept current findings
+    python -m tools.analysis --format json   # machine-readable findings
+                                             # (stable schema: rule, file,
+                                             # line, message, fingerprint)
+    python -m tools.analysis --write-baseline    # accept current findings
+    python -m tools.analysis --update-budgets    # re-record graphlint's
+                                                 # HBM manifest (never
+                                                 # relaxes a budget)
+    python -m tools.analysis --write-sharding-audit  # regenerate
+                                                 # docs/sharding_readiness.md
 
 Tier-1 wiring: ``tests/test_static_analysis.py`` calls :func:`run_all`
 directly (always full scope); ``tools/run_static_analysis.sh`` is the
@@ -19,16 +26,18 @@ CLI wrapper that also smokes the sanitizer builds.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
 import sys
 from typing import Dict, List, Optional, Set
 
-from . import abi, jaxlint, native_lint, pylocklint
+from . import abi, graphlint, jaxlint, native_lint, pylocklint
 from .findings import Finding, load_baseline, split_new
 
-__all__ = ["REPO_ROOT", "changed_files", "run_all", "main"]
+__all__ = ["REPO_ROOT", "changed_files", "run_all", "fingerprint",
+           "findings_json", "main"]
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -84,7 +93,9 @@ def run_all(root: str = None, baseline_path: str = None,
     cross-module passes still parse their whole scope, so a change in
     one module that breaks an invariant ANCHORED in another is only
     guaranteed to surface on a full run — which is why tier-1 always
-    runs full scope."""
+    runs full scope.  graphlint scopes by *trace closure* instead: a
+    program re-traces when any file its last recorded trace touched
+    changed (see ``graphlint._needs_trace``)."""
     root = root or REPO_ROOT
     # changed_files() returning None (git unavailable) degrades to a
     # full run — `only is None` means unscoped everywhere below
@@ -97,30 +108,98 @@ def run_all(root: str = None, baseline_path: str = None,
     findings += jaxlint.run(root, only=only)
     findings += native_lint.run(root, only=only)
     findings += pylocklint.run(root, only=only)
+    findings += graphlint.run(root, only=only)
     baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
     new, old = split_new(findings, baseline)
     return {"findings": findings, "new": new, "baselined": old,
             "changed": sorted(only) if only is not None else None}
 
 
+def fingerprint(f: Finding) -> str:
+    """Stable finding id for CI annotation — sha1 of the
+    line-independent baseline key, so unrelated edits do not churn
+    annotations."""
+    return hashlib.sha1(f.key.encode()).hexdigest()[:12]
+
+
+def findings_json(report: Dict) -> Dict:
+    """The ``--format json`` schema (stable; CI consumes it):
+    ``{"version": 1, "findings": [{rule, file, line, message,
+    fingerprint, analyzer, symbol, status}], "new": N,
+    "baselined": M}``."""
+    out = []
+    for status, fs in (("new", report["new"]),
+                       ("baselined", report["baselined"])):
+        for f in fs:
+            out.append({"rule": f.rule, "file": f.path, "line": f.line,
+                        "message": f.message,
+                        "fingerprint": fingerprint(f),
+                        "analyzer": f.analyzer, "symbol": f.symbol,
+                        "status": status})
+    return {"version": 1, "findings": out,
+            "new": len(report["new"]),
+            "baselined": len(report["baselined"])}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mxlint", description="repo static-analysis suite "
-        "(C-ABI / JAX hazards / native + Python concurrency)")
+        "(C-ABI / JAX hazards / native + Python concurrency / "
+        "compiled-program graphs)")
     ap.add_argument("--root", default=REPO_ROOT)
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
-    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text",
+                    help="output format; json is the stable "
+                         "machine-readable schema (rule, file, line, "
+                         "message, fingerprint) for CI annotation")
     ap.add_argument("--changed-only", action="store_true",
                     help="report only files changed vs the merge-base "
                          "(iteration mode — seconds, not the full "
-                         "sweep)")
+                         "sweep); graphlint re-traces only programs "
+                         "whose recorded trace closure changed")
     ap.add_argument("--all", action="store_true",
                     help="full scope (the tier-1 default; overrides "
                          "--changed-only)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept every current finding into the "
                          "baseline (review the diff!)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="re-record graphlint's per-program peak-live "
+                         "bytes + trace closures in hbm_budgets.json "
+                         "(ALWAYS full scope; never relaxes a budget)")
+    ap.add_argument("--write-sharding-audit", action="store_true",
+                    help="regenerate the sharding-readiness audit "
+                         "table (docs/sharding_readiness.md)")
     args = ap.parse_args(argv)
+    fmt = "json" if args.json else args.format
+
+    if args.update_budgets or args.write_sharding_audit:
+        # graphlint traces the IMPORTED checkout — a foreign --root
+        # would write this checkout's measurements into the other
+        # tree's manifest paths (or vice versa); refuse the mix
+        if os.path.realpath(args.root) != os.path.realpath(REPO_ROOT):
+            print("mxlint: --update-budgets/--write-sharding-audit "
+                  "audit the imported checkout (%s) and do not honor "
+                  "--root; run them from the target checkout"
+                  % REPO_ROOT, file=sys.stderr)
+            return 2
+
+    if args.update_budgets:
+        data = graphlint.update_budgets(args.root)
+        for name, e in sorted(data["programs"].items()):
+            print("graphlint: %-24s peak=%d budget=%d"
+                  % (name, e["peak_bytes"], e["budget_bytes"]))
+        print("graphlint: wrote %s" % graphlint.BUDGETS_PATH)
+        return 0
+    if args.write_sharding_audit:
+        path = os.path.join(args.root, graphlint.AUDIT_PATH)
+        with open(path, "w") as f:
+            f.write(graphlint.sharding_audit_md(args.root))
+        print("graphlint: wrote %s" % path)
+        return 0
 
     # --write-baseline must see the FULL finding set: writing from a
     # changed-only scope would silently drop baseline entries for
@@ -128,7 +207,7 @@ def main(argv=None) -> int:
     report = run_all(args.root, args.baseline,
                      changed_only=args.changed_only and not args.all
                      and not args.write_baseline)
-    if report.get("changed") is not None and not args.json:
+    if report.get("changed") is not None and fmt != "json":
         print("mxlint: --changed-only over %d changed file(s)"
               % len(report["changed"]))
     if args.write_baseline:
@@ -143,11 +222,8 @@ def main(argv=None) -> int:
               % (len(entries), args.baseline))
         return 0
 
-    if args.json:
-        print(json.dumps({
-            "new": [vars(f) for f in report["new"]],
-            "baselined": [vars(f) for f in report["baselined"]],
-        }, indent=2))
+    if fmt == "json":
+        print(json.dumps(findings_json(report), indent=2))
     else:
         for f in report["new"]:
             print("NEW  %s" % f)

@@ -1,11 +1,17 @@
 #!/bin/sh
-# Single entry point for the mxlint static-analysis suite (ISSUE 4/7):
-#   1. the four analyzers (C-ABI / JAX hazards / native concurrency /
-#      Python concurrency) — pure parsing, fails on any NEW violation
-#      vs baseline/pragmas.  DEFAULT SCOPE: --changed-only (files
-#      changed vs the merge-base + working tree), so iteration costs
-#      seconds; pass --all for the full tier-1 sweep (what
-#      tests/test_static_analysis.py always runs).
+# Single entry point for the mxlint static-analysis suite (ISSUE 4/7/8):
+#   1. the five analyzers (C-ABI / JAX hazards / native concurrency /
+#      Python concurrency / compiled-program graphs) — fails on any NEW
+#      violation vs baseline/pragmas.  DEFAULT SCOPE: --changed-only
+#      (files changed vs the merge-base + working tree; graphlint
+#      re-traces only programs whose recorded trace closure changed),
+#      so iteration costs seconds; pass --all for the full tier-1
+#      sweep (what tests/test_static_analysis.py always runs).  Other
+#      flags pass through (section headers go to stderr so the
+#      analyzer's stdout stays clean) — but for pure-JSON CI output
+#      call `python -m tools.analysis --format json` directly: this
+#      wrapper also runs the sanitizer smoke, whose pytest output
+#      follows on stdout.
 #   2. sanitizer smoke, delegated to tests/test_native_sanitize.py so
 #      the sanitizer matrix (flags, env, binaries, toolchain probe,
 #      skip reasons) lives in exactly one place — the test module
@@ -16,15 +22,27 @@
 set -e
 cd "$(dirname "$0")/.."
 
+# pull --all out of the positional params, keeping the rest intact as
+# "$@" so pass-through args survive word splitting (paths with spaces)
 SCOPE="--changed-only"
-for arg in "$@"; do
-    [ "$arg" = "--all" ] && SCOPE="--all"
+n=$#
+i=0
+while [ "$i" -lt "$n" ]; do
+    arg=$1
+    shift
+    if [ "$arg" = "--all" ]; then
+        SCOPE="--all"
+    else
+        set -- "$@" "$arg"
+    fi
+    i=$((i + 1))
 done
 
-echo "== mxlint analyzers ($SCOPE) =="
-python -m tools.analysis --baseline tools/analysis/baseline.json $SCOPE
+echo "== mxlint analyzers ($SCOPE) ==" >&2
+python -m tools.analysis --baseline tools/analysis/baseline.json \
+    $SCOPE "$@"
 
-echo "== sanitizer smoke (tests/test_native_sanitize.py) =="
+echo "== sanitizer smoke (tests/test_native_sanitize.py) ==" >&2
 python -m pytest tests/test_native_sanitize.py -q -p no:cacheprovider \
     -k "test_all_combined" -rs
-echo "== static analysis: OK =="
+echo "== static analysis: OK ==" >&2
