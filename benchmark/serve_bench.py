@@ -66,6 +66,19 @@ and external measurements subtract cleanly.
   decode accept×K sweep on the mixed Poisson workload (spec_K =
   0/2/4, tok/s + accept rate + tokens/step per row); ``--spec-K N``
   arms speculation on the headline e2e engine run instead.
+* ``tp`` (round 14, ``--tp N``) — tensor-parallel serving on the
+  8-device VIRTUAL CPU mesh (the same
+  ``--xla_force_host_platform_device_count`` mechanism the MULTICHIP
+  dry-runs use; requested before jax initializes, so ``--tp`` runs as
+  its own invocation — ENFORCED: the other sections are skipped, as
+  their recorded numbers assume the single-device host topology the
+  virtual mesh replaces): the closed-loop engine run at tp=1 and
+  tp=N on the identical workload, reporting tok/s, per-device
+  KV-pool bytes held/pooled (the ~1/tp claim), and a full f32-greedy
+  TOKEN-IDENTITY cross-check between the two (raises on the first
+  divergent request).  Off-chip the tok/s pair prices XLA:CPU's
+  sharded-collective overhead, not ICI — the per-device-bytes and
+  identity columns are the claims; the chip prices the speed.
 
 The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
 ``gpt_serve_metrics_overhead_pct`` / ``gpt_serve_prefix_hit_ttft_ms``
@@ -615,6 +628,74 @@ def run_gate_prefix(preset="full"):
     return out
 
 
+# --------------------------------------------- round-14 tensor parallel ---
+
+def run_tp(params, cfg, p, workload, tp):
+    """The ``--tp`` section: the engine at tp=1 vs tp=N on the
+    IDENTICAL workload (closed loop: submit everything, drain), with a
+    full token-identity cross-check — every request's output must be
+    bit-equal between the two (f32 greedy; RuntimeError otherwise).
+    Rows report tok/s, wall, and the per-device KV-pool accounting
+    behind the ~1/tp claim (pages shard the heads axis, so
+    ``hbm_held_per_device == hbm_held / tp`` exactly)."""
+    import jax
+    from mxnet_tpu.serving import ServingEngine
+    if tp > len(jax.devices()):
+        # fail BEFORE the tp=1 leg burns minutes of benchmark time
+        # on a run whose tp=N twin can never construct
+        raise SystemExit(
+            "serve_bench --tp %d: only %d device(s) visible (the "
+            "virtual CPU mesh provides 8)" % (tp, len(jax.devices())))
+    max_total = max(len(pr) + n for _, pr, n in workload)
+    pps = -(-max_total // p.page_size)
+    rows, outs = [], {}
+    for deg in (1, tp):
+        eng = ServingEngine(params, cfg, num_slots=p.num_slots,
+                            page_size=p.page_size, pages_per_slot=pps,
+                            prefill_chunk=p.prefill_chunk, tp=deg)
+        # pre-warm the compiled (and, at tp>1, mesh-lowered) step;
+        # drop the warmup's stats so the reported steps/preemptions
+        # cover exactly the timed window the tok/s covers
+        wid = eng.submit(workload[0][1], workload[0][2])
+        eng.run()
+        del eng.requests[wid]
+        for k in eng.stats:
+            eng.stats[k] = type(eng.stats[k])()
+        rids = []
+        t0 = time.perf_counter()
+        for _, prompt, n in workload:
+            rids.append(eng.submit(prompt, n))
+        peak_held = 0
+        while True:
+            r = eng.step()
+            peak_held = max(peak_held, eng.hbm_held)
+            if r is False:
+                break
+        wall = time.perf_counter() - t0
+        outs[deg] = [eng.requests[rid].output for rid in rids]
+        useful = sum(n for _, _, n in workload)
+        rows.append({
+            "section": "tp", "config": "tp%d" % deg, "tp": deg,
+            "tok_s": useful / wall, "wall_s": wall,
+            "hbm_peak_held": peak_held,
+            "hbm_peak_held_per_device": peak_held // deg,
+            "hbm_pool": eng.hbm_pool,
+            "hbm_pool_per_device": eng.hbm_pool_per_device,
+            "preemptions": eng.stats["preemptions"],
+            "steps": eng.stats["steps"]})
+    mismatches = sum(
+        not np.array_equal(a, b) for a, b in zip(outs[1], outs[tp]))
+    if mismatches:
+        raise RuntimeError(
+            "serve_bench --tp: %d/%d requests diverge between tp=1 "
+            "and tp=%d — the f32-greedy identity contract is broken"
+            % (mismatches, len(workload), tp))
+    for r in rows:
+        r["identity_checked"] = len(workload)
+        r["identity_mismatches"] = 0
+    return rows
+
+
 # ------------------------------------------------- round-11 decode levers ---
 
 def _decode_heavy_workload(p, n=None, seed=0):
@@ -842,6 +923,14 @@ def main(argv=None):
                          "drafter by a controlled-accept oracle "
                          "(propose the true greedy continuation with "
                          "probability A) — the break-even instrument")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="run the round-14 tensor-parallel section: "
+                         "engine at tp=1 vs tp=N on an 8-device "
+                         "virtual CPU mesh (per-device HBM held, "
+                         "tok/s, full tp={1,N} token-identity "
+                         "cross-check).  Must be its own invocation "
+                         "(the virtual mesh is requested before jax "
+                         "initializes)")
     ap.add_argument("--replicas", type=int, default=0, metavar="N",
                     help="run the round-10 cluster section over N "
                          "ServingEngine replicas (prefix-cache on/off "
@@ -863,11 +952,53 @@ def main(argv=None):
     if args.trace and args.no_telemetry:
         ap.error("--trace needs the telemetry section; drop "
                  "--no-telemetry")
+    if args.tp > 1:
+        # request the virtual CPU mesh BEFORE anything below imports
+        # jax (the same mechanism the tests' conftest and the
+        # MULTICHIP dry-runs use); a no-op if the flag is already
+        # present or a real multi-chip backend is up
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
     p = PRESETS["quick" if args.quick else args.preset]
 
     params, cfg = _model(p)
     wl = _workload(p, seed=args.seed)
     rows = []
+
+    if args.tp > 1:
+        # the tp section runs ALONE (the help text's "own invocation",
+        # enforced): the 8-virtual-device topology changes XLA:CPU
+        # threading, so every other section's numbers would be
+        # measured on a different host shape than their recorded
+        # baselines
+        print("--tp: virtual %d-device mesh active; running the tp "
+              "section only (other sections need their recorded "
+              "single-device topology)" % 8, flush=True)
+        tp_rows = run_tp(params, cfg, p, wl, args.tp)
+        rows.extend(tp_rows)
+        for r in tp_rows:
+            print(json.dumps(r), flush=True)
+        t1, tN = tp_rows
+        # both pairs read tp=1 first, matching the sentence's
+        # "tp=1 vs tp=N" order
+        print("tp identity: %d/%d requests token-identical tp=1 vs "
+              "tp=%d; per-device pool %d B -> %d B (1/%d = %.3fx); "
+              "tok/s %.0f -> %.0f (virtual CPU mesh — collective "
+              "overhead, not ICI)"
+              % (t1["identity_checked"] - tN["identity_mismatches"],
+                 t1["identity_checked"], args.tp,
+                 t1["hbm_pool_per_device"], tN["hbm_pool_per_device"],
+                 args.tp,
+                 tN["hbm_pool_per_device"]
+                 / max(1, t1["hbm_pool_per_device"]),
+                 t1["tok_s"], tN["tok_s"]), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rows, f, indent=1)
+        return 0
 
     # baseline batch = half the engine's slots, engine pool = the
     # baseline's contiguous HBM: equal memory, 2x the concurrency

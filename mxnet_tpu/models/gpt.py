@@ -19,7 +19,8 @@ from . import transformer as T
 
 __all__ = ["gpt_config", "gpt_tiny", "init_params", "forward",
            "make_train_step", "generate", "generate_speculative",
-           "quantize_decode_params", "draft_slice_params"]
+           "quantize_decode_params", "decode_param_specs",
+           "draft_slice_params"]
 
 
 def gpt_config(**kw):
@@ -122,6 +123,54 @@ def quantize_decode_params(params):
             for k in ("w1", "w2"):
                 nl[k] = q_cols(layer[k])
         layers.append(nl)
+    out["layers"] = layers
+    return out
+
+
+def decode_param_specs(params, cfg, tp="tp"):
+    """Megatron partition rules for the DECODE param tree — float or
+    ``quantize_decode_params`` weight-only int8 — as a mesh-free
+    ``PartitionSpec`` pytree matching ``params`` leaf-for-leaf.
+
+    Float leaves take their ``transformer.param_specs`` rule verbatim.
+    int8 ``{"q", "s"}`` leaves DERIVE theirs from the float weight's
+    rule (the ``docs/sharding_readiness.md`` derivation, now live
+    code): ``q`` keeps the full 2-D rule (same shape as the float
+    weight), and the 1-D scale ``s`` takes the rule entry of the dim
+    it indexes — per-COLUMN for the matmul weights (``q_cols``: s is
+    (out,), rule entry 1) and per-ROW for the embedding table
+    (``q_rows``: s is (vocab,), rule entry 0).  So a ``P(None, tp)``
+    weight yields ``s = P(tp)`` (w1/wq/…), a ``P(tp, None)`` weight
+    yields a replicated ``s`` (wo/w2 — the out dim is unsharded), and
+    ``tok_emb``'s per-row scales replicate.
+
+    The serving engine binds these to its mesh
+    (``serving/engine.py step_input_specs``); heads partition because
+    the qkv out-dims shard over ``tp`` and ``d_model/n_heads`` stays
+    whole — attention is head-local (softmax and the int8-KV quant
+    stats reduce over head_dim only, no cross-head collective), and
+    the one cross-device reduce is the ``P(tp, None)`` output
+    projection GSPMD already handles."""
+    from jax.sharding import PartitionSpec as P
+
+    # ep=None: the serving mesh has no expert axis — MoE layers (when
+    # present) declare experts replicated and only their FFN hidden
+    # dim tp-sharded, so the specs bind over a 'tp'-only mesh
+    base = T.param_specs(cfg, tp=tp, ep=None)
+
+    def derive(leaf, spec, per_row=False):
+        if isinstance(leaf, dict) and "q" in leaf and "s" in leaf:
+            entries = tuple(spec) + (None,) * (2 - len(tuple(spec)))
+            return {"q": spec,
+                    "s": P(entries[0] if per_row else entries[1])}
+        return spec
+
+    out = {k: derive(params[k], base[k], per_row=(k == "tok_emb"))
+           for k in params if k != "layers"}
+    layers = []
+    for layer, rules in zip(params["layers"], base["layers"]):
+        layers.append({k: derive(layer[k], rules[k])
+                       for k in layer})
     out["layers"] = layers
     return out
 

@@ -150,45 +150,62 @@ def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
             and i % cfg.moe_every == cfg.moe_every - 1)
 
 
-def param_shardings(cfg: TransformerConfig, mesh):
-    """NamedSharding pytree matching init_params: tp shards the hidden
-    dims, everything else replicated (scaling-book megatron layout)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def param_specs(cfg: TransformerConfig, tp="tp", ep="ep"):
+    """Megatron partition rules as a MESH-FREE ``PartitionSpec`` pytree
+    matching init_params: tp shards the hidden dims, everything else
+    replicated (scaling-book megatron layout).  ``tp``/``ep`` name the
+    mesh axes (pass ``None`` to drop an axis from the specs, e.g. for
+    a mesh without it).  ``param_shardings`` binds these to a mesh;
+    the serving engine's declared shardings (``serving/engine.py
+    step_input_specs``) and graphlint's sharding-readiness audit both
+    derive from THIS table, so there is exactly one copy of the
+    rules."""
+    from jax.sharding import PartitionSpec as P
 
-    has_tp = "tp" in mesh.axis_names
-    tp = "tp" if has_tp else None
+    rep = P()
 
-    def ns(*spec):
-        return NamedSharding(mesh, P(*spec))
-
-    rep = ns()
-
-    def layer_sharding(i):
+    def layer_spec(i):
         layer = {
-            "wq": ns(None, tp), "wk": ns(None, tp), "wv": ns(None, tp),
-            "wo": ns(tp, None),
-            "bq": ns(tp), "bk": ns(tp), "bv": ns(tp), "bo": rep,
+            "wq": P(None, tp), "wk": P(None, tp), "wv": P(None, tp),
+            "wo": P(tp, None),
+            "bq": P(tp), "bk": P(tp), "bv": P(tp), "bo": rep,
             "ln1": {"g": rep, "b": rep},
             "ln2": {"g": rep, "b": rep},
         }
         if _is_moe_layer(cfg, i):
-            from ..parallel.moe import moe_param_shardings
-            layer["moe"] = moe_param_shardings(mesh)
+            from ..parallel.moe import moe_param_specs
+            layer["moe"] = moe_param_specs(tp=tp, ep=ep)
         else:
-            layer.update({"w1": ns(None, tp), "b1": ns(tp),
-                          "w2": ns(tp, None), "b2": rep})
+            layer.update({"w1": P(None, tp), "b1": P(tp),
+                          "w2": P(tp, None), "b2": rep})
         return layer
 
     return {
-        "tok_emb": ns(None, tp),
-        "pos_emb": ns(None, tp),
-        "type_emb": ns(None, tp),
+        "tok_emb": P(None, tp),
+        "pos_emb": P(None, tp),
+        "type_emb": P(None, tp),
         "emb_ln": {"g": rep, "b": rep},
-        "mlm_dense": ns(None, tp),
+        "mlm_dense": P(None, tp),
         "mlm_ln": {"g": rep, "b": rep},
         "mlm_bias": rep,
-        "layers": [layer_sharding(i) for i in range(cfg.n_layers)],
+        "layers": [layer_spec(i) for i in range(cfg.n_layers)],
     }
+
+
+def param_shardings(cfg: TransformerConfig, mesh):
+    """NamedSharding pytree matching init_params — ``param_specs``
+    bound to ``mesh`` (axes the mesh lacks are dropped from the
+    specs)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    specs = param_specs(
+        cfg,
+        tp="tp" if "tp" in mesh.axis_names else None,
+        ep="ep" if "ep" in mesh.axis_names else None)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
 
 
 # ---------------------------------------------------------------------------

@@ -3,8 +3,8 @@
 Replaces the reference's KVStore/NCCL/ps-lite stack (SURVEY.md §2.4) with
 XLA collectives over a ``jax.sharding.Mesh``.
 """
-from .mesh import (make_mesh, default_mesh, current_mesh, mesh_scope,
-                   live_axis)
+from .mesh import (make_mesh, default_mesh, serving_mesh, current_mesh,
+                   mesh_scope, live_axis)
 from .data_parallel import DataParallelTrainer
 from .ring_attention import (ring_attention, ulysses_attention,
                              sequence_parallel_attention)

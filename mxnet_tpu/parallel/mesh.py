@@ -18,8 +18,8 @@ from typing import Optional, Sequence, Tuple
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "default_mesh", "current_mesh", "mesh_scope",
-           "live_axis", "shard_map_compat"]
+__all__ = ["make_mesh", "default_mesh", "serving_mesh", "current_mesh",
+           "mesh_scope", "live_axis", "shard_map_compat"]
 
 _CURRENT = []
 
@@ -96,6 +96,32 @@ def make_mesh(shape: Optional[dict] = None, devices=None):
 def default_mesh():
     """All devices on one ``dp`` axis."""
     return make_mesh()
+
+
+def serving_mesh(tp=1, devices=None):
+    """Serving-shaped mesh: one ``tp`` axis over the first ``tp``
+    devices.  The serving engine is single-program (no batch axis to
+    data-parallelize inside one replica — scale-out is the
+    ``ServingCluster``'s job), so its mesh is one tensor-parallel axis
+    and nothing else; the megatron rules in ``models/transformer.py``
+    and the engine's pool/row specs (``serving/engine.py
+    step_input_specs``) name only ``tp``.  Devices beyond ``tp`` stay
+    free for other replicas/work."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if tp < 1:
+        raise MXNetError("serving_mesh: tp must be >= 1, got %r"
+                         % (tp,))
+    if tp > len(devices):
+        raise MXNetError(
+            "serving_mesh: tp=%d needs %d devices but only %d are "
+            "visible (CPU hosts: set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N before jax "
+            "initializes — the virtual mesh the MULTICHIP dry-runs "
+            "use)" % (tp, tp, len(devices)))
+    return make_mesh({"tp": tp}, devices=list(devices)[:tp])
 
 
 class mesh_scope:

@@ -20,7 +20,8 @@ from typing import Optional
 
 from ..base import MXNetError
 
-__all__ = ["init_moe_ffn", "moe_ffn", "moe_param_shardings"]
+__all__ = ["init_moe_ffn", "moe_ffn", "moe_param_specs",
+           "moe_param_shardings"]
 
 
 def init_moe_ffn(key, d_model, d_ff, n_experts, param_dtype="float32"):
@@ -41,23 +42,31 @@ def init_moe_ffn(key, d_model, d_ff, n_experts, param_dtype="float32"):
     }
 
 
+def moe_param_specs(tp="tp", ep="ep"):
+    """Mesh-free ``PartitionSpec`` pytree matching init_moe_ffn:
+    experts over ``ep``, FFN hidden dim over ``tp`` (pass ``None`` to
+    drop an axis) — the spec twin ``moe_param_shardings`` binds."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "router": P(),
+        "w1": P(ep, None, tp),
+        "b1": P(ep, tp),
+        "w2": P(ep, tp, None),
+        "b2": P(ep, None),
+    }
+
+
 def moe_param_shardings(mesh):
     """NamedSharding pytree matching init_moe_ffn: experts over ``ep``,
     FFN hidden dim over ``tp`` when present."""
+    import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    ep = "ep" if "ep" in mesh.axis_names else None
-    tp = "tp" if "tp" in mesh.axis_names else None
-
-    def ns(*spec):
-        return NamedSharding(mesh, P(*spec))
-
-    return {
-        "router": ns(),
-        "w1": ns(ep, None, tp),
-        "b1": ns(ep, tp),
-        "w2": ns(ep, tp, None),
-        "b2": ns(ep, None),
-    }
+    specs = moe_param_specs(
+        tp="tp" if "tp" in mesh.axis_names else None,
+        ep="ep" if "ep" in mesh.axis_names else None)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def _top_k_gating(gates, k):

@@ -203,6 +203,13 @@ class ServingCluster:
     unchanged because committed tokens are committed tokens however
     many a step produced (recompute-exact resume replays them as
     prompt extension, pinned by ``tests/test_serving_cluster.py``).
+    ``tp=N``/``mesh=`` (round 14) likewise: every replica lowers its
+    step through the same tensor-parallel mesh, and the whole engine
+    config is captured ONCE (``_engine_kwargs``) so a failover
+    resubmission always lands on a survivor with identical tp/mesh
+    setup (``tests/test_serving_tp.py`` pins failover-under-tp).
+    On one host the replicas time-share the same tp devices — the
+    scale-out story across hosts is ROADMAP item 3.
     """
 
     def __init__(self, params, cfg, *, replicas=2, num_slots,
@@ -212,7 +219,7 @@ class ServingCluster:
                  watchdog_s=30.0, affinity_slack=None,
                  affinity_capacity=4096, retain_results=4096,
                  kernel="xla", spec_K=0, spec_drafter="ngram",
-                 spec_ngram=2):
+                 spec_ngram=2, tp=1, mesh=None):
         if replicas < 1:
             raise ValueError("ServingCluster: replicas must be >= 1")
         self.num_slots = num_slots
@@ -246,15 +253,42 @@ class ServingCluster:
             metrics = registry is not None or \
                 os.environ.get("MXNET_SERVING_METRICS", "0") == "1"
         self._obs = _ClusterObs(registry) if metrics else None
+        # ONE captured engine config (round 14): every replica — and
+        # any future re-admission target — is built from this dict, so
+        # a request resubmitted to a survivor after failover lands on
+        # an engine with the SAME tp/mesh/kernel/spec setup as the one
+        # that died.  Previously the kwargs were splatted ad hoc at
+        # the construction site only; adding an engine knob meant
+        # remembering to thread it here by hand.
+        if tp > 1 or mesh is not None:
+            # build the mesh and commit the params into their megatron
+            # shards ONCE, cluster-wide: every replica's engine then
+            # sees already-correctly-placed arrays and its device_put
+            # is a no-op — without this, R replicas would each retain
+            # an independent sharded copy of the weights on the same
+            # tp devices (R× the per-device weight bytes the tp story
+            # exists to divide)
+            import jax
+            from ..models import gpt as G
+            from ..parallel.mesh import serving_mesh
+            from .engine import _bind
+            if mesh is None:
+                mesh = serving_mesh(tp)
+            if int(mesh.shape.get("tp", 1)) > 1:
+                params = jax.device_put(
+                    params, _bind(mesh,
+                                  G.decode_param_specs(params, cfg)))
+        self._engine_kwargs = dict(
+            num_slots=num_slots, page_size=page_size,
+            num_pages=num_pages, pages_per_slot=pages_per_slot,
+            prefill_chunk=prefill_chunk, kv_int8=kv_int8,
+            prefix_cache=prefix_cache, metrics=bool(metrics),
+            kernel=kernel, spec_K=spec_K, spec_drafter=spec_drafter,
+            spec_ngram=spec_ngram, tp=tp, mesh=mesh)
         self.replicas: List[_Replica] = []
         for i in range(replicas):
-            eng = ServingEngine(
-                params, cfg, num_slots=num_slots, page_size=page_size,
-                num_pages=num_pages, pages_per_slot=pages_per_slot,
-                prefill_chunk=prefill_chunk, kv_int8=kv_int8,
-                prefix_cache=prefix_cache, metrics=bool(metrics),
-                rid_start=i * RID_BLOCK, kernel=kernel, spec_K=spec_K,
-                spec_drafter=spec_drafter, spec_ngram=spec_ngram)
+            eng = ServingEngine(params, cfg, rid_start=i * RID_BLOCK,
+                                **self._engine_kwargs)
             self.replicas.append(_Replica(i, eng))
         # pre-warm the (shared) step program BEFORE workers and the
         # watchdog start: a first-step compile longer than watchdog_s

@@ -45,10 +45,29 @@ Round 11 adds the two raw-decode-speed levers from ROADMAP item 2:
   position before any mask exposes them (the ``_decode_block``
   argument, serving edition).
 
+Round 14 scales the engine UP, not just out: ``tp=N`` lowers the one
+step program through a ``parallel/mesh.py`` tensor-parallel mesh.
+Params shard by the megatron rules the training side already uses
+(``models/transformer.py param_specs``; int8 ``{"q","s"}`` specs
+derived — ``models/gpt.py decode_param_specs``), the paged KV pools
+shard their HEADS axis (``P(None, None, 'tp', None)``) so each device
+holds 1/tp of every page, and every host-built row/table input
+replicates.  The scheduler above is untouched: page ids, block
+tables, free lists, and the prefix trie are host state meaning "this
+slice of every device's shard".  Attention needs no cross-head
+collective (softmax and int8-KV quant stats reduce over head_dim,
+which stays whole); the output projection's ``P('tp', None)``
+contraction is the one GSPMD-inserted reduce per layer.  Declared
+shardings live in :func:`step_input_specs`, which graphlint's
+sharding-readiness audit verifies against the megatron rule table
+(``docs/sharding_readiness.md``, UNCOVERED = 0) and whose pool
+donation stays pinned by ``graph-donation``.
+
 Exactness: under f32 greedy, engine outputs are token-identical to
 ``models/gpt.py generate`` per request, whatever the batch mix,
-admission order, page reuse, preemptions, kernel choice, or drafter
-quality — pinned by ``tests/test_serving.py``.
+admission order, page reuse, preemptions, kernel choice, drafter
+quality, or tp degree — pinned by ``tests/test_serving.py`` and
+``tests/test_serving_tp.py``.
 
 Telemetry (round 8, ``mxnet_tpu/obs``): with ``metrics=True`` (or
 ``MXNET_SERVING_METRICS=1``) the engine feeds a per-engine
@@ -79,7 +98,70 @@ from . import drafters
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["Request", "ServingEngine", "step_input_specs",
+           "step_output_specs"]
+
+
+def step_input_specs(params, cfg, kv_int8, tp="tp"):
+    """The ENGINE'S DECLARED shardings: a mesh-free ``PartitionSpec``
+    pytree for every input of the step program, positionally matching
+    ``_make_step``'s ``(params, pools, tokens, row_slot, row_pos,
+    row_live, bt, slot_rows)`` signature.
+
+    * params — the megatron rules via ``models/gpt.py
+      decode_param_specs`` (int8 q/s specs derived from the float
+      rules);
+    * pools — heads-sharded pages, ``PagedKVCache.POOL_SPEC``
+      (= P(None, None, 'tp', None) on the (pages, page_size, H, 2*dh)
+      layout; the f32 scale pool shards its H axis identically);
+    * everything host-built (token rows, slot/pos/live vectors, block
+      tables, sampling-row matrix) — replicated.
+
+    graphlint's sharding-readiness audit verifies THIS table against
+    the megatron rules and pins ``docs/sharding_readiness.md`` to it
+    (UNCOVERED count 0); the engine binds it to its mesh.  Mesh-free
+    so the FAST-tier spec test needs no devices."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import gpt as G
+    from .paged_kv import PagedKVCache
+
+    pool_spec = P(*[tp if a == "tp" else a
+                    for a in PagedKVCache.POOL_SPEC])
+    pool = {"kv": pool_spec}
+    if kv_int8:
+        pool["s"] = pool_spec
+    rep = P()
+    return (G.decode_param_specs(params, cfg, tp=tp),
+            [dict(pool) for _ in range(cfg.n_layers)],
+            rep, rep, rep, rep, rep, rep)
+
+
+def step_output_specs(cfg, kv_int8, tp="tp"):
+    """Output twin of ``step_input_specs``: the (S, n_sample) argmax
+    matrix replicates (the host reads it every step — the one
+    intended sync), the returned pools keep the input pool sharding
+    (shape/dtype AND sharding match is what keeps donation aliasing
+    the buffers in place — the ``graph-donation`` gate)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .paged_kv import PagedKVCache
+
+    pool_spec = P(*[tp if a == "tp" else a
+                    for a in PagedKVCache.POOL_SPEC])
+    pool = {"kv": pool_spec}
+    if kv_int8:
+        pool["s"] = pool_spec
+    return (P(), [dict(pool) for _ in range(cfg.n_layers)])
+
+
+def _bind(mesh, tree):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree,
+        is_leaf=lambda x: isinstance(x, P))
 
 
 @dataclasses.dataclass
@@ -136,14 +218,16 @@ _STEP_CACHE_MAX = 8
 _copy_cache: Dict[Any, Any] = {}
 
 
-def _make_copy(cfg, kv_int8):
+def _make_copy(cfg, kv_int8, mesh=None):
     """Jitted whole-page pool copy (COW at a shared-prefix
     divergence).  Page ids are traced scalars, so one compilation per
     pool config covers every (src, dst) pair and every engine whose
-    pools share that config."""
+    pools share that config.  With ``mesh`` the copy rides the same
+    heads-sharded pool placement as the step program (donation
+    preserved — the pools stay in place per device, no reshard)."""
     import jax
 
-    key = (cfg, bool(kv_int8))
+    key = (cfg, bool(kv_int8), mesh)
     fn = _copy_cache.get(key)
     if fn is not None:
         return fn
@@ -157,7 +241,15 @@ def _make_copy(cfg, kv_int8):
             out.append(new)
         return out
 
-    fn = jax.jit(copy, donate_argnums=(0,))
+    kw = {}
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        _, pool_shardings = step_output_specs(cfg, kv_int8)
+        pool_shardings = _bind(mesh, pool_shardings)
+        rep = _bind(mesh, P())
+        kw = {"in_shardings": (pool_shardings, rep, rep),
+              "out_shardings": pool_shardings}
+    fn = jax.jit(copy, donate_argnums=(0,), **kw)
     if len(_copy_cache) >= _STEP_CACHE_MAX:
         _copy_cache.pop(next(iter(_copy_cache)))
     _copy_cache[key] = fn
@@ -165,7 +257,8 @@ def _make_copy(cfg, kv_int8):
 
 
 def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
-               kv_int8, kernel="xla", n_sample=1):
+               kv_int8, kernel="xla", n_sample=1, mesh=None,
+               params=None):
     """Build (and cache) the jitted unified prefill+decode step.
 
     ``kernel`` selects the decode-attention implementation: ``"xla"``
@@ -179,6 +272,15 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     its pending token plus K draft rows and the host verifies the
     drafts against the returned per-row argmaxes.
 
+    With ``mesh`` (round 14, tensor-parallel serving) the ONE step is
+    lowered through the mesh: ``in_shardings``/``out_shardings`` from
+    the engine's declared spec table (``step_input_specs`` — megatron
+    rules for params, heads-sharded pools, replicated host rows), and
+    donation of the sharded pools survives because every donated pool
+    leaf has a shape/dtype/sharding-matched output (``params`` is
+    needed for the spec tree's structure only — float vs weight-only
+    int8).
+
     The compiled program is audited by graphlint
     (``tools/analysis/graphlint.py``, tier-1): pool donation is
     verified against the lowering (dropping ``donate_argnums=(1,)``
@@ -189,7 +291,9 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     import jax.numpy as jnp
 
     key = (cfg, num_slots, n_rows, pages_per_slot, page_size,
-           bool(kv_int8), kernel, n_sample)
+           bool(kv_int8), kernel, n_sample, mesh,
+           None if mesh is None
+           else jax.tree_util.tree_structure(params))
     fn = _step_cache.get(key)
     if fn is not None:
         return fn
@@ -287,7 +391,13 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
         next_tok = jnp.argmax(slot_logits, axis=-1).astype(jnp.int32)
         return next_tok, new_pools
 
-    fn = jax.jit(step, donate_argnums=(1,))
+    kw = {}
+    if mesh is not None:
+        kw = {"in_shardings": _bind(
+                  mesh, step_input_specs(params, cfg, kv_int8)),
+              "out_shardings": _bind(
+                  mesh, step_output_specs(cfg, kv_int8))}
+    fn = jax.jit(step, donate_argnums=(1,), **kw)
     if len(_step_cache) >= _STEP_CACHE_MAX:
         _step_cache.pop(next(iter(_step_cache)))
     _step_cache[key] = fn
@@ -505,6 +615,22 @@ class ServingEngine:
         ``f(tokens (n,), K) -> (K,)`` proposing the next K tokens
         (tests use adversarial/oracle callables).
     spec_ngram : n-gram length for the ngram drafter.
+    tp : tensor-parallel degree (round 14).  ``tp > 1`` builds (or
+        accepts via ``mesh=``) a ``parallel/mesh.py`` serving mesh and
+        lowers the ONE compiled step through it: params shard by the
+        megatron rules (int8 q/s specs derived), the paged KV pools
+        shard the HEADS axis (``P(None, None, 'tp', None)`` — each
+        device holds 1/tp of every page), host state (block tables,
+        free lists, the prefix-cache trie, row batches) stays
+        replicated, and pool donation survives the shardings.  Per-
+        device weight and KV-pool bytes drop ~1/tp, so a model ~tp×
+        too big for one chip serves; f32-greedy outputs stay
+        token-identical to ``tp=1`` and to ``generate`` (pinned by
+        ``tests/test_serving_tp.py``).  Requires ``cfg.n_heads % tp
+        == 0`` and ``kernel="xla"`` (the Pallas kernel path is
+        tp=1-only this round — the XLA gather path is the default).
+    mesh : optional pre-built mesh with a ``tp`` axis (e.g.
+        ``parallel.serving_mesh(tp)``); overrides ``tp``.
     rid_start : first request id this engine assigns (a cluster gives
         each replica a disjoint block so rids — and their trace
         swimlanes — are unique cluster-wide).
@@ -522,7 +648,7 @@ class ServingEngine:
                  num_pages=None, pages_per_slot=None, prefill_chunk=8,
                  kv_int8=False, prefix_cache=False, metrics=None,
                  registry=None, rid_start=0, kernel="xla", spec_K=0,
-                 spec_drafter="ngram", spec_ngram=2):
+                 spec_drafter="ngram", spec_ngram=2, tp=1, mesh=None):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -538,6 +664,46 @@ class ServingEngine:
         if spec_drafter != "ngram" and not callable(spec_drafter):
             raise ValueError("ServingEngine: spec_drafter must be "
                              "'ngram' or a callable")
+        if mesh is not None:
+            if "tp" not in mesh.axis_names:
+                raise ValueError("ServingEngine: mesh has no 'tp' "
+                                 "axis (build one with "
+                                 "parallel.serving_mesh)")
+            if tp not in (1, int(mesh.shape["tp"])):
+                raise ValueError(
+                    "ServingEngine: tp=%d disagrees with the mesh's "
+                    "tp axis (%d)" % (tp, mesh.shape["tp"]))
+            tp = int(mesh.shape["tp"])
+        if tp < 1:
+            raise ValueError("ServingEngine: tp must be >= 1")
+        if tp > 1:
+            if cfg.n_heads % tp:
+                raise ValueError(
+                    "ServingEngine: n_heads=%d not divisible by "
+                    "tp=%d — the KV pools shard the heads axis"
+                    % (cfg.n_heads, tp))
+            if kernel == "pallas":
+                raise ValueError(
+                    "ServingEngine: kernel='pallas' is tp=1-only "
+                    "this round (the fused block-table walk is not "
+                    "mesh-lowered); use the default XLA gather path "
+                    "for tp>1")
+            if isinstance(params, dict) and any(
+                    "moe" in layer for layer in params.get("layers",
+                                                           ())):
+                raise ValueError(
+                    "ServingEngine: MoE decode params are tp=1-only "
+                    "this round (expert dispatch is not validated "
+                    "under the serving mesh; experts would replicate "
+                    "with only the FFN hidden dim sharded)")
+            if mesh is None:
+                from ..parallel.mesh import serving_mesh
+                mesh = serving_mesh(tp)
+        self.tp = tp
+        # a trivial tp=1 mesh takes the unsharded single-device path
+        # (sharding constraints over trivial axes are not free on
+        # every backend — the live_axis argument in parallel/mesh.py)
+        self.mesh = mesh if tp > 1 else None
         if pages_per_slot is None:
             pages_per_slot = -(-cfg.max_len // page_size)
         # the attention view may be wider than cfg.max_len (its tail
@@ -550,6 +716,15 @@ class ServingEngine:
                 "ServingEngine: num_pages (%d) cannot hold one "
                 "max-length request (%d pages + scratch)"
                 % (num_pages, pages_per_slot))
+        if self.mesh is not None:
+            # commit the params into their megatron shards NOW: per-
+            # device weight bytes drop ~1/tp from this point on (the
+            # "model ~tp× too big for one chip" half of the claim —
+            # the pools are the other half)
+            import jax
+            params = jax.device_put(
+                params, _bind(self.mesh,
+                              G.decode_param_specs(params, cfg)))
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -567,7 +742,8 @@ class ServingEngine:
         # draft rows are dead padding like everything else
         self.n_rows = num_slots * (1 + self.spec_K) + prefill_chunk
         self.cache = PagedKVCache(cfg, num_pages, page_size,
-                                  kv_int8=self.kv_int8)
+                                  kv_int8=self.kv_int8,
+                                  mesh=self.mesh)
         # shared-prefix page reuse (round 10): content-keyed trie over
         # the pool; the allocator's pressure callback evicts
         # refcount-0 chains before ever refusing a live request
@@ -584,7 +760,8 @@ class ServingEngine:
         self._step_fn = _make_step(cfg, num_slots, self.n_rows,
                                    pages_per_slot, page_size,
                                    self.kv_int8, kernel=self.kernel,
-                                   n_sample=1 + self.spec_K)
+                                   n_sample=1 + self.spec_K,
+                                   mesh=self.mesh, params=self.params)
         self._queue: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * num_slots
         # rid_start: a ServingCluster gives each replica a disjoint
@@ -715,7 +892,8 @@ class ServingEngine:
         module-level keyed-cache program (``_make_copy``); pools are
         donated and update in place like the step program's."""
         if self._copy_fn is None:
-            self._copy_fn = _make_copy(self.cfg, self.kv_int8)
+            self._copy_fn = _make_copy(self.cfg, self.kv_int8,
+                                       mesh=self.mesh)
         self.cache.pools = self._copy_fn(self.cache.pools, src, dst)
 
     def _insert_prefix(self, req):
@@ -1193,3 +1371,13 @@ class ServingEngine:
     @property
     def hbm_pool(self):
         return self.cache.bytes_pool
+
+    @property
+    def hbm_held_per_device(self):
+        """Per-device share of the allocated page bytes (= hbm_held /
+        tp — pages shard the heads axis, so the split is exact)."""
+        return self.cache.bytes_held_per_device
+
+    @property
+    def hbm_pool_per_device(self):
+        return self.cache.bytes_pool_per_device

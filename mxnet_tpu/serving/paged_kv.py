@@ -34,6 +34,16 @@ int8-KV uses the per-(row, token) symmetric-s8 scale layout that
 ``models/gpt.py _kv_quantize`` emits (round 4) — the s pool is the
 paged arrangement of the contiguous ``{"kv", "s"}`` cache's scale
 buffer.
+
+Tensor parallelism (round 14): with ``mesh=`` (a ``parallel/mesh.py``
+mesh carrying a ``tp`` axis) every pool is laid out heads-sharded —
+``P(None, None, 'tp', None)`` on the (num_pages, page_size, **H**,
+2*dh) layout — so each device holds ``1/tp`` of every page's bytes
+(``bytes_held_per_device``).  Everything HOST-side is untouched and
+replicated by construction: the free list, block tables, page ids,
+and the prefix-cache trie are plain Python ints/dicts; a page id
+means "this slice of every device's pool shard", so allocation,
+COW, and prefix reuse are tp-oblivious.
 """
 from __future__ import annotations
 
@@ -65,7 +75,12 @@ class PagedKVCache:
     allocator.  ``pools`` is a list (one dict per layer) shaped for
     the engine's step program; reassign it after every donated call."""
 
-    def __init__(self, cfg, num_pages, page_size, kv_int8=False):
+    # heads-sharded pool placement: the one genuinely tp-sharded
+    # tensor in the serving step program (docs/sharding_readiness.md)
+    POOL_SPEC = (None, None, "tp", None)
+
+    def __init__(self, cfg, num_pages, page_size, kv_int8=False,
+                 mesh=None):
         import jax.numpy as jnp
 
         if num_pages < 2:
@@ -77,22 +92,39 @@ class PagedKVCache:
         self.num_pages = num_pages
         self.page_size = page_size
         self.kv_int8 = kv_int8
+        self.mesh = mesh
+        self.tp = 1
         H = cfg.n_heads
         dh = cfg.d_model // H
         cdt = jnp.dtype(cfg.dtype)
+        place = lambda x: x                  # noqa: E731
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if "tp" not in mesh.axis_names:
+                raise ValueError("PagedKVCache: mesh has no 'tp' axis")
+            self.tp = int(mesh.shape["tp"])
+            if H % self.tp:
+                raise ValueError(
+                    "PagedKVCache: n_heads=%d not divisible by tp=%d "
+                    "(pages shard the heads axis)" % (H, self.tp))
+            sharded = NamedSharding(mesh, P(*self.POOL_SPEC))
+
+            def place(x):
+                return jax.device_put(x, sharded)
         self.pools = []
         for _ in range(cfg.n_layers):
             if kv_int8:
                 self.pools.append({
-                    "kv": jnp.zeros((num_pages, page_size, H, 2 * dh),
-                                    jnp.int8),
-                    "s": jnp.zeros((num_pages, page_size, H, 2),
-                                   jnp.float32),
+                    "kv": place(jnp.zeros(
+                        (num_pages, page_size, H, 2 * dh), jnp.int8)),
+                    "s": place(jnp.zeros(
+                        (num_pages, page_size, H, 2), jnp.float32)),
                 })
             else:
                 self.pools.append({
-                    "kv": jnp.zeros((num_pages, page_size, H, 2 * dh),
-                                    cdt),
+                    "kv": place(jnp.zeros(
+                        (num_pages, page_size, H, 2 * dh), cdt)),
                 })
         # page 0 is scratch — never allocated
         self._free = deque(range(1, num_pages))
@@ -178,3 +210,15 @@ class PagedKVCache:
         """HBM the whole preallocated pool occupies (the capacity
         budget the engine was configured with)."""
         return self.num_pages * self.bytes_per_page
+
+    @property
+    def bytes_held_per_device(self):
+        """Per-device share of ``bytes_held``: pages shard the heads
+        axis over ``tp``, so each device holds exactly 1/tp of every
+        allocated page (H % tp == 0 is enforced at construction)."""
+        return self.bytes_held // self.tp
+
+    @property
+    def bytes_pool_per_device(self):
+        """Per-device share of the preallocated pool capacity."""
+        return self.bytes_pool // self.tp

@@ -40,6 +40,15 @@ it (``ServingEngine._cow_page``) — a shared page is never written.
 Telemetry is the allocator idiom: plain ints bumped on the host path
 (``hit_tokens_total`` etc.), folded into the engine's
 ``MetricsRegistry`` as deltas by ``_EngineObs.sync_prefix``.
+
+Tensor parallelism (round 14): the trie is HOST state and stays
+replicated-by-construction under ``tp > 1`` — an entry's page id
+names the same slice of every device's heads-sharded pool shard, so
+matching, refcounts, and eviction are tp-oblivious.  The one device
+operation here, the COW page copy at a divergence, rides the same
+heads-sharded donated program as the step
+(``engine._make_copy(mesh=...)``) — each device copies its 1/tp of
+the page in place.
 """
 from __future__ import annotations
 

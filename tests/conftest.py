@@ -65,6 +65,10 @@ _SLOW_GROUPS = {
     # schedules through the cluster; its own group so the sweep's
     # schedule count can grow without squeezing group f's budget)
     "test_interleave": "h",
+    # group i: ~2.5min — round-14 tensor-parallel serving (every tp
+    # config compiles a mesh-lowered step program on the virtual
+    # 8-device mesh; isolated for the same compile-budget reason as g)
+    "test_serving_tp": "i",
 }
 
 

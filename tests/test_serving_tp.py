@@ -1,0 +1,382 @@
+"""Tensor-parallel serving (round 14, ROADMAP item 1).
+
+The tentpole contract: ``ServingEngine(tp=N)`` lowers the ONE compiled
+step program through a ``parallel/mesh.py`` mesh — params sharded by
+the megatron rules (int8 q/s specs derived), paged KV pools sharded on
+the HEADS axis, host state replicated — and under f32 greedy the
+outputs stay TOKEN-IDENTICAL to ``tp=1`` and to ``models/gpt.py
+generate`` through everything the engine can do: mixed-length batches,
+in-flight joins, preemption/resume, prefix-cache hits with COW,
+int8-KV pages, and in-engine speculation.  The per-device half of the
+claim — KV-pool and weight bytes ~1/tp, so a model ~tp× too big for
+one chip serves — is asserted against the actual device shards.
+
+Runs on the conftest's virtual 8-device CPU mesh.  Slow tier, group i
+(each tp config compiles its own mesh-lowered step program); the
+mesh-free shardings-spec test at the bottom is FAST tier.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
+
+
+def _cfg(**kw):
+    from mxnet_tpu.models import gpt
+    base = dict(use_flash=False, remat=False, dropout=0.0,
+                dtype="float32", vocab_size=128, max_len=64)
+    base.update(kw)
+    return gpt.gpt_tiny(**base)
+
+
+def _ref(params, cfg, prompt, n, **kw):
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    return np.asarray(
+        gpt.generate(params, cfg, jnp.asarray(prompt)[None], n,
+                     **kw))[0]
+
+
+def _setup(seed=3, **kw):
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg(**kw)
+    params = T.init_params(jax.random.PRNGKey(seed), cfg)
+    return params, cfg
+
+
+# ------------------------------------------------------------ identity ---
+
+@pytest.mark.slow
+def test_tp2_token_identical_mixed_lengths_and_joins():
+    """The acceptance pin: a mixed prompt/output-length batch with an
+    in-flight join decodes token-identically at tp=2 — bit-equal to
+    the tp=1 engine on the same schedule AND to plain ``generate`` —
+    for float and weight-only-int8 params."""
+    from mxnet_tpu.models import gpt
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup()
+    shapes = [(5, 8), (3, 12), (9, 4), (2, 6)]
+    for p in (params, gpt.quantize_decode_params(params)):
+        outs = {}
+        for tp in (1, 2):
+            rng = np.random.RandomState(0)
+            eng = ServingEngine(p, cfg, num_slots=3, page_size=4,
+                                prefill_chunk=6, tp=tp)
+            reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32),
+                                N), N) for P, N in shapes[:3]]
+            for _ in range(3):
+                eng.step()
+            # the join lands mid-decode, same step on both engines
+            P, N = shapes[3]
+            reqs.append((eng.submit(
+                rng.randint(1, 90, P).astype(np.int32), N), N))
+            got = eng.run()
+            outs[tp] = [(got[rid], eng.requests[rid].prompt, N)
+                        for rid, N in reqs]
+            assert eng.cache.pages_in_use == 0
+        for (o2, prompt, N), (o1, _, _) in zip(outs[2], outs[1]):
+            np.testing.assert_array_equal(o2, o1)          # tp2 == tp1
+            np.testing.assert_array_equal(
+                o2, _ref(p, cfg, prompt, N))               # == generate
+
+
+@pytest.mark.slow
+def test_tp2_preemption_recompute_exact():
+    """An over-committed pool under tp=2: the youngest victim is
+    preempted, re-prefills its committed tokens on re-admission, and
+    every output — preempted or not — stays identical to generate."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup(seed=9)
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(params, cfg, num_slots=4, page_size=4,
+                        pages_per_slot=8, num_pages=12,
+                        prefill_chunk=4, tp=2)
+    reqs = []
+    for P, N in [(6, 20), (4, 24), (8, 16), (3, 22), (5, 18)]:
+        rid = eng.submit(rng.randint(1, 90, P).astype(np.int32), N)
+        reqs.append((rid, N))
+    outs = eng.run()
+    assert eng.stats["preemptions"] > 0, \
+        "pool was sized to force preemption"
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+    assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_tp2_prefix_cache_cow_hit_exact():
+    """Shared-prefix reuse under tp=2: a replayed full-page prompt
+    maps cached pages read-only, COWs the final-token page (each
+    device copies its 1/tp slice through the sharded donated copy
+    program), and decodes identically to generate."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup(seed=5)
+    rng = np.random.RandomState(7)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        prefill_chunk=8, tp=2, prefix_cache=True)
+    # 8 tokens = two full pages -> both donated on completion; the
+    # replay whole-input-matches, re-feeds the final token, and must
+    # COW the page that token lands in
+    pr = rng.randint(1, 90, 8).astype(np.int32)
+    r1 = eng.submit(pr, 6)
+    eng.run()
+    r2 = eng.submit(pr, 6)
+    eng.run()
+    assert eng.stats["prefix_hit_tokens"] > 0
+    assert eng.stats["cow_copies"] >= 1
+    ref = _ref(params, cfg, pr, 6)
+    np.testing.assert_array_equal(eng.requests[r1].output, ref)
+    np.testing.assert_array_equal(eng.requests[r2].output, ref)
+    # divergent-tail request: partial-page match, COW mid-page
+    pr2 = pr.copy()
+    pr2[6:] = (pr2[6:] + 1) % 90 + 1
+    r3 = eng.submit(pr2, 6)
+    eng.run()
+    np.testing.assert_array_equal(eng.requests[r3].output,
+                                  _ref(params, cfg, pr2, 6))
+
+
+@pytest.mark.slow
+def test_tp2_int8_kv_agreement():
+    """Paged int8-KV under tp=2 tracks contiguous
+    ``generate(kv_int8=True)`` the same way the tp=1 paged path does —
+    greedy agreement (page gathers and sharded reductions reorder the
+    sums), not bit equality."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup(seed=11, vocab_size=512, d_model=128,
+                         n_heads=4, n_layers=3, d_ff=256)
+    rng = np.random.RandomState(4)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        kv_int8=True, prefill_chunk=8, tp=2)
+    reqs = [eng.submit(rng.randint(1, 500, P).astype(np.int32), 12)
+            for P in (5, 7)]
+    outs = eng.run()
+    for rid in reqs:
+        ref = _ref(params, cfg, eng.requests[rid].prompt, 12,
+                   kv_int8=True)
+        assert (outs[rid] == ref).mean() >= 0.9, (outs[rid], ref)
+    # the f32 scale pool shards its heads axis alongside the int8 pool
+    assert len(eng.cache.pools[0]["s"].addressable_shards) == 2
+
+
+@pytest.mark.slow
+def test_tp2_speculation_token_identical():
+    """In-engine speculation rides the sharded step unchanged: draft
+    rows feed the same mesh-lowered program, per-row verify/commit and
+    pointer rollback stay host-side — tp=2 + spec_K=1 output is
+    token-identical to generate whatever the drafter proposes."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup(seed=3)
+    rng = np.random.RandomState(1)
+    shapes = [(5, 10), (3, 12), (7, 8)]
+    for drafter in ("ngram", lambda toks, K: toks[-K:]):
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                            prefill_chunk=6, tp=2, spec_K=1,
+                            spec_drafter=drafter)
+        reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32),
+                            N), N) for P, N in shapes]
+        outs = eng.run()
+        assert eng.stats["spec_drafted"] > 0
+        for rid, N in reqs:
+            np.testing.assert_array_equal(
+                outs[rid],
+                _ref(params, cfg, eng.requests[rid].prompt, N))
+
+
+# ----------------------------------------------------- per-device bytes ---
+
+@pytest.mark.slow
+def test_tp2_per_device_bytes_halve():
+    """The perf claim, measured: pool buffers and the tp-sharded
+    weights really live as 1/tp-size shards per device — the
+    accounting properties agree with the ACTUAL device placement, so
+    a model ~tp× too big for one chip's HBM serves at tp chips."""
+    from mxnet_tpu.models import gpt
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup()
+    engs = {tp: ServingEngine(
+        gpt.quantize_decode_params(params), cfg, num_slots=2,
+        page_size=4, tp=tp) for tp in (1, 2)}
+    e1, e2 = engs[1], engs[2]
+    assert e2.hbm_pool_per_device * 2 == e2.hbm_pool == e1.hbm_pool
+    # actual shards: every pool buffer splits exactly in half
+    for pool in e2.cache.pools:
+        shards = pool["kv"].addressable_shards
+        assert len(shards) == 2
+        assert all(s.data.nbytes == pool["kv"].nbytes // 2
+                   for s in shards)
+    # tp-sharded weights halve per device too (wq is P(None, 'tp'))
+    wq = e2.params["layers"][0]["wq"]["q"]
+    assert all(s.data.nbytes == wq.nbytes // 2
+               for s in wq.addressable_shards)
+    # replicated leaves (layer norms) do not
+    g = e2.params["layers"][0]["ln1"]["g"]
+    assert all(s.data.nbytes == g.nbytes
+               for s in g.addressable_shards)
+    # held bytes track allocation, per-device = 1/tp exactly
+    rid = e2.submit(np.arange(1, 9, dtype=np.int32), 4)
+    e2.step()
+    assert e2.hbm_held > 0
+    assert e2.hbm_held_per_device * 2 == e2.hbm_held
+    e2.cancel(rid)
+
+
+# ----------------------------------------------------------- validation ---
+
+@pytest.mark.slow
+def test_tp_validation():
+    """Clear errors at the boundary: a tp that does not divide the
+    heads, the tp=1-only Pallas kernel path, a mesh without a 'tp'
+    axis, tp/mesh disagreement, and a tp past the visible devices."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel.mesh import make_mesh, serving_mesh
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup()
+    with pytest.raises(ValueError, match="n_heads"):
+        ServingEngine(params, cfg, num_slots=1, page_size=4, tp=3)
+    with pytest.raises(ValueError, match="pallas.*tp=1"):
+        ServingEngine(params, cfg, num_slots=1, page_size=4, tp=2,
+                      kernel="pallas")
+    with pytest.raises(ValueError, match="no 'tp' axis"):
+        ServingEngine(params, cfg, num_slots=1, page_size=4,
+                      mesh=make_mesh({"dp": -1}))
+    with pytest.raises(ValueError, match="disagrees"):
+        ServingEngine(params, cfg, num_slots=1, page_size=4, tp=4,
+                      mesh=serving_mesh(2))
+    with pytest.raises(MXNetError, match="devices"):
+        serving_mesh(1024)
+    # MoE decode params are tp=1-only this round (clear error, like
+    # the pallas kernel path)
+    import jax
+    from mxnet_tpu.models import gpt
+    mcfg = _cfg(n_experts=2, moe_every=2)
+    mparams = jax.eval_shape(
+        lambda: gpt.init_params(jax.random.PRNGKey(0), mcfg))
+    with pytest.raises(ValueError, match="MoE.*tp=1-only"):
+        ServingEngine(mparams, mcfg, num_slots=1, page_size=4, tp=2)
+    # a trivial tp=1 mesh falls back to the unsharded path
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=4,
+                        mesh=serving_mesh(1))
+    assert eng.tp == 1 and eng.mesh is None
+
+
+# ------------------------------------------------------ cluster failover ---
+
+@pytest.mark.slow
+def test_cluster_failover_under_tp_preserves_config():
+    """Round-14 satellite fix: the cluster captures the WHOLE engine
+    config once (``_engine_kwargs``), so a request resubmitted to a
+    survivor after a replica failure lands on an engine with the same
+    tp/mesh setup — and the recompute-exact resume stays
+    token-identical to generate under tp=2."""
+    from mxnet_tpu.serving import ServingCluster
+
+    params, cfg = _setup(seed=5)
+    rng = np.random.RandomState(5)
+    cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                        page_size=4, prefill_chunk=6, metrics=True,
+                        watchdog_s=10.0, tp=2)
+    try:
+        # the config is captured once and applied to every replica
+        assert cl._engine_kwargs["tp"] == 2
+        assert all(r.engine.tp == 2 for r in cl.replicas)
+        # params are sharded ONCE cluster-wide: every replica holds
+        # the SAME committed buffers (the engine's device_put is a
+        # no-op on already-placed arrays), not an independent sharded
+        # copy per replica — R copies would multiply the per-device
+        # weight bytes tp exists to divide
+        assert cl.replicas[0].engine.params["tok_emb"] \
+            is cl.replicas[1].engine.params["tok_emb"]
+        eng0 = cl.replicas[0].engine
+        orig_step = eng0.step
+        calls = [0]
+
+        def bomb():
+            calls[0] += 1
+            if calls[0] == 4:
+                raise RuntimeError("injected replica failure")
+            return orig_step()
+
+        eng0.step = bomb
+        wl = [(rng.randint(1, 90, P).astype(np.int32), N)
+              for P, N in [(5, 10), (3, 12), (7, 8), (4, 9), (6, 7),
+                           (2, 11)]]
+        rids = [cl.submit(p, n) for p, n in wl]
+        for rid, (p, n) in zip(rids, wl):
+            np.testing.assert_array_equal(
+                cl.result(rid, timeout=300), _ref(params, cfg, p, n))
+        c = cl.metrics()["counters"]
+        assert c["cluster_failovers_total"] == 1
+        assert c["cluster_requests_completed_total"] == len(wl)
+        # the survivor that re-ran the work is itself tp=2
+        health = {h["replica"]: h for h in cl.health()}
+        assert health[0]["dead"] and health[1]["alive"]
+        assert cl.replicas[1].engine.tp == 2
+        assert any(cl.requests[r].failovers > 0 for r in rids)
+    finally:
+        cl.close(timeout=60)
+
+
+# ------------------------------------------------- FAST: mesh-free specs ---
+
+def test_step_input_specs_mesh_free():
+    """FAST tier: the engine's declared sharding table is pure spec —
+    no mesh, no devices, no arrays.  Pools shard exactly the heads
+    axis, int8 q/s derive from the float megatron rules (per-column
+    scales follow the sharded out-dim, per-row embedding scales
+    replicate), host-built rows replicate, and the tree aligns
+    leaf-for-leaf with the real program inputs."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.models import gpt
+    from mxnet_tpu.serving.engine import (step_input_specs,
+                                          step_output_specs)
+
+    cfg = _cfg()
+    params = jax.eval_shape(
+        lambda: gpt.quantize_decode_params(
+            gpt.init_params(jax.random.PRNGKey(0), cfg)))
+    specs = step_input_specs(params, cfg, kv_int8=True)
+    pspec, pools = specs[0], specs[1]
+    assert len(specs) == 8
+    # pools: heads axis (index 2) over tp, nothing else
+    assert all(pool["kv"] == P(None, None, "tp", None)
+               and pool["s"] == P(None, None, "tp", None)
+               for pool in pools)
+    assert len(pools) == cfg.n_layers
+    # host-built rows replicate
+    assert all(s == P() for s in specs[2:])
+    # megatron rules + q/s derivation
+    layer = pspec["layers"][0]
+    assert layer["wq"]["q"] == P(None, "tp")
+    assert layer["wq"]["s"] == P("tp")      # per-column, sharded out
+    assert layer["wo"]["q"] == P("tp", None)
+    assert layer["wo"]["s"] == P(None)      # per-column, unsharded out
+    assert pspec["tok_emb"]["q"] == P(None, "tp")
+    assert pspec["tok_emb"]["s"] == P(None)  # per-ROW (vocab) scales
+    assert pspec["emb_ln"]["g"] == P()
+    # float params take the rules verbatim
+    fparams = jax.eval_shape(
+        lambda: gpt.init_params(jax.random.PRNGKey(0), cfg))
+    fspecs = step_input_specs(fparams, cfg, kv_int8=False)
+    assert fspecs[0]["layers"][0]["wq"] == P(None, "tp")
+    assert "s" not in fspecs[1][0]
+    # spec tree structurally matches the params tree (binding to a
+    # mesh is a plain tree_map — what _make_step does)
+    assert jax.tree_util.tree_structure(
+        jax.tree_util.tree_map(lambda s: 0, pspec,
+                               is_leaf=lambda x: isinstance(x, P))) \
+        == jax.tree_util.tree_structure(params)
+    # output twin: replicated argmaxes, pool sharding preserved
+    # (shape/dtype/sharding match is what keeps donation aliasing)
+    out = step_output_specs(cfg, kv_int8=True)
+    assert out[0] == P() and out[1] == pools

@@ -547,16 +547,17 @@ class TestGraphlintLiveRepo:
         assert fs == [], "\n".join(str(f) for f in fs)
 
     def test_budget_manifest_covers_required_programs(self):
-        """The committed hbm_budgets.json covers the serving step, GPT
-        generate, and the train steps (acceptance criterion), agrees
-        exactly with the registry, and records a trace closure for
-        every program (the --changed-only scope)."""
+        """The committed hbm_budgets.json covers the serving step (all
+        three kernels/meshes), GPT generate, and the train steps
+        (acceptance criterion), agrees exactly with the registry, and
+        records a trace closure for every program (the --changed-only
+        scope)."""
         budgets = graphlint.load_budgets()
         progs = set(budgets["programs"])
-        assert {"serving_step", "serving_step_pallas", "cow_page_copy",
-                "gpt_generate", "gpt_spec_block",
-                "transformer_train_step", "gpt_train_step",
-                "paged_attention_kernel"} <= progs
+        assert {"serving_step", "serving_step_pallas",
+                "serving_step_tp", "cow_page_copy", "gpt_generate",
+                "gpt_spec_block", "transformer_train_step",
+                "gpt_train_step", "paged_attention_kernel"} <= progs
         assert progs == {sp.name for sp in graphlint.live_programs()}
         for name, e in budgets["programs"].items():
             assert e["budget_bytes"] >= e["peak_bytes"], name
@@ -565,16 +566,75 @@ class TestGraphlintLiveRepo:
         assert "mxnet_tpu/serving/engine.py" in ss
         assert "mxnet_tpu/models/gpt.py" in ss
 
+    def test_per_device_expected_peaks_recorded(self):
+        """Round-14 acceptance: the serving step entries carry
+        per-device (÷tp) expected peaks — the sharded inputs (pools +
+        tp-sharded params) divide by tp, replicated inputs and the
+        (conservatively replicated) intermediates do not, so the
+        per-device number sits strictly between peak/tp and peak and
+        decreases with tp."""
+        budgets = graphlint.load_budgets()
+        # the pallas step is tp=1-only this round — no ÷tp row for an
+        # unreachable configuration
+        assert "per_device_expected_peak_bytes" not in \
+            budgets["programs"]["serving_step_pallas"]
+        for name in ("serving_step", "serving_step_tp"):
+            e = budgets["programs"][name]
+            pd = e["per_device_expected_peak_bytes"]
+            assert set(pd) == {"tp%d" % t
+                               for t in graphlint._PER_DEVICE_TPS}
+            peak = e["peak_bytes"]
+            assert peak / 4 < pd["tp4"] < pd["tp2"] < peak, (name, pd)
+        # and it regenerates identically from the live spec table
+        sp = {s.name: s for s in graphlint.live_programs()}[
+            "serving_step"]
+        assert graphlint._per_device_expected_peaks(
+            sp, budgets["programs"]["serving_step"]["peak_bytes"]) \
+            == budgets["programs"]["serving_step"][
+                "per_device_expected_peak_bytes"]
+
     def test_sharding_audit_checked_in_and_current(self):
-        """The ServingEngine step-program sharding-readiness table is
-        committed (acceptance criterion) and regenerates identically —
-        the ROADMAP-1 work-list cannot silently go stale."""
+        """The ServingEngine step-program sharding audit is committed
+        (acceptance criterion) and regenerates identically.  Round 14:
+        the table now verifies the ENGINE'S DECLARED shardings
+        (serving/engine.py step_input_specs) against the megatron
+        rules — UNCOVERED count must be 0 and nothing may mismatch."""
         path = os.path.join(REPO_ROOT, graphlint.AUDIT_PATH)
         committed = open(path).read()
         assert committed == graphlint.sharding_audit_md(REPO_ROOT)
         assert "pools[*]['kv']" in committed
-        assert "UNCOVERED" in committed
-        assert "covered: P(None, 'tp')" in committed
+        assert "UNCOVERED count: 0, mismatched: 0" in committed
+        assert "P(None, None, 'tp', None)" in committed   # heads axis
+        assert "covered: P(None, 'tp')" in committed      # megatron
+        assert "MISMATCH — " not in committed
+
+    def test_sharding_readiness_verifies_engine_declaration(
+            self, monkeypatch):
+        """The graph-sharding-readiness rule genuinely audits the LIVE
+        declaration: a drifted step_input_specs — pools sharded on the
+        wrong axis, a host row vector suddenly tp-sharded — fires, and
+        the live declaration is clean."""
+        from jax.sharding import PartitionSpec as P
+        from mxnet_tpu.serving import engine as E
+        assert graphlint.sharding_readiness_findings(REPO_ROOT) == []
+        real = E.step_input_specs
+
+        def drifted(params, cfg, kv_int8, tp="tp"):
+            specs = list(real(params, cfg, kv_int8, tp=tp))
+            # pools sharded on the PAGE axis instead of heads, and the
+            # token rows tp-sharded (two distinct mismatch classes)
+            specs[1] = [{"kv": P(None, tp, None, None),
+                         "s": P(None, tp, None, None)}
+                        for _ in range(cfg.n_layers)]
+            specs[2] = P(tp)
+            return tuple(specs)
+
+        monkeypatch.setattr(E, "step_input_specs", drifted)
+        fs = graphlint.sharding_readiness_findings(REPO_ROOT)
+        assert _rules(fs) == {"graph-sharding-readiness": 1}
+        assert "mismatch" in fs[0].symbol
+        # anchored at the declaration, not at graphlint
+        assert fs[0].path == "mxnet_tpu/serving/engine.py"
 
     def test_graphlint_guards_the_kv_quantize_fix(self, monkeypatch):
         """Reverting _kv_quantize to the round-4 bf16-accumulation
@@ -640,6 +700,32 @@ class TestGraphlintLiveRepo:
                 sp, REPO_ROOT, budgets=graphlint.load_budgets())
         finally:
             E._step_cache.clear()    # never leak the undonated step
+        assert _rules(fs)["graph-donation"] == 1, [str(f) for f in fs]
+
+    def test_dropping_donation_refires_under_shardings(self,
+                                                       monkeypatch):
+        """Round-14 acceptance: pool donation is verified on the
+        SHARDED step too — stripping donate_argnums from the
+        tp-lowered build (in/out shardings intact) fires
+        graph-donation, i.e. the gate did not silently stop applying
+        when the program gained a mesh."""
+        import jax
+        from mxnet_tpu.serving import engine as E
+        real_jit = jax.jit
+
+        def nodonate_jit(*a, **kw):
+            kw.pop("donate_argnums", None)
+            return real_jit(*a, **kw)
+
+        monkeypatch.setattr(jax, "jit", nodonate_jit)
+        E._step_cache.clear()
+        try:
+            sp = {s.name: s for s in graphlint.live_programs()}[
+                "serving_step_tp"]
+            fs = graphlint.check_program(
+                sp, REPO_ROOT, budgets=graphlint.load_budgets())
+        finally:
+            E._step_cache.clear()
         assert _rules(fs)["graph-donation"] == 1, [str(f) for f in fs]
 
     def test_changed_only_traces_by_closure(self, monkeypatch):

@@ -66,14 +66,22 @@ Rules
     step or a train step serializes the device on the host every
     iteration.
 
-Sharding readiness (report, not a rule): :func:`sharding_audit_md`
-emits the audit table for the ServingEngine step program — for every
-program input leaf, whether the megatron partition rules
-(``models/transformer.py param_shardings`` over a ``parallel/mesh.py``
-mesh) already cover it, cover it derivably (int8 ``{"q","s"}`` leaves
-inherit their float weight's rule), or leave it UNCOVERED.  The
-checked-in ``docs/sharding_readiness.md`` is the work-list the
-tensor-parallel-serving issue (ROADMAP item 1) starts from.
+``graph-sharding-readiness``  (round 14, tensor-parallel serving) The
+    engine's DECLARED step-program shardings (``serving/engine.py
+    step_input_specs`` — what ``ServingEngine(tp=N)`` lowers through)
+    must cover every input: params matching the megatron rules
+    (``models/transformer.py param_specs``; int8 ``{"q","s"}`` leaves
+    verified against graphlint's own independent derivation of the
+    float rule), pools sharding exactly the heads axis over ``tp``,
+    host-built rows replicated.  UNCOVERED count must be 0 and covered
+    rows must MATCH — a drifted declaration (silent per-step reshard /
+    gather) or a new unsharded input fails tier-1.
+    :func:`sharding_audit_md` renders the same table into the
+    checked-in ``docs/sharding_readiness.md`` (pre-round-14 this was
+    the report-mode ROADMAP-1 work-list; now it is a verified
+    contract).  The sharded step's registry entry
+    (``serving_step_tp``) additionally records per-device (÷tp)
+    expected peaks next to its ``hbm_budgets.json`` row.
 
 Scope / suppression: findings go through the shared pragma + baseline
 machinery (``findings.py``).  ``--changed-only`` re-traces a program
@@ -166,6 +174,11 @@ def spec(name, build, *, donate=(), dtype_region=None, f32_allow=None,
 # compute, weight-only-int8 params, int8-KV pages, one draft row)
 _SLOTS, _PAGE, _CHUNK, _SPEC_K = 2, 4, 4, 1
 _GEN_B, _GEN_P, _GEN_NEW = 1, 8, 8
+# tensor-parallel serving step (round 14): tp degree of the sharded
+# registry entry, and the ÷tp columns of the per-device expected-peak
+# manifest rows (both must divide gpt_tiny's 4 heads)
+_TP = 2
+_PER_DEVICE_TPS = (2, 4)
 
 
 def _gpt_cfg():
@@ -228,6 +241,42 @@ def build_serving_step_xla():
 
 def build_serving_step_pallas():
     return _build_serving_step("pallas")
+
+
+def _registry_mesh():
+    """The tp mesh the sharded registry entry traces over — the same
+    virtual CPU mesh the tier-1 conftest and the MULTICHIP dry-runs
+    force (the CLI entry, ``tools/analysis/__main__.py``, requests it
+    before jax's backend initializes; library imports deliberately do
+    not mutate topology)."""
+    import jax
+    from mxnet_tpu.parallel.mesh import serving_mesh
+    if len(jax.devices()) < _TP:
+        raise RuntimeError(
+            "graphlint: the serving_step_tp registry entry needs a "
+            "%d-device mesh but only %d device(s) are visible — jax "
+            "initialized before tools.analysis could request the "
+            "virtual CPU mesh; run via `python -m tools.analysis` or "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            % (_TP, len(jax.devices())))
+    return serving_mesh(_TP)
+
+
+def build_serving_step_tp():
+    """The tensor-parallel serving step: the SAME live ``_make_step``
+    builder, lowered through a tp=``_TP`` mesh with the engine's
+    declared shardings (megatron params, heads-sharded pools,
+    replicated host rows).  Donation of the sharded pools must survive
+    the lowering — the ``graph-donation`` gate runs on this entry like
+    any other."""
+    from mxnet_tpu.serving.engine import _make_step
+    cfg = _gpt_cfg()
+    pps, n_rows, _ = _serve_geometry(cfg)
+    args = _serving_step_args(cfg)
+    fn = _make_step(cfg, _SLOTS, n_rows, pps, _PAGE, True,
+                    kernel="xla", n_sample=1 + _SPEC_K,
+                    mesh=_registry_mesh(), params=args[0])
+    return fn, args
 
 
 def build_cow_page_copy():
@@ -340,6 +389,8 @@ def live_programs() -> List[ProgramSpec]:
              dtype_region="int8", f32_allow=acc),
         spec("serving_step_pallas", build_serving_step_pallas,
              donate=(1,), dtype_region="int8", f32_allow=acc),
+        spec("serving_step_tp", build_serving_step_tp, donate=(1,),
+             dtype_region="int8", f32_allow=acc),
         spec("cow_page_copy", build_cow_page_copy, donate=(0,),
              dtype_region="int8", f32_allow={}),
         spec("gpt_generate", build_gpt_generate,
@@ -652,6 +703,43 @@ def check_program(sp: ProgramSpec, root: str,
 # manifest + runner entry points
 # ---------------------------------------------------------------------------
 
+def _per_device_expected_peaks(sp, peak: int) -> Optional[Dict]:
+    """Per-device (÷tp) expected peaks for the serving step programs,
+    recorded next to their manifest entries (round 14).
+
+    The estimator discounts the INPUTS the engine declares tp-sharded
+    (heads-sharded pools + megatron-sharded params, from
+    ``step_input_specs``): per_device(tp) = peak − sharded_bytes +
+    ceil(sharded_bytes / tp).  Intermediates are conservatively left
+    replicated (GSPMD shards most of them too, and the XLA gather
+    path's merged (T·H) view does re-gather heads), so the number is
+    an upper-bound trajectory gate like ``peak_bytes`` itself — the
+    point it pins is that the DOMINANT resident state (pools +
+    weights) divides by tp.
+
+    Recorded only for the mesh-lowerable entries: the Pallas step is
+    tp=1-only this round (the engine rejects kernel='pallas' with
+    tp>1), so advertising ÷tp numbers for it would describe an
+    unreachable configuration."""
+    if sp.name not in ("serving_step", "serving_step_tp"):
+        return None
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.serving import engine as E
+    cfg = _gpt_cfg()
+    args = _serving_step_args(cfg)
+    declared = E.step_input_specs(args[0], cfg, kv_int8=True)
+    leaves = jax.tree_util.tree_leaves(args)
+    specs = jax.tree_util.tree_leaves(
+        declared, is_leaf=lambda x: isinstance(x, P))
+    assert len(leaves) == len(specs)
+    sharded = sum(_aval_bytes(leaf)
+                  for leaf, spec in zip(leaves, specs)
+                  if "tp" in tuple(spec))
+    return {"tp%d" % tp: int(peak - sharded + math.ceil(sharded / tp))
+            for tp in _PER_DEVICE_TPS}
+
+
 def load_budgets(path: str = None) -> Dict:
     path = path or BUDGETS_PATH
     if not os.path.exists(path):
@@ -687,6 +775,13 @@ def run(root: str, only: Optional[Set[str]] = None,
         if only is not None and not _needs_trace(sp, budgets, only):
             continue
         findings.extend(check_program(sp, root, budgets))
+    # the sharding-readiness rule scopes with the serving step: it
+    # re-audits whenever the step program would re-trace (engine /
+    # model / analysis-infra changes), or always on a full run
+    step_sp = [sp for sp in specs if sp.name == "serving_step"]
+    if step_sp and (only is None
+                    or _needs_trace(step_sp[0], budgets, only)):
+        findings.extend(sharding_readiness_findings(root))
     by_path: Dict[str, List[Finding]] = {}
     for f in findings:
         by_path.setdefault(f.path, []).append(f)
@@ -725,6 +820,10 @@ def update_budgets(root: str, path: Optional[str] = None,
             "budget_bytes": budget,
             "closure": sorted(_trace_closure(jaxpr, root)),
         }
+        per_dev = _per_device_expected_peaks(sp, peak)
+        if per_dev is not None:
+            programs[sp.name]["per_device_expected_peak_bytes"] = \
+                per_dev
     data = {"version": 1, "programs": programs}
     with open(path, "w") as f:
         json.dump(data, f, indent=2, sort_keys=True)
@@ -759,60 +858,167 @@ def _agg_path(keystr_path: str) -> str:
     return re.sub(r"\[(\d+)\]", "[*]", keystr_path)
 
 
-def sharding_audit_md(root: str) -> str:
-    """The ServingEngine step-program input audit: every input leaf,
-    and whether the existing megatron rules cover it."""
+def _spec_str(p) -> str:
+    return "P%s" % (tuple(p),)
+
+
+def _derived_spec_strs(rule: str, leaf_key: str) -> Dict[str, str]:
+    """Expected declared specs for an int8 ``{"q","s"}`` pair whose
+    float weight carries ``rule`` (a ``_spec_str``): ``q`` inherits
+    the 2-D rule; the 1-D scale ``s`` takes the rule entry of the dim
+    it indexes — per-ROW for the embedding table (``q_rows``), per-
+    COLUMN for everything else (``q_cols``).  This is graphlint's OWN
+    derivation, independent of ``models/gpt.py decode_param_specs`` —
+    the audit verifies the engine's declaration against it."""
+    entries = [e.strip() for e in rule[2:-1].rstrip(",").split(",")]
+    entries += ["None"] * (2 - len(entries))
+    pick = entries[0] if leaf_key.startswith("['tok_emb']") \
+        else entries[1]
+    return {"q": rule, "s": "P(%s,)" % pick}
+
+
+_AUDIT_INPUT_NAMES = ["params", "pools", "tokens", "row_slot",
+                      "row_pos", "row_live", "bt", "slot_rows"]
+
+
+def _sharding_rows(cfg):
+    """Audit core: every step-program input leaf with its ENGINE-
+    DECLARED spec (``serving/engine.py step_input_specs``) verified
+    against the megatron rule table.  Returns (rows, counts) where
+    counts = {covered, derived, uncovered, mismatched}; a MISMATCH or
+    UNCOVERED row is a ``graph-sharding-readiness`` finding."""
     import jax
-    cfg = _gpt_cfg()
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.serving import engine as E
+
     rules = _partition_rules(cfg)
     args = _serving_step_args(cfg)
-    names = ["params", "pools", "tokens", "row_slot", "row_pos",
-             "row_live", "bt", "slot_rows"]
-    notes = {
-        "pools": "UNCOVERED — ROADMAP 1: partition the heads axis "
-                 "over tp (heads-partitioned pages); block tables "
-                 "stay host-side",
-        "tokens": "UNCOVERED — replicate (host-built row batch)",
-        "row_slot": "UNCOVERED — replicate",
-        "row_pos": "UNCOVERED — replicate",
-        "row_live": "UNCOVERED — replicate",
-        "bt": "UNCOVERED — replicate (block tables are host state)",
-        "slot_rows": "UNCOVERED — replicate",
-    }
+    declared = E.step_input_specs(args[0], cfg, kv_int8=True)
+    heads_axis = 2              # pools: (pages, page_size, H, 2*dh)
+
     rows: List[Tuple[str, str, str, int, str]] = []
+    counts = {"covered": 0, "derived": 0, "uncovered": 0,
+              "mismatched": 0}
     seen: Set[Tuple[str, str]] = set()
-    covered = derived = uncovered = 0
-    for name, arg in zip(names, args):
-        for path, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+    is_p = lambda x: isinstance(x, P)       # noqa: E731
+    for name, arg, dec in zip(_AUDIT_INPUT_NAMES, args, declared):
+        leaves = jax.tree_util.tree_flatten_with_path(arg)[0]
+        specs = jax.tree_util.tree_flatten_with_path(
+            dec, is_leaf=is_p)[0]
+        if len(leaves) != len(specs):
+            raise RuntimeError(
+                "graphlint: declared sharding tree for %r does not "
+                "match the program input tree (%d leaves vs %d "
+                "specs)" % (name, len(leaves), len(specs)))
+        for (path, leaf), (spath, spec) in zip(leaves, specs):
             ks = jax.tree_util.keystr(path)
+            if jax.tree_util.keystr(spath) != ks:
+                raise RuntimeError(
+                    "graphlint: declared spec path %s != input leaf "
+                    "path %s under %r"
+                    % (jax.tree_util.keystr(spath), ks, name))
             agg = name + _agg_path(ks)
             shape = "x".join(map(str, leaf.shape)) or "scalar"
             if (agg, shape) in seen:
                 continue
             seen.add((agg, shape))
             nbytes = _aval_bytes(leaf)
+            decs = _spec_str(spec)
             if name == "params":
-                base = ks
-                status = None
-                if base in rules:
-                    status = "covered: %s" % rules[base]
-                    covered += 1
+                expect, how = None, None
+                if ks in rules:
+                    expect, how = rules[ks], "covered"
                 else:
-                    # int8 {"q","s"} leaves inherit the float
-                    # weight's megatron rule (q: the rule itself;
-                    # s: its per-channel 1-D slice)
-                    m = re.match(r"(.*)\['([qs])'\]$", base)
+                    m = re.match(r"(.*)\['([qs])'\]$", ks)
                     if m and m.group(1) in rules:
-                        status = "derived(%s): from %s" % (
-                            m.group(2), rules[m.group(1)])
-                        derived += 1
-                if status is None:
-                    status = "UNCOVERED — no megatron rule"
-                    uncovered += 1
+                        expect = _derived_spec_strs(
+                            rules[m.group(1)], m.group(1))[m.group(2)]
+                        how = "derived(%s)" % m.group(2)
+                if expect is None:
+                    status = "UNCOVERED — no megatron rule for the " \
+                        "declared %s" % decs
+                    counts["uncovered"] += 1
+                elif decs != expect:
+                    status = "MISMATCH — engine declares %s, rule " \
+                        "says %s" % (decs, expect)
+                    counts["mismatched"] += 1
+                elif how == "covered":
+                    status = "covered: %s" % decs
+                    counts["covered"] += 1
+                else:
+                    status = "%s: %s from %s" % (how, decs,
+                                                 rules[m.group(1)])
+                    counts["derived"] += 1
+            elif name == "pools":
+                entries = tuple(spec)
+                ok = (len(entries) > heads_axis
+                      and entries[heads_axis] == "tp"
+                      and all(e is None for i, e in enumerate(entries)
+                              if i != heads_axis))
+                if ok:
+                    status = ("covered: %s — engine-declared, pages "
+                              "shard the HEADS axis; block tables / "
+                              "free lists / prefix trie stay "
+                              "host-side" % decs)
+                    counts["covered"] += 1
+                else:
+                    status = ("MISMATCH — pools must shard exactly "
+                              "the heads axis over tp, engine "
+                              "declares %s" % decs)
+                    counts["mismatched"] += 1
             else:
-                status = notes[name]
-                uncovered += 1
+                if tuple(spec) == ():
+                    status = ("covered: P() — engine-declared, "
+                              "replicated host-built row/table input")
+                    counts["covered"] += 1
+                else:
+                    status = ("MISMATCH — host-built inputs must "
+                              "replicate, engine declares %s" % decs)
+                    counts["mismatched"] += 1
             rows.append((agg, shape, str(leaf.dtype), nbytes, status))
+    return rows, counts
+
+
+def sharding_readiness_findings(root: str) -> List[Finding]:
+    """The ``graph-sharding-readiness`` rule (round 14): the engine's
+    declared step-program shardings (``step_input_specs``) must cover
+    EVERY input — params matching the megatron rules (int8 q/s
+    derived), pools heads-sharded, host rows replicated.  UNCOVERED
+    count must be 0 and covered rows must MATCH; the checked-in
+    ``docs/sharding_readiness.md`` renders the same table."""
+    import inspect
+    from mxnet_tpu.serving import engine as E
+    try:
+        line = inspect.getsourcelines(E.step_input_specs)[1]
+    except (OSError, TypeError):
+        line = 1
+    path = "mxnet_tpu/serving/engine.py"
+    findings: List[Finding] = []
+    _, counts = _sharding_rows(_gpt_cfg())
+    if counts["uncovered"]:
+        findings.append(Finding(
+            "graph", "graph-sharding-readiness", path, line,
+            "step_input_specs.uncovered",
+            "%d serving-step input group(s) have no declared/derivable"
+            " sharding — the step program cannot lower through the "
+            "mesh for them (see docs/sharding_readiness.md)"
+            % counts["uncovered"]))
+    if counts["mismatched"]:
+        findings.append(Finding(
+            "graph", "graph-sharding-readiness", path, line,
+            "step_input_specs.mismatch",
+            "%d serving-step input group(s) declare shardings that "
+            "contradict the megatron rule table / pool layout — "
+            "params would silently reshard (or gather) every step"
+            % counts["mismatched"]))
+    return findings
+
+
+def sharding_audit_md(root: str) -> str:
+    """The ServingEngine step-program input audit: every input leaf
+    with its engine-declared sharding, verified against the megatron
+    rules."""
+    rows, counts = _sharding_rows(_gpt_cfg())
     lines = [
         "# Sharding readiness — ServingEngine step program",
         "",
@@ -820,19 +1026,29 @@ def sharding_audit_md(root: str) -> str:
         "for every",
         "input of the serving step program (registry shapes: gpt_tiny, "
         "%d slots," % _SLOTS,
-        "page_size %d, spec_K %d, int8 weights + int8-KV), whether the"
-        % (_PAGE, _SPEC_K),
-        "megatron partition rules (`models/transformer.py "
-        "param_shardings` over a",
-        "`parallel/mesh.py` mesh) already cover it.  UNCOVERED rows "
-        "are the",
-        "work-list for lowering the engine through pjit — ROADMAP "
-        "item 1",
-        "(tensor-parallel serving) starts here.",
+        "page_size %d, spec_K %d, int8 weights + int8-KV), the "
+        "ENGINE'S DECLARED" % (_PAGE, _SPEC_K),
+        "sharding (`serving/engine.py step_input_specs` — what "
+        "`ServingEngine(tp=N)`",
+        "lowers the step through) verified against the megatron "
+        "partition rules",
+        "(`models/transformer.py param_shardings` over a "
+        "`parallel/mesh.py` mesh).",
+        "Round 13 this table was the ROADMAP-1 work-list (8 UNCOVERED "
+        "groups:",
+        "pools + host row vectors); round 14 landed tensor-parallel "
+        "serving and",
+        "the audit now VERIFIES the engine's declarations — UNCOVERED "
+        "or",
+        "MISMATCH rows fail tier-1 via the `graph-sharding-readiness` "
+        "rule.",
         "",
         "Regenerate: `python -m tools.analysis "
         "--write-sharding-audit`",
-        "(`tests/test_static_analysis.py` pins this file current).",
+        "(`tests/test_static_analysis.py` pins this file current; "
+        "`tools/run_static_analysis.sh --changed-only` regenerates it "
+        "when",
+        "serving/ or models/ change).",
         "",
         "| input | shape | dtype | bytes | partition rule |",
         "|---|---|---|---|---|",
@@ -843,14 +1059,18 @@ def sharding_audit_md(root: str) -> str:
     lines += [
         "",
         "**Summary:** %d covered, %d derived (int8 q/s from the float "
-        "rule), %d" % (covered, derived, uncovered),
-        "uncovered input groups.  Params are fully covered by the "
-        "existing",
-        "megatron rules; the paged KV pools are the one genuinely "
-        "sharded",
-        "tensor left (heads axis over tp), and the row/table int32 "
-        "vectors",
+        "rule)," % (counts["covered"], counts["derived"]),
+        "UNCOVERED count: %d, mismatched: %d.  Params follow the "
+        "megatron rules" % (counts["uncovered"], counts["mismatched"]),
+        "(weights tp-sharded, norms/biases-on-unsharded-dims "
+        "replicated), the",
+        "paged KV pools shard the heads axis over tp (each device "
+        "holds 1/tp of",
+        "every page), and the host-built row/table int32 vectors "
         "replicate.",
+        "Per-device expected peaks for the sharded step live in",
+        "`tools/analysis/hbm_budgets.json` "
+        "(`per_device_expected_peak_bytes`).",
         "",
     ]
     return "\n".join(lines)

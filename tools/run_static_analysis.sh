@@ -38,6 +38,26 @@ while [ "$i" -lt "$n" ]; do
     i=$((i + 1))
 done
 
+if [ "$SCOPE" = "--changed-only" ]; then
+    # the sharding-readiness audit (docs/sharding_readiness.md) is a
+    # rendered view of the engine's declared shardings vs the megatron
+    # rules — regenerate it whenever serving/ or models/ changed so
+    # the tier-1 pin (test_sharding_audit_checked_in_and_current)
+    # never trips on a stale table during iteration.  Full runs and
+    # CI leave the committed file authoritative.
+    # tools/analysis/ is included: the table's rendering/derivation
+    # lives in graphlint.py, so an audit-code edit also stales it
+    CHANGED=$( (git diff --name-only HEAD; \
+                git ls-files -o --exclude-standard) 2>/dev/null \
+               | grep -E '^(mxnet_tpu/(serving|models)|tools/analysis)/' \
+               || true)
+    if [ -n "$CHANGED" ]; then
+        echo "== regenerating docs/sharding_readiness.md (serving/" \
+             "or models/ changed) ==" >&2
+        python -m tools.analysis --write-sharding-audit >&2
+    fi
+fi
+
 echo "== mxlint analyzers ($SCOPE) ==" >&2
 python -m tools.analysis --baseline tools/analysis/baseline.json \
     $SCOPE "$@"
