@@ -274,6 +274,21 @@ def bench_gpt_serve_prefix_hit():
     return serve_bench.run_gate_prefix("full")["ttft_hit_ms"]
 
 
+def bench_gpt_serve_disagg_remote_hit():
+    """Disaggregated-serving gate (round 15): TTFT (ms) of a request
+    whose whole-page prompt prefix is cached in ANOTHER prefill
+    PROCESS — the requester fetches the pages over the transport
+    (raw int8/bf16 page bytes, ``serving/transport.py``) and COW
+    re-feeds one token instead of recomputing the prefill.  This is
+    the one number that prices the whole disaggregated path: peer
+    fetch + page install + handoff stream + decode admission.
+    Direction "lower": v <= hi; the cold-vs-remote speedup rides
+    along in the serve_bench ``disagg`` row."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    return serve_bench.run_gate_disagg("full")["ttft_remote_hit_ms"]
+
+
 def bench_gpt_spec_decode():
     """Speculative decode gate (round 6): batch 8, w8 target, ngram
     (prompt-lookup) drafter at K=4 on the structured ("loop") workload
@@ -336,6 +351,8 @@ BENCHES = {
     "gpt_serve_prefix_hit_ttft_ms": (bench_gpt_serve_prefix_hit,
                                      "lower"),
     "gpt_serve_decode_step_ms": (bench_gpt_serve_decode_step, "lower"),
+    "gpt_serve_disagg_remote_hit_ttft_ms":
+        (bench_gpt_serve_disagg_remote_hit, "lower"),
 }
 
 BAR = 0.15

@@ -22,6 +22,20 @@ sharded over ``DMLC_NUM_SERVER`` server processes by stable hash (server
 ``i`` listens on ``DMLC_PS_ROOT_PORT + i``) — the reference's ps-lite
 key-range partitioning.  Optional 2-bit gradient compression with error
 feedback rides the push wire path (``parallel/compression.py``).
+
+Round 15 adds a second frame kind to the same length-prefixed wire: a
+**raw frame** (:func:`send_frame` / :func:`recv_frame`) whose length
+prefix carries a flag bit and whose payload is a small pickled control
+header followed by N raw byte buffers sent/received without pickling
+or copying (``sendall(memoryview)`` out, ``recv_into`` a preallocated
+``bytearray`` in).  The disaggregated serving transport
+(``serving/transport.py``) streams int8 KV pages through it — tensor
+bytes never go through pickle.  Both frame kinds share
+:func:`_recv_exact`, which is hardened for the process-kill path: the
+length prefix is bounded (``MAX_FRAME_BYTES`` — a peer SIGKILLed
+mid-frame leaves garbage that must not turn into a 2^60-byte
+allocation), EINTR retries, and a reset/half-closed connection reads
+as EOF (``None``) instead of raising into the handler loop.
 """
 from __future__ import annotations
 
@@ -39,16 +53,38 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["DistServer", "DistKVStore", "create_dist_kvstore",
-           "run_server"]
+           "run_server", "send_frame", "recv_frame", "MAX_FRAME_BYTES"]
 
 
 # ---------------------------------------------------------------------------
 # wire protocol
 # ---------------------------------------------------------------------------
 
+# upper bound on any single frame component (pickled message, raw-frame
+# header, or one raw buffer).  A garbage length prefix — a peer killed
+# mid-frame, a stray client speaking another protocol — must fail the
+# connection, not allocate half the host's RAM before failing.
+MAX_FRAME_BYTES = 1 << 31
+
+# high bit of the length prefix marks a raw frame (header + raw
+# buffers) rather than a single pickled object; the remaining 63 bits
+# are the header length.  Legacy endpoints never see the flag — the
+# kvstore protocol is pickled-only.
+_RAW_FLAG = 1 << 63
+
+
 def _send(sock: socket.socket, obj):
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _check_len(n):
+    if n > MAX_FRAME_BYTES:
+        raise MXNetError(
+            "dist wire: frame length %d exceeds MAX_FRAME_BYTES %d — "
+            "garbage/oversized length prefix (peer killed mid-frame, "
+            "or a foreign protocol on this port)" % (n, MAX_FRAME_BYTES))
+    return n
 
 
 def _recv(sock: socket.socket):
@@ -56,20 +92,91 @@ def _recv(sock: socket.socket):
     if hdr is None:
         return None
     (n,) = struct.unpack("<Q", hdr)
-    data = _recv_exact(sock, n)
+    if n & _RAW_FLAG:
+        raise MXNetError(
+            "dist wire: raw frame on a pickled-protocol connection "
+            "(use recv_frame on transport endpoints)")
+    data = _recv_exact(sock, _check_len(n))
     if data is None:
         return None
     return pickle.loads(data)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Read exactly ``n`` bytes into a fresh bytearray; ``None`` on
+    EOF *or* abortive close (peer SIGKILL → ECONNRESET; a concurrently
+    closed local socket → EBADF/ENOTCONN).  EINTR retries.  The caller
+    treats ``None`` as a clean disconnect — the process-kill path must
+    look like EOF, not an exception racing ``__del__``."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:])
+        except InterruptedError:          # EINTR (pre-PEP475 paths)
+            continue
+        except socket.timeout:            # recv timeout is the caller's
+            raise                         # poll signal, not a disconnect
+        except OSError:
+            return None                   # reset / closed under us
+        if r == 0:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf) if n <= 64 else buf
+
+
+def send_frame(sock: socket.socket, meta, bufs=()):
+    """Send a raw frame: a small pickled ``meta`` header plus N raw
+    byte buffers.  Buffers are sent via ``sendall(memoryview)`` — no
+    pickling, no concatenation copy of tensor bytes (the header and
+    per-buffer length words are coalesced into one small send)."""
+    mb = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
+    views = [memoryview(b).cast("B") for b in bufs]
+    head = [struct.pack("<Q", _RAW_FLAG | len(mb)), mb,
+            struct.pack("<I", len(views))]
+    head.append(b"".join(struct.pack("<Q", v.nbytes) for v in views))
+    sock.sendall(b"".join(head))
+    for v in views:
+        sock.sendall(v)
+
+
+def recv_frame(sock: socket.socket):
+    """Receive either frame kind.  Returns ``(meta, bufs)`` for a raw
+    frame (``bufs`` = list of bytearrays read zero-copy via
+    ``recv_into``), ``(obj, None)`` for a legacy pickled message, or
+    ``None`` on EOF/reset."""
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<Q", hdr)
+    if not n & _RAW_FLAG:
+        data = _recv_exact(sock, _check_len(n))
+        if data is None:
+            return None
+        return pickle.loads(data), None
+    mb = _recv_exact(sock, _check_len(n & ~_RAW_FLAG))
+    if mb is None:
+        return None
+    meta = pickle.loads(mb)
+    cnt = _recv_exact(sock, 4)
+    if cnt is None:
+        return None
+    (nbuf,) = struct.unpack("<I", cnt)
+    if nbuf > 4096:
+        raise MXNetError("dist wire: raw frame claims %d buffers — "
+                         "garbage header" % nbuf)
+    lens = _recv_exact(sock, 8 * nbuf)
+    if lens is None and nbuf:
+        return None
+    sizes = struct.unpack("<%dQ" % nbuf, bytes(lens or b""))
+    bufs = []
+    for sz in sizes:
+        b = _recv_exact(sock, _check_len(sz))
+        if b is None:
+            return None
+        bufs.append(b if isinstance(b, bytearray) else bytearray(b))
+    return meta, bufs
 
 
 # ---------------------------------------------------------------------------
@@ -400,21 +507,45 @@ class DistKVStore:
                              % (self._q_exc,))
 
     def close(self):
-        """Stop the sender thread and close the server connections."""
+        """Stop the sender thread and close the server connections.
+
+        Hardened for the peer-SIGKILL path: a sender blocked on a dead
+        server's socket unblocks once the sockets are shut down (reset
+        reads as EOF via ``_recv_exact``), so the join is bounded even
+        when the peer died mid-frame; ``shutdown()`` before ``close()``
+        forces the half-closed case instead of leaving the fd to
+        linger in the kernel."""
         if self._sender is not None and self._sender.is_alive():
             self._q.put(None)
             self._sender.join(timeout=5)
+            if self._sender.is_alive():
+                # sender wedged on a dead transport: shut the sockets
+                # down under it (unblocks recv with reset-as-EOF) and
+                # re-join bounded
+                for s in self._socks:
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                self._sender.join(timeout=5)
             self._sender = None
         for s in self._socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass                     # already reset by a dead peer
             try:
                 s.close()
             except OSError:
                 pass
 
     def __del__(self):
+        # interpreter teardown after a peer SIGKILL can raise nearly
+        # anything out of close() (half-dead modules, reset sockets);
+        # a destructor must never propagate
         try:
             self.close()
-        except Exception:
+        except BaseException:
             pass
 
     # -- api --------------------------------------------------------------
@@ -432,20 +563,42 @@ class DistKVStore:
         return zlib.crc32(str(key).encode()) % self._num_servers
 
     def _rpc(self, *msg, key=None):
-        """Send to the server owning ``key`` (or server 0 if keyless)."""
+        """Send to the server owning ``key`` (or server 0 if keyless).
+        A dead transport (peer SIGKILL → EPIPE/ECONNRESET) surfaces as
+        :class:`MXNetError` — the same contract as the async path's
+        deferred errors, so callers never see raw socket errors."""
         sock = self._socks[self._server_of(key) if key is not None else 0]
         with self._lock:
-            _send(sock, msg)
-            return _recv(sock)
+            try:
+                _send(sock, msg)
+                out = _recv(sock)
+            except OSError as e:
+                raise MXNetError(
+                    "kvstore transport failed (server dead?): %s"
+                    % (e,)) from e
+            if out is None:               # reset-as-EOF mid-reply
+                raise MXNetError("kvstore transport closed by peer "
+                                 "mid-reply (server dead?)")
+            return out
 
     def _rpc_all(self, *msg):
         """Send to every server; returns the replies (barrier/optimizer)."""
         out = []
         with self._lock:
-            for sock in self._socks:
-                _send(sock, msg)
-            for sock in self._socks:
-                out.append(_recv(sock))
+            try:
+                for sock in self._socks:
+                    _send(sock, msg)
+                for sock in self._socks:
+                    reply = _recv(sock)
+                    if reply is None:
+                        raise MXNetError(
+                            "kvstore transport closed by peer "
+                            "mid-reply (server dead?)")
+                    out.append(reply)
+            except OSError as e:
+                raise MXNetError(
+                    "kvstore transport failed (server dead?): %s"
+                    % (e,)) from e
         return out
 
     def init(self, key, value):

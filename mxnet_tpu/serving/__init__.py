@@ -55,15 +55,35 @@ under f32, through the cluster as well — prefix hits, COW divergence,
 mid-flight replica failure, either attention kernel, and speculation
 with arbitrary drafters included (``tests/test_serving.py``,
 ``tests/test_serving_cluster.py``).
+
+Round 15 disaggregates the cluster across OS processes
+(ROADMAP item 3):
+
+- ``transport.py`` — framed zero-copy messaging over the
+  ``parallel/dist.py`` raw-frame wire (tensor bytes never pickle).
+- ``page_streamer.py`` — prefill→decode KV-page streaming pipelined
+  with prefill chunks; decode-side staging installer.
+- ``cluster.DisaggServingCluster`` — router + spawned prefill/decode
+  worker PROCESSES: chunked prefill on one process streams int8/f32
+  KV pages to a decode process that picks the request up at
+  ``n_cached = prompt_len``; the prefix trie's knowledge lives in a
+  router-owned ``ClusterPrefixIndex`` so a hot prefix is prefilled
+  once per CLUSTER and fetched (raw page bytes) by whoever needs it;
+  SIGKILL of any worker fails over recompute-exact from the token
+  stream.  ``serve_bench --disagg``;
+  ``gpt_serve_disagg_remote_hit_ttft_ms`` gate;
+  ``tests/test_serving_disagg.py`` (slow group j).
 """
 from .paged_kv import PagedKVCache
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, ClusterPrefixIndex
 from .drafters import ngram_draft
 from .engine import Request, ServingEngine
 from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
-                      RequestExpired, ClusterClosed, ClusterFailed)
+                      RequestExpired, ClusterClosed, ClusterFailed,
+                      DisaggServingCluster, run_worker)
 
-__all__ = ["PagedKVCache", "PrefixCache", "Request", "ServingEngine",
+__all__ = ["PagedKVCache", "PrefixCache", "ClusterPrefixIndex",
+           "Request", "ServingEngine",
            "ServingCluster", "ClusterRequest", "ClusterOverloaded",
            "RequestExpired", "ClusterClosed", "ClusterFailed",
-           "ngram_draft"]
+           "DisaggServingCluster", "run_worker", "ngram_draft"]

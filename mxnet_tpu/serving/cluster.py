@@ -37,10 +37,30 @@ gives clients a single async ``submit()/result()`` API.
 
 Clock: ``time.perf_counter`` throughout — the serving trace clock
 (mxlint ``clock-mix`` enforces this for the whole package).
+
+Round 15 promotes replicas to **processes** and splits roles:
+:class:`DisaggServingCluster` (bottom of this module) runs a router in
+THIS process and N prefill + M decode workers as spawned OS processes,
+wired by ``serving/transport.py`` over the ``parallel/dist.py`` raw
+frames.  A prefill worker runs chunked prefill only and streams
+finished int8/f32 KV pages to its request's decode worker
+(``serving/page_streamer.py`` — pipelined with the prefill chunks);
+the decode worker installs them and picks the request up at
+``n_cached = prompt_len``.  The prefix-cache trie's knowledge lives in
+the router's :class:`prefix_cache.ClusterPrefixIndex`; a replica
+matching another replica's chain fetches the page bytes peer-to-peer
+instead of re-prefilling — once per cluster, not once per replica.
+SIGKILL of any worker process triggers the router's watchdog: its
+requests resubmit to survivors with their streamed committed tokens
+as prompt extension — the same recompute-exact resume contract as the
+in-process cluster, now across a process boundary.
 """
 from __future__ import annotations
 
 import collections
+import itertools
+import os
+import queue
 import threading
 import time
 from typing import Dict, List, Optional
@@ -49,10 +69,11 @@ import numpy as np
 
 from .. import profiler
 from .engine import ServingEngine
-from .prefix_cache import chain_keys
+from .prefix_cache import chain_keys, ClusterPrefixIndex
 
 __all__ = ["ServingCluster", "ClusterRequest", "ClusterOverloaded",
-           "RequestExpired", "ClusterClosed", "ClusterFailed"]
+           "RequestExpired", "ClusterClosed", "ClusterFailed",
+           "DisaggServingCluster", "run_worker"]
 
 # rid blocks: replica i assigns engine rids in [i*RID_BLOCK, ...), so
 # request ids and trace swimlanes stay unique across the cluster
@@ -734,3 +755,1397 @@ class ServingCluster:
         snap["enabled"] = True
         snap["replicas"] = [r.engine.metrics() for r in self.replicas]
         return snap
+
+
+# ===========================================================================
+# Disaggregated prefill/decode serving (round 15): cross-PROCESS
+# replicas streaming int8 KV pages, with a cluster-level prefix index.
+# ===========================================================================
+
+class _DisaggObs:
+    """Router-side instrument bundle for the disaggregated cluster."""
+
+    _seq = [0]
+
+    def __init__(self, registry=None):
+        from .. import obs as O
+        if registry is None:
+            registry = O.MetricsRegistry(
+                labels={"disagg": str(self._seq[0])})
+            self._seq[0] += 1
+            O.register_engine_registry(registry)
+        self.registry = registry
+        c, g, h = registry.counter, registry.gauge, registry.histogram
+        self.submitted = c("cluster_requests_submitted_total",
+                           "requests accepted by cluster submit()")
+        self.completed = c("cluster_requests_completed_total",
+                           "requests finished across all workers")
+        self.failovers = c("cluster_failovers_total",
+                           "worker-process failures (SIGKILL, crash, "
+                           "or watchdog stall) failed over")
+        self.resubmitted = c("cluster_requests_resubmitted_total",
+                             "requests resubmitted after a worker "
+                             "death (recompute-exact resume)")
+        self.page_bytes = c("cluster_page_bytes_streamed_total",
+                            "KV page bytes moved between worker "
+                            "processes (prefill->decode streams + "
+                            "peer prefix fetches)")
+        self.pages_streamed = c("cluster_pages_streamed_total",
+                                "KV pages moved between worker "
+                                "processes")
+        self.remote_hits = c("serving_prefix_remote_hits_total",
+                             "prefix chains fetched from another "
+                             "replica instead of re-prefilled")
+        self.remote_hit_tokens = c(
+            "serving_prefix_remote_hit_tokens_total",
+            "prompt tokens whose prefill was skipped via a REMOTE "
+            "prefix fetch")
+        self.g_workers = g("cluster_workers_healthy",
+                           "worker processes accepting traffic")
+        self.g_in_flight = g("cluster_in_flight",
+                             "requests not yet terminal")
+        self.h_ttft = h("cluster_ttft_ms",
+                        help="cluster submit() -> first committed "
+                             "token seen at the router")
+        self.h_transfer = h("cluster_page_transfer_ms",
+                            help="page-frame send -> installed in the "
+                                 "decode pool (same-host monotonic "
+                                 "clock)")
+
+
+class _WorkerHandle:
+    """Router-side record of one worker process."""
+    __slots__ = ("name", "role", "proc", "conn", "data_host",
+                 "data_port", "last_seen", "dead", "outstanding",
+                 "stats", "stats_evt", "stats_sid", "error",
+                 "recv_thread")
+
+    def __init__(self, name, role):
+        self.name = name
+        self.role = role
+        self.proc = None
+        self.conn = None
+        self.data_host = None
+        self.data_port = None
+        self.last_seen = time.perf_counter()
+        self.dead = False
+        self.outstanding = set()          # rids currently assigned
+        self.stats: Dict = {}
+        self.stats_evt = threading.Event()
+        self.stats_sid = None             # awaited stats_req id
+        self.error = None
+        self.recv_thread = None
+
+    @property
+    def alive(self):
+        return not self.dead and self.conn is not None
+
+
+class DisaggRequest:
+    """Router-side request record for the disaggregated cluster.
+    ``committed`` is fed by the token stream from whichever worker is
+    running the request — it is the failover snapshot (a SIGKILLed
+    worker's memory is gone; only streamed tokens survive)."""
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
+                 "phase", "prefill", "decode", "gen", "committed",
+                 "output", "error", "done_evt", "submit_t",
+                 "first_token_t", "failovers", "delivered")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_id):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.state = "running"            # running|done|failed
+        self.phase = "prefill"            # prefill|decode
+        self.prefill: Optional[str] = None
+        self.decode: Optional[str] = None
+        self.gen = 0                      # incarnation fence
+        self.committed: List[int] = []
+        self.output: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.done_evt = threading.Event()
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.failovers = 0
+        self.delivered = False
+
+
+class DisaggServingCluster:
+    """Disaggregated prefill/decode serving across OS processes.
+
+    The router (this object, in the calling process) spawns
+    ``prefill`` + ``decode`` worker processes (``multiprocessing``
+    spawn — real pids, SIGKILL-able), ships each the model params and
+    engine config over the transport at handshake, and then routes:
+    every request runs chunked prefill on a prefill worker (its
+    engine capped at one sampled token), whose finished KV pages
+    stream to the request's decode worker pipelined with the prefill
+    chunks; the decode worker installs the pages, admits the request
+    at ``n_cached = prompt_len`` via ``engine.admit_prefilled``, and
+    streams committed tokens back to the router.
+
+    * **Cluster-level prefix reuse** — the router owns a
+      :class:`prefix_cache.ClusterPrefixIndex`; submit() attaches a
+      hint naming the replica holding the longest cached chain, and
+      the prefill worker fetches those pages peer-to-peer (raw int8
+      page bytes) instead of recomputing them.  A hot prefix is
+      prefilled once per CLUSTER; ``serving_prefix_remote_hits_total``
+      / ``cluster_page_bytes_streamed_total`` measure it.
+    * **Failover** — a worker that dies (SIGKILL, crash, socket loss)
+      or stalls past ``watchdog_s`` is failed over: its requests
+      resubmit to survivors with the router's streamed ``committed``
+      tokens as prompt extension (recompute-exact; f32-greedy output
+      is token-identical to an undisturbed run), fenced by per-request
+      incarnation numbers so a zombie's late frames never land.
+    * **Exactness** — prefill and decode run the SAME compiled step
+      program config; pages transfer as exact pool bytes.  Under f32
+      greedy the cluster output is bit-identical to single-engine
+      ``generate`` (pinned by ``tests/test_serving_disagg.py``).
+
+    Off-host scale-out uses the same protocol: pass ``spawn=False``
+    and start workers via ``tools/launch.py --launcher serve`` (or
+    ``run_worker()`` with ``MXNET_SERVE_*`` env) on any reachable
+    host.
+    """
+
+    def __init__(self, params, cfg, *, prefill=1, decode=1,
+                 num_slots, page_size=16, num_pages=None,
+                 pages_per_slot=None, prefill_chunk=8, kv_int8=False,
+                 kernel="xla", spec_K=0, metrics=None, registry=None,
+                 watchdog_s=30.0, spawn=True, host="127.0.0.1",
+                 port=0, ready_timeout=120.0):
+        if prefill < 1 or decode < 1:
+            raise ValueError("DisaggServingCluster: needs >= 1 "
+                             "prefill and >= 1 decode worker")
+        self.cfg = cfg
+        self.page_size = page_size
+        self.watchdog_s = float(watchdog_s)
+        self._engine_kwargs = dict(
+            num_slots=num_slots, page_size=page_size,
+            num_pages=num_pages, pages_per_slot=pages_per_slot,
+            prefill_chunk=prefill_chunk, kv_int8=kv_int8,
+            kernel=kernel, spec_K=spec_K)
+        # mirror of the workers' engine limits, so an invalid request
+        # fails the submit() call instead of poisoning a worker
+        pps = pages_per_slot if pages_per_slot is not None \
+            else -(-cfg.max_len // page_size)
+        self._max_seq = min(pps * page_size, cfg.max_len)
+        if metrics is None:
+            metrics = registry is not None or \
+                os.environ.get("MXNET_SERVING_METRICS", "0") == "1"
+        self._obs = _DisaggObs(registry) if metrics else None
+        self._lock = threading.RLock()
+        self._closed = False
+        self._next_rid = 0
+        self.requests: Dict[int, DisaggRequest] = {}
+        # terminal requests are retained up to this many, then the
+        # oldest DELIVERED ones drop — a long-running router must not
+        # grow its request table with total traffic served (the same
+        # contract as ServingCluster.retain_results)
+        self._retain = 4096
+        self._terminal: "collections.deque[int]" = collections.deque()
+        self.index = ClusterPrefixIndex()
+        self._rr = [0, 0]                 # round-robin cursors
+        # worker-reported cumulative stats, delta-folded into the
+        # router registry (same idiom as _EngineObs.sync_cache)
+        self._stat_seen: Dict[str, Dict[str, float]] = {}
+        self.workers: Dict[str, _WorkerHandle] = {}
+        for i in range(prefill):
+            self.workers["prefill%d" % i] = _WorkerHandle(
+                "prefill%d" % i, "prefill")
+        for i in range(decode):
+            self.workers["decode%d" % i] = _WorkerHandle(
+                "decode%d" % i, "decode")
+
+        from .transport import Listener, tree_to_frames
+        import jax
+        # port: 0 lets the OS pick (the spawned-worker path); an
+        # external launcher (tools/launch.py --launcher serve) picks
+        # the port up front and hands it to both sides via env
+        self._listener = Listener(host=host, port=port)
+        self._pending_conns: "queue.Queue" = queue.Queue()
+        self._listener.start(self._pending_conns.put)
+        host_params = jax.device_get(params)
+        self._params_frames = tree_to_frames(host_params)
+        if spawn:
+            import multiprocessing as mp
+            ctx = mp.get_context("spawn")
+            for name, wh in self.workers.items():
+                wh.proc = ctx.Process(
+                    target=_disagg_worker_entry,
+                    args=(name, wh.role, self._listener.host,
+                          self._listener.port),
+                    daemon=True, name="serving-" + name)
+                wh.proc.start()
+        try:
+            self._handshake_all(ready_timeout)
+        except BaseException:
+            # a failed construction must not strand live worker
+            # processes (each holding an engine) or the bound
+            # listener — the caller never gets an object to close()
+            for wh in self.workers.values():
+                if wh.proc is not None and wh.proc.is_alive():
+                    wh.proc.terminate()
+                if wh.conn is not None:
+                    wh.conn.close()
+            self._listener.close()
+            raise
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="disagg-monitor")
+        self._monitor.start()
+
+    # --------------------------------------------------- handshake ---
+    def _handshake_all(self, timeout):
+        deadline = time.perf_counter() + timeout
+        need = {n for n in self.workers}
+        while need:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                raise RuntimeError(
+                    "DisaggServingCluster: workers %s never connected"
+                    % sorted(need))
+            try:
+                conn = self._pending_conns.get(timeout=min(left, 1.0))
+            except queue.Empty:
+                continue
+            got = conn.recv(timeout=left)
+            if got in (None, "timeout"):
+                conn.close()
+                continue
+            kind, meta, _ = got
+            if kind != "hello" or meta.get("name") not in need:
+                conn.close()
+                continue
+            name = meta["name"]
+            wh = self.workers[name]
+            wh.conn = conn
+            pm, pb = self._params_frames
+            conn.send("config",
+                      {"cfg": self.cfg, "role": wh.role,
+                       "engine_kwargs": self._engine_kwargs,
+                       "params_meta": pm,
+                       "watchdog_s": self.watchdog_s}, pb)
+            need.discard(name)
+        # collect READY (with data ports) from everyone
+        for name, wh in self.workers.items():
+            got = wh.conn.recv(timeout=max(
+                1.0, deadline - time.perf_counter()))
+            if got in (None, "timeout") or got[0] != "ready":
+                raise RuntimeError(
+                    "DisaggServingCluster: worker %s failed to build "
+                    "its engine (%r)" % (name, got))
+            _, meta, _ = got
+            wh.data_host = meta["data_host"]
+            wh.data_port = meta["data_port"]
+            wh.last_seen = time.perf_counter()
+        peers = {n: {"role": w.role, "host": w.data_host,
+                     "port": w.data_port}
+                 for n, w in self.workers.items()}
+        for wh in self.workers.values():
+            wh.conn.send("peers", {"peers": peers})
+            wh.recv_thread = threading.Thread(
+                target=self._recv_loop, args=(wh,), daemon=True,
+                name="disagg-recv-" + wh.name)
+            wh.recv_thread.start()
+        if self._obs is not None:
+            self._obs.g_workers.set(
+                sum(w.alive for w in self.workers.values()))
+
+    # ------------------------------------------------- router recv ---
+    def _recv_loop(self, wh):
+        while True:
+            got = wh.conn.recv()
+            if got is None:
+                with self._lock:
+                    closed = self._closed or wh.dead
+                if not closed:
+                    self._fail_worker(wh, RuntimeError(
+                        "worker %s: connection lost (process died?)"
+                        % wh.name))
+                return
+            kind, meta, bufs = got
+            wh.last_seen = time.perf_counter()
+            if kind == "tokens":
+                self._on_tokens(wh, meta)
+            elif kind == "handed":
+                self._on_handed(wh, meta)
+            elif kind == "done":
+                self._on_done(wh, meta)
+            elif kind == "lost":
+                self._on_lost(wh, meta)
+            elif kind == "insert":
+                self.index.report_insert(wh.name, meta["keys"])
+            elif kind == "evict":
+                self.index.report_evict(wh.name, meta["keys"])
+            elif kind == "stats":
+                self._on_stats(wh, meta)
+            elif kind == "reqfail":
+                with self._lock:
+                    cr = self.requests.get(meta["rid"])
+                    if cr is not None and cr.gen == meta["gen"] \
+                            and cr.state == "running":
+                        cr.state = "failed"
+                        cr.error = RuntimeError(meta.get("msg", ""))
+                        for side in (cr.prefill, cr.decode):
+                            w = self.workers.get(side)
+                            if w is not None:
+                                w.outstanding.discard(cr.rid)
+                        self._terminal.append(cr.rid)
+                        cr.done_evt.set()
+            elif kind == "error":
+                self._fail_worker(wh, RuntimeError(
+                    "worker %s: %s" % (wh.name, meta.get("msg"))))
+                return
+
+    def _commit_tokens_locked(self, cr, toks, now):
+        """Append newly streamed tokens (router lock held)."""
+        if toks and cr.first_token_t is None:
+            cr.first_token_t = now
+            if self._obs is not None:
+                self._obs.h_ttft.observe((now - cr.submit_t) * 1e3)
+        cr.committed.extend(int(t) for t in toks)
+
+    def _on_tokens(self, wh, meta):
+        with self._lock:
+            cr = self.requests.get(meta["rid"])
+            if cr is None or cr.gen != meta["gen"] \
+                    or cr.state != "running":
+                return
+            self._commit_tokens_locked(cr, meta["toks"], time.perf_counter())
+
+    def _on_handed(self, wh, meta):
+        """Prefill finished and handed off to the decode worker.
+        Carries NO tokens: the decode worker reports the whole
+        committed stream (handoff tokens included) on its own FIFO
+        connection — splitting the stream across the two workers'
+        independent router connections would race, and a decode
+        'done' overtaking the prefill 'handed' would silently drop
+        (or reorder) the prefill-sampled token."""
+        with self._lock:
+            cr = self.requests.get(meta["rid"])
+            if cr is None or cr.gen != meta["gen"] \
+                    or cr.state != "running":
+                return
+            cr.phase = "decode"
+            wh.outstanding.discard(cr.rid)
+
+    def _on_done(self, wh, meta):
+        sends = []
+        with self._lock:
+            cr = self.requests.get(meta["rid"])
+            if cr is None or cr.gen != meta["gen"] \
+                    or cr.state != "running":
+                return
+            self._commit_tokens_locked(cr, meta.get("toks", ()),
+                                       time.perf_counter())
+            cr.output = np.concatenate(
+                [cr.prompt, np.asarray(cr.committed, np.int32)])
+            cr.state = "done"
+            for side in (cr.prefill, cr.decode):
+                w = self.workers.get(side)
+                if w is not None:
+                    w.outstanding.discard(cr.rid)
+            if cr.phase == "prefill" and cr.decode != wh.name:
+                # the request completed AT PREFILL: the decode side
+                # may hold staged pages from the stream — fence it
+                # authoritatively from here (the prefill worker's
+                # courtesy 'drop' is best-effort; a failed send would
+                # leak decode pool pages forever)
+                w = self.workers.get(cr.decode)
+                if w is not None and w.alive:
+                    sends.append((w.conn, (
+                        "abort", {"rid": cr.rid,
+                                  "below_gen": cr.gen + 1}, [])))
+            if self._obs is not None:
+                self._obs.completed.inc()
+                self._obs.g_in_flight.set(
+                    sum(r.state == "running"
+                        for r in self.requests.values()))
+            self._terminal.append(cr.rid)
+            self._purge_locked()
+            cr.done_evt.set()
+        self._do_sends(sends)
+
+    def _purge_locked(self):
+        excess = len(self._terminal) - self._retain
+        if excess <= 0:
+            return
+        kept: "collections.deque[int]" = collections.deque()
+        for rid in self._terminal:
+            req = self.requests.get(rid)
+            if excess > 0 and (req is None or req.delivered):
+                excess -= 1
+                self.requests.pop(rid, None)
+            else:
+                kept.append(rid)
+        self._terminal = kept
+
+    def _on_lost(self, wh, meta):
+        """A prefill worker abandoned a request because its decode
+        peer was unreachable (peer data-plane failure with the peer
+        PROCESS possibly still alive — the watchdog cannot see it):
+        reassign, with any streamed state fenced out."""
+        sends = []
+        with self._lock:
+            cr = self.requests.get(meta["rid"])
+            if cr is None or cr.gen != meta["gen"] \
+                    or cr.state != "running":
+                return
+            cr.gen += 1
+            cr.failovers += 1
+            for side in (cr.prefill, cr.decode):
+                w = self.workers.get(side)
+                if w is not None:
+                    w.outstanding.discard(cr.rid)
+                    if w.alive:
+                        sends.append((w.conn, (
+                            "abort", {"rid": cr.rid,
+                                      "below_gen": cr.gen}, [])))
+            if cr.failovers > 5:
+                # a persistently broken data plane must not ping-pong
+                # the request between worker pairs forever
+                cr.state = "failed"
+                cr.error = ClusterFailed(
+                    "request %d: abandoned %d times (worker data "
+                    "plane unreachable)" % (cr.rid, cr.failovers))
+                self._terminal.append(cr.rid)
+                cr.done_evt.set()
+            else:
+                sends.extend(self._dispatch_locked(cr))
+                if cr.state == "running" and self._obs is not None:
+                    self._obs.resubmitted.inc()
+        self._do_sends(sends)
+
+    def _on_stats(self, wh, meta):
+        wh.stats = meta["stats"]
+        obs = self._obs
+        if obs is not None:
+            seen = self._stat_seen.setdefault(wh.name, {})
+            for key, ctr in (("bytes_streamed", obs.page_bytes),
+                             ("pages_streamed", obs.pages_streamed),
+                             ("remote_hits", obs.remote_hits),
+                             ("remote_hit_tokens",
+                              obs.remote_hit_tokens)):
+                v = wh.stats.get(key, 0)
+                d = v - seen.get(key, 0)
+                if d > 0:
+                    ctr.inc(d)
+                seen[key] = v
+            for ms in wh.stats.get("transfer_ms", ()):
+                obs.h_transfer.observe(ms)
+        # set LAST, and only for the awaited stats_req reply: an
+        # unsolicited periodic frame serialized before the request
+        # must not satisfy the wait with a stale snapshot (a
+        # cluster_stats() caller reading the registry right after the
+        # event must see the REQUESTED message's deltas folded in)
+        if meta.get("sid") is not None \
+                and meta["sid"] == wh.stats_sid:
+            wh.stats_evt.set()
+
+    # ------------------------------------------------------ intake ---
+    def _pick(self, role, exclude=()):
+        """Least-outstanding over healthy workers of ``role``, ties
+        broken round-robin — back-to-back submits spread across
+        replicas (the cluster prefix index, not affinity stickiness,
+        is what makes spreading cheap here: the second replica fetches
+        the pages instead of recomputing them)."""
+        cands = sorted((w for w in self.workers.values()
+                        if w.role == role and w.alive
+                        and w.name not in exclude),
+                       key=lambda w: w.name)
+        if not cands:
+            return None
+        i = 0 if role == "prefill" else 1
+        cur = self._rr[i]
+        self._rr[i] = cur + 1
+        lo = min(len(w.outstanding) for w in cands)
+        tied = [w for w in cands if len(w.outstanding) == lo]
+        return tied[cur % len(tied)]
+
+    def submit(self, prompt, max_new_tokens, eos_id=None):
+        """Queue a request; returns its rid immediately."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("submit: empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("submit: max_new_tokens must be >= 1")
+        if prompt.size + int(max_new_tokens) > self._max_seq:
+            raise ValueError(
+                "submit: %d tokens > worker max_seq/max_len %d"
+                % (prompt.size + int(max_new_tokens), self._max_seq))
+        with self._lock:
+            if self._closed:
+                raise ClusterClosed("submit() after close()")
+            cr = DisaggRequest(self._next_rid, prompt,
+                               int(max_new_tokens), eos_id)
+            self._next_rid += 1
+            self.requests[cr.rid] = cr
+            if self._obs is not None:
+                self._obs.submitted.inc()
+                self._obs.g_in_flight.set(
+                    sum(r.state == "running"
+                        for r in self.requests.values()))
+            sends = self._dispatch_locked(cr)
+        self._do_sends(sends)
+        return cr.rid
+
+    def _dispatch_locked(self, cr):
+        """Assign (or reassign) a request; returns the (conn, frame)
+        sends to perform OUTSIDE the lock."""
+        pre = self._pick("prefill")
+        dec = self._pick("decode")
+        if pre is None or dec is None:
+            cr.state = "failed"
+            cr.error = ClusterFailed(
+                "no healthy %s worker" %
+                ("prefill" if pre is None else "decode"))
+            self._terminal.append(cr.rid)
+            cr.done_evt.set()
+            return []
+        cr.prefill, cr.decode = pre.name, dec.name
+        cr.phase = "prefill"
+        pre.outstanding.add(cr.rid)
+        dec.outstanding.add(cr.rid)
+        inp = cr.prompt if not cr.committed else np.concatenate(
+            [cr.prompt, np.asarray(cr.committed, np.int32)])
+        owner, depth = self.index.match(
+            chain_keys(inp, self.page_size))
+        hint = None
+        if owner is not None and owner != pre.name:
+            wo = self.workers.get(owner)
+            if wo is not None and wo.alive:
+                hint = owner
+        meta = {"rid": cr.rid, "gen": cr.gen,
+                "max_new": cr.max_new_tokens - len(cr.committed),
+                "eos": cr.eos_id, "decode": dec.name,
+                "hint": hint, "hint_depth": depth}
+        return [(pre.conn, ("submit", meta,
+                            [np.ascontiguousarray(inp).data]))]
+
+    def _do_sends(self, sends):
+        for conn, (kind, meta, bufs) in sends:
+            try:
+                conn.send(kind, meta, bufs)
+            except OSError:
+                pass                      # the monitor will fail it over
+
+    def result(self, rid, timeout=None):
+        """Block until the request finishes; returns prompt +
+        generated tokens.  Raises :class:`ClusterFailed` if no healthy
+        worker could finish it."""
+        cr = self.requests.get(rid)
+        if cr is None:
+            raise KeyError("result(%d): unknown rid (already "
+                           "collected and purged?)" % rid)
+        if not cr.done_evt.wait(timeout):
+            raise TimeoutError("result(%d): still running" % rid)
+        with self._lock:
+            cr.delivered = True
+            self._purge_locked()
+        if cr.state == "done":
+            return cr.output
+        raise ClusterFailed("request %d: %r" % (rid, cr.error))
+
+    # ---------------------------------------------------- failover ---
+    def _fail_worker(self, wh, error):
+        """A worker process died or stalled: fence it, drop its index
+        entries, resubmit its requests to survivors with the streamed
+        committed tokens as prompt extension (recompute-exact)."""
+        sends = []
+        with self._lock:
+            if wh.dead:
+                return
+            wh.dead = True
+            wh.error = error
+            self.index.drop_owner(wh.name)
+            if self._obs is not None:
+                self._obs.failovers.inc()
+                self._obs.g_workers.set(
+                    sum(w.alive for w in self.workers.values()))
+            # a request in the prefill phase dies with either of its
+            # assigned workers (pages may already be streaming to the
+            # decode side); one that completed handoff only dies with
+            # its DECODE worker — the prefill side is out of the loop
+            victims = [
+                cr for cr in self.requests.values()
+                if cr.state == "running"
+                and ((cr.phase == "prefill"
+                      and wh.name in (cr.prefill, cr.decode))
+                     or (cr.phase == "decode"
+                         and wh.name == cr.decode))]
+            for cr in victims:
+                cr.gen += 1
+                cr.failovers += 1
+                for side in (cr.prefill, cr.decode):
+                    w = self.workers.get(side)
+                    if w is not None:
+                        w.outstanding.discard(cr.rid)
+                # fence + free whatever the surviving side holds
+                for side in set((cr.prefill, cr.decode)):
+                    w = self.workers.get(side)
+                    if w is not None and w.alive:
+                        sends.append((w.conn, ("abort",
+                                               {"rid": cr.rid,
+                                                "below_gen":
+                                                cr.gen}, [])))
+                # already satisfiable from streamed tokens?
+                done = (len(cr.committed) >= cr.max_new_tokens
+                        or (cr.eos_id is not None
+                            and cr.eos_id in cr.committed))
+                if done:
+                    cr.output = np.concatenate(
+                        [cr.prompt,
+                         np.asarray(cr.committed, np.int32)])
+                    cr.state = "done"
+                    if self._obs is not None:
+                        self._obs.completed.inc()
+                    self._terminal.append(cr.rid)
+                    cr.done_evt.set()
+                    continue
+                sends.extend(self._dispatch_locked(cr))
+                if cr.state == "running" and self._obs is not None:
+                    self._obs.resubmitted.inc()
+        try:
+            wh.conn.close()
+        except Exception:
+            pass
+        self._do_sends(sends)
+
+    def _monitor_loop(self):
+        period = max(0.05, min(0.5, self.watchdog_s / 4.0))
+        while True:
+            time.sleep(period)
+            with self._lock:
+                if self._closed:
+                    return
+                suspects = []
+                now = time.perf_counter()
+                for wh in self.workers.values():
+                    if wh.dead:
+                        continue
+                    if wh.proc is not None and not wh.proc.is_alive():
+                        suspects.append((wh, "process exited"))
+                    elif wh.outstanding and \
+                            now - wh.last_seen > self.watchdog_s:
+                        suspects.append((wh, "stalled past watchdog "
+                                         "%.1fs" % self.watchdog_s))
+            for wh, why in suspects:
+                self._fail_worker(wh, RuntimeError(
+                    "worker %s: %s" % (wh.name, why)))
+
+    # --------------------------------------------------- accounting --
+    _stats_seq = itertools.count(1)
+
+    def cluster_stats(self, timeout=5.0):
+        """Fresh per-worker stats snapshot (stats-request round
+        trip, correlated by sequence id): {name: {..engine/prefix/
+        streamer counters..}} for LIVE workers."""
+        sid = next(self._stats_seq)
+        live = [w for w in self.workers.values() if w.alive]
+        for wh in live:
+            wh.stats_sid = sid
+            wh.stats_evt.clear()
+        for wh in live:
+            try:
+                wh.conn.send("stats_req", {"sid": sid})
+            except OSError:
+                pass
+        deadline = time.perf_counter() + timeout
+        for wh in live:
+            wh.stats_evt.wait(max(0.0,
+                                  deadline - time.perf_counter()))
+        return {wh.name: dict(wh.stats) for wh in live}
+
+    def health(self):
+        now = time.perf_counter()
+        with self._lock:
+            return [{"worker": w.name, "role": w.role,
+                     "alive": w.alive, "dead": w.dead,
+                     "outstanding": len(w.outstanding),
+                     "heartbeat_age_s": now - w.last_seen,
+                     "pid": None if w.proc is None else w.proc.pid,
+                     "error": repr(w.error) if w.error else None}
+                    for w in self.workers.values()]
+
+    @property
+    def registry(self):
+        return self._obs.registry if self._obs is not None else None
+
+    def kill_worker(self, name, sig=None):
+        """Test/ops helper: SIGKILL a spawned worker process."""
+        import signal as _signal
+        wh = self.workers[name]
+        if wh.proc is None:
+            raise ValueError("worker %s was not spawned locally"
+                             % name)
+        os.kill(wh.proc.pid, sig or _signal.SIGKILL)
+
+    def close(self, timeout=30.0):
+        with self._lock:
+            self._closed = True
+            workers = list(self.workers.values())
+            # a result() waiter on another thread must not block
+            # forever on a request the shutdown abandons — fail every
+            # non-terminal request loudly (the in-process cluster
+            # DRAINS instead; this transport has no graceful drain
+            # yet, so honesty beats a silent hang)
+            for cr in self.requests.values():
+                if cr.state == "running":
+                    cr.state = "failed"
+                    cr.error = ClusterClosed(
+                        "cluster closed with the request in flight")
+                    self._terminal.append(cr.rid)
+                    cr.done_evt.set()
+        for wh in workers:
+            if wh.conn is not None:
+                try:
+                    wh.conn.send("shutdown", {})
+                except OSError:
+                    pass
+        for wh in workers:
+            if wh.proc is not None:
+                wh.proc.join(timeout=timeout)
+                if wh.proc.is_alive():
+                    wh.proc.terminate()
+                    wh.proc.join(timeout=5)
+            if wh.conn is not None:
+                wh.conn.close()
+        self._listener.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# --------------------------------------------------------------------------
+# disaggregated worker-process side
+# --------------------------------------------------------------------------
+
+class _DisaggWorker:
+    """One prefill or decode worker process: a single main loop owns
+    the engine (all device work stays on one thread — receive threads
+    only enqueue host bytes), a data listener serves peer page
+    fetches / the prefill→decode stream, and a control connection
+    carries submits/tokens/stats to the router."""
+
+    def __init__(self, name, role, router_host, router_port):
+        from .transport import connect, frames_to_tree, Listener
+        self.name = name
+        self.role = role
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.fetch_inbox: "queue.Queue" = queue.Queue()
+        self.router = connect(router_host, router_port, timeout=60.0,
+                              retry_until=60.0)
+        self.router.send("hello", {"name": name, "role": role,
+                                   "pid": os.getpid()})
+        got = self.router.recv(timeout=120.0)
+        if got in (None, "timeout") or got[0] != "config":
+            raise RuntimeError("worker %s: bad config handshake: %r"
+                               % (name, got))
+        _, meta, bufs = got
+        self.cfg = meta["cfg"]
+        self.watchdog_s = meta.get("watchdog_s", 30.0)
+        params = frames_to_tree(meta["params_meta"], bufs)
+        kw = dict(meta["engine_kwargs"])
+        if role == "prefill":
+            # the prefill replica's trie is the cluster's page source;
+            # speculation never pays on a 1-token budget
+            kw.update(prefix_cache=True, spec_K=0)
+        else:
+            kw.update(prefix_cache=False)
+        self.eng = ServingEngine(params, self.cfg, **kw)
+        # pre-warm the compiled step BEFORE reporting ready: the
+        # handshake timeout covers the compile, so the router's
+        # watchdog never mistakes a first-request compile for a stall
+        wid = self.eng.submit(np.ones(1, np.int32), 1)
+        self.eng.run()
+        del self.eng.requests[wid]
+        if self.eng.prefix is not None:
+            self.eng.prefix.clear()
+        for k in self.eng.stats:
+            self.eng.stats[k] = type(self.eng.stats[k])()
+        if self.eng.prefix is not None:
+            self.eng.prefix.evict_cb = self._on_evict
+        if role == "prefill":
+            self.eng.retire_cb = self._on_retire
+        self._evicted_keys: List[bytes] = []
+        from .page_streamer import PageStreamer, PageReceiver
+        self.streamer = PageStreamer(self.eng)
+        self.receiver = PageReceiver(self.eng)
+        # data plane: loopback for spawned local workers; an
+        # externally-placed worker (another host) sets
+        # MXNET_SERVE_DATA_HOST to ITS reachable address — we then
+        # bind all interfaces and advertise that address to peers
+        data_host = os.environ.get("MXNET_SERVE_DATA_HOST")
+        self.listener = Listener(
+            host="0.0.0.0" if data_host else "127.0.0.1")
+        self.listener.start(self._peer_handler)
+        self.router.send("ready",
+                         {"data_host": data_host or "127.0.0.1",
+                          "data_port": self.listener.port})
+        got = self.router.recv(timeout=120.0)
+        if got in (None, "timeout") or got[0] != "peers":
+            raise RuntimeError("worker %s: no peer map" % name)
+        self.peers = got[1]["peers"]
+        self._peer_conns: Dict[str, object] = {}
+        # request state: engine rid -> {rid, gen, meta, inp}
+        self.by_erid: Dict[int, dict] = {}
+        self.by_rid: Dict[int, int] = {}  # cluster rid -> engine rid
+        self._reported: Dict[int, int] = {}   # rid -> tokens reported
+        self.remote_hits = 0
+        self.remote_hit_tokens = 0
+        self.fetch_bytes = 0
+        self._fetch_seq = 0               # fetch/reply correlation
+        # rid -> lowest still-valid gen (per-request fence): a
+        # fenced-out zombie prefill's late frames must be DROPPED —
+        # letting them recreate staging would read as an out-of-order
+        # stream and a protocol error must not kill a healthy worker
+        self._fenced: Dict[int, int] = {}
+        self.transfer_ms: List[float] = []
+        self._last_stats = 0.0
+        self._running = True
+        threading.Thread(target=self._router_recv, daemon=True,
+                         name="disagg-router-recv").start()
+
+    # -- feeder threads -> inbox ------------------------------------
+    def _router_recv(self):
+        while True:
+            got = self.router.recv()
+            if got is None:
+                self.inbox.put(("_lost", None, None, None))
+                return
+            kind, meta, bufs = got
+            self.inbox.put((kind, meta, bufs, None))
+
+    def _peer_handler(self, conn):
+        """One accepted peer connection: prefill→decode page streams
+        and sibling FETCH requests; frames are enqueued with the conn
+        so the main loop can reply in order."""
+        while True:
+            got = conn.recv()
+            if got is None:
+                return
+            kind, meta, bufs = got
+            if kind == "fetch":
+                self.fetch_inbox.put((meta, bufs, conn))
+                # wake token: an idle main loop is parked on the
+                # general inbox — without it a fetch waits out the
+                # full idle poll (20 ms) before being served, which
+                # would dominate the remote-hit TTFT
+                self.inbox.put(("_wake", None, None, None))
+            else:
+                self.inbox.put((kind, meta, bufs, conn))
+
+    def _on_evict(self, key):
+        self._evicted_keys.append(key)
+
+    def _on_retire(self, req):
+        """Engine retire hook (prefill role): snapshot the finishing
+        request's page ids + cache depth before ``_release`` clears
+        them — the post-step handoff export streams from this
+        snapshot (freed pages stay byte-intact until the next step's
+        allocations)."""
+        st = self.by_erid.get(req.rid)
+        if st is not None:
+            st["final_pages"] = list(req.pages)
+            st["final_n_cached"] = req.n_cached
+            st["final_chain_upto"] = req.chain_upto
+
+    # -- remote prefix fetch (prefill role) -------------------------
+    def _peer_conn(self, owner):
+        from .transport import connect
+        conn = self._peer_conns.get(owner)
+        if conn is None or conn.closed:
+            p = self.peers[owner]
+            conn = connect(p["host"], p["port"], timeout=10.0)
+            self._peer_conns[owner] = conn
+        return conn
+
+    def _serve_fetches(self):
+        """Answer queued sibling FETCH requests (also called while
+        WAITING on our own fetch — two replicas fetching from each
+        other must not deadlock)."""
+        while True:
+            try:
+                meta, bufs, conn = self.fetch_inbox.get_nowait()
+            except queue.Empty:
+                return
+            tokens = np.frombuffer(bytes(bufs[0]), np.int32)
+            reply_bufs = []
+            n_full = 0
+            if self.eng.prefix is not None:
+                entries, pages, m = self.eng.prefix.match(tokens)
+                try:
+                    n_full = min(len(pages), m // self.eng.page_size)
+                    if n_full:
+                        from .page_streamer import pages_to_bufs
+                        reply_bufs = pages_to_bufs(
+                            self.eng.cache.export_pages(
+                                pages[:n_full]))
+                finally:
+                    self.eng.prefix.release(entries)
+            try:
+                conn.send("fetch_reply",
+                          {"n": n_full, "fid": meta.get("fid"),
+                           "t_send": time.perf_counter()},
+                          reply_bufs)
+                self.fetch_bytes += sum(
+                    memoryview(b).nbytes for b in reply_bufs)
+            except OSError:
+                pass                      # requester died: their loss
+
+    def _fetch_remote(self, owner, tokens, timeout=15.0):
+        """Fetch the longest cached chain for ``tokens`` from a
+        sibling replica and graft it into the local trie.  A miss (or
+        a dead/slow peer) degrades to a cold local prefill — the
+        exactness contract never depends on the fetch."""
+        from .page_streamer import bufs_to_pages
+        self._fetch_seq += 1
+        fid = self._fetch_seq
+        try:
+            conn = self._peer_conn(owner)
+            conn.send("fetch", {"fid": fid},
+                      [np.ascontiguousarray(tokens).data])
+        except (OSError, KeyError):
+            return 0
+        deadline = time.perf_counter() + timeout
+        while True:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                # a reply may still be in flight on this cached conn;
+                # drop the conn so a LATER fetch cannot mistake the
+                # stale reply (old tokens' page bytes!) for its own
+                self._peer_conns.pop(owner, None)
+                conn.close()
+                return 0
+            got = conn.recv(timeout=min(left, 0.05))
+            if got == "timeout":
+                self._serve_fetches()     # break fetch-fetch deadlock
+                continue
+            if got is None:
+                self._peer_conns.pop(owner, None)
+                return 0
+            kind, meta, bufs = got
+            if kind != "fetch_reply" or meta.get("fid") != fid:
+                continue                  # stale/uncorrelated frame
+            break
+        n = meta["n"]
+        if not n:
+            return 0
+        ps = self.eng.page_size
+        ids = self.eng.cache.alloc(n)
+        if ids is None:
+            return 0                      # pool too tight: stay cold
+        self.eng.cache.install_pages(
+            ids, bufs_to_pages(self.eng.cache, n, bufs))
+        created = self.eng.prefix.insert_chain(
+            tokens[:n * ps], ids, upto_page=n)
+        created_idx = {j for j, _ in created}
+        # pages whose chain position was already cached locally stay
+        # unowned — free them instead of leaking
+        extra = [ids[j] for j in range(n) if j not in created_idx]
+        if extra:
+            self.eng.cache.free(extra)
+        # the fetched entries are cache-owned (refcount 0 until a
+        # request maps them); drop the donor refs insert_chain took
+        self.eng.prefix.release([e for _, e in created])
+        self.remote_hits += 1
+        self.remote_hit_tokens += n * ps
+        self.transfer_ms.append(
+            (time.perf_counter() - meta["t_send"]) * 1e3)
+        # bytes are counted SENDER-side only (the owner's
+        # _serve_fetches), matching the prefill→decode stream
+        # convention — counting here too would double every fetch in
+        # cluster_page_bytes_streamed_total
+        return n * ps
+
+    # -- message handling -------------------------------------------
+    def _handle(self, kind, meta, bufs, conn):
+        if kind == "submit":
+            inp = np.frombuffer(bytes(bufs[0]), np.int32)
+            if meta.get("hint") and self.eng.prefix is not None:
+                entries, _, m_local = self.eng.prefix.match(inp)
+                self.eng.prefix.release(entries)
+                ps = self.eng.page_size
+                if meta["hint_depth"] * ps > (m_local // ps) * ps:
+                    self._fetch_remote(meta["hint"], inp)
+            try:
+                erid = self.eng.submit(
+                    inp, 1 if self.role == "prefill"
+                    else meta["max_new"], eos_id=meta["eos"])
+            except Exception as e:
+                # a request THIS engine rejects fails alone — it must
+                # not take the worker (and every other request on it)
+                # down with it
+                self.router.send("reqfail", {"rid": meta["rid"],
+                                             "gen": meta["gen"],
+                                             "msg": repr(e)})
+                return
+            self.by_erid[erid] = {"rid": meta["rid"],
+                                  "gen": meta["gen"],
+                                  "meta": meta, "inp": inp}
+            self.by_rid[meta["rid"]] = erid
+            self._reported[meta["rid"]] = 0
+        elif kind == "pages":
+            key = tuple(meta["srid"])
+            if key[1] < self._fenced.get(key[0], -1):
+                return                    # zombie incarnation's frame
+            try:
+                self.receiver.on_pages(key, meta["start"],
+                                       meta["n"], bufs)
+            except RuntimeError:
+                # a gapped stream cannot be resumed; drop ITS staging
+                # and let the router's reassignment recover — one bad
+                # stream must not take down the whole worker
+                self.receiver.abort(key)
+                return
+            self.transfer_ms.append(
+                (time.perf_counter() - meta["t_send"]) * 1e3)
+        elif kind == "handoff":
+            key = tuple(meta["srid"])
+            if key[1] < self._fenced.get(key[0], -1):
+                return
+            self.receiver.on_handoff(
+                key, meta["total"],
+                dict(meta, prompt=np.frombuffer(bytes(bufs[0]),
+                                                np.int32)))
+        elif kind == "abort":
+            self._abort(meta["rid"], meta["below_gen"])
+        elif kind == "drop":
+            # the prefill side completed this request itself: free
+            # any staged pages of its stream
+            self.receiver.abort(tuple(meta["srid"]))
+        elif kind == "stats_req":
+            self._send_stats(force=True, sid=meta.get("sid"))
+        elif kind == "_wake":
+            pass                          # fetch_inbox wake token
+        elif kind in ("shutdown", "_lost"):
+            self._running = False
+
+    def _abort(self, rid, below_gen):
+        """Fence a resubmitted incarnation: drop staged pages and any
+        running engine request with an older gen; remember the fence
+        so the zombie's LATE frames drop instead of recreating
+        staging."""
+        if below_gen > self._fenced.get(rid, -1):
+            self._fenced[rid] = below_gen
+            if len(self._fenced) > 4096:  # bound: oldest rids first
+                for k in sorted(self._fenced)[:1024]:
+                    del self._fenced[k]
+        for key in [k for k in self.receiver.staged_rids
+                    if k[0] == rid and k[1] < below_gen]:
+            self.receiver.abort(key)
+        erid = self.by_rid.get(rid)
+        if erid is not None and self.by_erid[erid]["gen"] < below_gen:
+            self.by_erid.pop(erid)
+            self.by_rid.pop(rid, None)
+            self._reported.pop(rid, None)
+            self.streamer.drop(erid)
+            if erid in self.eng.requests:
+                self.eng.cancel(erid)
+                del self.eng.requests[erid]
+
+    # -- per-step work ----------------------------------------------
+    def _admit_ready(self):
+        """Decode role: admit handed-off requests whose pages are all
+        installed, as slots free up."""
+        self.receiver.retry_installs()
+        for key in list(self.receiver.staged_rids):
+            if not self.receiver.ready(key):
+                continue
+            if self.eng.free_slots == 0:
+                return
+            pages, meta = self.receiver.take(key)
+            rid, gen = key
+            erid = self.eng.admit_prefilled(
+                meta["prompt"], meta["toks"], pages,
+                max_new_tokens=meta["max_new"], eos_id=meta["eos"])
+            self.by_erid[erid] = {"rid": rid, "gen": gen,
+                                  "meta": meta}
+            self.by_rid[rid] = erid
+            # report from zero: the handoff tokens travel to the
+            # router in OUR stream (single FIFO connection), not the
+            # prefill worker's — cross-connection ordering is the
+            # race _on_handed documents
+            self._reported[rid] = 0
+
+    def _abandon(self, erid, st):
+        """The decode peer is unreachable (connect refused, or a send
+        died mid-stream — which also means the decode side's in-order
+        page stream now has a gap): abandon this incarnation and hand
+        the request BACK to the router for reassignment.  Merely
+        relying on decode-death failover is not enough — the peer
+        PROCESS may be alive with only the data-plane link broken,
+        and its heartbeats would keep the watchdog quiet forever."""
+        try:
+            self.router.send("lost", {"rid": st["rid"],
+                                      "gen": st["gen"]})
+        except OSError:
+            pass                          # router gone: shutting down
+        self.streamer.drop(erid)
+        self.by_erid.pop(erid, None)
+        self.by_rid.pop(st["rid"], None)
+        self._reported.pop(st["rid"], None)
+        if erid in self.eng.requests:
+            if self.eng.requests[erid].state in ("queued", "running"):
+                self.eng.cancel(erid)
+            del self.eng.requests[erid]
+
+    def _stream_pages(self, finished):
+        """Prefill role: after a step, stream newly-completed pages of
+        every in-flight handoff; finish the stream + hand off for
+        requests that sampled their token this step."""
+        fin = set(finished or ())
+        for erid, st in list(self.by_erid.items()):
+            req = self.eng.requests.get(erid)
+            if req is None:
+                continue
+            final = erid in fin
+            dec = self._conn_or_none(st["meta"]["decode"])
+            if final:
+                out = self.streamer.pump(
+                    erid, st.get("final_n_cached", req.n_cached),
+                    st.get("final_pages", req.pages), final=True)
+            else:
+                out = self.streamer.pump(erid, req.n_cached,
+                                         req.pages)
+            if out is not None and dec is not None:
+                start, n, bufs = out
+                try:
+                    dec.send("pages",
+                             {"srid": (st["rid"], st["gen"]),
+                              "start": start, "n": n,
+                              "t_send": time.perf_counter()}, bufs)
+                except OSError:
+                    self._drop_peer(st["meta"]["decode"])
+                    dec = None            # gap in the stream: abandon
+            if dec is None and st["meta"]["max_new"] > 1:
+                self._abandon(erid, st)
+                continue
+            if final:
+                toks = [int(t) for t in req.generated]
+                total = self.streamer.pending(erid)
+                remaining = st["meta"]["max_new"] - len(toks)
+                eos = st["meta"]["eos"]
+                if eos is not None and toks and toks[-1] == eos:
+                    remaining = 0         # eos at prefill: complete
+                if remaining > 0:
+                    try:
+                        dec.send(
+                            "handoff",
+                            {"srid": (st["rid"], st["gen"]),
+                             "total": total, "toks": toks,
+                             "max_new": st["meta"]["max_new"],
+                             "eos": st["meta"]["eos"]},
+                            [np.ascontiguousarray(st["inp"]).data])
+                    except OSError:
+                        # the decode side never got the handoff:
+                        # reporting "handed" anyway would strand the
+                        # request on a worker that keeps heartbeating
+                        self._drop_peer(st["meta"]["decode"])
+                        self._report_inserts(
+                            req, st.get("final_chain_upto", 0))
+                        self._abandon(erid, st)
+                        continue
+                    # phase flip only — the decode worker reports the
+                    # tokens (see _on_handed)
+                    self.router.send("handed", {"rid": st["rid"],
+                                                "gen": st["gen"]})
+                else:
+                    # 1-token budget / eos at prefill: prefill was
+                    # the whole request — tell the decode side to
+                    # drop any pages already streamed to it, or they
+                    # leak in its staging
+                    self.router.send("done", {"rid": st["rid"],
+                                              "gen": st["gen"],
+                                              "toks": toks})
+                    if dec is not None:
+                        try:
+                            dec.send("drop",
+                                     {"srid": (st["rid"],
+                                               st["gen"])})
+                        except OSError:
+                            pass
+                self._report_inserts(req,
+                                     st.get("final_chain_upto", 0))
+                self.streamer.drop(erid)
+                self.by_erid.pop(erid, None)
+                self.by_rid.pop(st["rid"], None)
+                self._reported.pop(st["rid"], None)
+                del self.eng.requests[erid]
+
+    def _report_inserts(self, req, chain_upto):
+        """Tell the router which chains this replica now holds
+        (``chain_upto`` from the retire-time snapshot — ``_release``
+        zeroes the live field before this runs)."""
+        if self.eng.prefix is None or chain_upto == 0:
+            return
+        keys = chain_keys(req.prompt,
+                          self.eng.page_size)[:chain_upto]
+        if keys:
+            try:
+                self.router.send("insert", {"keys": keys})
+            except OSError:
+                pass
+
+    def _flush_tokens(self, finished):
+        """Decode role: stream each request's newly committed tokens;
+        DONE when finished."""
+        fin = set(finished or ())
+        for erid, st in list(self.by_erid.items()):
+            req = self.eng.requests.get(erid)
+            if req is None:
+                continue
+            rid = st["rid"]
+            new = [int(t) for t in
+                   req.generated[self._reported.get(rid, 0):]]
+            if erid in fin:
+                self.router.send("done", {"rid": rid,
+                                          "gen": st["gen"],
+                                          "toks": new})
+                self.by_erid.pop(erid, None)
+                self.by_rid.pop(rid, None)
+                self._reported.pop(rid, None)
+                del self.eng.requests[erid]
+            elif new:
+                self.router.send("tokens", {"rid": rid,
+                                            "gen": st["gen"],
+                                            "toks": new})
+                self._reported[rid] = len(req.generated)
+
+    def _conn_or_none(self, name):
+        try:
+            return self._peer_conn(name)
+        except (OSError, KeyError):
+            return None
+
+    def _drop_peer(self, name):
+        """Evict a cached peer connection after a send failure — the
+        Connection object never learns its socket died, so leaving it
+        cached would poison every later send to that peer even after
+        the peer recovers (the next ``_peer_conn`` reconnects)."""
+        conn = self._peer_conns.pop(name, None)
+        if conn is not None:
+            conn.close()
+
+    def _send_stats(self, force=False, sid=None):
+        now = time.perf_counter()
+        if not force and now - self._last_stats < 0.25:
+            return
+        self._last_stats = now
+        eng = self.eng
+        prefix = eng.prefix
+        stats = {
+            "role": self.role,
+            "steps": eng.stats["steps"],
+            "prefill_rows": eng.stats["prefill_rows"],
+            "decode_rows": eng.stats["decode_rows"],
+            "preemptions": eng.stats["preemptions"],
+            "prefix_hit_tokens": eng.stats["prefix_hit_tokens"],
+            "pages_in_use": eng.cache.pages_in_use,
+            "free_pages": eng.cache.free_pages,
+            "prefix_cached_pages":
+                0 if prefix is None else prefix.cached_pages,
+            "prefix_refs": 0 if prefix is None else prefix.refs_total,
+            "active_requests": len(self.by_erid),
+            "staged_rids": len(self.receiver.staged_rids),
+            "remote_hits": self.remote_hits,
+            "remote_hit_tokens": self.remote_hit_tokens,
+            "bytes_streamed": self.streamer.bytes_streamed_total
+            + self.fetch_bytes,
+            "pages_streamed": self.streamer.pages_streamed_total,
+            "pages_installed": self.receiver.pages_installed_total,
+            # send-then-clear: the router OBSERVES every sample it
+            # receives into the transfer histogram, so samples must
+            # travel exactly once (re-sending a sliding window would
+            # re-observe lingering samples every 0.25 s tick)
+            "transfer_ms": self.transfer_ms,
+        }
+        self.transfer_ms = []
+        if self._evicted_keys:
+            keys, self._evicted_keys = self._evicted_keys, []
+            try:
+                self.router.send("evict", {"keys": keys})
+            except OSError:
+                pass
+        try:
+            self.router.send("stats", {"stats": stats, "sid": sid})
+        except OSError:
+            self._running = False
+
+    # -- main loop ---------------------------------------------------
+    def run(self):
+        try:
+            while self._running:
+                drained = False
+                while True:
+                    try:
+                        item = self.inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    drained = True
+                    self._handle(*item)
+                self._serve_fetches()
+                if not self._running:
+                    break
+                if self.role == "decode":
+                    self._admit_ready()
+                busy = bool(self.eng._queue) or any(
+                    s is not None for s in self.eng._slots)
+                if busy:
+                    finished = self.eng.step()
+                    if self.role == "prefill":
+                        self._stream_pages(finished)
+                    else:
+                        self._flush_tokens(finished)
+                elif not drained:
+                    try:
+                        item = self.inbox.get(timeout=0.02)
+                        self._handle(*item)
+                    except queue.Empty:
+                        pass
+                self._send_stats()
+        except Exception as e:
+            try:
+                self.router.send("error", {"msg": repr(e)})
+            except OSError:
+                pass
+            raise
+        finally:
+            self.listener.close()
+            self.router.close()
+            for c in self._peer_conns.values():
+                c.close()
+
+
+def _disagg_worker_entry(name, role, router_host, router_port):
+    """Spawned-process entry point (multiprocessing spawn target).
+
+    Exits via ``os._exit``: a worker that ran its engine has live
+    PJRT/XLA thread pools whose C++ static destructors abort
+    (``std::terminate``) under normal interpreter teardown; the
+    router tracks liveness by connection EOF, so skipping teardown
+    loses nothing."""
+    try:
+        _DisaggWorker(name, role, router_host, router_port).run()
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        os._exit(1)
+    os._exit(0)
+
+
+def run_worker():
+    """Externally-launched worker entry (``tools/launch.py --launcher
+    serve`` or bare env): connects to the router named by
+    ``MXNET_SERVE_ROUTER_HOST``/``MXNET_SERVE_ROUTER_PORT`` as
+    ``MXNET_SERVE_WORKER`` with role ``MXNET_SERVE_ROLE``."""
+    _disagg_worker_entry(
+        os.environ["MXNET_SERVE_WORKER"],
+        os.environ.get("MXNET_SERVE_ROLE", "prefill"),
+        os.environ.get("MXNET_SERVE_ROUTER_HOST", "127.0.0.1"),
+        int(os.environ["MXNET_SERVE_ROUTER_PORT"]))

@@ -785,6 +785,14 @@ class ServingEngine:
                 "ServingEngine: registry= given but metrics=False — "
                 "the registry would be silently ignored")
         self._obs = _EngineObs(registry) if metrics else None
+        # optional retire hook (round 15, disaggregated serving): step
+        # frees a finished request's pages before returning, but the
+        # prefill worker must export them for the handoff stream —
+        # the callback runs at retire time, pages still assigned.
+        # (Freed page CONTENT stays intact until the NEXT step's
+        # allocations, so a post-step export of the snapshotted ids
+        # is race-free on the single engine thread.)
+        self.retire_cb = None
 
     # ------------------------------------------------------- intake --
     def submit(self, prompt, max_new_tokens, eos_id=None):
@@ -816,6 +824,74 @@ class ServingEngine:
             self._obs.submitted.inc()
             self._obs.g_queued.set(len(self._queue))
         return req.rid
+
+    @property
+    def free_slots(self):
+        """Decode slots currently unoccupied (the disaggregated decode
+        worker admits handed-off requests only when one is free)."""
+        return sum(r is None for r in self._slots)
+
+    def admit_prefilled(self, prompt, generated, pages, *,
+                        max_new_tokens, eos_id=None, rid=None):
+        """Adopt an externally-prefilled request (disaggregated
+        serving, round 15): ``pages`` were already allocated from THIS
+        engine's cache and installed with the k/v content of positions
+        ``[0, P + len(generated) - 1)`` (P = prompt length) — the
+        prefill replica's exact pool bytes.  ``generated`` must carry
+        at least the prefill side's first sampled token; the request
+        resumes mid-decode exactly where a single engine would be
+        after committing those tokens (``pending`` = the last one,
+        ``n_cached`` = P + len(generated) - 1), so under f32 greedy
+        the continuation is bit-identical to an undisturbed run.
+
+        Raises if no slot is free — the caller (the decode worker
+        loop) checks ``free_slots`` first and re-tries later rather
+        than queueing device pages behind a full engine."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        generated = [int(t) for t in generated]
+        if not generated:
+            raise ValueError("admit_prefilled: needs >= 1 committed "
+                             "token (the prefill side samples the "
+                             "first before handoff)")
+        if prompt.size < 1:
+            raise ValueError("admit_prefilled: empty prompt")
+        total = prompt.size + max_new_tokens
+        if total > self.max_seq or total > self.cfg.max_len:
+            raise ValueError(
+                "admit_prefilled: %d tokens > max_seq %d / max_len %d"
+                % (total, self.max_seq, self.cfg.max_len))
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        if not free:
+            raise RuntimeError("admit_prefilled: no free slot")
+        n_cached = prompt.size + len(generated) - 1
+        need = -(-n_cached // self.page_size) if n_cached else 0
+        if len(pages) < need:
+            raise ValueError(
+                "admit_prefilled: %d pages cannot cover %d cached "
+                "positions" % (len(pages), n_cached))
+        now = time.perf_counter()
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      eos_id=eos_id, submit_t=now, wait_start=now)
+        req.generated = generated
+        req.pending = generated[-1]
+        req.n_cached = n_cached
+        req.n_prefilled = n_cached
+        req.pages = list(pages)
+        req.slot = free[0]
+        req.state = "running"
+        self.requests[rid] = req
+        self._slots[req.slot] = req
+        self.stats["admitted"] += 1
+        if self._obs is not None:
+            self._obs.submitted.inc()
+            self._obs.admitted.inc()
+            self._obs.g_running.set(
+                sum(r is not None for r in self._slots))
+        return rid
 
     def cancel(self, rid):
         """Force-retire a request (frees its slot and pages
@@ -1249,6 +1325,8 @@ class ServingEngine:
                     break
             if done:
                 req.state = "done"
+                if self.retire_cb is not None:
+                    self.retire_cb(req)
                 self._release(req)
                 finished.append(req.rid)
                 if obs is not None:

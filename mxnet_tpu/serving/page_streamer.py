@@ -1,0 +1,221 @@
+"""KV-page streaming between disaggregated serving processes.
+
+The prefill replica owns a :class:`PageStreamer`: after every engine
+step it exports the pages a handoff request has **newly completed**
+(``n_cached`` crossed another page boundary) and frames them for the
+decode replica — so page transfer is pipelined with prefill chunks and
+decode-side installation overlaps the tail of prefill instead of
+starting after it.  The decode replica owns a :class:`PageReceiver`:
+arriving page content is installed into the local ``PagedKVCache`` as
+pool space allows (held as host bytes when the pool is momentarily
+dry), and a request is admitted the moment its final page and handoff
+metadata are in.
+
+Wire layout (the ``PAGES`` frame): raw buffers in pool order — for
+each layer, the ``kv`` page block then (under int8-KV) the ``s``
+scale block, shapes derived from the receiver's own pool config (the
+page is self-describing given the engine config both sides were built
+from; byte lengths are cross-checked on install).  Content bytes are
+EXACT pool bytes: under f32 the handed-off decode is bit-identical to
+a single-engine run, under int8-KV the quantized pages + f32 scales
+transfer losslessly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PageStreamer", "PageReceiver", "pages_to_bufs",
+           "bufs_to_pages", "page_wire_bytes"]
+
+
+def _page_shapes(cfg, page_size, kv_int8):
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    out = [("kv", (page_size, H, 2 * dh),
+            "int8" if kv_int8 else str(cfg.dtype))]
+    if kv_int8:
+        out.append(("s", (page_size, H, 2), "float32"))
+    return out
+
+
+def _raw(a) -> memoryview:
+    """Zero-copy byte view of an array — via a uint8 reinterpret for
+    extension dtypes (bfloat16) whose buffers numpy refuses to
+    export directly."""
+    a = np.ascontiguousarray(a)
+    try:
+        return a.data
+    except ValueError:
+        return a.view(np.uint8).data
+
+
+def pages_to_bufs(content) -> List:
+    """``PagedKVCache.export_pages`` output → ordered raw buffers."""
+    bufs = []
+    for layer in content:
+        bufs.append(_raw(layer["kv"]))
+        if "s" in layer:
+            bufs.append(_raw(layer["s"]))
+    return bufs
+
+
+def bufs_to_pages(cache, n: int, bufs: List):
+    """Ordered raw buffers → the ``install_pages`` content layout for
+    ``cache`` (shape/dtype derived from the cache's own pool config;
+    lengths are validated there)."""
+    from .transport import _np_dtype
+
+    shapes = _page_shapes(cache.cfg, cache.page_size, cache.kv_int8)
+    want = cache.cfg.n_layers * len(shapes)
+    if len(bufs) != want:
+        raise ValueError("page frame: %d buffers, expected %d "
+                         "(n_layers x pool keys)" % (len(bufs), want))
+    out, i = [], 0
+    for _ in range(cache.cfg.n_layers):
+        layer = {}
+        for key, shape, dtype in shapes:
+            # frombuffer on the received bytearray directly — bytes()
+            # here would re-copy every page payload on the hot
+            # install path (recv_into already landed them zero-copy)
+            layer[key] = np.frombuffer(
+                bufs[i], _np_dtype(dtype)).reshape((n,) + shape)
+            i += 1
+        out.append(layer)
+    return out
+
+
+def page_wire_bytes(cache, n: int) -> int:
+    """Bytes ``n`` pages cost on the wire (== their pool bytes)."""
+    return n * cache.bytes_per_page
+
+
+class PageStreamer:
+    """Prefill-side per-request streaming state: which pages have
+    already been sent, and which are newly ready after a step."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._sent: Dict[int, int] = {}          # rid -> pages sent
+        self.pages_streamed_total = 0
+        self.bytes_streamed_total = 0
+
+    def pending(self, rid: int) -> int:
+        return self._sent.get(rid, 0)
+
+    def pump(self, rid: int, n_cached: int, pages: List[int],
+             final: bool = False) -> Optional[Tuple[int, int, List]]:
+        """Export the request's newly-completed pages (``pages`` /
+        ``n_cached`` are passed in rather than read off the live
+        request: at handoff time the engine has already retired the
+        request and the ids come from the retire-time snapshot).
+        Returns ``(start_page, n_pages, bufs)`` or ``None`` when
+        nothing new is ready.  ``final=True`` includes the trailing
+        partial page (positions beyond ``n_cached`` in it are scratch
+        the decode side never reads)."""
+        ps = self.engine.page_size
+        ready = (n_cached + ps - 1) // ps if final \
+            else n_cached // ps
+        ready = min(ready, len(pages))
+        start = self._sent.get(rid, 0)
+        if ready <= start:
+            return None
+        content = self.engine.cache.export_pages(pages[start:ready])
+        self._sent[rid] = ready
+        n = ready - start
+        self.pages_streamed_total += n
+        self.bytes_streamed_total += page_wire_bytes(self.engine.cache,
+                                                     n)
+        return start, n, pages_to_bufs(content)
+
+    def drop(self, rid: int):
+        self._sent.pop(rid, None)
+
+
+class _Staged:
+    __slots__ = ("installed", "held", "next_idx", "total", "meta")
+
+    def __init__(self):
+        self.installed: List[int] = []    # local page ids, in order
+        self.held: List = []              # content awaiting pool space
+        self.next_idx = 0                 # next page index expected
+        self.total: Optional[int] = None  # set by the handoff frame
+        self.meta: Optional[dict] = None  # handoff metadata
+
+
+class PageReceiver:
+    """Decode-side staging: install arriving pages eagerly (pipelined
+    with the prefill tail), hold content host-side when the pool is
+    dry, admit when complete."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._staged: Dict[int, _Staged] = {}
+        self.pages_installed_total = 0
+
+    def on_pages(self, rid: int, start: int, n: int, bufs: List):
+        """A ``PAGES`` frame arrived: stage (and, pool permitting,
+        install) its content.  Out-of-order frames are a protocol
+        error — pages ride one in-order TCP stream."""
+        st = self._staged.setdefault(rid, _Staged())
+        expect = st.next_idx + sum(h[0] for h in st.held)
+        if start != expect:
+            raise RuntimeError(
+                "page stream for rid %r out of order: got start %d, "
+                "expected %d" % (rid, start, expect))
+        st.held.append((n, bufs))
+        self._try_install(st)
+
+    def on_handoff(self, rid: int, total_pages: int, meta: dict):
+        st = self._staged.setdefault(rid, _Staged())
+        st.total = total_pages
+        st.meta = meta
+        self._try_install(st)
+
+    def _try_install(self, st: _Staged):
+        while st.held:
+            n, bufs = st.held[0]
+            ids = self.engine.cache.alloc(n)
+            if ids is None:
+                return                    # pool dry: hold host-side
+            content = bufs_to_pages(self.engine.cache, n, bufs)
+            self.engine.cache.install_pages(ids, content)
+            st.installed.extend(ids)
+            st.next_idx += n
+            st.held.pop(0)
+            self.pages_installed_total += n
+
+    def ready(self, rid: int) -> bool:
+        """All pages installed + handoff metadata present?"""
+        st = self._staged.get(rid)
+        return (st is not None and st.total is not None
+                and not st.held and st.next_idx == st.total)
+
+    def retry_installs(self):
+        """Pool pressure may have eased (a request retired): drain
+        held content."""
+        for st in self._staged.values():
+            self._try_install(st)
+
+    def take(self, rid: int) -> Tuple[List[int], dict]:
+        """Claim a ready request's installed pages + handoff meta (the
+        caller passes them to ``engine.admit_prefilled``); the staging
+        record is dropped — pages now belong to the engine request."""
+        st = self._staged.pop(rid)
+        return st.installed, st.meta
+
+    def abort(self, rid: int) -> int:
+        """Drop a partially-streamed request (its prefill replica
+        died, or the router resubmitted it): free installed pages,
+        discard held content.  Returns pages freed."""
+        st = self._staged.pop(rid, None)
+        if st is None:
+            return 0
+        if st.installed:
+            self.engine.cache.free(st.installed)
+        return len(st.installed)
+
+    @property
+    def staged_rids(self):
+        return list(self._staged)

@@ -44,10 +44,26 @@ replicated by construction: the free list, block tables, page ids,
 and the prefix-cache trie are plain Python ints/dicts; a page id
 means "this slice of every device's pool shard", so allocation,
 COW, and prefix reuse are tp-oblivious.
+
+Disaggregated serving (round 15) makes a page the **unit of
+transfer**: :meth:`PagedKVCache.export_pages` gathers N pages of every
+layer pool to host numpy (one device gather + one device→host copy per
+pool key), and :meth:`PagedKVCache.install_pages` scatters received
+page content into freshly-allocated local pages through a jitted,
+pool-donating program (``_make_install`` — same in-place-update
+contract as the engine's step, audited by graphlint as
+``serving_page_install``).  Page counts are padded to power-of-two
+buckets so the compiled gather/install programs stay O(log pool)
+per config; padding rows target scratch page 0, whose contents are
+never read.  The wire layout is exactly the pool layout — int8 pages
++ f32 scale pages under int8-KV — so a page moves as the compact,
+quantized, self-describing unit the round-7/round-4 design already
+made it.
 """
 from __future__ import annotations
 
 from collections import deque
+from typing import Any, Dict
 
 __all__ = ["PagedKVCache", "contiguous_kv_bytes"]
 
@@ -55,6 +71,78 @@ __all__ = ["PagedKVCache", "contiguous_kv_bytes"]
 def _dtype_size(dtype):
     import jax.numpy as jnp
     return jnp.dtype(dtype).itemsize
+
+
+def _bucket(n):
+    """Smallest power of two >= n (compile-count bound for the
+    export/install programs)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# jitted page gather/scatter programs, keyed by pool config + bucket —
+# module-level like the engine's _step_cache/_copy_cache so the
+# interleaving explorer's many short-lived engines share compilations
+_xfer_cache: Dict[Any, Any] = {}
+_XFER_CACHE_MAX = 32
+
+
+def _make_install(cfg, kv_int8, bucket, mesh=None):
+    """Jitted whole-page scatter: install ``bucket`` pages of received
+    content into the donated pools at ``ids`` (padding ids point at
+    scratch page 0 — written, never read).  Donation keeps the pools
+    updating in place exactly like the step program; graphlint's
+    ``serving_page_install`` registry entry gates it."""
+    import jax
+
+    key = ("install", cfg, bool(kv_int8), bucket, mesh)
+    fn = _xfer_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def install(pools, ids, content):
+        out = []
+        for pool, new in zip(pools, content):
+            o = {"kv": pool["kv"].at[ids].set(new["kv"])}
+            if "s" in pool:
+                o["s"] = pool["s"].at[ids].set(new["s"])
+            out.append(o)
+        return out
+
+    fn = jax.jit(install, donate_argnums=(0,))
+    if len(_xfer_cache) >= _XFER_CACHE_MAX:
+        _xfer_cache.pop(next(iter(_xfer_cache)))
+    _xfer_cache[key] = fn
+    return fn
+
+
+def _make_export(cfg, kv_int8, bucket, mesh=None):
+    """Jitted whole-page gather: ``bucket`` pages of every layer pool
+    as one stacked array per pool key (the host slices off padding
+    after the one device→host copy)."""
+    import jax
+
+    key = ("export", cfg, bool(kv_int8), bucket, mesh)
+    fn = _xfer_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def export(pools, ids):
+        out = []
+        for pool in pools:
+            o = {"kv": pool["kv"][ids]}
+            if "s" in pool:
+                o["s"] = pool["s"][ids]
+            out.append(o)
+        return out
+
+    fn = jax.jit(export)
+    if len(_xfer_cache) >= _XFER_CACHE_MAX:
+        _xfer_cache.pop(next(iter(_xfer_cache)))
+    _xfer_cache[key] = fn
+    return fn
 
 
 def contiguous_kv_bytes(cfg, batch, total, kv_int8=False):
@@ -185,6 +273,66 @@ class PagedKVCache:
         self._free.extend(pages)
         self._in_use -= len(pages)
         self.freed_pages_total += len(pages)
+
+    # ---------------------------------------------- page transfer ----
+    def export_pages(self, page_ids):
+        """Gather ``page_ids``' content across every layer pool to
+        host numpy: a list (per layer) of ``{"kv": (n, ps, H, 2dh)}``
+        (+ ``"s"`` under int8-KV) arrays in ``page_ids`` order — the
+        disaggregated wire payload, byte-identical to the pool layout.
+        One jitted gather + one device→host copy per call (bucketed
+        page count, so compilations stay bounded)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = len(page_ids)
+        if n == 0:
+            return []
+        b = _bucket(n)
+        ids = np.zeros(b, np.int32)       # padding gathers scratch
+        ids[:n] = page_ids
+        fn = _make_export(self.cfg, self.kv_int8, b, mesh=self.mesh)
+        out = jax.device_get(fn(self.pools, jnp.asarray(ids)))
+        return [{k: v[:n] for k, v in layer.items()} for layer in out]
+
+    def install_pages(self, page_ids, content):
+        """Scatter received page ``content`` (the ``export_pages``
+        layout, host arrays or buffer-backed views) into this pool's
+        ``page_ids`` (already allocated by the caller).  Runs the
+        jitted donating install program — the pools update in place
+        and ``self.pools`` is reassigned, exactly like a step."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = len(page_ids)
+        if n == 0:
+            return
+        if len(content) != self.cfg.n_layers:
+            raise ValueError(
+                "install_pages: %d layers of content for a %d-layer "
+                "pool" % (len(content), self.cfg.n_layers))
+        b = _bucket(n)
+        ids = np.zeros(b, np.int32)       # padding scatters to scratch
+        ids[:n] = page_ids
+        padded = []
+        for layer, pool in zip(content, self.pools):
+            lay = {}
+            for k, ref in pool.items():
+                a = np.asarray(layer[k])
+                want = (n,) + tuple(ref.shape[1:])
+                if a.shape != want or a.dtype != ref.dtype:
+                    raise ValueError(
+                        "install_pages: content %s %r/%s does not "
+                        "match pool page shape %r/%s"
+                        % (k, a.shape, a.dtype, want, ref.dtype))
+                if b != n:
+                    pad = np.zeros((b - n,) + want[1:], a.dtype)
+                    a = np.concatenate([a, pad], axis=0)
+                lay[k] = jnp.asarray(a)
+            padded.append(lay)
+        fn = _make_install(self.cfg, self.kv_int8, b, mesh=self.mesh)
+        self.pools = fn(self.pools, jnp.asarray(ids), padded)
 
     # -------------------------------------------------- accounting ---
     @property

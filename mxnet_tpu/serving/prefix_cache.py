@@ -49,15 +49,32 @@ operation here, the COW page copy at a divergence, rides the same
 heads-sharded donated program as the step
 (``engine._make_copy(mesh=...)``) — each device copies its 1/tp of
 the page in place.
+
+Disaggregated serving (round 15) promotes the trie's KNOWLEDGE — not
+its pages — to the cluster: the router process owns a
+:class:`ClusterPrefixIndex` mapping each chain key (the same
+content-cumulative keys :func:`chain_keys` produces) to the replica
+that holds the pages.  Replicas report inserts and evictions as
+messages where the in-process cluster made direct calls; a replica
+that matches another replica's chain fetches the page BYTES over the
+transport and grafts them into its own trie — the hot prefix is
+prefilled once per cluster, then copied, never recomputed.
+First-inserter-wins keeps "who computed it" well-defined; a dead
+replica's keys drop wholesale (``drop_owner``) so stale hints can at
+worst cost one failed fetch (the requester falls back to a cold
+prefill, still exact).  ``PrefixCache.evict_cb`` is the replica-side
+hook: pressure eviction of a chain entry reports its cumulative key
+so the router index never advertises pages that are gone.
 """
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional, Tuple
+import threading
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache", "chain_keys"]
+__all__ = ["PrefixCache", "ClusterPrefixIndex", "chain_keys"]
 
 _ROOT_ID = 0
 
@@ -119,6 +136,12 @@ class PrefixCache:
         self.pages_inserted_total = 0
         self.pages_evicted_total = 0
         self.cow_total = 0
+        # optional eviction hook (round 15, disaggregated serving):
+        # called with the dropped entry's cumulative chain key so the
+        # replica can report the eviction to the router's
+        # ClusterPrefixIndex — the remote-protocol twin of what used
+        # to be an in-process refcount/eviction call
+        self.evict_cb = None
 
     # ------------------------------------------------------ queries --
     @property
@@ -271,7 +294,20 @@ class PrefixCache:
             freed += 1
         return freed
 
+    def chain_key(self, e: _Entry) -> bytes:
+        """The entry's cumulative content key — the same bytes
+        :func:`chain_keys` would produce for its page position, built
+        by walking the parent chain (root block first)."""
+        blocks = []
+        node: Optional[_Entry] = e
+        while node is not None:
+            blocks.append(node.block)
+            node = node.parent
+        return b"".join(reversed(blocks))
+
     def _drop(self, e: _Entry):
+        if self.evict_cb is not None:
+            self.evict_cb(self.chain_key(e))
         parent_id = e.parent.eid if e.parent is not None else _ROOT_ID
         del self._by_key[(parent_id, e.block)]
         kids = self._children.get(parent_id)
@@ -289,3 +325,72 @@ class PrefixCache:
         referenced by running requests survive."""
         while self.evict(len(self._by_key)):
             pass
+
+
+class ClusterPrefixIndex:
+    """Router-owned cluster-level prefix index (round 15): which
+    replica holds the pages for each content chain key.
+
+    First-inserter-wins — a key's owner is the replica that COMPUTED
+    the chain (later replicas fetch copies; their local tries serve
+    their own traffic but the cluster index keeps pointing at one
+    canonical source, so "prefilled once per cluster" stays a
+    well-defined claim the obs counters can reconcile).  Eviction
+    messages remove a key only if the reporter owns it; a dead
+    replica's keys drop wholesale.  Thread-safe: the router's
+    per-connection receive threads all report here."""
+
+    def __init__(self, capacity: int = 65536):
+        self._mu = threading.Lock()
+        self._owner: Dict[bytes, str] = {}
+        self._by_owner: Dict[str, Set[bytes]] = {}
+        self._cap = int(capacity)
+        self.keys_inserted_total = 0
+        self.keys_evicted_total = 0
+        self.hints_total = 0
+
+    def __len__(self):
+        with self._mu:
+            return len(self._owner)
+
+    def match(self, keys: List[bytes]) -> Tuple[Optional[str], int]:
+        """Longest consecutive head of ``keys`` held by ONE replica:
+        returns ``(owner, depth_pages)`` (``(None, 0)`` on a cold
+        prefix).  Chains are cumulative, so a single owner covering
+        ``keys[:d]`` holds a contiguous chain from the root."""
+        with self._mu:
+            owner = self._owner.get(keys[0]) if keys else None
+            if owner is None:
+                return None, 0
+            d = 1
+            while d < len(keys) and self._owner.get(keys[d]) == owner:
+                d += 1
+            self.hints_total += 1
+            return owner, d
+
+    def report_insert(self, owner: str, keys: List[bytes]):
+        with self._mu:
+            mine = self._by_owner.setdefault(owner, set())
+            for k in keys:
+                if k not in self._owner:
+                    if len(self._owner) >= self._cap:
+                        break             # bounded: stop indexing, not
+                    self._owner[k] = owner  # serving
+                    mine.add(k)
+                    self.keys_inserted_total += 1
+
+    def report_evict(self, owner: str, keys: List[bytes]):
+        with self._mu:
+            mine = self._by_owner.get(owner, set())
+            for k in keys:
+                if self._owner.get(k) == owner:
+                    del self._owner[k]
+                    mine.discard(k)
+                    self.keys_evicted_total += 1
+
+    def drop_owner(self, owner: str):
+        """A replica process died: none of its pages exist anymore."""
+        with self._mu:
+            for k in self._by_owner.pop(owner, set()):
+                if self._owner.get(k) == owner:
+                    del self._owner[k]

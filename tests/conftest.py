@@ -69,6 +69,11 @@ _SLOW_GROUPS = {
     # config compiles a mesh-lowered step program on the virtual
     # 8-device mesh; isolated for the same compile-budget reason as g)
     "test_serving_tp": "i",
+    # group j: ~4min — round-15 disaggregated prefill/decode serving
+    # (each test spawns 2-3 worker OS processes that each import jax
+    # and compile a step program; isolated so the per-test process
+    # spawn cost never squeezes another group's budget)
+    "test_serving_disagg": "j",
 }
 
 

@@ -279,6 +279,30 @@ def build_serving_step_tp():
     return fn, args
 
 
+def build_serving_page_install():
+    """The disaggregated page-install scatter (round 15): received
+    page content lands in the donated pools in place — same
+    in-place-update contract as the step program, so its donation and
+    HBM peak are gated like the step's (``serving/paged_kv.py
+    _make_install``; bucket 4 pages, int8-KV layout)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.paged_kv import _make_install
+    cfg = _gpt_cfg()
+    _, _, num_pages = _serve_geometry(cfg)
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    b = 4
+    fn = _make_install(cfg, True, b)
+    content = [{"kv": jax.ShapeDtypeStruct((b, _PAGE, H, 2 * dh),
+                                           jnp.int8),
+                "s": jax.ShapeDtypeStruct((b, _PAGE, H, 2),
+                                          jnp.float32)}
+               for _ in range(cfg.n_layers)]
+    return fn, (_abstract_pools(cfg, num_pages),
+                jax.ShapeDtypeStruct((b,), jnp.int32), content)
+
+
 def build_cow_page_copy():
     import jax
     import jax.numpy as jnp
@@ -393,6 +417,8 @@ def live_programs() -> List[ProgramSpec]:
              dtype_region="int8", f32_allow=acc),
         spec("cow_page_copy", build_cow_page_copy, donate=(0,),
              dtype_region="int8", f32_allow={}),
+        spec("serving_page_install", build_serving_page_install,
+             donate=(0,), dtype_region="int8", f32_allow={}),
         spec("gpt_generate", build_gpt_generate,
              dtype_region="int8", f32_allow=gen_acc),
         spec("gpt_spec_block", build_gpt_spec_block,

@@ -74,6 +74,14 @@ HOT_REGIONS: List[Tuple[str, str]] = [
      r"|_monitor_loop|_fail_replica|drain_replica)$"),
     ("mxnet_tpu/serving/prefix_cache.py",
      r"(?:.*\.)?(match|insert_chain|evict|_drop)$"),
+    # round 15: the disaggregated page export/install paths run per
+    # transfer on the worker main loop — the ONE device round-trip
+    # each (gather→host, host→scatter) is the transfer itself; any
+    # additional sync, in-loop jit, or clock mix here stalls the
+    # prefill→decode pipeline per page frame
+    ("mxnet_tpu/serving/paged_kv.py",
+     r"(?:.*\.)?(export_pages|install_pages)$"),
+    ("mxnet_tpu/serving/page_streamer.py", r".*"),
     # round 12: the metrics-registry mutation path — instrument
     # creation and reset run under the registry lock; a device sync or
     # in-loop jit there blocks every scrape and engine step behind it

@@ -72,7 +72,11 @@ resolve through ``self`` exactly, through typed attributes
 the method name is **unique** across the analyzed modules — ambiguous
 names contribute no call edge rather than false ones.  Locks on
 non-``self`` receivers are identified by (module, attribute) — good
-enough while each module spells its locks distinctly.
+enough while each module spells its locks distinctly.  Guarded-field
+groups for non-``self`` receivers are scoped by the WRITING class as
+well as the attribute (round 15): two router classes in one module
+each mutating their own request records under their own lock must
+not alias into one group and flag the minority lock's sites.
 """
 from __future__ import annotations
 
@@ -590,7 +594,14 @@ class _FnScanner:
             if recv == "self" and fn.cls:
                 group = "%s.%s" % (fn.cls, attr)
             else:
-                group = "::%s" % attr
+                # non-self receivers scope to the WRITING class as
+                # well as the attribute (round 15): ServingCluster
+                # and DisaggServingCluster both mutate request
+                # records with `state`/`error`/... fields, each
+                # consistently under its OWN router lock — keying by
+                # bare attribute aliased the two classes' disciplines
+                # and flagged every site under the minority lock
+                group = "%s::%s" % (fn.cls or "", attr)
             self.prog.writes.setdefault((fn.mod, group), []).append(
                 (line, tuple(sorted(held)), in_init, fn.qual, attr))
 

@@ -171,6 +171,47 @@ def launch_mpi(args, command, runner=None):
         return 127
 
 
+def launch_serve(args, command):
+    """Role-aware disaggregated-serving launcher (round 15): spawn
+    ``--prefill`` + ``--decode`` worker processes running
+    ``mxnet_tpu.serving.run_worker`` and the given command as the
+    ROUTER process, all wired through ``MXNET_SERVE_*`` env.  The
+    router script must build ``DisaggServingCluster(...,
+    spawn=False, prefill=<n>, decode=<m>,
+    port=int(os.environ["MXNET_SERVE_ROUTER_PORT"]))`` — worker
+    processes connect to it exactly like locally-spawned ones, so
+    the same protocol scales from this single-host topology to one
+    worker per host (run ``run_worker()`` remotely with the env
+    pointing at the router)."""
+    port = args.port or _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "MXNET_SERVE_ROUTER_HOST": "127.0.0.1",
+        "MXNET_SERVE_ROUTER_PORT": str(port),
+        "MXNET_SERVE_PREFILL": str(args.prefill),
+        "MXNET_SERVE_DECODE": str(args.decode),
+    })
+    router = subprocess.Popen(command, env=base_env)
+    workers = []
+    for role, n in (("prefill", args.prefill),
+                    ("decode", args.decode)):
+        for i in range(n):
+            env = dict(base_env)
+            env["MXNET_SERVE_ROLE"] = role
+            env["MXNET_SERVE_WORKER"] = "%s%d" % (role, i)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 "from mxnet_tpu.serving import run_worker; "
+                 "run_worker()"], env=env))
+    try:
+        code = router.wait()
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+    return code
+
+
 def launch_sge(args, command):
     """SGE launcher (reference: ``dmlc_tracker/sge.py``): submit a job
     ARRAY of num_servers + num_workers tasks via ``qsub``; each task
@@ -218,17 +259,26 @@ def launch_sge(args, command):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-n", "--num-workers", type=int, default=None)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--launcher", choices=["local", "ssh", "mpi",
-                                           "slurm", "sge", "yarn"],
+                                           "slurm", "sge", "yarn",
+                                           "serve"],
                     default="local")
+    ap.add_argument("--prefill", type=int, default=1,
+                    help="serve launcher: prefill worker processes")
+    ap.add_argument("--decode", type=int, default=1,
+                    help="serve launcher: decode worker processes")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("-p", "--port", type=int, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.launcher == "serve":
+        sys.exit(launch_serve(args, args.command))
+    if args.num_workers is None:
+        ap.error("-n/--num-workers is required for this launcher")
     if args.launcher == "local":
         sys.exit(launch_local(args, args.command))
     if args.launcher in ("mpi", "slurm"):
