@@ -289,6 +289,32 @@ def bench_gpt_serve_disagg_remote_hit():
     return serve_bench.run_gate_disagg("full")["ttft_remote_hit_ms"]
 
 
+def bench_gpt_serve_goodput():
+    """Goodput SLO gate (round 16): percent of arrivals that COMPLETE
+    within their per-request SLO (TTFT + worst inter-token gap
+    budgets) through the scripted burst10x scenario — a 10× arrival
+    burst over a diurnal ramp with heavy-tailed lengths, one replica
+    killed mid-burst, the metrics-driven autoscaler reacting
+    (serve_bench.run_gate_goodput, full preset).  This is the "stays
+    up" gate: tok/s gates measure speed at steady state, this one
+    measures completions a client would call good while the cluster
+    is being hurt.  The run itself hard-fails (RuntimeError) unless
+    every request completes bit-identical to the generate oracle with
+    zero leaked pages/refs — the gate VALUE is only the SLO fraction.
+    Direction "higher": v >= lo.  Reproducibility is enforced here:
+    the row must carry the trace seed + sha (the same pair checked
+    into MULTICHIP_r08.json) or the gate refuses to report."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    row = serve_bench.run_gate_goodput("full")
+    if not row.get("trace_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_serve_goodput: result row carries no trace seed/sha "
+            "— the measurement is not reproducible; refusing to gate "
+            "it (got keys %s)" % sorted(row))
+    return 100.0 * row["goodput_frac"]
+
+
 def bench_gpt_spec_decode():
     """Speculative decode gate (round 6): batch 8, w8 target, ngram
     (prompt-lookup) drafter at K=4 on the structured ("loop") workload
@@ -353,6 +379,7 @@ BENCHES = {
     "gpt_serve_decode_step_ms": (bench_gpt_serve_decode_step, "lower"),
     "gpt_serve_disagg_remote_hit_ttft_ms":
         (bench_gpt_serve_disagg_remote_hit, "lower"),
+    "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
 }
 
 BAR = 0.15

@@ -80,11 +80,27 @@ and external measurements subtract cleanly.
   sharded-collective overhead, not ICI — the per-device-bytes and
   identity columns are the claims; the chip prices the speed.
 
+* ``trace`` (round 16, ``--trace burst10x`` or a
+  ``traffic_trace.py`` JSON file) — OPEN-LOOP replay of a seeded
+  workload trace (diurnal ramp + 10× burst + heavy-tailed lengths)
+  against ``ServingCluster`` (or ``DisaggServingCluster`` with
+  ``--disagg``), with the metrics-driven autoscaler live and a
+  seeded chaos schedule (one replica death mid-burst; real SIGKILL
+  for disagg).  Reports GOODPUT (completions meeting per-request
+  TTFT + worst-token-gap SLO) and hard-fails unless every request
+  completes bit-identical to the ``generate`` oracle with zero
+  leaked pages/refs after the scaler returns to min size.  Runs
+  ALONE (it owns the replica topology); the row carries the trace
+  seed + sha256 so ``MULTICHIP_r08.json`` reproduces from the
+  checked-in seed (docs/perf.md "Traffic realism").
+
 The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
 ``gpt_serve_metrics_overhead_pct`` / ``gpt_serve_prefix_hit_ttft_ms``
-/ ``gpt_serve_decode_step_ms`` gates (benchmark/perf_regression.py)
-run ``run_gate()`` / ``run_gate_telemetry()`` / ``run_gate_prefix()``
-/ ``run_gate_decode_step()`` below on the full-size preset.
+/ ``gpt_serve_decode_step_ms`` / ``gpt_serve_goodput`` gates
+(benchmark/perf_regression.py) run ``run_gate()`` /
+``run_gate_telemetry()`` / ``run_gate_prefix()`` /
+``run_gate_decode_step()`` / ``run_gate_goodput()`` below on the
+full-size preset.
 """
 import argparse
 import dataclasses
@@ -122,6 +138,14 @@ class Preset:
     rate: float = 100.0                   # arrivals/sec
     prompt_lens: tuple = (16, 32, 64, 128, 192)
     out_lens: tuple = (16, 32, 64, 128, 160)
+    # per-request SLO budgets for the round-16 trace-replay goodput
+    # section (docs/perf.md "Traffic realism"): TTFT covers admission
+    # queueing + chunked prefill at burst depth; the worst inter-token
+    # gap covers a preemption re-prefill or one replica failover —
+    # sized so steady-state traffic passes with margin and sustained
+    # overload / unabsorbed faults do not
+    slo_ttft_ms: float = 1000.0
+    slo_tbt_ms: float = 350.0
 
 
 PRESETS = {
@@ -131,12 +155,14 @@ PRESETS = {
                   n_layers=4, d_ff=1024, max_len=256, w8=False,
                   dtype="float32", num_slots=8, page_size=16,
                   prefill_chunk=16, n_requests=32, rate=64.0,
-                  prompt_lens=(8, 16, 32, 64), out_lens=(8, 16, 32, 64)),
+                  prompt_lens=(8, 16, 32, 64), out_lens=(8, 16, 32, 64),
+                  slo_ttft_ms=750.0, slo_tbt_ms=250.0),
     "quick": Preset("quick", vocab=256, d_model=64, n_heads=4,
                     n_layers=2, d_ff=128, max_len=64, w8=False,
                     dtype="float32", num_slots=4, page_size=4,
                     prefill_chunk=8, n_requests=8, rate=50.0,
-                    prompt_lens=(4, 8, 12), out_lens=(4, 8, 12)),
+                    prompt_lens=(4, 8, 12), out_lens=(4, 8, 12),
+                    slo_ttft_ms=500.0, slo_tbt_ms=200.0),
 }
 
 
@@ -836,6 +862,301 @@ def run_gate_disagg(preset="full"):
     return out
 
 
+# ------------------------------------------ round-16 traffic realism ---
+
+def _trace_spec(p, seed, duration_s=None):
+    """The scripted burst10x trace spec for a preset: one diurnal
+    cycle, a 10× burst window in its rising half, heavy-tailed
+    lengths clamped to the preset's shapes (prompt lengths snapped to
+    a geometric grid so the exactness oracle compiles a handful of
+    ``generate`` programs, not one per length)."""
+    import traffic_trace as TT
+    if duration_s is None:
+        duration_s = 1.5 if p.name == "quick" else 4.0
+    return TT.burst10x_spec(
+        seed=seed, vocab=p.vocab,
+        max_total=max(p.prompt_lens) + max(p.out_lens),
+        base_rate=p.rate / 4.0, duration_s=duration_s,
+        prompt_max=max(p.prompt_lens), out_max=max(p.out_lens))
+
+
+def _oracle_outputs(params, cfg, reqs):
+    """Single-engine ``generate`` oracle for a list of (prompt, n)
+    requests, grouped by prompt length (one compile per distinct
+    length) and chunked to bound the contiguous KV allocation.
+    Returns the full continuation per request index."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    by_len = {}
+    for i, (prompt, n) in enumerate(reqs):
+        by_len.setdefault(len(prompt), []).append((i, prompt, n))
+    out = [None] * len(reqs)
+    for P, group in sorted(by_len.items()):
+        n_max = max(n for _, _, n in group)
+        for k in range(0, len(group), 32):
+            chunk = group[k:k + 32]
+            batch = jnp.asarray(np.stack([pr for _, pr, _ in chunk]))
+            o = np.asarray(gpt.generate(params, cfg, batch, n_max))
+            for (i, _, n), row in zip(chunk, o):
+                out[i] = row[:P + n].astype(np.int32)
+    return out
+
+
+def run_trace_replay(params, cfg, p, trace, *, disagg=False,
+                     autoscale=True, min_replicas=2, max_replicas=4,
+                     chaos_events=None, chaos_seed=0, slo=None,
+                     verify_oracle=True):
+    """Round-16 headline section: OPEN-LOOP replay of a seeded
+    workload trace (diurnal ramp + 10× burst + heavy-tailed lengths,
+    ``benchmark/traffic_trace.py``) against the serving cluster, with
+    the metrics-driven autoscaler live and a seeded chaos schedule
+    firing at trace-relative times.
+
+    Reports GOODPUT — completions that met their per-request SLO
+    (TTFT and worst inter-token gap budgets), as a fraction of all
+    arrivals and as SLO-good tokens per wall second — alongside the
+    raw tok/s the earlier sections report.  Open loop means arrivals
+    never wait for the cluster: a queue the autoscaler fails to drain
+    shows up as TTFT-violating (or rejected) requests, exactly as a
+    real front door would see it.
+
+    Hard checks, each a RuntimeError (the acceptance criteria of the
+    round, reconciled rather than asserted in prose): every submitted
+    request completes; every completed output is BIT-IDENTICAL to the
+    single-engine ``generate`` oracle (f32 greedy); after the drain
+    the autoscaler has returned to ``min_replicas`` and no replica
+    holds a page or a prefix ref beyond its cache-owned set.
+
+    The result row carries ``seed`` and ``trace_sha`` so the run is
+    reproducible from the checked-in JSON alone
+    (``perf_regression.py`` refuses a goodput gate without the hash).
+    """
+    import traffic_trace as TT
+    from mxnet_tpu.serving import (Autoscaler, ChaosDriver,
+                                   ChaosEvent, ClusterOverloaded,
+                                   DisaggServingCluster,
+                                   ServingCluster)
+    wl = TT.workload(trace)
+    spec = trace["spec"]
+    slo = slo or TT.SLO(p.slo_ttft_ms, p.slo_tbt_ms)
+    geo = _engine_geometry(p, wl, section="trace")
+    if chaos_events is None:
+        # the scripted scenario: one replica death mid-burst (a real
+        # SIGKILL for the disagg cluster's worker processes, the
+        # injected-raise failover path for in-process replicas —
+        # prefill-targeted there so the single decode role survives)
+        mid = spec["burst_at_s"] + spec["burst_dur_s"] / 2.0
+        chaos_events = [ChaosEvent(mid, "kill",
+                                   "prefill" if disagg else None)]
+    if disagg:
+        cl = DisaggServingCluster(params, cfg, prefill=2, decode=1,
+                                  metrics=True, watchdog_s=60.0,
+                                  **geo)
+        size0 = 3
+    else:
+        cl = ServingCluster(params, cfg, replicas=min_replicas,
+                            metrics=True, watchdog_s=60.0,
+                            max_queue=10 ** 6, **geo)
+        size0 = min_replicas
+    scaler = None
+    drv = ChaosDriver(cl, chaos_events, seed=chaos_seed)
+    try:
+        # pre-warm outside the clock (each disagg worker pre-warms in
+        # its own handshake; this covers the router paths)
+        wid = cl.submit(wl[0][1], wl[0][2])
+        cl.result(wid, timeout=600)
+        if autoscale:
+            # the TTFT trigger is the load signal that works for BOTH
+            # flavors: the disagg cluster has no admission queue (its
+            # backlog is worker-side), so queue depth alone would
+            # never fire there — a windowed TTFT p95 past the SLO is
+            # the operator-visible symptom either way
+            scaler = Autoscaler(
+                cl, min_size=size0,
+                max_size=max(max_replicas, size0),
+                interval_s=0.05, cooldown_s=0.5,
+                up_queue_factor=1.0, down_queue_factor=0.25,
+                ttft_p95_slo_ms=slo.ttft_ms,
+                up_ticks=2, down_ticks=20,
+                drain_timeout_s=120.0).start()
+        submitted = {}
+        rejected = []
+        t0 = time.perf_counter()
+        for at, prompt, n in wl:
+            while True:
+                now = time.perf_counter() - t0
+                drv.poll(now)
+                if now >= at:
+                    break
+                time.sleep(min(at - now, 0.01))
+            try:
+                submitted[cl.submit(prompt, n)] = (at, prompt, n)
+            except ClusterOverloaded as e:
+                rejected.append({"at": at, "n": n,
+                                 "retry_after_s": e.retry_after_s})
+        while True:
+            drv.poll(time.perf_counter() - t0)
+            if cl.drain(timeout=0.25) and drv.done():
+                break
+            if time.perf_counter() - t0 > 600:
+                raise RuntimeError("serve_bench --trace: replay did "
+                                   "not drain within 600s")
+        wall = time.perf_counter() - t0
+
+        good, ttfts, worst_tbts = [], [], []
+        completed = failed = 0
+        for rid, (at, prompt, n) in submitted.items():
+            cr = cl.requests[rid]
+            if cr.state == "done":
+                completed += 1
+            else:
+                failed += 1
+            ok, ttft_ms, tbt_ms = TT.classify_request(
+                cr.submit_t, cr.token_times, n, slo)
+            good.append((ok, n))
+            if np.isfinite(ttft_ms):
+                ttfts.append(ttft_ms)
+            if np.isfinite(tbt_ms):
+                worst_tbts.append(tbt_ms)
+        arrivals = len(submitted) + len(rejected)
+        goodput_frac = sum(ok for ok, _ in good) / max(1, arrivals)
+        goodput_tok = sum(n for ok, n in good if ok)
+        useful = sum(n for _, _, n in wl)
+        if failed or completed != len(submitted):
+            raise RuntimeError(
+                "serve_bench --trace: %d/%d submitted requests "
+                "completed (%d failed) — the chaos/scale scenario "
+                "lost requests" % (completed, len(submitted), failed))
+
+        mismatches = 0
+        if verify_oracle:
+            reqs = [(pr, n) for _, pr, n in
+                    (submitted[rid] for rid in submitted)]
+            oracle = _oracle_outputs(params, cfg, reqs)
+            for (rid, (at, prompt, n)), o in zip(submitted.items(),
+                                                 oracle):
+                if not np.array_equal(cl.requests[rid].output, o):
+                    mismatches += 1
+            if mismatches:
+                raise RuntimeError(
+                    "serve_bench --trace: %d/%d completions diverge "
+                    "from the generate oracle — exactness broken "
+                    "under chaos/scaling" % (mismatches,
+                                             len(submitted)))
+
+        # the autoscaler must come back down, and nothing may leak
+        scale_ups = scale_downs = 0
+        if scaler is not None:
+            deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < deadline:
+                if scaler.error is not None:
+                    # the loop died on a real actuation failure (e.g.
+                    # the zero-leak RuntimeError): that diagnosis,
+                    # not a generic convergence message, is the
+                    # result
+                    raise scaler.error
+                if scaler._healthy() <= size0:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    "serve_bench --trace: autoscaler never returned "
+                    "to min size %d after the drain" % size0)
+            scale_ups = sum(e["action"] == "up" for e in scaler.events)
+            scale_downs = sum(e["action"] == "down"
+                              for e in scaler.events)
+        if disagg:
+            st = cl.cluster_stats()
+            for name, s in st.items():
+                if (s.get("prefix_refs", 0)
+                        or s.get("staged_rids", 0)
+                        or s.get("active_requests", 0)
+                        or s.get("pages_in_use", 0)
+                        != s.get("prefix_cached_pages", 0)):
+                    raise RuntimeError(
+                        "serve_bench --trace: worker %s leaks after "
+                        "drain: %r" % (name, s))
+        else:
+            for rep in cl.replicas:
+                eng = rep.engine
+                if eng is None or rep.dead:
+                    continue              # removed: checked at drain
+                refs = 0 if eng.prefix is None else \
+                    eng.prefix.refs_total
+                cached = 0 if eng.prefix is None else \
+                    eng.prefix.cached_pages
+                if refs or eng.cache.pages_in_use != cached:
+                    raise RuntimeError(
+                        "serve_bench --trace: replica %d leaks after "
+                        "drain (refs=%d, in_use=%d, cached=%d)"
+                        % (rep.idx, refs, eng.cache.pages_in_use,
+                           cached))
+
+        snap = cl.registry.snapshot()["counters"]
+        ttft_p50, ttft_p99 = _lat_stats(ttfts)
+        tbt_p50, tbt_p99 = _lat_stats(worst_tbts)
+        return {
+            "section": "trace",
+            "config": "trace_%s_%s" % (spec["name"],
+                                       "disagg_p2_d1" if disagg else
+                                       "r%d-%d" % (min_replicas,
+                                                   max_replicas)),
+            "seed": spec["seed"], "trace_sha": TT.trace_hash(trace),
+            "events": len(wl), "arrivals": arrivals,
+            "submitted": len(submitted), "rejected": len(rejected),
+            "completed": completed,
+            "goodput_frac": goodput_frac,
+            "goodput_tok_s": goodput_tok / wall,
+            "tok_s": useful / wall, "wall_s": wall,
+            "slo_ttft_ms": slo.ttft_ms, "slo_tbt_ms": slo.tbt_ms,
+            "ttft_p50_ms": ttft_p50, "ttft_p99_ms": ttft_p99,
+            "worst_tbt_p50_ms": tbt_p50, "worst_tbt_p99_ms": tbt_p99,
+            "failovers": int(snap.get("cluster_failovers_total", 0)),
+            "resubmitted": int(snap.get(
+                "cluster_requests_resubmitted_total", 0)),
+            "scale_ups": scale_ups, "scale_downs": scale_downs,
+            "chaos": drv.applied,
+            "oracle_checked": len(submitted) if verify_oracle else 0,
+            "oracle_mismatches": mismatches,
+        }
+    finally:
+        # the scaler may re-raise a parked actuation error — it must
+        # not abort the rest of the cleanup (SIGSTOPped chaos pids,
+        # worker processes) nor mask an exception already unwinding
+        scaler_err = None
+        if scaler is not None:
+            try:
+                scaler.close()
+            except Exception as e:
+                scaler_err = e
+        drv.close()
+        cl.close(timeout=120)
+        if scaler_err is not None and sys.exc_info()[0] is None:
+            raise scaler_err
+
+
+_goodput_gate_cache = {}
+
+
+def run_gate_goodput(preset="full", seed=0):
+    """The ``gpt_serve_goodput`` gate: goodput fraction (in PERCENT)
+    through the scripted burst10x scenario — a 10× arrival burst with
+    one replica killed mid-burst while the autoscaler reacts — on the
+    given preset.  The returned row carries the trace seed + sha; the
+    perf harness refuses the gate if the hash is missing, so a gated
+    number is always reproducible from the checked-in seed."""
+    key = (preset, seed)
+    if key in _goodput_gate_cache:
+        return _goodput_gate_cache[key]
+    import traffic_trace as TT
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    trace = TT.generate_trace(_trace_spec(p, seed))
+    row = run_trace_replay(params, cfg, p, trace)
+    _goodput_gate_cache[key] = row
+    return row
+
+
 # --------------------------------------------- round-14 tensor parallel ---
 
 def run_tp(params, cfg, p, workload, tp):
@@ -1158,15 +1479,41 @@ def main(argv=None):
                          "value (including 0) always wins")
     ap.add_argument("--no-telemetry", action="store_true",
                     help="skip the metrics-enabled telemetry section")
-    ap.add_argument("--trace", default=None, metavar="FILE",
+    ap.add_argument("--chrome-trace", default=None, metavar="FILE",
                     help="profile the telemetry run and dump the "
                          "combined chrome-trace (op events + request "
-                         "lifecycle spans) to FILE")
+                         "lifecycle spans) to FILE (renamed from "
+                         "--trace in round 16 — --trace now replays "
+                         "workload traces)")
+    ap.add_argument("--trace", default=None, metavar="FILE|burst10x",
+                    help="run the round-16 trace-replay section "
+                         "ALONE: open-loop replay of a workload "
+                         "trace (a traffic_trace.py JSON file, or "
+                         "'burst10x' to generate the scripted "
+                         "10x-burst scenario from --seed) against "
+                         "the cluster with the autoscaler live and a "
+                         "seeded chaos schedule (one replica death "
+                         "mid-burst); reports goodput vs the preset "
+                         "SLO budgets and cross-checks bit-exactness "
+                         "vs the generate oracle.  Combine with "
+                         "--disagg for the cross-process cluster "
+                         "(real SIGKILL)")
+    ap.add_argument("--no-autoscale", action="store_true",
+                    help="trace replay: pin the replica count")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="trace replay: no fault injection")
+    ap.add_argument("--no-oracle", action="store_true",
+                    help="trace replay: skip the generate-oracle "
+                         "bit-exactness cross-check")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="victim-draw seed for the chaos schedule")
+    ap.add_argument("--min-replicas", type=int, default=2)
+    ap.add_argument("--max-replicas", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
-    if args.trace and args.no_telemetry:
-        ap.error("--trace needs the telemetry section; drop "
+    if args.chrome_trace and args.no_telemetry:
+        ap.error("--chrome-trace needs the telemetry section; drop "
                  "--no-telemetry")
     if args.tp > 1:
         # request the virtual CPU mesh BEFORE anything below imports
@@ -1216,6 +1563,47 @@ def main(argv=None):
                 json.dump(rows, f, indent=1)
         return 0
 
+    if args.trace:
+        # the trace-replay section runs ALONE: it owns the replica
+        # topology (autoscaler!) and its goodput numbers assume the
+        # host isn't also running the closed-loop sections
+        import traffic_trace as TT
+        if os.path.exists(args.trace):
+            trace = TT.load_trace(args.trace)
+        elif args.trace == "burst10x":
+            trace = TT.generate_trace(_trace_spec(p, args.seed))
+        else:
+            ap.error("--trace: %r is neither a trace file nor "
+                     "'burst10x'" % args.trace)
+        r = run_trace_replay(
+            params, cfg, p, trace, disagg=args.disagg,
+            autoscale=not args.no_autoscale,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            chaos_events=[] if args.no_chaos else None,
+            chaos_seed=args.chaos_seed,
+            verify_oracle=not args.no_oracle)
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+        print("trace %s (seed %d, sha %s): goodput %.1f%% (%d/%d "
+              "arrivals in SLO ttft<=%.0fms tbt<=%.0fms), %.0f "
+              "SLO-good tok/s of %.0f; TTFT p50/p99 %.1f/%.1f ms; "
+              "%d failover(s), %d scale-up(s)/%d scale-down(s); "
+              "oracle %d/%d bit-identical"
+              % (trace["spec"]["name"], r["seed"], r["trace_sha"],
+                 100 * r["goodput_frac"],
+                 round(r["goodput_frac"] * r["arrivals"]),
+                 r["arrivals"], r["slo_ttft_ms"], r["slo_tbt_ms"],
+                 r["goodput_tok_s"], r["tok_s"], r["ttft_p50_ms"],
+                 r["ttft_p99_ms"], r["failovers"], r["scale_ups"],
+                 r["scale_downs"],
+                 r["oracle_checked"] - r["oracle_mismatches"],
+                 r["oracle_checked"]), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rows, f, indent=1)
+        return 0
+
     # baseline batch = half the engine's slots, engine pool = the
     # baseline's contiguous HBM: equal memory, 2x the concurrency
     batch = max(1, p.num_slots // 2)
@@ -1243,13 +1631,13 @@ def main(argv=None):
         # runs inside run_engine and raises on >10% p99 divergence)
         t = run_engine(params, cfg, p, wl, num_pages=pages,
                        metrics=True)
-        if args.trace:
+        if args.chrome_trace:
             # a SEPARATE profiled run produces the dump: tracing has
             # its own per-step cost (event construction + locked
             # appends) that must not contaminate the telemetry row's
             # overhead number above
             from mxnet_tpu import profiler
-            profiler.set_config(filename=args.trace)
+            profiler.set_config(filename=args.chrome_trace)
             profiler.set_state("run")
             run_engine(params, cfg, p, wl, num_pages=pages,
                        metrics=True)

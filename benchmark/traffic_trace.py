@@ -1,0 +1,258 @@
+"""Seeded workload traces for production-traffic realism (round 16).
+
+Every serving number before this round was steady-state Poisson at a
+fixed replica count — "fast", but silent on "stays up".  This module
+is the workload half of the traffic-realism layer (ROADMAP item 2): a
+checked-in, seeded trace FORMAT plus a generator producing the three
+properties real front-door traffic has and steady Poisson lacks:
+
+* **diurnal ramp** — the arrival rate follows a sinusoid (one "day"
+  compressed into the trace duration), so an autoscaler sees load
+  that drifts, not a constant;
+* **bursty arrivals** — a scripted burst window multiplies the
+  instantaneous rate (the 10× burst of the goodput gate), generated
+  by Poisson thinning against the rate envelope, so arrivals stay a
+  genuine (inhomogeneous) Poisson process;
+* **heavy-tailed lengths** — prompt/output lengths draw from clamped
+  lognormals (the shape measured on real LLM traffic), with prompt
+  lengths optionally snapped to a small geometric grid so the
+  bit-exactness oracle (`gpt.generate` per distinct prompt length)
+  needs a bounded number of compiles.
+
+A trace is a plain-JSON dict ``{"version", "spec", "events"}`` where
+``events`` is ``[[arrival_s, [prompt tokens...], n_new], ...]`` sorted
+by arrival.  ``trace_hash`` is the sha256 of the canonical JSON — the
+reproducibility fingerprint ``serve_bench --trace`` writes into its
+result rows, so a checked-in (seed, spec) pair fully identifies the
+workload (same seed ⇒ same hash, pinned by
+``tests/test_serving_traffic.py``).
+
+Goodput is defined HERE, next to the traffic that motivates it: a
+completion counts toward goodput only if it met its SLO —
+time-to-first-token within ``SLO.ttft_ms`` AND every inter-token gap
+within ``SLO.tbt_ms`` (the worst gap is what a streaming client
+actually experiences across preemptions, failovers, and queueing).
+Rejected or dropped requests count against goodput by construction.
+
+CLI::
+
+    python benchmark/traffic_trace.py --seed 7 --out /tmp/trace.json
+
+Clock note: traces carry RELATIVE arrival seconds; the replay harness
+(`serve_bench.run_trace_replay`) maps them onto its own
+``time.perf_counter`` timeline.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import math
+import sys
+
+import numpy as np
+
+__all__ = ["TRACE_VERSION", "TraceSpec", "SLO", "rate_at",
+           "generate_trace", "trace_hash", "save_trace", "load_trace",
+           "workload", "classify_request", "burst10x_spec"]
+
+TRACE_VERSION = 1
+
+
+@dataclasses.dataclass
+class TraceSpec:
+    """Everything needed to regenerate a trace bit-identically."""
+    name: str = "custom"
+    seed: int = 0
+    duration_s: float = 4.0
+    base_rate: float = 16.0        # arrivals/s at the diurnal mean
+    diurnal_period_s: float = 4.0  # one compressed "day"
+    diurnal_amp: float = 0.4       # fractional rate swing, [0, 1)
+    burst_at_s: float = 1.6        # burst window start
+    burst_dur_s: float = 0.5
+    burst_mult: float = 10.0       # the "10x burst"
+    prompt_mu: float = 3.0         # lognormal of token counts
+    prompt_sigma: float = 0.8
+    prompt_min: int = 8
+    prompt_max: int = 128
+    # snap prompt lengths to this ladder (ascending) so the
+    # generate() oracle compiles one program per rung, not per length;
+    # empty = no snapping
+    prompt_grid: tuple = ()
+    out_mu: float = 2.8
+    out_sigma: float = 0.9
+    out_min: int = 4
+    out_max: int = 64
+    vocab: int = 4096
+    max_total: int = 256           # hard cap on prompt + output
+
+
+@dataclasses.dataclass
+class SLO:
+    """Per-request service-level objective (milliseconds)."""
+    ttft_ms: float
+    tbt_ms: float
+
+
+def rate_at(spec: TraceSpec, t: float) -> float:
+    """Instantaneous arrival rate at trace-relative time ``t``."""
+    r = spec.base_rate * (
+        1.0 + spec.diurnal_amp
+        * math.sin(2.0 * math.pi * t / spec.diurnal_period_s))
+    if spec.burst_at_s <= t < spec.burst_at_s + spec.burst_dur_s:
+        r *= spec.burst_mult
+    return r
+
+
+def _clamped_lognormal(rng, mu, sigma, lo, hi):
+    return int(min(hi, max(lo, round(float(rng.lognormal(mu,
+                                                         sigma))))))
+
+
+def _snap(n, grid):
+    if not grid:
+        return n
+    return min(grid, key=lambda g: (abs(g - n), g))
+
+
+def generate_trace(spec: TraceSpec) -> dict:
+    """Generate the trace for ``spec`` (deterministic in the seed).
+
+    Arrivals come from Poisson thinning against the rate envelope:
+    candidate points at the peak rate, each kept with probability
+    rate(t)/peak — an exact sampler for the inhomogeneous process,
+    and the same numpy draw sequence on every run."""
+    rng = np.random.RandomState(spec.seed)
+    peak = spec.base_rate * (1.0 + spec.diurnal_amp) * spec.burst_mult
+    events = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= spec.duration_s:
+            break
+        if float(rng.rand()) * peak > rate_at(spec, t):
+            continue                       # thinned out
+        P = _snap(_clamped_lognormal(rng, spec.prompt_mu,
+                                     spec.prompt_sigma,
+                                     spec.prompt_min,
+                                     spec.prompt_max),
+                  spec.prompt_grid)
+        N = _clamped_lognormal(rng, spec.out_mu, spec.out_sigma,
+                               spec.out_min, spec.out_max)
+        if P + N > spec.max_total:
+            N = max(1, spec.max_total - P)
+        prompt = rng.randint(1, spec.vocab, P).astype(np.int32)
+        events.append([round(t, 6), [int(x) for x in prompt], int(N)])
+    return {"version": TRACE_VERSION,
+            "spec": dataclasses.asdict(spec),
+            "events": events}
+
+
+def trace_hash(trace: dict) -> str:
+    """sha256 fingerprint of the canonical trace JSON (spec included:
+    two specs that happen to emit the same events are still different
+    workload DEFINITIONS)."""
+    blob = json.dumps(
+        {"version": trace["version"], "spec": trace["spec"],
+         "events": trace["events"]},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def save_trace(path: str, trace: dict):
+    with open(path, "w") as f:
+        json.dump(trace, f, separators=(",", ":"))
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        trace = json.load(f)
+    if trace.get("version") != TRACE_VERSION:
+        raise ValueError("trace %s: version %r != %d"
+                         % (path, trace.get("version"), TRACE_VERSION))
+    return trace
+
+
+def workload(trace: dict):
+    """Trace events as the ``serve_bench`` workload shape:
+    ``[(arrival_s, prompt (P,) int32, n_new), ...]``."""
+    return [(t, np.asarray(prompt, np.int32), n)
+            for t, prompt, n in trace["events"]]
+
+
+def classify_request(submit_t, token_times, n_new, slo: SLO):
+    """SLO classification for one request.
+
+    Returns ``(ok, ttft_ms, worst_tbt_ms)``.  ``ok`` requires the
+    request to have COMPLETED (all ``n_new`` tokens), met the TTFT
+    budget, and kept every inter-token gap within the TBT budget —
+    the worst gap is the stall a streaming client saw, whatever its
+    cause (queueing, preemption re-prefill, replica failover)."""
+    if not token_times:
+        return False, float("inf"), float("inf")
+    ttft_ms = (token_times[0] - submit_t) * 1e3
+    worst_tbt_ms = 0.0
+    for a, b in zip(token_times, token_times[1:]):
+        worst_tbt_ms = max(worst_tbt_ms, (b - a) * 1e3)
+    ok = (len(token_times) >= n_new
+          and ttft_ms <= slo.ttft_ms and worst_tbt_ms <= slo.tbt_ms)
+    return ok, ttft_ms, worst_tbt_ms
+
+
+def burst10x_spec(*, seed=0, vocab=4096, max_total=256,
+                  base_rate=16.0, duration_s=4.0,
+                  prompt_max=None, out_max=None) -> TraceSpec:
+    """The scripted goodput-gate scenario: one diurnal cycle with a
+    10× burst window in its rising half.  Prompt lengths snap to a
+    geometric ladder so the exactness oracle compiles at most ~6
+    ``generate`` programs.  ``max_total`` must not exceed the model's
+    ``cfg.max_len``."""
+    prompt_max = prompt_max or max_total // 2
+    out_max = out_max or max_total // 4
+    grid, g = [], max(4, prompt_max // 16)
+    while g <= prompt_max:
+        grid.append(int(g))
+        g *= 2
+    return TraceSpec(
+        name="burst10x", seed=seed, duration_s=duration_s,
+        base_rate=base_rate, diurnal_period_s=duration_s,
+        diurnal_amp=0.4, burst_at_s=0.4 * duration_s,
+        burst_dur_s=0.125 * duration_s, burst_mult=10.0,
+        prompt_mu=math.log(max(grid[0] * 2, 8)), prompt_sigma=0.8,
+        prompt_min=grid[0], prompt_max=prompt_max,
+        prompt_grid=tuple(grid),
+        out_mu=math.log(max(out_max // 4, 4)), out_sigma=0.9,
+        out_min=2, out_max=out_max, vocab=vocab,
+        max_total=max_total)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--base-rate", type=float, default=16.0)
+    ap.add_argument("--duration-s", type=float, default=4.0)
+    ap.add_argument("--vocab", type=int, default=4096)
+    ap.add_argument("--max-total", type=int, default=256)
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the trace JSON here (default: stdout "
+                         "summary only)")
+    args = ap.parse_args(argv)
+    spec = burst10x_spec(seed=args.seed, vocab=args.vocab,
+                         max_total=args.max_total,
+                         base_rate=args.base_rate,
+                         duration_s=args.duration_s)
+    trace = generate_trace(spec)
+    n = len(trace["events"])
+    toks = sum(len(p) + m for _, p, m in trace["events"])
+    print(json.dumps({"trace_sha": trace_hash(trace), "events": n,
+                      "total_tokens": toks, "seed": spec.seed,
+                      "spec": spec.name}))
+    if args.out:
+        save_trace(args.out, trace)
+        print("trace written to %s" % args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

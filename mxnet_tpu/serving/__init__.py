@@ -73,6 +73,25 @@ Round 15 disaggregates the cluster across OS processes
   stream.  ``serve_bench --disagg``;
   ``gpt_serve_disagg_remote_hit_ttft_ms`` gate;
   ``tests/test_serving_disagg.py`` (slow group j).
+
+Round 16 adds the traffic-realism layer (ROADMAP item 2):
+
+- ``autoscaler.Autoscaler`` — a metrics-driven control loop over the
+  ``cluster_*`` gauges/histograms that drives the clusters' scaling
+  actuation paths (``add_replica``/``remove_replica`` thread
+  replicas; role-aware ``add_worker``/``drain_worker`` disagg worker
+  processes) with hysteresis, cooldowns, and a replica budget;
+  scale-down drains gracefully under a CHECKED zero-leak contract.
+- ``chaos.ChaosDriver`` — seeded, trace-relative fault injection
+  (injected replica death/stall in-process; real SIGKILL/SIGSTOP/
+  connection-reset for disagg worker processes), so "replica death
+  during the burst" is a reproducible scenario.
+- ``ClusterOverloaded.retry_after_s`` — a structured Retry-After
+  hint from queue excess / recent drain rate (the future HTTP 429).
+  Workload side: ``benchmark/traffic_trace.py`` (seeded diurnal +
+  burst + heavy-tail traces, goodput SLO classification) and
+  ``serve_bench --trace`` (open-loop replay + ``gpt_serve_goodput``
+  gate; ``tests/test_serving_traffic.py``, slow group k).
 """
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache, ClusterPrefixIndex
@@ -81,9 +100,13 @@ from .engine import Request, ServingEngine
 from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
                       RequestExpired, ClusterClosed, ClusterFailed,
                       DisaggServingCluster, run_worker)
+from .autoscaler import Autoscaler, HistogramWindow
+from .chaos import ChaosDriver, ChaosEvent, chaos_schedule
 
 __all__ = ["PagedKVCache", "PrefixCache", "ClusterPrefixIndex",
            "Request", "ServingEngine",
            "ServingCluster", "ClusterRequest", "ClusterOverloaded",
            "RequestExpired", "ClusterClosed", "ClusterFailed",
-           "DisaggServingCluster", "run_worker", "ngram_draft"]
+           "DisaggServingCluster", "run_worker", "ngram_draft",
+           "Autoscaler", "HistogramWindow",
+           "ChaosDriver", "ChaosEvent", "chaos_schedule"]

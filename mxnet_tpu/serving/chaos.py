@@ -1,0 +1,199 @@
+"""Deterministic fault injection for the serving clusters (round 16).
+
+"Replica death during the burst" was, until this round, a hand-run
+test: somebody called ``kill_worker`` at roughly the right moment.
+This module makes chaos a REPRODUCIBLE artifact, the same way the
+round-12 interleaving explorer made races one: a chaos schedule is
+fully identified by ``(trace, seed)`` — the same seed protocol as
+``tools/analysis/interleave.py`` (``docs/static_analysis.md``) — and
+events fire at TRACE-RELATIVE times from the replay loop's own
+clock, so the scenario in ``MULTICHIP_r08.json`` replays from its
+checked-in seed alone.
+
+Event kinds, per cluster flavor:
+
+====================  ===============================  =====================
+kind                  ServingCluster (threads)         DisaggServingCluster
+====================  ===============================  =====================
+``kill``              injected raise in the victim     real ``SIGKILL`` of
+                      replica's next ``step()`` (the   the worker process
+                      worker-raise failover path)
+``stall``             injected sleep past the          ``SIGSTOP`` (process
+                      watchdog (the monitor-stall      alive, silent — the
+                      failover path)                   watchdog's case)
+``reset``             —                                router-side close of
+                                                       the control
+                                                       connection
+====================  ===============================  =====================
+
+The driver is POLLED from the replay loop (``poll(now_rel)``), not
+threaded: the application point is a deterministic place in the
+harness's own sequence, and the only nondeterminism left is the
+victim draw — taken from the driver's seeded ``random.Random`` over
+the eligible victims sorted by name/index.
+
+A ``stall``-stopped disagg worker process cannot run signal handlers;
+``close()`` SIGKILLs any still-stopped pid so a chaos run never
+leaks a T-state process.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional
+
+__all__ = ["ChaosEvent", "ChaosDriver", "chaos_schedule"]
+
+
+class ChaosEvent:
+    """One scheduled fault.  ``target`` is None (seeded draw at fire
+    time), a replica index (in-process), or a worker name / role
+    prefix (disagg)."""
+    __slots__ = ("t", "kind", "target")
+
+    def __init__(self, t, kind, target=None):
+        if kind not in ("kill", "stall", "reset"):
+            raise ValueError("ChaosEvent: kind must be kill/stall/"
+                             "reset, got %r" % (kind,))
+        self.t = float(t)
+        self.kind = kind
+        self.target = target
+
+    def __repr__(self):
+        return "ChaosEvent(t=%.3f, %s, target=%r)" % (
+            self.t, self.kind, self.target)
+
+
+def chaos_schedule(seed: int, duration_s: float, n_events: int = 1,
+                   kinds=("kill",), window=(0.25, 0.75)
+                   ) -> List[ChaosEvent]:
+    """Seeded event schedule: ``n_events`` faults at times drawn
+    uniformly inside ``window`` (fractions of ``duration_s``), kinds
+    cycling through ``kinds``.  Same seed ⇒ same schedule."""
+    rng = random.Random(seed)
+    lo, hi = window
+    times = sorted(rng.uniform(lo * duration_s, hi * duration_s)
+                   for _ in range(n_events))
+    return [ChaosEvent(t, kinds[i % len(kinds)])
+            for i, t in enumerate(times)]
+
+
+class ChaosDriver:
+    """Apply a chaos schedule to a live cluster as replay time
+    passes.  ``poll(now_rel)`` fires every not-yet-applied event whose
+    time has come; ``applied`` is the audit log the benchmark writes
+    into its result row."""
+
+    def __init__(self, cluster, events, seed: int = 0):
+        self.cluster = cluster
+        self.events = sorted(events, key=lambda e: e.t)
+        self.rng = random.Random(seed)
+        self._next = 0
+        self.applied: List[dict] = []
+        self._stopped_pids: List[int] = []
+        # flavor: the disagg cluster is the one with worker PROCESSES
+        self._disagg = hasattr(cluster, "kill_worker")
+
+    # ------------------------------------------------------- firing --
+    def poll(self, now_rel: float):
+        """Fire due events.  Returns the number fired."""
+        fired = 0
+        while self._next < len(self.events) \
+                and self.events[self._next].t <= now_rel:
+            ev = self.events[self._next]
+            self._next += 1
+            victim = self._apply(ev)
+            self.applied.append(
+                {"t": ev.t, "kind": ev.kind, "victim": victim})
+            fired += 1
+        return fired
+
+    def done(self):
+        return self._next >= len(self.events)
+
+    # ------------------------------------------------------ victims --
+    def _apply(self, ev):
+        if self._disagg:
+            return self._apply_disagg(ev)
+        return self._apply_inproc(ev)
+
+    def _pick_replica(self, ev):
+        reps = [r for r in self.cluster.replicas
+                if r.alive and not r.dead and not r.draining
+                and r.engine is not None]
+        if ev.target is not None:
+            reps = [r for r in reps if r.idx == ev.target]
+        if not reps:
+            return None
+        return self.rng.choice(sorted(reps, key=lambda r: r.idx))
+
+    def _pick_worker(self, ev):
+        ws = [w for w in self.cluster.workers.values()
+              if w.alive and not w.draining]
+        if isinstance(ev.target, str):
+            exact = [w for w in ws if w.name == ev.target]
+            ws = exact or [w for w in ws
+                           if w.role == ev.target]
+        if not ws:
+            return None
+        return self.rng.choice(sorted(ws, key=lambda w: w.name))
+
+    # ----------------------------------------------- in-process arm --
+    def _apply_inproc(self, ev):
+        if ev.kind == "reset":
+            return None                   # no connections to reset
+        rep = self._pick_replica(ev)
+        if rep is None:
+            return None
+        eng = rep.engine
+        orig = eng.step
+        armed = [True]
+        if ev.kind == "kill":
+            def chaos_step():
+                if armed[0]:
+                    armed[0] = False
+                    raise RuntimeError(
+                        "chaos: injected death of replica %d"
+                        % rep.idx)
+                return orig()
+        else:                             # stall past the watchdog
+            stall_s = self.cluster.watchdog_s * 1.5
+
+            def chaos_step():
+                if armed[0]:
+                    armed[0] = False
+                    time.sleep(stall_s)
+                return orig()
+        eng.step = chaos_step
+        return rep.idx
+
+    # ---------------------------------------------------- disagg arm --
+    def _apply_disagg(self, ev):
+        import signal
+        wh = self._pick_worker(ev)
+        if wh is None:
+            return None
+        if ev.kind == "kill":
+            self.cluster.kill_worker(wh.name)
+        elif ev.kind == "stall":
+            if wh.proc is None:
+                return None
+            self._stopped_pids.append(wh.proc.pid)
+            self.cluster.kill_worker(wh.name, sig=signal.SIGSTOP)
+        else:                             # reset: drop the control conn
+            try:
+                wh.conn.close()
+            except Exception:
+                pass
+        return wh.name
+
+    def close(self):
+        """Reap SIGSTOPped processes (they cannot handle SIGTERM)."""
+        import signal
+        import os as _os
+        for pid in self._stopped_pids:
+            try:
+                _os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        self._stopped_pids = []

@@ -80,8 +80,36 @@ __all__ = ["ServingCluster", "ClusterRequest", "ClusterOverloaded",
 RID_BLOCK = 1 << 20
 
 
+def _env_default(name, fallback, cast=float):
+    """Operational limits default from ``MXNET_SERVE_*`` env vars
+    (round 16): the watchdog/TTL/admission bounds were hard-coded
+    construction defaults, but the autoscaler and chaos tests need
+    tighter timeouts than production wants, and ops wants to retune
+    a deployment without editing call sites (docs/env_vars.md).  An
+    explicit constructor argument always wins; the env var only
+    replaces the built-in default."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return fallback
+    try:
+        return cast(v)
+    except ValueError:
+        raise ValueError("%s=%r: expected %s"
+                         % (name, v, cast.__name__))
+
+
 class ClusterOverloaded(RuntimeError):
-    """submit() refused: the bounded admission queue is full."""
+    """submit() refused: the bounded admission queue is full.
+
+    Carries a structured ``retry_after_s`` hint — the estimated time
+    until the queue drains below the admission bound at the cluster's
+    recent completion rate (groundwork for the HTTP front door's
+    429 + Retry-After, ROADMAP item 6).  Also surfaced on the
+    ``cluster_retry_after_s`` gauge at each rejection."""
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class RequestExpired(RuntimeError):
@@ -103,8 +131,8 @@ class ClusterRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id",
                  "deadline", "state", "replica", "engine_rid",
                  "committed", "output", "error", "done_evt",
-                 "submit_t", "first_token_t", "affinity_keys",
-                 "failovers", "delivered")
+                 "submit_t", "first_token_t", "token_times",
+                 "affinity_keys", "failovers", "delivered")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, deadline,
                  affinity_keys):
@@ -122,6 +150,10 @@ class ClusterRequest:
         self.done_evt = threading.Event()
         self.submit_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
+        # per-token commit timestamps across ALL incarnations — the
+        # goodput classifier's input (worst inter-token gap = the
+        # stall a streaming client saw, failovers included)
+        self.token_times: List[float] = []
         self.affinity_keys = affinity_keys
         self.failovers = 0
         self.delivered = False
@@ -203,6 +235,15 @@ class _ClusterObs:
         self.g_in_flight = g("cluster_in_flight",
                              "requests holding an engine slot or "
                              "engine queue entry")
+        self.g_retry_after = g("cluster_retry_after_s",
+                               "last Retry-After hint handed to a "
+                               "rejected submit() (queue excess / "
+                               "recent drain rate)")
+        self.scale_ups = c("cluster_scale_ups_total",
+                           "replicas added (add_replica)")
+        self.scale_downs = c("cluster_scale_downs_total",
+                             "replicas drained and released "
+                             "(remove_replica)")
         self.h_ttft = h("cluster_ttft_ms",
                         help="cluster submit() -> first committed "
                              "token (any incarnation)")
@@ -236,8 +277,9 @@ class ServingCluster:
     def __init__(self, params, cfg, *, replicas=2, num_slots,
                  page_size=16, num_pages=None, pages_per_slot=None,
                  prefill_chunk=8, kv_int8=False, prefix_cache=True,
-                 metrics=None, registry=None, max_queue=256,
-                 watchdog_s=30.0, affinity_slack=None,
+                 metrics=None, registry=None, max_queue=None,
+                 watchdog_s=None, default_ttl_s=None,
+                 affinity_slack=None,
                  affinity_capacity=4096, retain_results=4096,
                  kernel="xla", spec_K=0, spec_drafter="ngram",
                  spec_ngram=2, tp=1, mesh=None):
@@ -245,8 +287,18 @@ class ServingCluster:
             raise ValueError("ServingCluster: replicas must be >= 1")
         self.num_slots = num_slots
         self.page_size = page_size
+        # operational limits: explicit argument > MXNET_SERVE_* env >
+        # built-in default (docs/env_vars.md "Serving cluster limits")
+        if max_queue is None:
+            max_queue = _env_default("MXNET_SERVE_MAX_QUEUE", 256,
+                                     int)
+        if watchdog_s is None:
+            watchdog_s = _env_default("MXNET_SERVE_WATCHDOG_S", 30.0)
+        if default_ttl_s is None:
+            default_ttl_s = _env_default("MXNET_SERVE_TTL_S", None)
         self.max_queue = int(max_queue)
         self.watchdog_s = float(watchdog_s)
+        self.default_ttl_s = default_ttl_s
         self.prefix_enabled = bool(prefix_cache)
         # affinity may leave the favored replica at most this many
         # WAITING requests deeper than the shallowest queue: the cache
@@ -306,24 +358,37 @@ class ServingCluster:
             prefix_cache=prefix_cache, metrics=bool(metrics),
             kernel=kernel, spec_K=spec_K, spec_drafter=spec_drafter,
             spec_ngram=spec_ngram, tp=tp, mesh=mesh)
+        # kept for add_replica (autoscaler scale-up): a replica added
+        # mid-run must be built from the SAME params/config as the
+        # originals (references only — params are already placed)
+        self._params, self._cfg = params, cfg
+        self._rid_blocks = replicas       # next replica's rid block
+        # recent completion timestamps — the drain-rate estimate
+        # behind ClusterOverloaded.retry_after_s
+        self._completions: "collections.deque[float]" = \
+            collections.deque(maxlen=256)
+        # set True by an attaching Autoscaler: the zero-replica state
+        # is then RECOVERABLE (tick self-heals below min_size), so
+        # requests stranded by the last replica's death PARK here
+        # instead of failing; add_replica reroutes them.  Without a
+        # scaler the round-10 fail-fast contract stands.
+        self.scaler_attached = False
+        self._orphans: "collections.deque[ClusterRequest]" = \
+            collections.deque()
         self.replicas: List[_Replica] = []
         for i in range(replicas):
             eng = ServingEngine(params, cfg, rid_start=i * RID_BLOCK,
                                 **self._engine_kwargs)
             self.replicas.append(_Replica(i, eng))
+        # submit()-side validation limits, captured once (replica 0's
+        # engine may be released by a later scale-down)
+        self._max_seq = self.replicas[0].engine.max_seq
         # pre-warm the (shared) step program BEFORE workers and the
         # watchdog start: a first-step compile longer than watchdog_s
         # would otherwise read as a stall and cascade failovers across
         # equally-cold survivors.  One compile covers every replica —
         # the step cache keys on config, not engine.
-        eng0 = self.replicas[0].engine
-        wid = eng0.submit(np.ones(1, np.int32), 1)
-        eng0.run()
-        del eng0.requests[wid]
-        for k in eng0.stats:
-            eng0.stats[k] = type(eng0.stats[k])()
-        if metrics:
-            eng0.reset_metrics()
+        self._warm_engine(self.replicas[0].engine)
         for rep in self.replicas:
             rep.thread = threading.Thread(
                 target=self._worker, args=(rep,), daemon=True,
@@ -333,6 +398,25 @@ class ServingCluster:
             target=self._monitor_loop, daemon=True,
             name="serving-cluster-monitor")
         self._monitor.start()
+        # publish the healthy count NOW: the gauges are otherwise
+        # first written on traffic, and an autoscaler attached to an
+        # idle fresh cluster would read healthy=0 and fire a spurious
+        # self-heal scale-up
+        if self._obs is not None:
+            with self._lock:
+                self._sync_gauges_locked()
+
+    @staticmethod
+    def _warm_engine(eng):
+        """Compile + first-dispatch an engine outside the serving
+        clock, then zero the warmup's footprint from its stats."""
+        wid = eng.submit(np.ones(1, np.int32), 1)
+        eng.run()
+        del eng.requests[wid]
+        for k in eng.stats:
+            eng.stats[k] = type(eng.stats[k])()
+        if eng.metrics_enabled:
+            eng.reset_metrics()
 
     # ------------------------------------------------------- intake --
     def submit(self, prompt, max_new_tokens, eos_id=None, ttl_s=None):
@@ -342,34 +426,56 @@ class ServingCluster:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # validate NOW, in the caller's thread, with the engine's own
         # rules: a request the engines would reject must fail the
-        # submit() call, not poison a replica worker later
-        eng0 = self.replicas[0].engine
+        # submit() call, not poison a replica worker later.  Limits
+        # are read from the captured spec, not replicas[0] — replica
+        # 0 may have been scale-downed (engine released) by now.
         if prompt.size < 1:
             raise ValueError("submit: empty prompt")
         if max_new_tokens < 1:
             raise ValueError("submit: max_new_tokens must be >= 1")
         total = prompt.size + int(max_new_tokens)
-        if total > eng0.max_seq:
+        if total > self._max_seq:
             raise ValueError(
                 "submit: %d tokens > replica max_seq %d"
-                % (total, eng0.max_seq))
-        if total > eng0.cfg.max_len:
+                % (total, self._max_seq))
+        if total > self._cfg.max_len:
             raise ValueError("submit: %d tokens > cfg.max_len=%d"
-                             % (total, eng0.cfg.max_len))
+                             % (total, self._cfg.max_len))
         keys = chain_keys(prompt, self.page_size) \
             if self.prefix_enabled else []
         with self._lock:
             if self._closed:
                 raise ClusterClosed("submit() after close()")
             if not self._healthy():
+                if self.scaler_attached:
+                    # the autoscaler will restore min capacity —
+                    # refuse RETRYABLY, not terminally.  The hint is
+                    # RECOVERY-based (the queue-drain formula reads
+                    # ~1 ms whenever the queue is shallow, which
+                    # would tell clients to hammer a cluster whose
+                    # self-heal takes seconds)
+                    hint = max(0.05, self.watchdog_s / 4.0)
+                    if self._obs is not None:
+                        self._obs.rejected.inc()
+                        self._obs.g_retry_after.set(hint)
+                    raise ClusterOverloaded(
+                        "no healthy replicas (self-heal pending); "
+                        "retry after %.3fs" % hint,
+                        retry_after_s=hint)
                 raise ClusterClosed("no healthy replicas")
-            if sum(r.waiting for r in self.replicas) >= self.max_queue:
+            waiting = sum(r.waiting for r in self.replicas)
+            if waiting >= self.max_queue:
+                hint = self._retry_after_locked(waiting)
                 if self._obs is not None:
                     self._obs.rejected.inc()
+                    self._obs.g_retry_after.set(hint)
                 raise ClusterOverloaded(
                     "admission queue full (%d waiting >= max_queue "
-                    "%d)" % (sum(r.waiting for r in self.replicas),
-                             self.max_queue))
+                    "%d); retry after %.3fs"
+                    % (waiting, self.max_queue, hint),
+                    retry_after_s=hint)
+            if ttl_s is None:
+                ttl_s = self.default_ttl_s
             deadline = None if ttl_s is None \
                 else time.perf_counter() + float(ttl_s)
             cr = ClusterRequest(self._next_rid, prompt,
@@ -454,6 +560,28 @@ class ServingCluster:
         while len(self._affinity) > self._affinity_cap:
             self._affinity.popitem(last=False)
         return target
+
+    def _retry_after_locked(self, waiting):
+        """Retry-After hint for a rejected submit(): the time for the
+        queue excess over the admission bound (plus one average
+        request) to drain at the cluster's recent completion rate.
+        With no completions observed yet the hint falls back to one
+        watchdog quarter — short enough to retry soon, long enough to
+        not hammer a cluster that is still compiling."""
+        now = time.perf_counter()
+        comp = self._completions
+        # age out stale samples: a rate computed across an idle gap
+        # would hand a busy-again cluster an hours-long hint
+        horizon = now - max(5.0, self.watchdog_s)
+        while comp and comp[0] < horizon:
+            comp.popleft()
+        if len(comp) >= 2 and now > comp[0]:
+            # len-1 completion INTERVALS over the observed span —
+            # conservatively low rate, conservatively long hint
+            rate = (len(comp) - 1) / (now - comp[0])
+            excess = waiting - self.max_queue + 1
+            return max(0.001, excess / max(rate, 1e-6))
+        return max(0.001, self.watchdog_s / 4.0)
 
     def _retire_locked(self, cr):
         """Bound the request table: remember terminal rids in order
@@ -575,6 +703,8 @@ class ServingCluster:
             ereq = rep.engine.requests[erid]
             cr.output = ereq.output
             cr.state = "done"
+            cr.token_times.extend(ereq.token_times)
+            self._completions.append(time.perf_counter())
             if cr.first_token_t is None and ereq.token_times:
                 cr.first_token_t = ereq.token_times[0]
             # the engine-side record (prompt/generated/output arrays)
@@ -621,6 +751,7 @@ class ServingCluster:
                 if ereq is not None:
                     cr.committed.extend(int(t)
                                         for t in list(ereq.generated))
+                    cr.token_times.extend(ereq.token_times)
                     if cr.first_token_t is None and ereq.token_times:
                         cr.first_token_t = ereq.token_times[0]
                 cr.failovers += 1
@@ -640,6 +771,7 @@ class ServingCluster:
                         [cr.prompt,
                          np.asarray(cr.committed, np.int32)])
                     cr.state = "done"
+                    self._completions.append(now)
                     self._retire_locked(cr)
                     if obs is not None:
                         obs.completed.inc()
@@ -648,6 +780,14 @@ class ServingCluster:
                 cr.state = "queued"
                 cr.engine_rid = None
                 if not survivors:
+                    if self.scaler_attached and not self._closed:
+                        # round 16: the zero-replica state is
+                        # recoverable (the autoscaler self-heals
+                        # below min_size) — PARK the request;
+                        # add_replica reroutes it when capacity
+                        # returns, close() fails it if none ever does
+                        self._orphans.append(cr)
+                        continue
                     cr.state = "failed"
                     cr.error = error
                     self._retire_locked(cr)
@@ -710,12 +850,157 @@ class ServingCluster:
         rep.wake.set()
         return rep.drained_evt.wait(timeout)
 
+    # ------------------------------------------------- scale-up/down --
+    def add_replica(self):
+        """Scale-up actuation (round 16, driven by
+        ``serving/autoscaler.py``): build ONE more engine replica from
+        the captured ``_engine_kwargs`` and put it in rotation.
+        Engine construction + pre-warm run OUTSIDE the cluster lock
+        (the step program is already compiled — the cost is params
+        placement and one cached-program dispatch); only the rid-block
+        reservation and the rotation append hold it.  Returns the new
+        replica index."""
+        with self._lock:
+            if self._closed:
+                raise ClusterClosed("add_replica() after close()")
+            block = self._rid_blocks
+            self._rid_blocks += 1
+        eng = ServingEngine(self._params, self._cfg,
+                            rid_start=block * RID_BLOCK,
+                            **self._engine_kwargs)
+        self._warm_engine(eng)
+        with self._lock:
+            idx = None if self._closed else len(self.replicas)
+            if idx is not None:
+                rep = _Replica(idx, eng)
+                self.replicas.append(rep)
+                rep.thread = threading.Thread(
+                    target=self._worker, args=(rep,), daemon=True,
+                    name="serving-replica-%d" % idx)
+                rep.thread.start()
+                # requests stranded by a total-loss failover ride the
+                # new capacity (recompute-exact resume, committed
+                # tokens already snapshotted by _fail_replica)
+                while self._orphans:
+                    cr = self._orphans.popleft()
+                    if cr.state != "queued":
+                        continue
+                    target = self._route_locked(cr)
+                    target.inbox.append(cr)
+                    cr.replica = target.idx
+                    target.wake.set()
+                    if self._obs is not None:
+                        self._obs.resubmitted.inc()
+                if self._obs is not None:
+                    self._obs.scale_ups.inc()
+                    self._sync_gauges_locked()
+        if idx is None:
+            # lost the race with close(): release the freshly built,
+            # never-published engine's cache-owned state before
+            # abandoning it to GC (the rid block is just a counter)
+            if eng.prefix is not None:
+                eng.prefix.clear()
+            raise ClusterClosed("add_replica() after close()")
+        return idx
+
+    def remove_replica(self, idx=None, timeout=None):
+        """Scale-down actuation: gracefully drain one replica (the
+        least-loaded healthy one unless ``idx`` names it), verify it
+        leaked nothing, and release its KV pool.  Never removes the
+        last healthy replica.  Returns the removed index, or None if
+        no replica was eligible / the drain timed out.
+
+        The zero-leak contract is CHECKED, not assumed: after the
+        drain the replica's prefix cache must hold zero refs, and
+        clearing it must return the pool to zero pages in use —
+        anything else raises RuntimeError (a page leak found at
+        scale-down is a bug, not an operational event)."""
+        with self._lock:
+            healthy = self._healthy()
+            if len(healthy) <= 1:
+                return None
+            if idx is None:
+                idx = min(healthy, key=lambda r: (r.load, -r.idx)).idx
+            elif not any(r.idx == idx for r in healthy):
+                return None
+        if not self.drain_replica(idx, timeout) \
+                and not self.replicas[idx].drained_evt.is_set():
+            # timed out with work still in flight: back in rotation
+            # (mirrors drain_worker) — leaving draining set would
+            # silently shrink capacity without ever releasing the
+            # replica
+            with self._lock:
+                rep = self.replicas[idx]
+                if rep.alive and not rep.dead:
+                    rep.draining = False
+                    rep.wake.set()
+            return None
+        rep = self.replicas[idx]
+        eng = rep.engine
+        leaked_refs = 0 if eng.prefix is None else eng.prefix.refs_total
+        if eng.prefix is not None:
+            eng.prefix.clear()
+        in_use = eng.cache.pages_in_use
+        if leaked_refs or in_use:
+            raise RuntimeError(
+                "remove_replica(%d): %d prefix refs / %d pages still "
+                "held after drain — scale-down would leak" %
+                (idx, leaked_refs, in_use))
+        with self._lock:
+            rep.dead = True               # waiting -> 0, never routed
+            rep.engine = None             # release pools/params refs
+            if self._obs is not None:
+                self._obs.scale_downs.inc()
+                self._sync_gauges_locked()
+        return idx
+
+    def detach_scaler(self):
+        """The attached autoscaler is going away: requests parked for
+        a self-heal that will now never come must fail loudly instead
+        of hanging their result() waiters forever."""
+        with self._lock:
+            self.scaler_attached = False
+            while self._orphans:
+                cr = self._orphans.popleft()
+                if cr.state != "queued":
+                    continue
+                cr.state = "failed"
+                cr.error = ClusterFailed(
+                    "request %d: parked for scale-up but the "
+                    "autoscaler detached" % cr.rid)
+                self._retire_locked(cr)
+                cr.done_evt.set()
+
+    # the autoscaler's actuation protocol (shared with
+    # DisaggServingCluster): scale_up() -> bool, scale_down() -> bool
+    def scale_up(self):
+        self.add_replica()
+        return True
+
+    def scale_down(self, timeout=60.0):
+        return self.remove_replica(timeout=timeout) is not None
+
+    @property
+    def slots_per_replica(self):
+        return self.num_slots
+
     def close(self, timeout=None):
         """Drain every replica and stop the monitor.  In-flight work
         finishes first (the watchdog still covers a replica that
         stalls during shutdown)."""
         with self._lock:
             self._closed = True
+            # parked orphans will never see new capacity now
+            while self._orphans:
+                cr = self._orphans.popleft()
+                if cr.state != "queued":
+                    continue
+                cr.state = "failed"
+                cr.error = ClusterClosed(
+                    "cluster closed with the request parked for "
+                    "scale-up")
+                self._retire_locked(cr)
+                cr.done_evt.set()
         for rep in self.replicas:
             rep.wake.set()
         for rep in self.replicas:
@@ -753,7 +1038,8 @@ class ServingCluster:
             return {"enabled": False}
         snap = self._obs.registry.snapshot()
         snap["enabled"] = True
-        snap["replicas"] = [r.engine.metrics() for r in self.replicas]
+        snap["replicas"] = [r.engine.metrics() for r in self.replicas
+                            if r.engine is not None]
         return snap
 
 
@@ -816,9 +1102,9 @@ class _DisaggObs:
 class _WorkerHandle:
     """Router-side record of one worker process."""
     __slots__ = ("name", "role", "proc", "conn", "data_host",
-                 "data_port", "last_seen", "dead", "outstanding",
-                 "stats", "stats_evt", "stats_sid", "error",
-                 "recv_thread")
+                 "data_port", "last_seen", "dead", "draining",
+                 "outstanding", "stats", "stats_evt", "stats_sid",
+                 "error", "recv_thread")
 
     def __init__(self, name, role):
         self.name = name
@@ -829,6 +1115,7 @@ class _WorkerHandle:
         self.data_port = None
         self.last_seen = time.perf_counter()
         self.dead = False
+        self.draining = False
         self.outstanding = set()          # rids currently assigned
         self.stats: Dict = {}
         self.stats_evt = threading.Event()
@@ -849,7 +1136,8 @@ class DisaggRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
                  "phase", "prefill", "decode", "gen", "committed",
                  "output", "error", "done_evt", "submit_t",
-                 "first_token_t", "failovers", "delivered")
+                 "first_token_t", "token_times", "failovers",
+                 "delivered")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id):
         self.rid = rid
@@ -867,6 +1155,9 @@ class DisaggRequest:
         self.done_evt = threading.Event()
         self.submit_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
+        # router-side arrival time of each streamed token (tokens in
+        # one frame share a timestamp) — the goodput classifier's view
+        self.token_times: List[float] = []
         self.failovers = 0
         self.delivered = False
 
@@ -913,14 +1204,20 @@ class DisaggServingCluster:
                  num_slots, page_size=16, num_pages=None,
                  pages_per_slot=None, prefill_chunk=8, kv_int8=False,
                  kernel="xla", spec_K=0, metrics=None, registry=None,
-                 watchdog_s=30.0, spawn=True, host="127.0.0.1",
-                 port=0, ready_timeout=120.0):
+                 watchdog_s=None, spawn=True, host="127.0.0.1",
+                 port=0, ready_timeout=None):
         if prefill < 1 or decode < 1:
             raise ValueError("DisaggServingCluster: needs >= 1 "
                              "prefill and >= 1 decode worker")
+        if watchdog_s is None:
+            watchdog_s = _env_default("MXNET_SERVE_WATCHDOG_S", 30.0)
+        if ready_timeout is None:
+            ready_timeout = _env_default(
+                "MXNET_SERVE_READY_TIMEOUT_S", 120.0)
         self.cfg = cfg
         self.page_size = page_size
         self.watchdog_s = float(watchdog_s)
+        self._spawn = bool(spawn)
         self._engine_kwargs = dict(
             num_slots=num_slots, page_size=page_size,
             num_pages=num_pages, pages_per_slot=pages_per_slot,
@@ -946,6 +1243,9 @@ class DisaggServingCluster:
         self._retain = 4096
         self._terminal: "collections.deque[int]" = collections.deque()
         self.index = ClusterPrefixIndex()
+        # hellos from workers that connected while another worker's
+        # add_worker handshake was draining the accept queue
+        self._early_hellos: Dict[str, object] = {}
         self._rr = [0, 0]                 # round-robin cursors
         # worker-reported cumulative stats, delta-folded into the
         # router registry (same idiom as _EngineObs.sync_cache)
@@ -1106,6 +1406,7 @@ class DisaggServingCluster:
             if self._obs is not None:
                 self._obs.h_ttft.observe((now - cr.submit_t) * 1e3)
         cr.committed.extend(int(t) for t in toks)
+        cr.token_times.extend(now for _ in toks)
 
     def _on_tokens(self, wh, meta):
         with self._lock:
@@ -1253,6 +1554,7 @@ class DisaggServingCluster:
         the pages instead of recomputing them)."""
         cands = sorted((w for w in self.workers.values()
                         if w.role == role and w.alive
+                        and not w.draining
                         and w.name not in exclude),
                        key=lambda w: w.name)
         if not cands:
@@ -1330,6 +1632,20 @@ class DisaggServingCluster:
                 conn.send(kind, meta, bufs)
             except OSError:
                 pass                      # the monitor will fail it over
+
+    def drain(self, timeout=None):
+        """Wait until every submitted request reaches a terminal
+        state.  Returns True if fully drained (the same contract as
+        ``ServingCluster.drain`` — the trace-replay harness drives
+        both flavors through it)."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        for cr in list(self.requests.values()):
+            left = None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+            if not cr.done_evt.wait(left):
+                return False
+        return True
 
     def result(self, rid, timeout=None):
         """Block until the request finishes; returns prompt +
@@ -1463,6 +1779,7 @@ class DisaggServingCluster:
         with self._lock:
             return [{"worker": w.name, "role": w.role,
                      "alive": w.alive, "dead": w.dead,
+                     "draining": w.draining,
                      "outstanding": len(w.outstanding),
                      "heartbeat_age_s": now - w.last_seen,
                      "pid": None if w.proc is None else w.proc.pid,
@@ -1481,6 +1798,225 @@ class DisaggServingCluster:
             raise ValueError("worker %s was not spawned locally"
                              % name)
         os.kill(wh.proc.pid, sig or _signal.SIGKILL)
+
+    # ------------------------------------------------- scale-up/down --
+    def _handshake_one(self, wh, timeout):
+        """Handshake ONE late worker on the live listener (the
+        add_worker path — same protocol as the construction-time
+        ``_handshake_all``).  Hellos from OTHER concurrently-joining
+        workers are stashed, not closed — closing them would kill a
+        sibling's join (the multi-worker ``--workers-only`` flow
+        starts several workers at once; _handshake_all's any-name
+        acceptance has the same property at construction)."""
+        deadline = time.perf_counter() + timeout
+        with self._lock:
+            conn = self._early_hellos.pop(wh.name, None)
+        while conn is None:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                raise RuntimeError(
+                    "add_worker: %s never connected" % wh.name)
+            try:
+                cand = self._pending_conns.get(timeout=min(left, 1.0))
+            except queue.Empty:
+                continue
+            got = cand.recv(timeout=left)
+            if got in (None, "timeout"):
+                cand.close()
+                continue
+            kind, meta, _ = got
+            name = meta.get("name") if kind == "hello" else None
+            if name == wh.name:
+                conn = cand
+            elif name:
+                # a sibling joiner beat us to the accept queue: park
+                # its hello'd connection for ITS add_worker call
+                with self._lock:
+                    old = self._early_hellos.pop(name, None)
+                    self._early_hellos[name] = cand
+                if old is not None:
+                    old.close()
+            else:
+                cand.close()
+        wh.conn = conn
+        pm, pb = self._params_frames
+        wh.conn.send("config",
+                     {"cfg": self.cfg, "role": wh.role,
+                      "engine_kwargs": self._engine_kwargs,
+                      "params_meta": pm,
+                      "watchdog_s": self.watchdog_s}, pb)
+        got = wh.conn.recv(timeout=max(
+            1.0, deadline - time.perf_counter()))
+        if got in (None, "timeout") or got[0] != "ready":
+            raise RuntimeError(
+                "add_worker: worker %s failed to build its engine "
+                "(%r)" % (wh.name, got))
+        _, meta, _ = got
+        wh.data_host = meta["data_host"]
+        wh.data_port = meta["data_port"]
+        wh.last_seen = time.perf_counter()
+
+    def add_worker(self, role, spawn=None, ready_timeout=None):
+        """Scale-up actuation (round 16): add one more ``role``
+        worker PROCESS to the live cluster.  ``spawn=True`` forks it
+        here (multiprocessing spawn, like construction);
+        ``spawn=False`` waits for an externally-launched worker —
+        ``tools/launch.py --launcher serve --workers-only`` (or bare
+        ``run_worker()`` with ``MXNET_SERVE_*`` env) started against
+        this router's port, which is how an autoscaler adds capacity
+        on ANOTHER host.  Blocks through handshake + engine pre-warm;
+        every live worker receives the refreshed peer map.  Returns
+        the new worker's name."""
+        if role not in ("prefill", "decode"):
+            raise ValueError("add_worker: role must be 'prefill' or "
+                             "'decode', got %r" % (role,))
+        if ready_timeout is None:
+            ready_timeout = _env_default(
+                "MXNET_SERVE_READY_TIMEOUT_S", 120.0)
+        with self._lock:
+            if self._closed:
+                raise ClusterClosed("add_worker() after close()")
+            i = 0
+            while "%s%d" % (role, i) in self.workers:
+                i += 1
+            name = "%s%d" % (role, i)
+            wh = _WorkerHandle(name, role)
+            # hidden from _pick until FULLY ready: the handshake sets
+            # wh.conn (making it "alive") several messages before the
+            # worker has its peer map — a submit dispatched into that
+            # window would hit a worker still in __init__, which
+            # treats the unexpected frame as a broken handshake and
+            # dies
+            wh.draining = True
+            self.workers[name] = wh
+        if spawn is None:
+            spawn = self._spawn
+        if spawn:
+            import multiprocessing as mp
+            ctx = mp.get_context("spawn")
+            wh.proc = ctx.Process(
+                target=_disagg_worker_entry,
+                args=(name, role, self._listener.host,
+                      self._listener.port),
+                daemon=True, name="serving-" + name)
+            wh.proc.start()
+        try:
+            self._handshake_one(wh, ready_timeout)
+        except BaseException:
+            with self._lock:
+                wh.dead = True
+                self.workers.pop(name, None)
+            if wh.proc is not None and wh.proc.is_alive():
+                wh.proc.terminate()
+            if wh.conn is not None:
+                wh.conn.close()
+            raise
+        with self._lock:
+            peers = {n: {"role": w.role, "host": w.data_host,
+                         "port": w.data_port}
+                     for n, w in self.workers.items() if w.alive}
+            targets = [w for w in self.workers.values() if w.alive]
+        for w in targets:
+            try:
+                w.conn.send("peers", {"peers": peers})
+            except OSError:
+                pass                      # the monitor will fail it over
+        wh.recv_thread = threading.Thread(
+            target=self._recv_loop, args=(wh,), daemon=True,
+            name="disagg-recv-" + wh.name)
+        wh.recv_thread.start()
+        with self._lock:
+            wh.draining = False           # ready: now routable
+            if self._obs is not None:
+                self._obs.g_workers.set(
+                    sum(w.alive for w in self.workers.values()))
+        return name
+
+    def drain_worker(self, name, timeout=60.0):
+        """Graceful scale-down of one worker process: stop routing to
+        it, wait for its outstanding requests to finish, then shut it
+        down (clean exit, not SIGKILL — its engine drains with zero
+        in-flight loss).  Refuses to drain the last live worker of a
+        role.  Returns True once drained and stopped; False (and back
+        in rotation) on timeout."""
+        with self._lock:
+            wh = self.workers[name]
+            if wh.dead:
+                return False
+            siblings = [w for w in self.workers.values()
+                        if w.role == wh.role and w.alive
+                        and not w.draining and w is not wh]
+            if not siblings:
+                return False
+            wh.draining = True
+        deadline = time.perf_counter() + float(timeout)
+        drained = False
+        while time.perf_counter() < deadline:
+            with self._lock:
+                drained = not wh.outstanding
+            if drained:
+                break
+            time.sleep(0.02)
+        if not drained:
+            with self._lock:
+                wh.draining = False       # back in rotation
+            return False
+        with self._lock:
+            wh.dead = True                # recv EOF won't fail over
+            self.index.drop_owner(name)
+            if self._obs is not None:
+                self._obs.g_workers.set(
+                    sum(w.alive for w in self.workers.values()))
+        try:
+            wh.conn.send("shutdown", {})
+        except OSError:
+            pass
+        if wh.proc is not None:
+            wh.proc.join(timeout=10)
+            if wh.proc.is_alive():
+                wh.proc.terminate()
+        try:
+            wh.conn.close()
+        except Exception:
+            pass
+        return True
+
+    # the autoscaler's actuation protocol (shared with
+    # ServingCluster) — role-aware here: scale_up grows the role with
+    # the higher mean outstanding load, scale_down drains the
+    # least-loaded worker of any role that keeps >= 1 worker
+    def scale_up(self):
+        with self._lock:
+            load = {}
+            for role in ("prefill", "decode"):
+                ws = [w for w in self.workers.values()
+                      if w.role == role and w.alive
+                      and not w.draining]
+                load[role] = (float("inf") if not ws else
+                              sum(len(w.outstanding) for w in ws)
+                              / len(ws))
+        role = max(sorted(load), key=lambda r: load[r])
+        self.add_worker(role)
+        return True
+
+    def scale_down(self, timeout=60.0):
+        with self._lock:
+            cands = []
+            for role in ("prefill", "decode"):
+                ws = [w for w in self.workers.values()
+                      if w.role == role and w.alive
+                      and not w.draining]
+                if len(ws) > 1:
+                    cands.extend(ws)
+            if not cands:
+                return False
+            name = min(cands, key=lambda w: (len(w.outstanding),
+                                             w.name)).name
+        return self.drain_worker(name, timeout=timeout)
+
+    @property
+    def slots_per_replica(self):
+        return self._engine_kwargs["num_slots"]
 
     def close(self, timeout=30.0):
         with self._lock:
@@ -1512,6 +2048,14 @@ class DisaggServingCluster:
                     wh.proc.join(timeout=5)
             if wh.conn is not None:
                 wh.conn.close()
+        with self._lock:
+            early = list(self._early_hellos.values())
+            self._early_hellos.clear()
+        for conn in early:
+            try:
+                conn.close()
+            except Exception:
+                pass
         self._listener.close()
 
     def __enter__(self):
@@ -1819,6 +2363,11 @@ class _DisaggWorker:
             # the prefill side completed this request itself: free
             # any staged pages of its stream
             self.receiver.abort(tuple(meta["srid"]))
+        elif kind == "peers":
+            # live peer-map refresh (router add_worker/scale-up):
+            # only ever grows or re-addresses — cached conns to
+            # still-present peers stay valid
+            self.peers = meta["peers"]
         elif kind == "stats_req":
             self._send_stats(force=True, sid=meta.get("sid"))
         elif kind == "_wake":

@@ -74,6 +74,12 @@ _SLOW_GROUPS = {
     # and compile a step program; isolated so the per-test process
     # spawn cost never squeezes another group's budget)
     "test_serving_disagg": "j",
+    # group k: ~3min — round-16 traffic realism (seeded trace replay,
+    # autoscaler up/down with the zero-leak drain contract, chaos
+    # kill/stall under burst vs the generate oracle; own group
+    # because the scenarios pace themselves on the wall clock and
+    # replica-thread scheduling jitter must not squeeze f/h)
+    "test_serving_traffic": "k",
 }
 
 

@@ -379,9 +379,17 @@ def test_cluster_backpressure_and_ttl():
         r_ok = cl.submit(rng.randint(1, 90, 4).astype(np.int32), 20)
         r_ttl = cl.submit(rng.randint(1, 90, 4).astype(np.int32), 4,
                           ttl_s=0.0)
-        with pytest.raises(ClusterOverloaded):
+        with pytest.raises(ClusterOverloaded) as ei:
             for _ in range(10):
                 cl.submit(rng.randint(1, 90, 4).astype(np.int32), 4)
+        # round-16 satellite: the rejection carries a structured
+        # Retry-After hint (queue excess / recent drain rate — the
+        # future HTTP 429 + Retry-After), mirrored on the gauge
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s > 0
+        assert "retry after" in str(ei.value)
+        assert cl.metrics()["gauges"]["cluster_retry_after_s"] == \
+            ei.value.retry_after_s
         with pytest.raises(RequestExpired):
             cl.result(r_ttl, timeout=120)
         out = cl.result(r_ok, timeout=300)

@@ -373,6 +373,62 @@ class TestPylockFixtures:
         assert "py-guarded-field" not in _rules(new)
 
 
+class TestPylockAutoscalerCoverage:
+    """ISSUE 11 satellite: pylocklint's guarded-field / lock-order
+    inference reaches the round-16 ``serving/autoscaler.py`` (the
+    live module's cleanliness is pinned by
+    ``test_pylocklint_zero_findings_even_baselined``, which now scans
+    it — these prove a violation planted THERE would fire, i.e. the
+    coverage is real, not vacuous)."""
+
+    def test_planted_guarded_field_fires(self):
+        src = ("import threading\n"
+               "class Autoscaler:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self.target = 0\n"
+               "    def tick(self):\n"
+               "        with self._mu:\n"
+               "            self.target = 1\n"
+               "    def _loop(self):\n"
+               "        self.target = 2\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/autoscaler.py")
+        assert _rules(fs) == {"py-guarded-field": 1}
+
+    def test_planted_lock_order_cycle_fires(self):
+        src = ("import threading\n"
+               "class Autoscaler:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self._scale_mu = threading.Lock()\n"
+               "    def tick(self):\n"
+               "        with self._mu:\n"
+               "            with self._scale_mu:\n"
+               "                pass\n"
+               "    def _loop(self):\n"
+               "        with self._scale_mu:\n"
+               "            with self._mu:\n"
+               "                pass\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/autoscaler.py")
+        assert "py-lock-order" in _rules(fs)
+
+    def test_planted_blocking_under_lock_fires(self):
+        # the autoscaler's real hazard shape: actuation (a blocking
+        # drain) while holding a lock
+        src = ("import threading, time\n"
+               "class Autoscaler:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "    def tick(self):\n"
+               "        with self._mu:\n"
+               "            time.sleep(1.0)\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/autoscaler.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+
 class TestBenchSyncFixtures:
     """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
     unsynced-jit pattern fires once, the pragma'd twin is suppressed,
@@ -515,6 +571,16 @@ class TestHotRegionAdditions:
         ("mxnet_tpu/obs/metrics.py",
          "class MetricsRegistry:\n"
          " def _get(self, cls, name):\n%s"),
+        # round 16: the autoscaler control loop, the chaos driver's
+        # replay-time apply path, and the trace generator
+        ("mxnet_tpu/serving/autoscaler.py",
+         "class Autoscaler:\n"
+         " def tick(self, now=None):\n%s"),
+        ("mxnet_tpu/serving/chaos.py",
+         "class ChaosDriver:\n"
+         " def poll(self, now_rel):\n%s"),
+        ("benchmark/traffic_trace.py",
+         "def generate_trace(spec):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)

@@ -97,6 +97,16 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/models/gpt.py", r"generate(?:_speculative)?$"),
     ("benchmark/serve_bench.py", r".*"),
     ("benchmark/spec_decode_probe.py", r".*"),
+    # round 16: the autoscaler control loop ticks continuously next
+    # to the serving threads (a host sync or in-loop jit there stalls
+    # every scaling decision behind device work), the chaos driver's
+    # poll/apply path runs inside the replay's timed loop, and the
+    # trace generator feeds seeded workloads whose timing sections
+    # must stay pure host work (bench-no-sync applies to the
+    # benchmark/ module as usual)
+    ("mxnet_tpu/serving/autoscaler.py", r".*"),
+    ("mxnet_tpu/serving/chaos.py", r".*"),
+    ("benchmark/traffic_trace.py", r".*"),
 ]
 
 # modules whose timestamps must stay on the shared perf_counter clock

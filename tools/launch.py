@@ -183,29 +183,53 @@ def launch_serve(args, command):
     the same protocol scales from this single-host topology to one
     worker per host (run ``run_worker()`` remotely with the env
     pointing at the router)."""
+    if args.workers_only and not args.port:
+        sys.stderr.write(
+            "--workers-only: -p/--port must name the LIVE router's "
+            "control port (the workers have nothing to rendezvous "
+            "with otherwise)\n")
+        return 2
     port = args.port or _free_port()
     base_env = dict(os.environ)
     base_env.update({
-        "MXNET_SERVE_ROUTER_HOST": "127.0.0.1",
+        "MXNET_SERVE_ROUTER_HOST": args.router_host,
         "MXNET_SERVE_ROUTER_PORT": str(port),
         "MXNET_SERVE_PREFILL": str(args.prefill),
         "MXNET_SERVE_DECODE": str(args.decode),
     })
-    router = subprocess.Popen(command, env=base_env)
+    router = None
+    if not args.workers_only:
+        router = subprocess.Popen(command, env=base_env)
     workers = []
     for role, n in (("prefill", args.prefill),
                     ("decode", args.decode)):
         for i in range(n):
             env = dict(base_env)
             env["MXNET_SERVE_ROLE"] = role
-            env["MXNET_SERVE_WORKER"] = "%s%d" % (role, i)
+            # --workers-only joins a LIVE cluster (round 16: the
+            # autoscaler's off-host scale-up path — the router's
+            # add_worker(role, spawn=False) is waiting for exactly
+            # this name): name from --worker-start so the operator
+            # matches what the router expects; the default topology
+            # numbers workers from 0 as before
+            env["MXNET_SERVE_WORKER"] = "%s%d" % (
+                role, args.worker_start + i)
             workers.append(subprocess.Popen(
                 [sys.executable, "-c",
                  "from mxnet_tpu.serving import run_worker; "
                  "run_worker()"], env=env))
     try:
-        code = router.wait()
+        if router is not None:
+            code = router.wait()
+        else:
+            code = 0
+            for p in workers:
+                p.wait()
+                code = code or p.returncode
     finally:
+        # reap workers in BOTH modes: after the router exits, and on
+        # an abnormal exit (Ctrl-C mid-wait) of a --workers-only
+        # launcher — otherwise the workers run on unsupervised
         for p in workers:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
@@ -269,11 +293,25 @@ def main():
                     help="serve launcher: prefill worker processes")
     ap.add_argument("--decode", type=int, default=1,
                     help="serve launcher: decode worker processes")
+    ap.add_argument("--workers-only", action="store_true",
+                    help="serve launcher: spawn ONLY workers against "
+                         "a LIVE router at --router-host:-p (round-16 "
+                         "scale-up path: the router must be waiting "
+                         "in add_worker(role, spawn=False)); no "
+                         "router command is run")
+    ap.add_argument("--router-host", default="127.0.0.1",
+                    help="serve launcher: router control host the "
+                         "workers connect to")
+    ap.add_argument("--worker-start", type=int, default=0,
+                    help="serve launcher: first worker INDEX per "
+                         "role (--workers-only joining a cluster "
+                         "that already has prefill0..N-1)")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("-p", "--port", type=int, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
-    if not args.command:
+    if not args.command and not (args.launcher == "serve"
+                                 and args.workers_only):
         ap.error("no command given")
     if args.launcher == "serve":
         sys.exit(launch_serve(args, args.command))
