@@ -1287,6 +1287,9 @@ class DisaggServingCluster:
             for wh in self.workers.values():
                 if wh.proc is not None and wh.proc.is_alive():
                     wh.proc.terminate()
+                    # reap: a SIGTERMed child stays a zombie pid
+                    # until joined (py-resource-lifecycle)
+                    wh.proc.join(timeout=5)
                 if wh.conn is not None:
                     wh.conn.close()
             self._listener.close()
@@ -1908,6 +1911,7 @@ class DisaggServingCluster:
                 self.workers.pop(name, None)
             if wh.proc is not None and wh.proc.is_alive():
                 wh.proc.terminate()
+                wh.proc.join(timeout=5)   # reap the zombie pid
             if wh.conn is not None:
                 wh.conn.close()
             raise
@@ -1975,6 +1979,7 @@ class DisaggServingCluster:
             wh.proc.join(timeout=10)
             if wh.proc.is_alive():
                 wh.proc.terminate()
+                wh.proc.join(timeout=5)   # reap the zombie pid
         try:
             wh.conn.close()
         except Exception:
@@ -2212,26 +2217,37 @@ class _DisaggWorker:
     def _serve_fetches(self):
         """Answer queued sibling FETCH requests (also called while
         WAITING on our own fetch — two replicas fetching from each
-        other must not deadlock)."""
+        other must not deadlock).  The reply goes out on EVERY exit
+        edge: if serving the fetch raises, the requester gets an n=0
+        miss NOW instead of waiting out its full fetch timeout on a
+        reply that will never come — and one bad fetch must not take
+        down the whole worker (proto-reply-pairing's checked
+        invariant)."""
         while True:
             try:
                 meta, bufs, conn = self.fetch_inbox.get_nowait()
             except queue.Empty:
                 return
-            tokens = np.frombuffer(bytes(bufs[0]), np.int32)
             reply_bufs = []
             n_full = 0
-            if self.eng.prefix is not None:
-                entries, pages, m = self.eng.prefix.match(tokens)
-                try:
-                    n_full = min(len(pages), m // self.eng.page_size)
-                    if n_full:
-                        from .page_streamer import pages_to_bufs
-                        reply_bufs = pages_to_bufs(
-                            self.eng.cache.export_pages(
-                                pages[:n_full]))
-                finally:
-                    self.eng.prefix.release(entries)
+            try:
+                tokens = np.frombuffer(bytes(bufs[0]), np.int32)
+                if self.eng.prefix is not None:
+                    entries, pages, m = self.eng.prefix.match(tokens)
+                    try:
+                        n_full = min(len(pages),
+                                     m // self.eng.page_size)
+                        if n_full:
+                            from .page_streamer import pages_to_bufs
+                            reply_bufs = pages_to_bufs(
+                                self.eng.cache.export_pages(
+                                    pages[:n_full]))
+                    finally:
+                        self.eng.prefix.release(entries)
+            except Exception:
+                # degrade to a miss: the requester falls back to a
+                # cold prefill instead of eating its fetch timeout
+                n_full, reply_bufs = 0, []
             try:
                 conn.send("fetch_reply",
                           {"n": n_full, "fid": meta.get("fid"),
@@ -2311,6 +2327,12 @@ class _DisaggWorker:
     def _handle(self, kind, meta, bufs, conn):
         if kind == "submit":
             inp = np.frombuffer(bytes(bufs[0]), np.int32)
+            if meta["gen"] < self._fenced.get(meta["rid"], -1):
+                # a late dispatch racing an abort for a NEWER
+                # incarnation of the same rid: the router no longer
+                # wants this gen — admitting it would resurrect a
+                # fenced zombie (proto-gen-fence checked invariant)
+                return
             if meta.get("hint") and self.eng.prefix is not None:
                 entries, _, m_local = self.eng.prefix.match(inp)
                 self.eng.prefix.release(entries)
@@ -2360,16 +2382,19 @@ class _DisaggWorker:
         elif kind == "abort":
             self._abort(meta["rid"], meta["below_gen"])
         elif kind == "drop":
+            key = tuple(meta["srid"])
+            if key[1] < self._fenced.get(key[0], -1):
+                return                    # zombie incarnation's frame
             # the prefill side completed this request itself: free
             # any staged pages of its stream
-            self.receiver.abort(tuple(meta["srid"]))
+            self.receiver.abort(key)
         elif kind == "peers":
             # live peer-map refresh (router add_worker/scale-up):
             # only ever grows or re-addresses — cached conns to
             # still-present peers stay valid
             self.peers = meta["peers"]
         elif kind == "stats_req":
-            self._send_stats(force=True, sid=meta.get("sid"))
+            self._send_stats(sid=meta.get("sid"))
         elif kind == "_wake":
             pass                          # fetch_inbox wake token
         elif kind in ("shutdown", "_lost"):
@@ -2581,11 +2606,21 @@ class _DisaggWorker:
         if conn is not None:
             conn.close()
 
-    def _send_stats(self, force=False, sid=None):
-        now = time.perf_counter()
-        if not force and now - self._last_stats < 0.25:
+    def _maybe_send_stats(self):
+        """Rate-limited periodic stats tick (the main loop's path);
+        the `stats_req` reply rides :meth:`_send_stats` directly — a
+        rate limit on the reply path would DROP solicited replies
+        and stall the router's cluster_stats() round trip."""
+        if time.perf_counter() - self._last_stats < 0.25:
             return
-        self._last_stats = now
+        self._send_stats()
+
+    def _send_stats(self, sid=None):
+        """Send one stats frame NOW.  This is the `stats_req` →
+        `stats` reply path, so it must reach the send on every exit
+        edge (proto-reply-pairing): no early returns; the only
+        excused failure is the router connection itself dying."""
+        self._last_stats = time.perf_counter()
         eng = self.eng
         prefix = eng.prefix
         stats = {
@@ -2657,7 +2692,7 @@ class _DisaggWorker:
                         self._handle(*item)
                     except queue.Empty:
                         pass
-                self._send_stats()
+                self._maybe_send_stats()
         except Exception as e:
             try:
                 self.router.send("error", {"msg": repr(e)})

@@ -1,0 +1,142 @@
+"""Seeded violations for every protolint rule (tests/test_static_analysis).
+
+Analyzed standalone with ``roles={"FixRouter": "router",
+"FixWorker": "worker"}`` — a two-endpoint toy protocol mirroring the
+live router ↔ worker topology.  Each rule has exactly the seeded
+firing sites asserted by ``TestProtoFixtures`` plus one
+pragma-suppressed twin — the twin lines carry the string
+"suppressed twin" so the test can assert nothing on or directly below
+them surfaced.
+"""
+import threading
+
+from mxnet_tpu.serving.transport import Listener, connect  # noqa: F401
+
+ROLES = {"FixRouter": "router", "FixWorker": "worker"}
+
+
+class FixRouter:
+    """Router endpoint: send sites + the reply-dispatch loop."""
+
+    def __init__(self, conn):
+        self.conn = conn              # control conn to the worker
+        self.jobs = {}
+
+    # -- send sites ---------------------------------------------------
+    def send_job(self):
+        # fires proto-meta-schema: the worker's job arm reads
+        # meta["payload"], which this site omits
+        self.conn.send("job", {"rid": 1})
+
+    def send_job_twin(self):
+        # mxlint: allow(proto-meta-schema) -- suppressed twin
+        self.conn.send("job", {"rid": 2})
+
+    def send_orphan(self):
+        # fires proto-unhandled-kind: no worker arm dispatches it
+        self.conn.send("orphan", {"rid": 3})
+
+    def send_orphan_twin(self):
+        # mxlint: allow(proto-unhandled-kind) -- suppressed twin
+        self.conn.send("orphan", {"rid": 4})
+
+    def send_cancel(self):
+        # the worker's cancel arm is the unfenced gen handler
+        self.conn.send("cancel", {"rid": 5, "gen": 0})
+
+    def send_revoke(self):
+        # the worker's revoke arm is the pragma'd gen-handler twin
+        self.conn.send("revoke", {"rid": 6, "gen": 1})
+
+    def send_fine(self):
+        # clean: the worker's fine arm fences the gen properly
+        self.conn.send("fine", {"rid": 7, "gen": 2})
+
+    def send_requests(self):
+        # ping_req's reply path may raise before the reply;
+        # echo_req's is the pragma'd twin
+        self.conn.send("ping_req", {"q": 8})
+        self.conn.send("echo_req", {"q": 9})
+
+    # -- dispatch (replies from the worker) ---------------------------
+    def recv_loop(self):
+        got = self.conn.recv()
+        if got is None:
+            return
+        kind, meta, bufs = got
+        if kind == "ping":
+            self.jobs[meta["rid"]] = "ping"
+        elif kind == "echo":
+            self.jobs[meta["rid"]] = "echo"
+
+
+class FixWorker:
+    """Worker endpoint: the hand-written dispatch chain."""
+
+    def __init__(self, router):
+        self.router = router          # conn back to the router
+        self.state = {}
+        self._fenced = {}
+
+    def handle(self, kind, meta, bufs):
+        if kind == "job":
+            self.state[meta["rid"]] = meta["payload"]
+        elif kind == "cancel":
+            # fires proto-gen-fence: gen-carrying kind, no fence
+            self.state[meta["rid"]] = "dead"
+        # mxlint: allow(proto-gen-fence) -- suppressed twin
+        elif kind == "revoke":
+            self.state[meta["rid"]] = "revoked"
+        elif kind == "fine":
+            if meta["gen"] < self._fenced.get(meta["rid"], -1):
+                return                # clean: fenced before mutating
+            self.state[meta["rid"]] = "ok"
+        elif kind == "ghost":
+            # fires proto-unknown-kind: no peer ever sends it
+            pass
+        # mxlint: allow(proto-unknown-kind) -- suppressed twin
+        elif kind == "phantom":
+            pass
+        elif kind == "ping_req":
+            # fires proto-reply-pairing: compute() may raise before
+            # the reply is attempted — the exception edge drops it
+            data = self.compute(meta["q"])
+            self.router.send("ping", {"rid": data})
+        elif kind == "echo_req":
+            # mxlint: allow(proto-reply-pairing) -- suppressed twin
+            data = self.compute(meta["q"])
+            self.router.send("echo", {"rid": data})
+
+    def compute(self, q):
+        return q * 2
+
+
+class FixResources:
+    """py-resource-lifecycle shapes (role-independent: the lifecycle
+    pass scans the whole package, not just protocol endpoints)."""
+
+    def leak_listener(self, flag):
+        lst = Listener()
+        if flag:
+            return None               # fires: exit without close
+        lst.close()
+
+    def leak_listener_twin(self, flag):
+        lst = Listener()
+        if flag:
+            # mxlint: allow(py-resource-lifecycle) -- suppressed twin
+            return None
+        lst.close()
+
+    def clean_escape(self, host, port):
+        conn = connect(host, port)
+        self.conn = conn              # escapes into owned state
+        return conn
+
+    def clean_daemon_thread(self, fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()                     # daemon threads self-reap
+
+    def clean_reaped(self, proc):
+        proc.terminate()
+        proc.join(timeout=5)          # terminate + reap: clean

@@ -27,7 +27,7 @@ import time
 import pytest
 
 from tools.analysis import (abi, graphlint, jaxlint, native_lint,
-                            pylocklint)
+                            protolint, pylocklint)
 from tools.analysis.findings import (Finding, apply_pragmas,
                                      load_baseline, split_new)
 from tools.analysis.runner import (BINDINGS, HEADER, REPO_ROOT,
@@ -581,6 +581,18 @@ class TestHotRegionAdditions:
          " def poll(self, now_rel):\n%s"),
         ("benchmark/traffic_trace.py",
          "def generate_trace(spec):\n%s"),
+        # round 17: the disagg scale-actuation paths protolint's
+        # call-graph walks also cover — add_worker/drain_worker and
+        # the late-join handshake helper run while the cluster serves
+        ("mxnet_tpu/serving/cluster.py",
+         "class DisaggServingCluster:\n"
+         " def add_worker(self, role):\n%s"),
+        ("mxnet_tpu/serving/cluster.py",
+         "class DisaggServingCluster:\n"
+         " def drain_worker(self, name):\n%s"),
+        ("mxnet_tpu/serving/cluster.py",
+         "class DisaggServingCluster:\n"
+         " def _handshake_one(self, wh, timeout):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)
@@ -599,6 +611,336 @@ class TestHotRegionAdditions:
         fs = jaxlint.lint_source(src, "mxnet_tpu/serving/cluster.py",
                                  clock=False)
         assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# protolint (ISSUE 12): live repo, fixtures, protocol audit workflow
+# ---------------------------------------------------------------------------
+def _serving_modules():
+    return protolint._load_modules(REPO_ROOT)
+
+
+def _with_cluster(src):
+    mods = _serving_modules()
+    mods["mxnet_tpu/serving/cluster.py"] = src
+    return mods
+
+
+class TestProtolintLiveRepo:
+    def test_protolint_zero_findings_even_baselined(self):
+        """ISSUE 12 acceptance criterion: the wire-protocol &
+        process-lifecycle audit reports ZERO findings with an EMPTY
+        baseline over mxnet_tpu/serving/ — nothing grandfathered."""
+        fs = protolint.run(REPO_ROOT)
+        assert fs == [], "\n".join(str(f) for f in fs)
+
+    def test_protocol_audit_checked_in_and_current(self):
+        """docs/protocol.md is committed (acceptance criterion) and
+        regenerates identically; every conn.send kind in serving/ has
+        a handler row (no UNCOVERED), and the gen-fenced kinds are
+        marked."""
+        path = os.path.join(REPO_ROOT, protolint.AUDIT_PATH)
+        committed = open(path).read()
+        assert committed == protolint.protocol_audit_md(REPO_ROOT)
+        assert "UNCOVERED" not in committed
+        for kind in ("submit", "pages", "handoff", "fetch",
+                     "fetch_reply", "stats_req", "stats", "abort",
+                     "tokens", "done", "hello", "ready", "config",
+                     "peers", "shutdown"):
+            assert "| `%s` |" % kind in committed, kind
+        # the gen-fence column is verified, not decorative
+        assert "| NO |" not in committed
+        # synthetic in-process kinds never reach the wire table
+        assert "| `_wake` |" not in committed
+        assert "| `_lost` |" not in committed
+
+    def test_audit_covers_every_send_kind(self):
+        """The table covers exactly the literal-kind send sites the
+        model sees — a new conn.send kind cannot ship without a row
+        (and, via tier-1, without a handler)."""
+        committed = open(os.path.join(
+            REPO_ROOT, protolint.AUDIT_PATH)).read()
+        prog = protolint.build_model(_serving_modules())
+        kinds = {s.kind for s in prog.sends
+                 if not s.kind.startswith("_")}
+        assert kinds, "protocol model saw no send sites"
+        for kind in kinds:
+            assert "| `%s` |" % kind in committed, kind
+
+    def test_protolint_guards_the_submit_gen_fence(self):
+        """Deleting the round-17 fence in the worker's submit arm
+        re-fires proto-gen-fence — the pass genuinely guards the fix
+        shipped in this PR (PR-4/7/8 convention)."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        fence = (
+            '            if meta["gen"] < self._fenced.get('
+            'meta["rid"], -1):\n'
+            "                # a late dispatch racing an abort for a "
+            "NEWER\n"
+            "                # incarnation of the same rid: the "
+            "router no longer\n"
+            "                # wants this gen — admitting it would "
+            "resurrect a\n"
+            "                # fenced zombie (proto-gen-fence checked "
+            "invariant)\n"
+            "                return\n")
+        assert fence in src
+        fs = protolint.analyze(_with_cluster(src.replace(fence, "",
+                                                         1)))
+        got = [f for f in fs if f.rule == "proto-gen-fence"
+               and f.symbol == "submit"]
+        assert len(got) == 1, [str(f) for f in fs]
+
+    def test_protolint_guards_the_fetch_reply_degrade(self):
+        """The fetch server's degrade-to-miss handler is what makes
+        the fetch/fetch_reply pairing hold on exception edges —
+        replacing it with a re-raise re-fires proto-reply-pairing."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        handler = (
+            "            except Exception:\n"
+            "                # degrade to a miss: the requester falls "
+            "back to a\n"
+            "                # cold prefill instead of eating its "
+            "fetch timeout\n"
+            "                n_full, reply_bufs = 0, []\n")
+        assert handler in src
+        broken = src.replace(
+            handler, "            except Exception:\n"
+                     "                raise\n", 1)
+        fs = protolint.analyze(_with_cluster(broken))
+        got = [f for f in fs if f.rule == "proto-reply-pairing"
+               and f.symbol == "fetch"]
+        assert len(got) == 1, [str(f) for f in fs]
+
+    def test_protolint_guards_the_stats_reply_path(self):
+        """_send_stats is the stats_req reply path: reintroducing the
+        pre-round-17 rate-limit early-return re-fires
+        proto-reply-pairing (a rate-limited reply DROPS solicited
+        replies and stalls cluster_stats() to its timeout)."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        entry = ("        self._last_stats = time.perf_counter()\n"
+                 "        eng = self.eng\n")
+        assert entry in src
+        broken = src.replace(entry, (
+            "        if sid is None:\n"
+            "            return\n" + entry), 1)
+        fs = protolint.analyze(_with_cluster(broken))
+        got = [f for f in fs if f.rule == "proto-reply-pairing"
+               and f.symbol == "stats_req"]
+        assert len(got) == 1, [str(f) for f in fs]
+
+    def test_protolint_guards_the_terminate_reap_fixes(self):
+        """Dropping any of the round-17 post-terminate joins re-fires
+        py-resource-lifecycle: a SIGTERMed worker process stays a
+        zombie pid until the router exits."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        reap = "                wh.proc.join(timeout=5)   " \
+               "# reap the zombie pid\n"
+        assert reap in src
+        fs = protolint.analyze(_with_cluster(src.replace(reap, "",
+                                                         1)))
+        got = [f for f in fs if f.rule == "py-resource-lifecycle"
+               and f.symbol == "terminate"]
+        assert len(got) == 1, [str(f) for f in fs]
+
+    def test_protolint_catches_meta_schema_drift(self):
+        """Dropping a meta key one side still reads fires
+        proto-meta-schema at the drifted SEND site — the cross-process
+        KeyError class the rule exists for."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        whole = ('self.router.send("lost", {"rid": st["rid"],\n'
+                 '                                      '
+                 '"gen": st["gen"]})')
+        assert whole in src
+        broken = src.replace(
+            whole, 'self.router.send("lost", {"rid": st["rid"]})', 1)
+        fs = protolint.analyze(_with_cluster(broken))
+        got = [f for f in fs if f.rule == "proto-meta-schema"]
+        assert len(got) == 1 and got[0].symbol == "lost" \
+            and "'gen'" in got[0].message, [str(f) for f in fs]
+
+    def test_protolint_catches_dropped_dispatch_arm(self):
+        """Deleting a dispatch arm fires proto-unhandled-kind at the
+        send site — the silent-drop class."""
+        src = _serving_modules()["mxnet_tpu/serving/cluster.py"]
+        arm = ('            elif kind == "handed":\n'
+               "                self._on_handed(wh, meta)\n")
+        assert arm in src
+        fs = protolint.analyze(_with_cluster(src.replace(arm, "", 1)))
+        got = [f for f in fs if f.rule == "proto-unhandled-kind"]
+        assert len(got) == 1 and got[0].symbol == "handed", \
+            [str(f) for f in fs]
+
+    def test_changed_only_trigger_gating(self, monkeypatch):
+        """--changed-only: protolint re-analyzes only when serving/,
+        parallel/dist.py, or tools/analysis/ change; any other change
+        set skips the pass entirely (and a triggered run reports only
+        changed files, pylocklint's convention)."""
+        assert protolint.triggered(None)
+        assert protolint.triggered({"mxnet_tpu/serving/cluster.py"})
+        assert protolint.triggered({"mxnet_tpu/parallel/dist.py"})
+        assert protolint.triggered({"tools/analysis/protolint.py"})
+        assert not protolint.triggered({"README.md",
+                                        "mxnet_tpu/models/gpt.py"})
+
+        def boom(*a, **kw):
+            raise AssertionError("analyzed despite no trigger")
+
+        monkeypatch.setattr(protolint, "analyze", boom)
+        assert protolint.run(REPO_ROOT, only={"README.md"}) == []
+
+
+class TestProtoFixtures:
+    """Every protolint rule fires exactly once as seeded in
+    fixtures/mxlint/proto_fixture.py, pragma twins stay suppressed,
+    clean shapes stay silent, and the baseline suppresses by key
+    (ISSUE 12 satellite, mirroring pylock_fixture.py)."""
+
+    ROLES = {"FixRouter": "router", "FixWorker": "worker"}
+
+    @pytest.fixture(scope="class")
+    def findings(self):
+        src = open(os.path.join(FIXTURES, "proto_fixture.py")).read()
+        return protolint.lint_source(src, "proto_fixture.py",
+                                     roles=self.ROLES)
+
+    def test_each_rule_fires_exactly_once(self, findings):
+        assert _rules(findings) == {
+            "proto-unhandled-kind": 1,    # orphan send site
+            "proto-unknown-kind": 1,      # ghost arm
+            "proto-meta-schema": 1,       # job missing payload
+            "proto-gen-fence": 1,         # cancel arm unfenced
+            "proto-reply-pairing": 1,     # ping_req exception edge
+            "py-resource-lifecycle": 1,   # leaked Listener
+        }, [str(f) for f in findings]
+
+    def test_findings_name_their_kinds(self, findings):
+        by_rule = {f.rule: f for f in findings}
+        assert by_rule["proto-unhandled-kind"].symbol == "orphan"
+        assert by_rule["proto-unknown-kind"].symbol == "ghost"
+        assert by_rule["proto-meta-schema"].symbol == "job"
+        assert "'payload'" in by_rule["proto-meta-schema"].message
+        assert by_rule["proto-gen-fence"].symbol == "cancel"
+        assert by_rule["proto-reply-pairing"].symbol == "ping_req"
+        assert by_rule["py-resource-lifecycle"].symbol == "lst"
+
+    def test_pragma_suppressed_twins(self, findings):
+        src = open(os.path.join(FIXTURES, "proto_fixture.py")).read()
+        lines = {(f.rule, f.line) for f in findings}
+        hit = 0
+        for i, text in enumerate(src.splitlines(), 1):
+            if "suppressed twin" in text:
+                hit += 1
+                assert not any(ln in (i, i + 1, i + 2)
+                               for _, ln in lines), \
+                    "twin at line %d surfaced" % i
+        assert hit >= 6                   # one twin per rule (+ the
+        #                                   docstring's mentions)
+
+    def test_clean_shapes_silent(self, findings):
+        """The fenced arm (fine), the replying pair twin (echo_req),
+        the escaping connection, the daemon thread, and the
+        terminate+join pair seeded NO findings."""
+        import ast
+        src = open(os.path.join(FIXTURES, "proto_fixture.py")).read()
+        spans = {}
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.FunctionDef):
+                spans[node.name] = (node.lineno, node.end_lineno)
+        for f in findings:
+            for name in ("send_fine", "recv_loop", "clean_escape",
+                         "clean_daemon_thread", "clean_reaped"):
+                lo, hi = spans[name]
+                assert not (lo <= f.line <= hi), \
+                    "%s seeded clean but got %s" % (name, f)
+
+    def test_baseline_suppresses(self, findings):
+        baseline = {f.key for f in findings
+                    if f.rule == "proto-gen-fence"}
+        new, old = split_new(findings, baseline)
+        assert _rules(old) == {"proto-gen-fence": 1}
+        assert "proto-gen-fence" not in _rules(new)
+
+
+class TestProtolintWalkerEdges:
+    """Review-pass regressions: walker edge cases that would each be
+    a silent false negative (the zero-findings bar leans on the
+    analyzer actually looking)."""
+
+    PROBE = (
+        "class W:\n"
+        "    def __init__(self, router):\n"
+        "        self.router = router\n"
+        "    def handle(self, kind, meta, bufs):\n"
+        "        if kind == 'ping_req':\n"
+        "%s"
+        "class R:\n"
+        "    def __init__(self, conn):\n"
+        "        self.conn = conn\n"
+        "    def go(self):\n"
+        "        self.conn.send('ping_req', {'q': 1})\n"
+        "    def recv_loop(self):\n"
+        "        kind, meta, bufs = self.conn.recv()\n"
+        "        if kind == 'ping':\n"
+        "            pass\n")
+    ROLES = {"R": "router", "W": "worker"}
+
+    def _lint(self, arm_body):
+        return protolint.lint_source(self.PROBE % arm_body, "m.py",
+                                     roles=self.ROLES)
+
+    def test_last_arm_in_chain_is_exit_edge_checked(self):
+        """An arm whose whole If fits the arm span (the LAST arm of
+        an elif chain) must still get branch analysis — reordering
+        _handle must never silently disable the reply check."""
+        fs = self._lint(
+            "            data = self.compute(meta['q'])\n"
+            "            self.router.send('ping', {'rid': data})\n")
+        assert _rules(fs) == {"proto-reply-pairing": 1}
+
+    def test_reply_in_one_branch_does_not_cover_the_other(self):
+        """`if ok: send_reply()` / `else: return` drops the reply on
+        the else edge — containment alone must not settle it."""
+        fs = self._lint(
+            "            if meta.get('ok', 0):\n"
+            "                self.router.send('ping', {'rid': 1})\n"
+            "            else:\n"
+            "                return\n")
+        assert _rules(fs) == {"proto-reply-pairing": 1}
+
+    def test_bare_try_finally_does_not_protect(self):
+        """try/finally without a handler does not stop the exception
+        — the reply is still dropped on that edge."""
+        fs = self._lint(
+            "            try:\n"
+            "                data = self.compute(meta['q'])\n"
+            "            finally:\n"
+            "                self.cleanup()\n"
+            "            self.router.send('ping', {'rid': data})\n")
+        assert _rules(fs) == {"proto-reply-pairing": 1}
+
+    def test_fall_through_exit_leaks_resource(self):
+        """The implicit function-end exit is an exit path too: an
+        acquired resource that is never settled must flag even with
+        no explicit return."""
+        fs = protolint.lint_source(
+            "class C:\n"
+            "    def f(self):\n"
+            "        lst = Listener()\n", "m.py", roles={})
+        assert _rules(fs) == {"py-resource-lifecycle": 1}
+
+    def test_settle_in_block_continuation_is_clean(self):
+        """A resource acquired inside an `if` and settled after it
+        (the _peer_conn shape) must NOT flag on the if-body's end."""
+        fs = protolint.lint_source(
+            "class C:\n"
+            "    def f(self, cached):\n"
+            "        conn = cached\n"
+            "        if conn is None:\n"
+            "            conn = connect('h', 1)\n"
+            "        self.conns[0] = conn\n"
+            "        return conn\n", "m.py", roles={})
+        assert fs == [], [str(f) for f in fs]
 
 
 # ---------------------------------------------------------------------------

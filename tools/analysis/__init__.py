@@ -1,6 +1,6 @@
 """mxlint — the repo-native static-analysis suite (ISSUE 4 + 7 + 8).
 
-Five analyzers, each a module here, all runnable as tier-1 tests
+Six analyzers, each a module here, all runnable as tier-1 tests
 (``tests/test_static_analysis.py``) and as a CLI
 (``python -m tools.analysis``, ``--changed-only`` for the seconds-fast
 iteration scope, ``--format json`` for CI annotation):
@@ -22,7 +22,15 @@ iteration scope, ``--format json`` for CI annotation):
   against the lowering, peak-live-bytes vs the committed
   ``hbm_budgets.json`` manifest, bf16/int8→f32 dtype drift, host
   callbacks in hot programs, plus the report-mode sharding-readiness
-  audit (``docs/sharding_readiness.md``).
+  audit (``docs/sharding_readiness.md``);
+* :mod:`.protolint` — wire-protocol & process-lifecycle audit of the
+  disaggregated serving cluster (``mxnet_tpu/serving/`` over the
+  ``parallel/dist.py`` raw frames): per-role send-site ↔ dispatch-arm
+  agreement, meta-key schema drift between processes, the incarnation
+  gen fence as a checked invariant, request/reply pairing on every
+  exit edge, and Process/Connection/Listener lifecycle (the
+  ``py-ref-leak`` exit-edge machinery generalized to OS resources),
+  plus the checked-in protocol audit (``docs/protocol.md``).
 
 The dynamic half of ISSUE 7 lives in :mod:`.interleave`: a loom-lite
 deterministic scheduler that serializes the serving cluster's threads

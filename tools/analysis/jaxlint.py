@@ -69,9 +69,15 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     # inside _fail_replica stalls EVERY waiter under the cluster lock)
     # and the eviction/COW leaf (_drop runs inside the allocator's
     # pressure callback, mid-admission)
+    # round 17: the round-16 autoscaler actuation paths protolint's
+    # call-graph walks also cover — add_worker/drain_worker and the
+    # late-join handshake helper run while the cluster serves; a host
+    # sync or in-loop jit there stalls scale actuation behind device
+    # work exactly like a stall in the failover path would
     ("mxnet_tpu/serving/cluster.py",
      r"(?:.*\.)?(_worker|_pump_inbox|_complete|_route_locked"
-     r"|_monitor_loop|_fail_replica|drain_replica)$"),
+     r"|_monitor_loop|_fail_replica|drain_replica"
+     r"|add_worker|drain_worker|_handshake_one)$"),
     ("mxnet_tpu/serving/prefix_cache.py",
      r"(?:.*\.)?(match|insert_chain|evict|_drop)$"),
     # round 15: the disaggregated page export/install paths run per

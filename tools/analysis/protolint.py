@@ -1,0 +1,1456 @@
+"""Wire-protocol & process-lifecycle analyzer (mxlint analyzer 6 —
+ISSUE 12 tentpole).
+
+Round 15 made the serving stack a multi-process distributed system:
+router, prefill and decode workers exchange ~20 stringly-typed message
+kinds (``conn.send("kind", {...}, bufs)`` over the ``parallel/dist.py``
+raw-frame wire), dispatched by hand-written ``elif kind ==`` chains and
+fenced against zombie incarnations by per-handler gen checks.  Nothing
+machine-checked that the two processes agree on the protocol: a kind
+nobody handles is a silent drop, a meta key one side stopped sending is
+a runtime ``KeyError`` mid-serve, a handler that forgets the gen fence
+re-admits a zombie incarnation, and a dropped request/reply pairing is
+a distributed stall.  This pass AST-models the per-role protocol and
+checks those agreements statically, the way the C-ABI pass checks the
+header against the ctypes table.
+
+The protocol model
+------------------
+Endpoints are the classes in :data:`ROLES` (``DisaggServingCluster`` =
+the router process, ``_DisaggWorker`` = a worker process — prefill and
+decode share one dispatch, and the peer fetch server is the same
+class's data plane).  A **send site** is either a literal-kind
+``X.send("kind", meta, bufs)`` call or the deferred-send tuple idiom
+``(conn_expr, ("kind", meta, bufs))`` (what ``_dispatch_locked``
+returns for ``_do_sends`` to perform outside the lock).  The send's
+**target** role is ``router`` when the receiver expression mentions a
+role name (``self.router.send``), else ``worker`` (the router only
+ever talks to workers; worker→worker is the peer data plane).  A
+**dispatch arm** is any comparison of the handler's kind variable
+(a parameter named ``kind``, a name unpacked at position 0 of a
+``.recv()`` result, or ``got[0]``) against a string literal — the
+``elif kind ==`` chains, the handshake guards
+(``if got[0] != "ready": raise``), and conditional-expression tests
+all count.  Kinds starting with ``_`` are in-process synthetic
+(``_wake``/``_lost`` ride the worker inbox, never the wire) and are
+excluded from the model.
+
+Rules
+-----
+``proto-unhandled-kind``  A kind is sent to a role with no dispatch
+    arm anywhere in that role — the frame would be silently dropped
+    (or, in a handshake window, kill the connection).  Fires at the
+    send site.
+
+``proto-unknown-kind``  A dispatch arm for a kind no peer ever sends —
+    dead protocol surface that drifts out of date unnoticed.  Fires at
+    the arm.
+
+``proto-meta-schema``  Every meta key a handler reads via ``meta["k"]``
+    or defaultless ``meta.get("k")`` — directly in the arm, through
+    same-class calls the arm passes the meta dict into, or through the
+    queue hand-off idiom (``self.q.put((meta, ...))`` →
+    ``self.q.get()``) — must be present at every send site of that
+    kind whose meta resolves to a dict literal.  Schema drift between
+    processes is today a runtime KeyError mid-serve.  Fires at the
+    drifted send site, once per missing key.
+
+``proto-gen-fence``  A handler for any kind whose send sites carry an
+    incarnation gen (a ``gen``-named meta key, a value read off a
+    ``.gen`` field, or the ``srid`` convention — ``srid`` is
+    ``(rid, gen)`` by protocol contract) must contain a gen-fence
+    comparison (an operand derived from the meta's gen/srid, or
+    naming a ``gen`` field) and must not mutate state before it.  The
+    PR-10 zombie fence becomes a checked invariant, not a convention.
+
+``proto-reply-pairing``  Request/reply kinds — inferred by name:
+    ``fetch``/``fetch_reply`` (K → ``K_reply``) and
+    ``stats_req``/``stats`` (``K_req`` → K), both sides must exist in
+    the model — must reach a reply send **on every exit edge of the
+    replying function, exception edges included**: an early return or
+    an unprotected may-raise call before the reply attempt is a
+    distributed stall (the requester waits out its full timeout for a
+    reply that will never come).  A reply send inside ``try/except``
+    counts as the attempt — a dead peer excuses the reply, a local
+    exception does not.  The obligation follows the queue hand-off
+    (the fetch arm enqueues; ``_serve_fetches`` owes the reply from
+    the dequeue on).
+
+``py-resource-lifecycle``  pylocklint's ``py-ref-leak`` exit-edge
+    machinery, generalized to OS resources: a ``Connection`` /
+    ``Listener`` / ``Process`` / socket / non-daemon ``Thread`` bound
+    to a local name must, on every exit path including exception
+    edges, be settled — closed/joined/terminated, stored into owned
+    state, returned, or handed to another call (ownership transfer).
+    Threads constructed ``daemon=True`` are exempt (the repo's
+    watchdog/recv threads are self-reaping by design); Processes are
+    NOT — a pid needs reaping however the process exits.  Also:
+    ``X.terminate()`` with no later ``X.join()`` in the same function
+    leaves a zombie pid for the router's lifetime.
+
+Approximations (documented, in the pylocklint tradition):
+
+* Meta dicts are tracked only while they stay the dispatch variable —
+  a meta stored into a request record and read back later
+  (``st["meta"]["decode"]``) is invisible to the schema rule; the
+  audit table documents the full send-side schema regardless.
+* Send sites whose meta is not a dict literal (directly or via a
+  single same-function ``meta = {...}`` assignment, ``dict(k=v)``
+  also resolves) are skipped by the schema rule, never guessed.
+* A ``try`` protects its body's exception edges when it has a handler
+  that does not just re-raise; handler bodies are not themselves
+  walked for the obligation.
+* Calls resolve through ``self`` and unique module-level names only —
+  ambiguous names contribute no edge.
+
+The audit (``--write-protocol-audit`` → ``docs/protocol.md``) renders
+the whole model as a per-kind table — sender→receiver roles, send
+site(s), handler site(s), meta schema, bufs layout, gen fence — and is
+pinned current by tier-1 exactly like ``docs/sharding_readiness.md``.
+
+Scoping: the protocol lives in ``mxnet_tpu/serving/`` over the
+``parallel/dist.py`` wire; ``--changed-only`` re-analyzes only when
+serving/, ``parallel/dist.py``, or ``tools/analysis/`` change (and
+then reports findings in changed files, like pylocklint — tier-1
+always runs full scope).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_pragmas
+
+__all__ = ["ROLES", "PACKAGES", "AUDIT_PATH", "analyze", "lint_source",
+           "run", "build_model", "protocol_audit_md"]
+
+# repo-relative package roots holding the protocol endpoints
+PACKAGES = ["mxnet_tpu/serving"]
+
+# --changed-only trigger set: prefixes + exact files
+TRIGGER_PREFIXES = ("mxnet_tpu/serving/", "tools/analysis/")
+TRIGGER_FILES = ("mxnet_tpu/parallel/dist.py",)
+
+AUDIT_PATH = "docs/protocol.md"
+
+# The declared topology (the registry idiom graphlint also uses):
+# endpoint class -> role.  Fixtures pass their own mapping.
+ROLES: Dict[str, str] = {"DisaggServingCluster": "router",
+                         "_DisaggWorker": "worker"}
+
+# resource constructors the lifecycle rule tracks (terminal call name)
+RESOURCE_CTORS = {"Connection", "Listener", "Process", "Thread",
+                  "connect", "create_connection", "socket"}
+# settle methods on a tracked resource name
+_SETTLE_METHODS = {"close", "join", "terminate", "kill", "shutdown",
+                   "release"}
+
+# calls treated as non-raising by the exit-edge walkers
+_SAFE_NAME_CALLS = {"len", "min", "max", "int", "float", "bool",
+                    "str", "repr", "list", "tuple", "set", "dict",
+                    "sorted", "enumerate", "zip", "abs", "range",
+                    "isinstance", "id", "getattr", "hasattr", "sum",
+                    "any", "all", "print", "type"}
+_SAFE_ATTR_CALLS = {"get", "append", "appendleft", "pop", "popleft",
+                    "discard", "add", "items", "values", "keys",
+                    "update", "extend", "clear", "perf_counter",
+                    "release", "copy", "setdefault", "put",
+                    "put_nowait", "set", "is_set", "getpid"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _may_raise(stmt: ast.AST) -> Optional[int]:
+    """Line of the first call in ``stmt`` that can raise (whitelisted
+    builtins and obviously-safe methods excluded)."""
+    for n in ast.walk(stmt):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name) and f.id in _SAFE_NAME_CALLS:
+            continue
+        if isinstance(f, ast.Attribute) and f.attr in _SAFE_ATTR_CALLS:
+            continue
+        return n.lineno
+    return None
+
+
+def _try_protects(stmt: ast.Try) -> bool:
+    """A try protects its body's exception edges when it has a handler
+    that does not just re-raise (the handler redirects the edge and
+    execution continues after the try).  A bare try/finally does NOT
+    protect — the exception propagates past the finally."""
+    for h in stmt.handlers:
+        if not (len(h.body) == 1 and isinstance(h.body[0], ast.Raise)
+                and h.body[0].exc is None):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# model records
+# ---------------------------------------------------------------------------
+class SendSite:
+    __slots__ = ("kind", "mod", "line", "cls", "role", "target",
+                 "keys", "carries_gen", "bufs", "fnqual")
+
+    def __init__(self, kind, mod, line, cls, role, target, keys,
+                 carries_gen, bufs, fnqual):
+        self.kind = kind
+        self.mod = mod
+        self.line = line
+        self.cls = cls
+        self.role = role            # sender role
+        self.target = target        # receiver role
+        self.keys = keys            # frozenset | None (unresolvable)
+        self.carries_gen = carries_gen
+        self.bufs = bufs            # short source descriptor
+        self.fnqual = fnqual
+
+
+class Arm:
+    __slots__ = ("kind", "mod", "line", "cls", "role", "fnqual",
+                 "span", "required", "optional", "has_fence",
+                 "fence_line", "early_mut_line", "reach")
+
+    def __init__(self, kind, mod, line, cls, role, fnqual, span):
+        self.kind = kind
+        self.mod = mod
+        self.line = line
+        self.cls = cls
+        self.role = role
+        self.fnqual = fnqual
+        self.span = span            # (lo, hi) line range of the arm
+        self.required: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.has_fence = False
+        self.fence_line: Optional[int] = None
+        self.early_mut_line: Optional[int] = None
+        self.reach: Set[str] = set()   # reachable same-class fn quals
+
+
+class _Fn:
+    __slots__ = ("qual", "mod", "cls", "name", "node", "role")
+
+    def __init__(self, qual, mod, cls, name, node, role):
+        self.qual = qual
+        self.mod = mod
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.role = role
+
+
+class _Module:
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, rel)
+
+
+# ---------------------------------------------------------------------------
+# per-function protocol scan
+# ---------------------------------------------------------------------------
+class _FnScan:
+    """Everything protolint needs from one function body: kind tests,
+    meta reads, gen-fence compares, mutations, meta-passing calls,
+    queue puts, and send sites."""
+
+    def __init__(self, prog: "_Program", fn: _Fn,
+                 extra_meta: Tuple[str, ...] = (),
+                 extra_gen: Tuple[str, ...] = ()):
+        self.prog = prog
+        self.fn = fn
+        node = fn.node
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        self.kind_vars: Set[str] = {p for p in params if p == "kind"}
+        self.meta_vars: Set[str] = {p for p in params if p == "meta"}
+        self.meta_vars.update(extra_meta)
+        self.recv_vars: Set[str] = set()
+        # seeded gen-derived names (callee params bound from gen reads)
+        self.gen_vars: Set[str] = set(extra_gen)
+        # collected events, all (line, ...) in source order
+        self.reads: List[Tuple[int, str, bool]] = []   # line, key, req
+        self.fences: List[int] = []
+        self.mutations: List[int] = []
+        self.kind_tests: List[Tuple[int, str, str, ast.AST]] = []
+        # meta-passing call edges: (line, callee qual, param name)
+        self.meta_calls: List[Tuple[int, str, Optional[str]]] = []
+        # plain same-class call edges: (line, callee qual)
+        self.calls: List[Tuple[int, str]] = []
+        # queue puts of the meta var: (line, queue attr, position)
+        self.qputs: List[Tuple[int, str, int]] = []
+        self._collect_vars()
+        self._collect()
+
+    # -- variable discovery -------------------------------------------
+    def _collect_vars(self):
+        """recv-result names and (kind, meta) unpack targets."""
+        for n in ast.walk(self.fn.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            v = n.value
+            is_recv = isinstance(v, ast.Call) and isinstance(
+                v.func, ast.Attribute) and v.func.attr == "recv"
+            for tgt in n.targets:
+                if is_recv and isinstance(tgt, ast.Name):
+                    self.recv_vars.add(tgt.id)
+        # unpacks of recv vars: kind, meta, bufs = got
+        for n in ast.walk(self.fn.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            v = n.value
+            src_is_recv = (
+                isinstance(v, ast.Name) and v.id in self.recv_vars
+            ) or (isinstance(v, ast.Call)
+                  and isinstance(v.func, ast.Attribute)
+                  and v.func.attr == "recv")
+            if not src_is_recv:
+                continue
+            for tgt in n.targets:
+                if isinstance(tgt, ast.Tuple) and len(tgt.elts) >= 2:
+                    e0, e1 = tgt.elts[0], tgt.elts[1]
+                    if isinstance(e0, ast.Name) and e0.id != "_":
+                        self.kind_vars.add(e0.id)
+                    if isinstance(e1, ast.Name) and e1.id != "_":
+                        self.meta_vars.add(e1.id)
+
+    def _is_kind_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.kind_vars
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.value, ast.Name) and \
+                node.value.id in self.recv_vars:
+            s = node.slice
+            return isinstance(s, ast.Constant) and s.value == 0
+        return False
+
+    def _is_meta_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.meta_vars
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.value, ast.Name) and \
+                node.value.id in self.recv_vars:
+            s = node.slice
+            return isinstance(s, ast.Constant) and s.value == 1
+        return False
+
+    # -- event collection ---------------------------------------------
+    def _meta_read(self, node: ast.AST) -> Optional[Tuple[str, bool]]:
+        """(key, required) when ``node`` reads a meta key."""
+        if isinstance(node, ast.Subscript) and \
+                self._is_meta_expr(node.value):
+            k = _str_const(node.slice)
+            if k is not None:
+                return k, True
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "get" \
+                and self._is_meta_expr(node.func.value) and node.args:
+            k = _str_const(node.args[0])
+            if k is not None:
+                return k, len(node.args) < 2
+        return None
+
+    def _expr_gen_derived(self, expr: ast.AST) -> bool:
+        """Does ``expr`` carry incarnation-gen information?  A meta
+        read of a gen/srid key, a name previously derived from one, or
+        anything naming a ``gen`` field."""
+        for n in ast.walk(expr):
+            r = self._meta_read(n)
+            if r is not None and ("gen" in r[0] or r[0] == "srid"):
+                return True
+            if isinstance(n, ast.Name) and n.id in self.gen_vars:
+                return True
+            if isinstance(n, ast.Name) and "gen" in n.id:
+                return True
+            if isinstance(n, ast.Attribute) and "gen" in n.attr:
+                return True
+            if isinstance(n, ast.Subscript):
+                k = _str_const(n.slice)
+                if k is not None and "gen" in k:
+                    return True
+        return False
+
+    def _resolve_call(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name) and f.value.id == "self" \
+                and self.fn.cls:
+            qual = "%s::%s.%s" % (self.fn.mod, self.fn.cls, f.attr)
+            if qual in self.prog.fns:
+                return qual
+        elif isinstance(f, ast.Name):
+            quals = self.prog.by_name.get(f.id, [])
+            if len(quals) == 1:
+                return quals[0]
+        return None
+
+    def _collect(self):
+        fn = self.fn
+        for node in ast.walk(fn.node):
+            line = getattr(node, "lineno", 0)
+            r = self._meta_read(node)
+            if r is not None:
+                self.reads.append((line, r[0], r[1]))
+            if isinstance(node, ast.Compare):
+                self._on_compare(node)
+            if isinstance(node, ast.Assign):
+                # gen-derived propagation: key = tuple(meta["srid"])
+                if self._expr_gen_derived(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.gen_vars.add(tgt.id)
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        self.mutations.append(line)
+            elif isinstance(node, (ast.AugAssign, ast.Delete)):
+                tgts = node.targets if isinstance(node, ast.Delete) \
+                    else [node.target]
+                for tgt in tgts:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        self.mutations.append(line)
+            elif isinstance(node, ast.Expr) and isinstance(
+                    node.value, ast.Call):
+                f = node.value.func
+                if isinstance(f, ast.Attribute):
+                    root = f.value
+                    while isinstance(root, (ast.Attribute,
+                                            ast.Subscript)):
+                        root = root.value
+                    if isinstance(root, ast.Name) and \
+                            root.id == "self" and \
+                            f.attr not in ("send", "recv", "close"):
+                        self.mutations.append(line)
+            if isinstance(node, ast.Call):
+                qual = self._resolve_call(node)
+                if qual is not None and qual != fn.qual:
+                    self.calls.append((line, qual))
+                    pname = self._meta_param_for(node, qual)
+                    if pname is not None:
+                        self.meta_calls.append((line, qual, pname))
+                self._on_qput(node, line)
+
+    def _on_compare(self, node: ast.Compare):
+        line = node.lineno
+        left = node.left
+        op = node.ops[0]
+        comp = node.comparators[0]
+        if self._is_kind_expr(left) and isinstance(
+                op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn)):
+            lits: List[str] = []
+            k = _str_const(comp)
+            if k is not None:
+                lits.append(k)
+            elif isinstance(comp, (ast.Tuple, ast.List)):
+                lits.extend(s for s in map(_str_const, comp.elts)
+                            if s is not None)
+            kindop = "eq" if isinstance(op, (ast.Eq, ast.In)) \
+                else "ne"
+            for k in lits:
+                self.kind_tests.append((line, k, kindop, node))
+        # gen fence: any compare with a gen-derived operand
+        if any(self._expr_gen_derived(side)
+               for side in [node.left] + list(node.comparators)):
+            self.fences.append(line)
+
+    def _meta_param_for(self, call: ast.Call,
+                        qual: str) -> Optional[str]:
+        """When the call passes the dispatch meta dict itself, return
+        the callee parameter name it binds to."""
+        callee = self.prog.fns[qual].node
+        cargs = callee.args
+        names = [a.arg for a in cargs.posonlyargs + cargs.args]
+        if names and names[0] == "self":
+            names = names[1:]
+        for i, a in enumerate(call.args):
+            if self._is_meta_expr(a) and i < len(names):
+                return names[i]
+        for kw in call.keywords:
+            if kw.arg and self._is_meta_expr(kw.value):
+                return kw.arg
+        return None
+
+    def _on_qput(self, call: ast.Call, line: int):
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("put", "put_nowait")
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"):
+            return
+        if not call.args or not isinstance(call.args[0], ast.Tuple):
+            return
+        for i, e in enumerate(call.args[0].elts):
+            if self._is_meta_expr(e):
+                self.qputs.append((line, f.value.attr, i))
+                return
+
+
+# ---------------------------------------------------------------------------
+# whole-model construction
+# ---------------------------------------------------------------------------
+class _Program:
+    def __init__(self, modules: Dict[str, str],
+                 roles: Optional[Dict[str, str]] = None):
+        self.roles = dict(ROLES if roles is None else roles)
+        self.role_names = set(self.roles.values())
+        self.modules = {rel: _Module(rel, src)
+                        for rel, src in sorted(modules.items())}
+        self.fns: Dict[str, _Fn] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        self._collect_fns()
+        self.scans: Dict[Tuple[str, Tuple[str, ...]], _FnScan] = {}
+        self.sends: List[SendSite] = []
+        self.arms: List[Arm] = []
+        self.findings: List[Finding] = []
+        self._collect_sends()
+        self._collect_arms()
+
+    # ------------------------------------------------------ helpers --
+    def _collect_fns(self):
+        for mod in self.modules.values():
+            def walk(node, cls, outer):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        if outer is not None:
+                            continue      # nested defs ride the parent
+                        qual = "%s::%s%s" % (
+                            mod.rel, cls + "." if cls else "",
+                            child.name)
+                        self.fns[qual] = _Fn(
+                            qual, mod.rel, cls, child.name, child,
+                            self.roles.get(cls))
+                        self.by_name.setdefault(child.name,
+                                                []).append(qual)
+                        walk(child, cls, qual)
+                    elif isinstance(child, ast.ClassDef):
+                        walk(child, child.name, outer)
+                    else:
+                        walk(child, cls, outer)
+            walk(mod.tree, None, None)
+
+    def scan(self, qual: str,
+             extra_meta: Tuple[str, ...] = ()) -> _FnScan:
+        key = (qual, tuple(sorted(extra_meta)))
+        if key not in self.scans:
+            self.scans[key] = _FnScan(self, self.fns[qual],
+                                      extra_meta)
+        return self.scans[key]
+
+    def _add(self, rule, mod, line, symbol, msg):
+        self.findings.append(Finding("proto", rule, mod, line,
+                                     symbol, msg))
+
+    def _target_of(self, role: str, recv_expr: ast.AST) -> str:
+        d = _dotted(recv_expr).lower()
+        for r in sorted(self.role_names):
+            if r in d:
+                return r
+        # default topology: everything else is a worker-side conn
+        # (the router only talks to workers; worker↔worker is the
+        # peer data plane)
+        return "worker" if "worker" in self.role_names else role
+
+    # ---------------------------------------------------- send sites --
+    def _resolve_meta_keys(self, expr: Optional[ast.AST],
+                           fnnode: ast.AST,
+                           line: int) -> Tuple[Optional[frozenset],
+                                               bool]:
+        """(keys, carries_gen) for a send's meta expression; keys is
+        None when unresolvable."""
+        if expr is None or (isinstance(expr, ast.Constant)
+                            and expr.value is None):
+            return frozenset(), False
+        if isinstance(expr, ast.Name):
+            # nearest preceding `name = {...}` in the same function
+            best = None
+            for n in ast.walk(fnnode):
+                if isinstance(n, ast.Assign) and n.lineno < line:
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name) and \
+                                tgt.id == expr.id:
+                            if best is None or n.lineno > best.lineno:
+                                best = n
+            if best is not None:
+                return self._resolve_meta_keys(best.value, fnnode,
+                                               line)
+            return None, False
+        if isinstance(expr, ast.Dict):
+            keys: Set[str] = set()
+            gen = False
+            for k, v in zip(expr.keys, expr.values):
+                ks = _str_const(k) if k is not None else None
+                if ks is None:
+                    return None, self._values_gen(expr)
+                keys.add(ks)
+                if "gen" in ks or ks == "srid":
+                    gen = True
+                if not gen and self._values_gen(v):
+                    gen = True
+            return frozenset(keys), gen
+        if isinstance(expr, ast.Call) and isinstance(
+                expr.func, ast.Name) and expr.func.id == "dict" \
+                and not expr.args:
+            keys = {kw.arg for kw in expr.keywords if kw.arg}
+            gen = any("gen" in k or k == "srid" for k in keys) or \
+                any(self._values_gen(kw.value)
+                    for kw in expr.keywords)
+            return frozenset(keys), gen
+        return None, self._values_gen(expr)
+
+    @staticmethod
+    def _values_gen(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and "gen" in n.attr:
+                return True
+            if isinstance(n, ast.Subscript):
+                k = _str_const(n.slice)
+                if k is not None and "gen" in k:
+                    return True
+        return False
+
+    @staticmethod
+    def _bufs_desc(expr: Optional[ast.AST]) -> str:
+        if expr is None:
+            return "—"
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            return "—" if not expr.elts else str(len(expr.elts))
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return _dotted(expr) or "expr"
+
+    def _collect_sends(self):
+        for qual, fn in sorted(self.fns.items()):
+            if fn.role is None:
+                continue
+            for node in ast.walk(fn.node):
+                kind = recv = meta = bufs = None
+                line = getattr(node, "lineno", 0)
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and \
+                        node.func.attr == "send" and node.args:
+                    kind = _str_const(node.args[0])
+                    recv = node.func.value
+                    meta = node.args[1] if len(node.args) > 1 else None
+                    bufs = node.args[2] if len(node.args) > 2 else None
+                elif isinstance(node, ast.Tuple) and \
+                        len(node.elts) == 2 and \
+                        isinstance(node.elts[1], ast.Tuple) and \
+                        2 <= len(node.elts[1].elts) <= 3 and \
+                        _str_const(node.elts[1].elts[0]) is not None:
+                    inner = node.elts[1].elts
+                    kind = _str_const(inner[0])
+                    recv = node.elts[0]
+                    meta = inner[1]
+                    bufs = inner[2] if len(inner) == 3 else None
+                if kind is None or kind.startswith("_"):
+                    continue
+                keys, gen = self._resolve_meta_keys(meta, fn.node,
+                                                    line)
+                self.sends.append(SendSite(
+                    kind, fn.mod, line, fn.cls, fn.role,
+                    self._target_of(fn.role, recv), keys, gen,
+                    self._bufs_desc(bufs), qual))
+
+    # -------------------------------------------------------- arms ---
+    def _collect_arms(self):
+        for qual, fn in sorted(self.fns.items()):
+            if fn.role is None:
+                continue
+            scan = self.scan(qual)
+            if not scan.kind_tests:
+                continue
+            tests = sorted(scan.kind_tests, key=lambda t: t[0])
+            test_lines = sorted({t[0] for t in tests})
+            fn_end = fn.node.end_lineno
+            for line, kind, op, node in tests:
+                if op == "eq":
+                    span = self._eq_span(fn.node, node, line)
+                else:
+                    later = [tl for tl in test_lines if tl > line]
+                    span = (line, (later[0] - 1) if later else fn_end)
+                arm = Arm(kind, fn.mod, line, fn.cls, fn.role, qual,
+                          span)
+                self._fill_arm(arm, scan)
+                self.arms.append(arm)
+
+    def _eq_span(self, fnnode, cmpnode,
+                 line) -> Tuple[int, int]:
+        """Line span covered by an equality arm: the If/IfExp body
+        whose test contains the compare, plus the test itself."""
+        hit = None
+        for n in ast.walk(fnnode):
+            if isinstance(n, (ast.If, ast.IfExp)):
+                if any(sub is cmpnode for sub in ast.walk(n.test)):
+                    hit = n
+        if isinstance(hit, ast.If):
+            return (hit.lineno, hit.body[-1].end_lineno)
+        if isinstance(hit, ast.IfExp):
+            return (hit.body.lineno, hit.body.end_lineno)
+        return (line, line)
+
+    def _fill_arm(self, arm: Arm, scan: _FnScan):
+        lo, hi = arm.span
+        for line, key, req in scan.reads:
+            if lo <= line <= hi:
+                (arm.required if req else arm.optional).add(key)
+        fence_lines = [ln for ln in scan.fences if lo <= ln <= hi]
+        # transitive: calls inside the span that receive the meta (or
+        # gen-derived args) contribute reads and fences; the queue
+        # hand-off contributes its consumer
+        reach_fences: List[int] = []
+        seen: Set[str] = set()
+
+        def absorb(qual: str, extra_meta: Tuple[str, ...],
+                   via_line: int, depth: int):
+            if qual in seen or depth > 4:
+                return
+            seen.add(qual)
+            arm.reach.add(qual)
+            sub = self.scan(qual, extra_meta)
+            for _, key, req in sub.reads:
+                (arm.required if req else arm.optional).add(key)
+            if sub.fences:
+                reach_fences.append(via_line)
+            for line2, q2, pname in sub.meta_calls:
+                absorb(q2, (pname,) if pname else (), via_line,
+                       depth + 1)
+
+        for line, qual, pname in scan.meta_calls:
+            if lo <= line <= hi:
+                absorb(qual, (pname,) if pname else (), line, 1)
+        # plain same-class calls: reply sends may live one or two
+        # hops down (`stats_req` → _send_stats) without the meta
+        # dict traveling along
+        for line, qual in scan.calls:
+            if lo <= line <= hi:
+                arm.reach.add(qual)
+                for _, q2 in self.scan(qual).calls:
+                    arm.reach.add(q2)
+        # calls passing gen-derived expressions (e.g. the abort arm's
+        # self._abort(meta["rid"], meta["below_gen"])): bind the
+        # callee params receiving them as gen-derived seeds
+        for line, qual in scan.calls:
+            if not (lo <= line <= hi) or qual in seen:
+                continue
+            callnodes = [n for n in ast.walk(scan.fn.node)
+                         if isinstance(n, ast.Call)
+                         and getattr(n, "lineno", 0) == line]
+            for cn in callnodes:
+                if scan._resolve_call(cn) != qual:
+                    continue
+                callee = self.fns[qual].node
+                cargs = callee.args
+                names = [a.arg for a in cargs.posonlyargs + cargs.args]
+                if names and names[0] == "self":
+                    names = names[1:]
+                genp = tuple(
+                    names[i] for i, a in enumerate(cn.args)
+                    if i < len(names) and scan._expr_gen_derived(a))
+                if genp:
+                    probe = _FnScan(self, self.fns[qual],
+                                    extra_gen=genp)
+                    if probe.fences:
+                        reach_fences.append(line)
+                    arm.reach.add(qual)
+        # queue hand-off consumers
+        for line, attr, pos in scan.qputs:
+            if not (lo <= line <= hi):
+                continue
+            for cqual, cextra in self._queue_consumers(
+                    scan.fn, attr, pos):
+                absorb(cqual, cextra, line, 1)
+        all_fences = sorted(fence_lines + reach_fences)
+        if all_fences:
+            arm.has_fence = True
+            arm.fence_line = all_fences[0]
+            muts = [ln for ln in scan.mutations
+                    if lo <= ln <= hi and ln < arm.fence_line]
+            if muts:
+                arm.early_mut_line = muts[0]
+
+    def _queue_consumers(self, fn: _Fn, attr: str,
+                         pos: int) -> List[Tuple[str,
+                                                 Tuple[str, ...]]]:
+        """Same-class functions that dequeue ``self.<attr>`` — the
+        unpack target at ``pos`` becomes their meta variable."""
+        out = []
+        for qual, other in self.fns.items():
+            if other.cls != fn.cls or other.mod != fn.mod:
+                continue
+            for n in ast.walk(other.node):
+                if isinstance(n, ast.Assign) and isinstance(
+                        n.value, ast.Call) and isinstance(
+                        n.value.func, ast.Attribute) and \
+                        n.value.func.attr in ("get", "get_nowait"):
+                    qv = n.value.func.value
+                    if isinstance(qv, ast.Attribute) and \
+                            qv.attr == attr and isinstance(
+                            qv.value, ast.Name) and \
+                            qv.value.id == "self":
+                        tgt = n.targets[0]
+                        if isinstance(tgt, ast.Tuple) and \
+                                pos < len(tgt.elts) and isinstance(
+                                tgt.elts[pos], ast.Name):
+                            out.append((qual,
+                                        (tgt.elts[pos].id,)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# reply-pairing exit-edge walker
+# ---------------------------------------------------------------------------
+def _contains_reply_send(stmt: ast.AST, reply: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and n.func.attr == "send" \
+                and n.args and _str_const(n.args[0]) == reply:
+            return True
+        if isinstance(n, ast.Tuple) and len(n.elts) >= 2 and \
+                _str_const(n.elts[0]) == reply and isinstance(
+                n.elts[1], (ast.Dict, ast.Name)):
+            return True
+    return False
+
+
+class _ReplyWalker:
+    """Every path from the obligation start must reach a reply-send
+    attempt — early exits and unprotected may-raise calls before it
+    are dropped replies (ref-leak-style forward walk)."""
+
+    def __init__(self, prog: _Program, mod: str, kind: str,
+                 reply: str):
+        self.prog = prog
+        self.mod = mod
+        self.kind = kind
+        self.reply = reply
+        self.reported = False
+
+    def _add(self, line, msg):
+        if self.reported:
+            return
+        self.reported = True
+        self.prog._add("proto-reply-pairing", self.mod, line,
+                       self.kind, msg)
+
+    def track(self, stmts, protected: bool) -> bool:
+        for stmt in stmts:
+            # settle-by-containment applies to LEAF statements only:
+            # a compound statement holding the send in one branch
+            # must still have its other branches walked (an
+            # `if ok: send_reply()` / `else: return` must not pass)
+            if not isinstance(stmt, (ast.If, ast.Try, ast.For,
+                                     ast.While, ast.With)) and \
+                    _contains_reply_send(stmt, self.reply):
+                return True
+            if isinstance(stmt, ast.Try):
+                prot = protected or _try_protects(stmt)
+                if self.track(stmt.body, prot):
+                    return True
+                continue
+            if isinstance(stmt, ast.If):
+                t = self.track(stmt.body, protected)
+                e = self.track(stmt.orelse, protected)
+                if t and (stmt.orelse and e):
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                if self.track(stmt.body, protected):
+                    return True
+                continue
+            if isinstance(stmt, ast.With):
+                if self.track(stmt.body, protected):
+                    return True
+                continue
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break,
+                                 ast.Raise)):
+                self._add(stmt.lineno,
+                          "handler exit before sending %r — the "
+                          "%r requester waits out its timeout for a "
+                          "reply that will never come"
+                          % (self.reply, self.kind))
+                return True
+            if not protected:
+                line = _may_raise(stmt)
+                if line is not None:
+                    self._add(line,
+                              "call may raise before the %r reply is "
+                              "attempted — the exception edge drops "
+                              "the reply to %r (wrap it so the reply "
+                              "still goes out, even empty)"
+                              % (self.reply, self.kind))
+                    return True
+        return False
+
+
+def _reply_pass(prog: _Program):
+    sent_kinds = {s.kind for s in prog.sends}
+    for arm in prog.arms:
+        if arm.kind.startswith("_"):
+            continue
+        reply = None
+        if arm.kind + "_reply" in sent_kinds:
+            reply = arm.kind + "_reply"
+        elif arm.kind.endswith("_req") and arm.kind[:-4] in sent_kinds:
+            reply = arm.kind[:-4]
+        if reply is None:
+            continue
+        walker = _ReplyWalker(prog, arm.mod, arm.kind, reply)
+        armfn = prog.fns[arm.fnqual]
+        lo, hi = arm.span
+        stmts = _span_stmts(armfn.node, lo, hi)
+        # the LAST arm of an elif chain fits its whole If inside the
+        # span — unwrap to the matched body so branch analysis runs
+        # (the test-false path owes no reply: the kind didn't match)
+        while len(stmts) == 1 and isinstance(stmts[0], ast.If) and \
+                any(_str_const(c) == arm.kind
+                    for n in ast.walk(stmts[0].test)
+                    if isinstance(n, ast.Compare)
+                    for c in n.comparators):
+            stmts = stmts[0].body
+        if any(_contains_reply_send(s, reply) for s in stmts):
+            if not walker.track(stmts, False):
+                walker._add(hi, "no %r reply on the fall-through "
+                            "path of the %r arm" % (reply, arm.kind))
+            continue
+        # the reply lives in a reachable function (direct call or the
+        # queue hand-off): walk that function from its obligation
+        # start
+        target = None
+        for qual in sorted(arm.reach):
+            fnode = prog.fns[qual].node
+            if any(_contains_reply_send(s, reply)
+                   for s in ast.walk(fnode)
+                   if isinstance(s, ast.stmt)):
+                target = qual
+                break
+        if target is None:
+            walker._add(arm.line,
+                        "the %r arm never reaches a %r reply send — "
+                        "the request/reply pairing is broken"
+                        % (arm.kind, reply))
+            continue
+        fnode = prog.fns[target].node
+        start = _dequeue_region(fnode)
+        if start is None:
+            start = fnode.body
+        if not walker.track(start, False):
+            walker._add(fnode.end_lineno,
+                        "no %r reply on the fall-through path of %s"
+                        % (reply, prog.fns[target].name))
+
+
+def _span_stmts(fnnode, lo, hi) -> List[ast.stmt]:
+    """Top-most statements fully inside the line span."""
+    out = []
+
+    def walk(stmts):
+        for s in stmts:
+            if s.lineno >= lo and s.end_lineno <= hi:
+                out.append(s)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, attr, None)
+                    if sub:
+                        walk(sub)
+                for h in getattr(s, "handlers", ()):
+                    walk(h.body)
+    walk(fnnode.body)
+    return out
+
+
+def _is_dequeue_call(n: ast.AST) -> bool:
+    """A queue dequeue: ``self.<q>.get_nowait()`` or a no-positional
+    ``self.<q>.get(timeout=...)`` (dict ``.get(k)`` always has a
+    positional arg, so it never matches)."""
+    return (isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and isinstance(n.func.value, ast.Attribute)
+            and (n.func.attr == "get_nowait"
+                 or (n.func.attr == "get" and not n.args)))
+
+
+def _dequeue_region(fnnode) -> Optional[List[ast.stmt]]:
+    """Statements following the queue-dequeue statement in its block —
+    the reply obligation's start for the hand-off idiom.  The dequeue
+    may sit inside a try (the ``except queue.Empty: return`` idiom);
+    the obligation then continues with the try's block siblings."""
+    def find(stmts):
+        for i, s in enumerate(stmts):
+            subs = [getattr(s, a, None)
+                    for a in ("body", "orelse", "finalbody")]
+            subs = [b for b in subs if b]
+            subs.extend(h.body for h in getattr(s, "handlers", ()))
+            inner = None
+            for b in subs:
+                inner = find(b)
+                if inner is not None:
+                    break
+            if inner is not None:
+                return inner if inner else stmts[i + 1:]
+            nested = {id(n) for b in subs for st in b
+                      for n in ast.walk(st)}
+            if any(_is_dequeue_call(n) and id(n) not in nested
+                   for n in ast.walk(s)):
+                return stmts[i + 1:]
+        return None
+    return find(fnnode.body)
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle pass (py-ref-leak machinery, generalized)
+# ---------------------------------------------------------------------------
+def _ctor_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    t = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return t if t in RESOURCE_CTORS else None
+
+
+def _is_daemon_thread(call: ast.Call, ctor: str) -> bool:
+    if ctor != "Thread":
+        return False
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _name_in(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _settles_resource(stmt: ast.AST, name: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and \
+                n.func.attr in _SETTLE_METHODS and isinstance(
+                n.func.value, ast.Name) and n.func.value.id == name:
+            return True
+    return False
+
+
+def _escapes_resource(stmt: ast.AST, name: str) -> bool:
+    if isinstance(stmt, ast.Return) and stmt.value is not None \
+            and _name_in(stmt.value, name):
+        return True
+    if isinstance(stmt, ast.Assign) and _name_in(stmt.value, name):
+        for tgt in stmt.targets:
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                return True
+    # handed to another call (Thread(args=(conn,)), q.put((conn,..)),
+    # Connection(sock), handler(conn) ...): ownership transfers
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            args = list(n.args) + [kw.value for kw in n.keywords]
+            recv_is_self = isinstance(n.func, ast.Attribute) and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == name
+            if not recv_is_self and any(_name_in(a, name)
+                                        for a in args):
+                return True
+    return False
+
+
+class _ResourceScanner:
+    def __init__(self, prog: _Program, fn: _Fn):
+        self.prog = prog
+        self.fn = fn
+        self._reported = False            # per-tracked-resource flag
+
+    def _add(self, line, name, msg):
+        self._reported = True
+        self.prog._add("py-resource-lifecycle", self.fn.mod, line,
+                       name, msg)
+
+    def scan(self):
+        self._scan_block(self.fn.node.body, [])
+        self._terminate_reap()
+
+    def _acquire(self, stmt) -> Optional[Tuple[str, str]]:
+        if not isinstance(stmt, ast.Assign):
+            return None
+        v = stmt.value
+        if not isinstance(v, ast.Call):
+            return None
+        ctor = _ctor_name(v)
+        if ctor is None or _is_daemon_thread(v, ctor):
+            return None
+        tgt = stmt.targets[0]
+        if isinstance(tgt, ast.Name):
+            return tgt.id, ctor
+        return None
+
+    def _scan_block(self, body, conts):
+        """``conts``: the continuation blocks execution falls into
+        after this block ends (innermost first) — a resource acquired
+        inside an ``if`` may legitimately settle after it."""
+        for i, stmt in enumerate(body):
+            got = self._acquire(stmt)
+            if got is not None:
+                name, ctor = got
+                self._reported = False
+                settled = self._track(body[i + 1:], name, ctor,
+                                      stmt.lineno, protected=False)
+                for cont in conts:
+                    if settled:
+                        break
+                    settled = self._track(cont, name, ctor,
+                                          stmt.lineno,
+                                          protected=False)
+                if not settled and not self._reported:
+                    # clean fall-through off the function end is an
+                    # exit path too
+                    self._add(stmt.lineno, name,
+                              "function exit leaks the %s bound to "
+                              "%r (never closed/joined, stored, or "
+                              "returned on the fall-through path)"
+                              % (ctor, name))
+                # keep scanning for further acquisitions after it
+            sub_conts = [body[i + 1:]] + conts
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._scan_block(sub, sub_conts)
+            for h in getattr(stmt, "handlers", ()):
+                self._scan_block(h.body, sub_conts)
+
+    def _try_settles(self, stmt: ast.Try, name: str) -> bool:
+        return any(_settles_resource(s, name) or
+                   _escapes_resource(s, name)
+                   for h in stmt.handlers for s in h.body) or \
+            any(_settles_resource(s, name) for s in stmt.finalbody)
+
+    def _track(self, stmts, name, ctor, acq_line,
+               protected) -> bool:
+        for stmt in stmts:
+            if _settles_resource(stmt, name) or \
+                    _escapes_resource(stmt, name):
+                return True
+            if isinstance(stmt, ast.Try):
+                prot = protected or self._try_settles(stmt, name)
+                if self._track(stmt.body, name, ctor, acq_line,
+                               prot):
+                    return True
+                continue
+            if isinstance(stmt, ast.If):
+                t = self._track(stmt.body, name, ctor, acq_line,
+                                protected)
+                e = self._track(stmt.orelse, name, ctor, acq_line,
+                                protected)
+                if t and (stmt.orelse and e):
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.With)):
+                if self._track(stmt.body, name, ctor, acq_line,
+                               protected):
+                    return True
+                continue
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break,
+                                 ast.Raise)):
+                self._add(stmt.lineno, name,
+                          "exit leaks the %s bound to %r at line %d "
+                          "(neither closed/joined nor stored/"
+                          "returned on this path)"
+                          % (ctor, name, acq_line))
+                return True
+            if not protected:
+                line = _may_raise(stmt)
+                if line is not None:
+                    self._add(line, name,
+                              "call may raise between the %s "
+                              "construction at line %d and its "
+                              "close/escape — the exception edge "
+                              "leaks it" % (ctor, acq_line))
+                    return True
+        return False
+
+    def _terminate_reap(self):
+        """``X.terminate()`` with no later ``X.join()`` in the same
+        function leaves a zombie pid."""
+        terms: List[Tuple[int, str]] = []
+        joins: List[Tuple[int, str]] = []
+        for n in ast.walk(self.fn.node):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute):
+                if n.func.attr == "terminate":
+                    terms.append((n.lineno, _dotted(n.func.value)))
+                elif n.func.attr == "join":
+                    joins.append((n.lineno, _dotted(n.func.value)))
+        for line, who in terms:
+            if not any(jl > line and jw == who for jl, jw in joins):
+                self._add(line, "terminate",
+                          "%s.terminate() is never followed by "
+                          "%s.join() in this function — a SIGTERMed "
+                          "process stays a zombie pid until the "
+                          "parent exits" % (who, who))
+
+
+# ---------------------------------------------------------------------------
+# rule passes over the model
+# ---------------------------------------------------------------------------
+def _protocol_pass(prog: _Program):
+    handled: Dict[Tuple[str, str], List[Arm]] = {}
+    for arm in prog.arms:
+        handled.setdefault((arm.role, arm.kind), []).append(arm)
+    sent: Dict[Tuple[str, str], List[SendSite]] = {}
+    for s in prog.sends:
+        sent.setdefault((s.target, s.kind), []).append(s)
+
+    # unhandled kinds: fire at every send site of the (target, kind)
+    for (target, kind), sites in sorted(sent.items()):
+        if (target, kind) in handled:
+            continue
+        for s in sites:
+            prog._add("proto-unhandled-kind", s.mod, s.line, kind,
+                      "%r is sent to the %s role but no %s class "
+                      "has a dispatch arm for it — the frame is "
+                      "silently dropped" % (kind, target, target))
+
+    # unknown kinds: an arm nobody sends to
+    for (role, kind), arms in sorted(handled.items()):
+        if kind.startswith("_") or (role, kind) in sent:
+            continue
+        for arm in arms:
+            prog._add("proto-unknown-kind", arm.mod, arm.line, kind,
+                      "dispatch arm for %r but no peer ever sends it "
+                      "to the %s role — dead protocol surface"
+                      % (kind, role))
+
+    # meta schema: union required keys per (role, kind); check sites
+    for (role, kind), arms in sorted(handled.items()):
+        required: Set[str] = set()
+        for arm in arms:
+            required |= arm.required
+        if not required:
+            continue
+        for s in sent.get((role, kind), []):
+            if s.keys is None:
+                continue                  # unresolvable: never guess
+            for key in sorted(required - s.keys):
+                prog._add(
+                    "proto-meta-schema", s.mod, s.line, kind,
+                    "send site omits meta[%r], which the %s handler "
+                    "reads without a default — schema drift between "
+                    "processes is a runtime KeyError mid-serve"
+                    % (key, role))
+
+    # gen fence: kinds whose sends carry gen need fenced handlers
+    gen_kinds = {(s.target, s.kind) for s in prog.sends
+                 if s.carries_gen}
+    for arm in prog.arms:
+        if (arm.role, arm.kind) not in gen_kinds:
+            continue
+        if not arm.has_fence:
+            prog._add(
+                "proto-gen-fence", arm.mod, arm.line, arm.kind,
+                "handler for gen-carrying %r never compares the "
+                "incarnation gen — a zombie worker's late frame "
+                "lands in a resubmitted request (the PR-10 fence is "
+                "a checked invariant, not a convention)" % arm.kind)
+        elif arm.early_mut_line is not None:
+            prog._add(
+                "proto-gen-fence", arm.mod, arm.early_mut_line,
+                arm.kind,
+                "handler for gen-carrying %r mutates state before "
+                "the gen fence at line %d — the fence must come "
+                "first" % (arm.kind, arm.fence_line))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def build_model(modules: Dict[str, str],
+                roles: Optional[Dict[str, str]] = None) -> _Program:
+    return _Program(modules, roles)
+
+
+def analyze(modules: Dict[str, str],
+            roles: Optional[Dict[str, str]] = None) -> List[Finding]:
+    """Analyze ``{rel_path: source}`` as one protocol; findings are
+    pragma-filtered per module."""
+    prog = build_model(modules, roles)
+    _protocol_pass(prog)
+    _reply_pass(prog)
+    for qual in sorted(prog.fns):
+        _ResourceScanner(prog, prog.fns[qual]).scan()
+    out: List[Finding] = []
+    for rel, mod in prog.modules.items():
+        fs = [f for f in prog.findings if f.path == rel]
+        out.extend(apply_pragmas(fs, mod.source))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_source(source: str, rel_path: str,
+                roles: Optional[Dict[str, str]] = None
+                ) -> List[Finding]:
+    """Single-module entry (fixtures drive this directly)."""
+    return analyze({rel_path: source}, roles)
+
+
+def _load_modules(root: str) -> Dict[str, str]:
+    modules: Dict[str, str] = {}
+    for pkg in PACKAGES:
+        d = os.path.join(root, pkg)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            rel = "%s/%s" % (pkg, name)
+            with open(os.path.join(root, rel)) as f:
+                modules[rel] = f.read()
+    return modules
+
+
+def triggered(only: Optional[Set[str]]) -> bool:
+    """Does the change set intersect the protocol's trigger scope?"""
+    if only is None:
+        return True
+    return any(p in TRIGGER_FILES
+               or p.startswith(TRIGGER_PREFIXES) for p in only)
+
+
+def run(root: str, only: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint the live protocol.  ``only`` (--changed-only): the whole
+    analysis is skipped unless serving/, ``parallel/dist.py``, or
+    ``tools/analysis/`` changed; when it runs, reporting is restricted
+    to changed files (pylocklint's convention — tier-1 always runs
+    full scope)."""
+    if not triggered(only):
+        return []
+    findings = analyze(_load_modules(root))
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# protocol audit (docs/protocol.md)
+# ---------------------------------------------------------------------------
+def protocol_audit_md(root: str) -> str:
+    """Render the wire-protocol model as the checked-in audit table
+    (pinned current by tier-1, like docs/sharding_readiness.md)."""
+    prog = build_model(_load_modules(root))
+    handled: Dict[Tuple[str, str], List[Arm]] = {}
+    for arm in prog.arms:
+        handled.setdefault((arm.role, arm.kind), []).append(arm)
+    by_kind: Dict[str, List[SendSite]] = {}
+    for s in prog.sends:
+        by_kind.setdefault(s.kind, []).append(s)
+    for (role, kind), arms in handled.items():
+        by_kind.setdefault(kind, [])
+
+    def site(mod, line):
+        return "%s:%d" % (os.path.basename(mod), line)
+
+    def fnq(qual):
+        return qual.split("::", 1)[1]
+
+    lines = [
+        "# Wire protocol — disaggregated serving cluster",
+        "",
+        "Generated by protolint (`python -m tools.analysis "
+        "--write-protocol-audit`) from",
+        "the AST protocol model over `mxnet_tpu/serving/` — the "
+        "router ↔ worker control",
+        "plane and the worker ↔ worker data plane riding "
+        "`parallel/dist.py` raw frames",
+        "through `serving/transport.py`.  Checked in and pinned "
+        "current by tier-1",
+        "(`tests/test_static_analysis.py`) exactly like "
+        "`docs/sharding_readiness.md`;",
+        "`tools/run_static_analysis.sh --changed-only` regenerates "
+        "it when serving/,",
+        "`parallel/dist.py`, or `tools/analysis/` change.",
+        "",
+        "Meta schema = the union of keys set at every send site "
+        "(protolint's",
+        "`proto-meta-schema` verifies each handler-read key is "
+        "present at each site).",
+        "Gen fence: `yes` = every handler compares the incarnation "
+        "gen before mutating",
+        "state (`proto-gen-fence`); `—` = the kind carries no gen.  "
+        "`srid` is the",
+        "`(rid, gen)` pair by protocol contract.  In-process "
+        "synthetic kinds",
+        "(`_wake`, `_lost`) never travel the wire and are excluded.",
+        "",
+        "| kind | route | sent from | handled at | meta schema | "
+        "bufs | gen fence |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for kind in sorted(by_kind):
+        if kind.startswith("_"):
+            continue                      # in-process synthetic
+        sites = by_kind[kind]
+        routes = sorted({"%s → %s" % (s.role, s.target)
+                         for s in sites})
+        senders = sorted({site(s.mod, s.line) for s in sites})
+        targets = sorted({s.target for s in sites})
+        arms = []
+        for t in targets:
+            arms.extend(handled.get((t, kind), []))
+        if not sites:           # arm with no sender (should not ship)
+            for (role, k), al in handled.items():
+                if k == kind:
+                    arms.extend(a for a in al if a not in arms)
+        handlers = sorted({"`%s` (%s)" % (fnq(a.fnqual),
+                                          site(a.mod, a.line))
+                           for a in arms}) or ["**UNCOVERED**"]
+        keysets = [s.keys for s in sites if s.keys is not None]
+        allkeys: Set[str] = set().union(*keysets) if keysets else set()
+        everykeys = set.intersection(*map(set, keysets)) \
+            if keysets else set()
+        schema = ", ".join(
+            "`%s`" % k if k in everykeys else "`%s`?" % k
+            for k in sorted(allkeys)) or "—"
+        bufs = "/".join(sorted({s.bufs for s in sites})) or "—"
+        carries = any(s.carries_gen for s in sites)
+        if not carries:
+            fence = "—"
+        elif arms and all(a.has_fence and a.early_mut_line is None
+                          for a in arms):
+            fence = "yes"
+        else:
+            fence = "NO"
+        lines.append("| `%s` | %s | %s | %s | %s | %s | %s |" % (
+            kind, "; ".join(routes) or "?",
+            "; ".join(senders) or "—",
+            "; ".join(handlers), schema, bufs, fence))
+    lines += [
+        "",
+        "Reply pairings (checked on every exit edge, exception edges "
+        "included, by",
+        "`proto-reply-pairing`): `fetch` → `fetch_reply` (the peer "
+        "fetch server replies",
+        "even when serving the fetch fails — the requester degrades "
+        "to a cold prefill",
+        "instead of waiting out its timeout), `stats_req` → `stats` "
+        "(`_send_stats`",
+        "replies unconditionally; the periodic rate limit lives in "
+        "`_maybe_send_stats`).",
+        "",
+    ]
+    return "\n".join(lines)

@@ -1,4 +1,4 @@
-"""mxlint entry point — run all five analyzers against the live repo.
+"""mxlint entry point — run all six analyzers against the live repo.
 
 Usage (from the repo root)::
 
@@ -18,6 +18,8 @@ Usage (from the repo root)::
                                                  # relaxes a budget)
     python -m tools.analysis --write-sharding-audit  # regenerate
                                                  # docs/sharding_readiness.md
+    python -m tools.analysis --write-protocol-audit  # regenerate
+                                                 # docs/protocol.md
 
 Tier-1 wiring: ``tests/test_static_analysis.py`` calls :func:`run_all`
 directly (always full scope); ``tools/run_static_analysis.sh`` is the
@@ -33,7 +35,8 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Set
 
-from . import abi, graphlint, jaxlint, native_lint, pylocklint
+from . import (abi, graphlint, jaxlint, native_lint, protolint,
+               pylocklint)
 from .findings import Finding, load_baseline, split_new
 
 __all__ = ["REPO_ROOT", "changed_files", "run_all", "fingerprint",
@@ -109,6 +112,7 @@ def run_all(root: str = None, baseline_path: str = None,
     findings += native_lint.run(root, only=only)
     findings += pylocklint.run(root, only=only)
     findings += graphlint.run(root, only=only)
+    findings += protolint.run(root, only=only)
     baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
     new, old = split_new(findings, baseline)
     return {"findings": findings, "new": new, "baselined": old,
@@ -145,7 +149,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mxlint", description="repo static-analysis suite "
         "(C-ABI / JAX hazards / native + Python concurrency / "
-        "compiled-program graphs)")
+        "compiled-program graphs / serving wire protocol)")
     ap.add_argument("--root", default=REPO_ROOT)
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--json", action="store_true",
@@ -173,8 +177,19 @@ def main(argv=None) -> int:
     ap.add_argument("--write-sharding-audit", action="store_true",
                     help="regenerate the sharding-readiness audit "
                          "table (docs/sharding_readiness.md)")
+    ap.add_argument("--write-protocol-audit", action="store_true",
+                    help="regenerate the serving wire-protocol audit "
+                         "table (docs/protocol.md)")
     args = ap.parse_args(argv)
     fmt = "json" if args.json else args.format
+
+    if args.write_protocol_audit:
+        # pure AST (no import of the checkout), so --root is honored
+        path = os.path.join(args.root, protolint.AUDIT_PATH)
+        with open(path, "w") as f:
+            f.write(protolint.protocol_audit_md(args.root))
+        print("protolint: wrote %s" % path)
+        return 0
 
     if args.update_budgets or args.write_sharding_audit:
         # graphlint traces the IMPORTED checkout — a foreign --root

@@ -1,7 +1,8 @@
 #!/bin/sh
 # Single entry point for the mxlint static-analysis suite (ISSUE 4/7/8):
-#   1. the five analyzers (C-ABI / JAX hazards / native concurrency /
-#      Python concurrency / compiled-program graphs) — fails on any NEW
+#   1. the six analyzers (C-ABI / JAX hazards / native concurrency /
+#      Python concurrency / compiled-program graphs / serving wire
+#      protocol) — fails on any NEW
 #      violation vs baseline/pragmas.  DEFAULT SCOPE: --changed-only
 #      (files changed vs the merge-base + working tree; graphlint
 #      re-traces only programs whose recorded trace closure changed),
@@ -47,14 +48,28 @@ if [ "$SCOPE" = "--changed-only" ]; then
     # CI leave the committed file authoritative.
     # tools/analysis/ is included: the table's rendering/derivation
     # lives in graphlint.py, so an audit-code edit also stales it
-    CHANGED=$( (git diff --name-only HEAD; \
+    CHANGED_ALL=$( (git diff --name-only HEAD; \
                 git ls-files -o --exclude-standard) 2>/dev/null \
+               || true)
+    CHANGED=$(printf '%s\n' "$CHANGED_ALL" \
                | grep -E '^(mxnet_tpu/(serving|models)|tools/analysis)/' \
                || true)
     if [ -n "$CHANGED" ]; then
         echo "== regenerating docs/sharding_readiness.md (serving/" \
              "or models/ changed) ==" >&2
         python -m tools.analysis --write-sharding-audit >&2
+    fi
+    # the wire-protocol audit (docs/protocol.md) is protolint's
+    # rendered model of serving/'s send sites + dispatch arms — same
+    # staleness story, different trigger set (serving/, the
+    # parallel/dist.py wire, or the analyzer itself)
+    CHANGED_PROTO=$(printf '%s\n' "$CHANGED_ALL" \
+               | grep -E '^(mxnet_tpu/serving/|mxnet_tpu/parallel/dist\.py|tools/analysis/)' \
+               || true)
+    if [ -n "$CHANGED_PROTO" ]; then
+        echo "== regenerating docs/protocol.md (serving/," \
+             "parallel/dist.py, or tools/analysis/ changed) ==" >&2
+        python -m tools.analysis --write-protocol-audit >&2
     fi
 fi
 
