@@ -315,6 +315,32 @@ def bench_gpt_serve_goodput():
     return 100.0 * row["goodput_frac"]
 
 
+def bench_gpt_serve_tier_hit():
+    """KV-tiering gate (round 18): TTFT (ms) of a request whose whole
+    prompt chain was SPILLED to the host-DRAM tier — the engine
+    re-installs the exact pool bytes through the bucketed donated
+    scatter (the warm hit) instead of re-running 12 chunked-prefill
+    steps.  This is the number that prices the middle tier of the
+    hbm → host → peer hierarchy; the hot/cold TTFTs and the
+    swap-vs-recompute resume pair ride along in the serve_bench
+    ``tier`` rows and docs/perf.md "KV tiering".  The run itself
+    hard-fails (RuntimeError) unless hot < warm < cold strictly,
+    swap-resume beats recompute-resume, every completion is
+    bit-identical to the generate oracle, and nothing leaks — the
+    gate VALUE is only the warm TTFT.  Direction "lower": v <= hi.
+    Reproducibility is enforced here like the goodput gate's: the row
+    must carry its seed + sweep sha or the gate refuses to report."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    row = serve_bench.run_gate_tier("full")
+    if not row.get("sweep_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_serve_tier_hit_ttft_ms: result row carries no "
+            "seed/sweep sha — the measurement is not reproducible; "
+            "refusing to gate it (got keys %s)" % sorted(row))
+    return row["ttft_warm_ms"]
+
+
 def bench_gpt_spec_decode():
     """Speculative decode gate (round 6): batch 8, w8 target, ngram
     (prompt-lookup) drafter at K=4 on the structured ("loop") workload
@@ -380,6 +406,8 @@ BENCHES = {
     "gpt_serve_disagg_remote_hit_ttft_ms":
         (bench_gpt_serve_disagg_remote_hit, "lower"),
     "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
+    "gpt_serve_tier_hit_ttft_ms": (bench_gpt_serve_tier_hit,
+                                   "lower"),
 }
 
 BAR = 0.15

@@ -92,11 +92,27 @@ Round 16 adds the traffic-realism layer (ROADMAP item 2):
   burst + heavy-tail traces, goodput SLO classification) and
   ``serve_bench --trace`` (open-loop replay + ``gpt_serve_goodput``
   gate; ``tests/test_serving_traffic.py``, slow group k).
+
+Round 18 adds hierarchical KV tiering (ROADMAP item 4):
+
+- ``tier_store.HostTierStore`` — a byte-budgeted host-DRAM LRU of
+  exact pool-layout page bytes under every engine's pool
+  (``ServingEngine(tier_bytes=N)`` / ``MXNET_SERVE_TIER_BYTES``):
+  pressure-evicted refcount-0 prefix chains SPILL instead of drop
+  and re-install as **warm hits** (the outcome between hot-hit and
+  miss); preemption victims SWAP OUT and resume install-exact
+  instead of recompute-exact — O(transfer), not O(prefill).  In the
+  disaggregated cluster the router's ``ClusterPrefixIndex`` carries
+  a per-key tier tag (``hbm``/``host``) and spilled chains stay
+  peer-fetchable, served straight from the owner's host tier.
+  ``serve_bench --tier-sweep``; ``gpt_serve_tier_hit_ttft_ms`` gate;
+  ``tests/test_serving_tier.py`` (slow group l).
 """
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache, ClusterPrefixIndex
 from .drafters import ngram_draft
 from .engine import Request, ServingEngine
+from .tier_store import HostTierStore
 from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
                       RequestExpired, ClusterClosed, ClusterFailed,
                       DisaggServingCluster, run_worker)
@@ -104,7 +120,7 @@ from .autoscaler import Autoscaler, HistogramWindow
 from .chaos import ChaosDriver, ChaosEvent, chaos_schedule
 
 __all__ = ["PagedKVCache", "PrefixCache", "ClusterPrefixIndex",
-           "Request", "ServingEngine",
+           "HostTierStore", "Request", "ServingEngine",
            "ServingCluster", "ClusterRequest", "ClusterOverloaded",
            "RequestExpired", "ClusterClosed", "ClusterFailed",
            "DisaggServingCluster", "run_worker", "ngram_draft",

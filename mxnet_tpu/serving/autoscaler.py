@@ -177,12 +177,14 @@ class Autoscaler:
             # floor) is restored IMMEDIATELY — hysteresis and
             # cooldown exist to damp load oscillation, not to slow
             # fault recovery
+            t_act = time.perf_counter()
             if self.cluster.scale_up():
                 self._last_action_t = now
                 self._over_ticks = 0
                 self._under_ticks = 0
                 self.events.append(
                     {"t": now, "action": "up", "self_heal": True,
+                     "actuation_s": time.perf_counter() - t_act,
                      "waiting": waiting, "in_flight": in_flight,
                      "healthy": healthy, "ttft_p95_ms": ttft_p95})
                 return "up"
@@ -200,6 +202,12 @@ class Autoscaler:
         cooling = (self._last_action_t is not None
                    and now - self._last_action_t < self.cooldown_s)
         action = None
+        # actuation latency rides every event (round 18): the
+        # spawn-vs-standby economics — ~15 s process spawn + compile
+        # vs an O(ms) standby adoption — are a MEASURED property of
+        # each scale-up, not an assertion (serve_bench --trace
+        # reports it per row)
+        t_act = time.perf_counter()
         if (self._over_ticks >= self.up_ticks and not cooling
                 and healthy < self.max_size):
             if self.cluster.scale_up():
@@ -213,9 +221,10 @@ class Autoscaler:
             self._over_ticks = 0
             self._under_ticks = 0
             self.events.append(
-                {"t": now, "action": action, "waiting": waiting,
-                 "in_flight": in_flight, "healthy": healthy,
-                 "ttft_p95_ms": ttft_p95})
+                {"t": now, "action": action,
+                 "actuation_s": time.perf_counter() - t_act,
+                 "waiting": waiting, "in_flight": in_flight,
+                 "healthy": healthy, "ttft_p95_ms": ttft_p95})
         return action
 
     def _detach(self):

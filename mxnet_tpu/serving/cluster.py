@@ -282,7 +282,7 @@ class ServingCluster:
                  affinity_slack=None,
                  affinity_capacity=4096, retain_results=4096,
                  kernel="xla", spec_K=0, spec_drafter="ngram",
-                 spec_ngram=2, tp=1, mesh=None):
+                 spec_ngram=2, tp=1, mesh=None, tier_bytes=None):
         if replicas < 1:
             raise ValueError("ServingCluster: replicas must be >= 1")
         self.num_slots = num_slots
@@ -357,7 +357,8 @@ class ServingCluster:
             prefill_chunk=prefill_chunk, kv_int8=kv_int8,
             prefix_cache=prefix_cache, metrics=bool(metrics),
             kernel=kernel, spec_K=spec_K, spec_drafter=spec_drafter,
-            spec_ngram=spec_ngram, tp=tp, mesh=mesh)
+            spec_ngram=spec_ngram, tp=tp, mesh=mesh,
+            tier_bytes=tier_bytes)
         # kept for add_replica (autoscaler scale-up): a replica added
         # mid-run must be built from the SAME params/config as the
         # originals (references only — params are already placed)
@@ -1205,7 +1206,7 @@ class DisaggServingCluster:
                  pages_per_slot=None, prefill_chunk=8, kv_int8=False,
                  kernel="xla", spec_K=0, metrics=None, registry=None,
                  watchdog_s=None, spawn=True, host="127.0.0.1",
-                 port=0, ready_timeout=None):
+                 port=0, ready_timeout=None, tier_bytes=None):
         if prefill < 1 or decode < 1:
             raise ValueError("DisaggServingCluster: needs >= 1 "
                              "prefill and >= 1 decode worker")
@@ -1222,7 +1223,7 @@ class DisaggServingCluster:
             num_slots=num_slots, page_size=page_size,
             num_pages=num_pages, pages_per_slot=pages_per_slot,
             prefill_chunk=prefill_chunk, kv_int8=kv_int8,
-            kernel=kernel, spec_K=spec_K)
+            kernel=kernel, spec_K=spec_K, tier_bytes=tier_bytes)
         # mirror of the workers' engine limits, so an invalid request
         # fails the submit() call instead of poisoning a worker
         pps = pages_per_slot if pages_per_slot is not None \
@@ -1251,6 +1252,12 @@ class DisaggServingCluster:
         # router registry (same idiom as _EngineObs.sync_cache)
         self._stat_seen: Dict[str, Dict[str, float]] = {}
         self.workers: Dict[str, _WorkerHandle] = {}
+        # pre-provisioned standby workers (round 18): fully handshaken
+        # (engine built + pre-warmed) but held out of routing AND out
+        # of the healthy-capacity gauge until scale_up() adopts them —
+        # burst capacity priced at a peer-map flip, not at
+        # process-spawn + jax import + compile
+        self._standby: set = set()
         for i in range(prefill):
             self.workers["prefill%d" % i] = _WorkerHandle(
                 "prefill%d" % i, "prefill")
@@ -1353,8 +1360,15 @@ class DisaggServingCluster:
                 name="disagg-recv-" + wh.name)
             wh.recv_thread.start()
         if self._obs is not None:
-            self._obs.g_workers.set(
-                sum(w.alive for w in self.workers.values()))
+            self._obs.g_workers.set(self._serving_count())
+
+    def _serving_count(self):
+        """Workers counted as serving capacity: alive and not parked
+        as standby (a standby worker is warm but deliberately invisible
+        to the autoscaler's healthy gauge — counting it would tell the
+        scaler the capacity is already deployed)."""
+        return sum(w.alive and w.name not in self._standby
+                   for w in self.workers.values())
 
     # ------------------------------------------------- router recv ---
     def _recv_loop(self, wh):
@@ -1382,6 +1396,12 @@ class DisaggServingCluster:
                 self.index.report_insert(wh.name, meta["keys"])
             elif kind == "evict":
                 self.index.report_evict(wh.name, meta["keys"])
+            elif kind == "tier":
+                # round 18: chains moved between the worker's tiers
+                # (spill hbm->host / warm restore host->hbm) — re-tag,
+                # never forget: a spilled chain is still fetchable
+                self.index.report_tier(wh.name, meta["keys"],
+                                       meta["tier"])
             elif kind == "stats":
                 self._on_stats(wh, meta)
             elif kind == "reqfail":
@@ -1615,17 +1635,26 @@ class DisaggServingCluster:
         dec.outstanding.add(cr.rid)
         inp = cr.prompt if not cr.committed else np.concatenate(
             [cr.prompt, np.asarray(cr.committed, np.int32)])
-        owner, depth = self.index.match(
+        owner, depth, tier = self.index.match(
             chain_keys(inp, self.page_size))
         hint = None
         if owner is not None and owner != pre.name:
             wo = self.workers.get(owner)
             if wo is not None and wo.alive:
                 hint = owner
+        # hint_tier (round 18): where the owner's copy lives —
+        # "hbm" (device pool, a gather away) or "host" (spilled to
+        # the owner's host tier, served without any device work).
+        # The prefill worker weighs the peer fetch against its OWN
+        # hot + warm local depth (probe_depth), so a peer copy only
+        # wins when it covers strictly more than local HBM + local
+        # host DRAM together — transfer must beat transfer, not just
+        # prefill.
         meta = {"rid": cr.rid, "gen": cr.gen,
                 "max_new": cr.max_new_tokens - len(cr.committed),
                 "eos": cr.eos_id, "decode": dec.name,
-                "hint": hint, "hint_depth": depth}
+                "hint": hint, "hint_depth": depth,
+                "hint_tier": tier if hint is not None else None}
         return [(pre.conn, ("submit", meta,
                             [np.ascontiguousarray(inp).data]))]
 
@@ -1678,11 +1707,11 @@ class DisaggServingCluster:
                 return
             wh.dead = True
             wh.error = error
+            self._standby.discard(wh.name)
             self.index.drop_owner(wh.name)
             if self._obs is not None:
                 self._obs.failovers.inc()
-                self._obs.g_workers.set(
-                    sum(w.alive for w in self.workers.values()))
+                self._obs.g_workers.set(self._serving_count())
             # a request in the prefill phase dies with either of its
             # assigned workers (pages may already be streaming to the
             # decode side); one that completed handoff only dies with
@@ -1782,6 +1811,7 @@ class DisaggServingCluster:
         with self._lock:
             return [{"worker": w.name, "role": w.role,
                      "alive": w.alive, "dead": w.dead,
+                     "standby": w.name in self._standby,
                      "draining": w.draining,
                      "outstanding": len(w.outstanding),
                      "heartbeat_age_s": now - w.last_seen,
@@ -1859,7 +1889,8 @@ class DisaggServingCluster:
         wh.data_port = meta["data_port"]
         wh.last_seen = time.perf_counter()
 
-    def add_worker(self, role, spawn=None, ready_timeout=None):
+    def add_worker(self, role, spawn=None, ready_timeout=None,
+                   standby=False):
         """Scale-up actuation (round 16): add one more ``role``
         worker PROCESS to the live cluster.  ``spawn=True`` forks it
         here (multiprocessing spawn, like construction);
@@ -1869,7 +1900,17 @@ class DisaggServingCluster:
         this router's port, which is how an autoscaler adds capacity
         on ANOTHER host.  Blocks through handshake + engine pre-warm;
         every live worker receives the refreshed peer map.  Returns
-        the new worker's name."""
+        the new worker's name.
+
+        ``standby=True`` (round 18, the pre-provisioned-join path):
+        the worker is brought ALL the way up — handshake, params
+        ship, engine build, step-program pre-warm, peer map — but
+        parked out of routing and out of the healthy-capacity gauge.
+        ``scale_up()`` adopts the warmest standby of the needed role
+        in O(peer-map flip) instead of paying process-spawn + jax
+        import + compile (~15 s on CPU — longer than the whole burst
+        the round-16 goodput row measured; the honest caveat this
+        path exists to close)."""
         if role not in ("prefill", "decode"):
             raise ValueError("add_worker: role must be 'prefill' or "
                              "'decode', got %r" % (role,))
@@ -1908,6 +1949,7 @@ class DisaggServingCluster:
         except BaseException:
             with self._lock:
                 wh.dead = True
+                self._standby.discard(name)
                 self.workers.pop(name, None)
             if wh.proc is not None and wh.proc.is_alive():
                 wh.proc.terminate()
@@ -1930,11 +1972,31 @@ class DisaggServingCluster:
             name="disagg-recv-" + wh.name)
         wh.recv_thread.start()
         with self._lock:
-            wh.draining = False           # ready: now routable
+            if standby:
+                # fully warm, deliberately invisible: stays draining
+                # (never routed, never chaos-targeted) until adopted
+                self._standby.add(name)
+            else:
+                wh.draining = False       # ready: now routable
             if self._obs is not None:
-                self._obs.g_workers.set(
-                    sum(w.alive for w in self.workers.values()))
+                self._obs.g_workers.set(self._serving_count())
         return name
+
+    def adopt_standby(self, role):
+        """Put one pre-provisioned standby ``role`` worker into
+        rotation (round 18).  O(flag flip): the worker is already
+        handshaken, pre-warmed, and in every peer map.  Returns its
+        name, or None when no standby of that role is parked."""
+        with self._lock:
+            for name in sorted(self._standby):
+                wh = self.workers.get(name)
+                if wh is not None and wh.role == role and wh.alive:
+                    self._standby.discard(name)
+                    wh.draining = False
+                    if self._obs is not None:
+                        self._obs.g_workers.set(self._serving_count())
+                    return name
+        return None
 
     def drain_worker(self, name, timeout=60.0):
         """Graceful scale-down of one worker process: stop routing to
@@ -1967,10 +2029,10 @@ class DisaggServingCluster:
             return False
         with self._lock:
             wh.dead = True                # recv EOF won't fail over
+            self._standby.discard(name)   # a drained spare is gone
             self.index.drop_owner(name)
             if self._obs is not None:
-                self._obs.g_workers.set(
-                    sum(w.alive for w in self.workers.values()))
+                self._obs.g_workers.set(self._serving_count())
         try:
             wh.conn.send("shutdown", {})
         except OSError:
@@ -2001,6 +2063,10 @@ class DisaggServingCluster:
                               sum(len(w.outstanding) for w in ws)
                               / len(ws))
         role = max(sorted(load), key=lambda r: load[r])
+        # a pre-provisioned standby of the needed role is adopted in
+        # O(peer-map flip); only a cold cluster pays spawn + compile
+        if self.adopt_standby(role) is not None:
+            return True
         self.add_worker(role)
         return True
 
@@ -2113,15 +2179,36 @@ class _DisaggWorker:
         wid = self.eng.submit(np.ones(1, np.int32), 1)
         self.eng.run()
         del self.eng.requests[wid]
+        # pre-warm the bucketed page-transfer programs too (round
+        # 18): the first peer fetch, prefill->decode stream, or
+        # pressure spill after handshake must pay a TRANSFER, not a
+        # compile — a bucket-4 install compile inside a fetch reply
+        # is most of a cold prefill on CPU.  One allocated page
+        # repeated per bucket exercises every gather/scatter shape
+        # the small-run paths use; the page is scratch-grade warmup
+        # state and goes straight back to the free list.
+        ids = self.eng.cache.alloc(1)
+        if ids is not None:
+            for b in (1, 2, 4, 8):
+                content = self.eng.cache.export_pages(ids * b)
+                self.eng.cache.install_pages(ids * b, content)
+            self.eng.cache.free(ids)
         if self.eng.prefix is not None:
             self.eng.prefix.clear()
         for k in self.eng.stats:
             self.eng.stats[k] = type(self.eng.stats[k])()
         if self.eng.prefix is not None:
             self.eng.prefix.evict_cb = self._on_evict
+            if self.eng.tier is not None:
+                self.eng.prefix.tier_cb = self._on_tier_move
         if role == "prefill":
             self.eng.retire_cb = self._on_retire
         self._evicted_keys: List[bytes] = []
+        # chain key -> last tier seen ("host"/"hbm"), flushed with the
+        # stats tick as `tier` frames: absolute per-key state, so only
+        # the LAST transition per key travels (a spill+restore inside
+        # one tick cancels out to a no-op re-tag)
+        self._tier_moves: Dict[bytes, str] = {}
         from .page_streamer import PageStreamer, PageReceiver
         self.streamer = PageStreamer(self.eng)
         self.receiver = PageReceiver(self.eng)
@@ -2147,6 +2234,7 @@ class _DisaggWorker:
         self._reported: Dict[int, int] = {}   # rid -> tokens reported
         self.remote_hits = 0
         self.remote_hit_tokens = 0
+        self.remote_hits_host_tier = 0
         self.fetch_bytes = 0
         self._fetch_seq = 0               # fetch/reply correlation
         # rid -> lowest still-valid gen (per-request fence): a
@@ -2191,6 +2279,10 @@ class _DisaggWorker:
 
     def _on_evict(self, key):
         self._evicted_keys.append(key)
+        self._tier_moves.pop(key, None)   # gone beats any re-tag
+
+    def _on_tier_move(self, key, tier):
+        self._tier_moves[key] = tier
 
     def _on_retire(self, req):
         """Engine retire hook (prefill role): snapshot the finishing
@@ -2233,15 +2325,30 @@ class _DisaggWorker:
             try:
                 tokens = np.frombuffer(bytes(bufs[0]), np.int32)
                 if self.eng.prefix is not None:
-                    entries, pages, m = self.eng.prefix.match(tokens)
+                    # restore=False: serving a sibling must not spend
+                    # OUR pool pages re-installing spilled chains —
+                    # the spilled tail ships straight from host DRAM
+                    entries, pages, m = self.eng.prefix.match(
+                        tokens, restore=False)
                     try:
-                        n_full = min(len(pages),
-                                     m // self.eng.page_size)
-                        if n_full:
-                            from .page_streamer import pages_to_bufs
+                        n_hot = min(len(pages),
+                                    m // self.eng.page_size)
+                        parts = []
+                        if n_hot:
+                            parts.append(self.eng.cache.export_pages(
+                                pages[:n_hot]))
+                        # round 18: spilled continuation off the host
+                        # tier — a spilled chain stays P2P-fetchable,
+                        # and CHEAPER to serve (no device gather)
+                        tail = self.eng.prefix.spilled_content(
+                            tokens, n_hot)
+                        n_full = n_hot + len(tail)
+                        parts.extend(tail)
+                        if parts:
+                            from .page_streamer import (
+                                merge_page_content, pages_to_bufs)
                             reply_bufs = pages_to_bufs(
-                                self.eng.cache.export_pages(
-                                    pages[:n_full]))
+                                merge_page_content(parts))
                     finally:
                         self.eng.prefix.release(entries)
             except Exception:
@@ -2258,11 +2365,16 @@ class _DisaggWorker:
             except OSError:
                 pass                      # requester died: their loss
 
-    def _fetch_remote(self, owner, tokens, timeout=15.0):
+    def _fetch_remote(self, owner, tokens, timeout=15.0,
+                      peer_tier=None):
         """Fetch the longest cached chain for ``tokens`` from a
         sibling replica and graft it into the local trie.  A miss (or
         a dead/slow peer) degrades to a cold local prefill — the
-        exactness contract never depends on the fetch."""
+        exactness contract never depends on the fetch.  ``peer_tier``
+        is the router's tag for the owner's copy (``hbm``/``host``) —
+        accounting only: a spilled peer chain serves from its host
+        tier without a device gather, and the per-tier hit counters
+        are how the tier-sweep benchmark prices that difference."""
         from .page_streamer import bufs_to_pages
         self._fetch_seq += 1
         fid = self._fetch_seq
@@ -2315,6 +2427,8 @@ class _DisaggWorker:
         self.eng.prefix.release([e for _, e in created])
         self.remote_hits += 1
         self.remote_hit_tokens += n * ps
+        if peer_tier == "host":
+            self.remote_hits_host_tier += 1
         self.transfer_ms.append(
             (time.perf_counter() - meta["t_send"]) * 1e3)
         # bytes are counted SENDER-side only (the owner's
@@ -2334,11 +2448,16 @@ class _DisaggWorker:
                 # fenced zombie (proto-gen-fence checked invariant)
                 return
             if meta.get("hint") and self.eng.prefix is not None:
-                entries, _, m_local = self.eng.prefix.match(inp)
-                self.eng.prefix.release(entries)
-                ps = self.eng.page_size
-                if meta["hint_depth"] * ps > (m_local // ps) * ps:
-                    self._fetch_remote(meta["hint"], inp)
+                # round 18: the local depth a fetch must beat counts
+                # BOTH tiers — hot trie pages and spilled (host-tier)
+                # pages, which restore for one install.  A peer copy
+                # wins only on strictly deeper coverage: transfer
+                # competes with transfer, not with prefill
+                # (probe_depth takes no refs and restores nothing).
+                hot, warm = self.eng.prefix.probe_depth(inp)
+                if meta["hint_depth"] > hot + warm:
+                    self._fetch_remote(meta["hint"], inp,
+                                       peer_tier=meta.get("hint_tier"))
             try:
                 erid = self.eng.submit(
                     inp, 1 if self.role == "prefill"
@@ -2639,6 +2758,28 @@ class _DisaggWorker:
             "staged_rids": len(self.receiver.staged_rids),
             "remote_hits": self.remote_hits,
             "remote_hit_tokens": self.remote_hit_tokens,
+            "remote_hits_host_tier": self.remote_hits_host_tier,
+            "prefix_spilled_pages":
+                0 if prefix is None else prefix.spilled_pages,
+            "warm_hits": 0 if prefix is None
+                else prefix.warm_hits_total,
+            "warm_hit_tokens": 0 if prefix is None
+                else prefix.warm_hit_tokens_total,
+            "swap_outs": eng.stats["swap_outs"],
+            "swap_ins": eng.stats["swap_ins"],
+            # inlined (not eng.tier.stats()): this fn is the
+            # stats_req reply path, so the dict build must be
+            # call-free — proto-reply-pairing's exception-edge rule
+            "tier": None if eng.tier is None else {
+                "pages_held": eng.tier.pages_held,
+                "bytes_held": eng.tier.bytes_held,
+                "budget_bytes": eng.tier.budget_bytes,
+                "spilled_pages_total": eng.tier.spilled_pages_total,
+                "installed_pages_total":
+                    eng.tier.installed_pages_total,
+                "bytes_moved_total": eng.tier.bytes_moved_total,
+                "evicted_pages_total": eng.tier.evicted_pages_total,
+                "evictions_total": eng.tier.evictions_total},
             "bytes_streamed": self.streamer.bytes_streamed_total
             + self.fetch_bytes,
             "pages_streamed": self.streamer.pages_streamed_total,
@@ -2650,6 +2791,17 @@ class _DisaggWorker:
             "transfer_ms": self.transfer_ms,
         }
         self.transfer_ms = []
+        if self._tier_moves:
+            moves, self._tier_moves = self._tier_moves, {}
+            by_tier: Dict[str, List[bytes]] = {}
+            for k, t in moves.items():
+                by_tier.setdefault(t, []).append(k)
+            for t, keys in by_tier.items():
+                try:
+                    self.router.send("tier", {"keys": keys,
+                                              "tier": t})
+                except OSError:
+                    pass
         if self._evicted_keys:
             keys, self._evicted_keys = self._evicted_keys, []
             try:

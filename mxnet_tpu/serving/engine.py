@@ -63,11 +63,23 @@ sharding-readiness audit verifies against the megatron rule table
 (``docs/sharding_readiness.md``, UNCOVERED = 0) and whose pool
 donation stays pinned by ``graph-donation``.
 
+Round 18 adds the tier under the pool (ROADMAP item 4): with
+``tier_bytes=N`` the engine owns a ``serving/tier_store.py
+HostTierStore`` — a byte-budgeted host-DRAM LRU of exact pool-layout
+page bytes.  Pressure eviction of refcount-0 prefix chains SPILLS
+them there instead of dropping (``PrefixCache`` warm hits re-install
+on the next match), and preemption SWAPS the victim's written pages
+out (``_preempt_victim``) so resume is install-exact
+(``_admit``/``_swap_in``) instead of recompute-exact — preemption
+cost becomes O(transfer) instead of O(prefill).  Every tier path
+degrades to the pre-tier behavior when the tier refuses or the entry
+was LRU-aged: exactness NEVER depends on the tier, only latency does.
+
 Exactness: under f32 greedy, engine outputs are token-identical to
 ``models/gpt.py generate`` per request, whatever the batch mix,
-admission order, page reuse, preemptions, kernel choice, drafter
-quality, or tp degree — pinned by ``tests/test_serving.py`` and
-``tests/test_serving_tp.py``.
+admission order, page reuse, preemptions, swap-outs, kernel choice,
+drafter quality, or tp degree — pinned by ``tests/test_serving.py``,
+``tests/test_serving_tier.py`` and ``tests/test_serving_tp.py``.
 
 Telemetry (round 8, ``mxnet_tpu/obs``): with ``metrics=True`` (or
 ``MXNET_SERVING_METRICS=1``) the engine feeds a per-engine
@@ -97,6 +109,7 @@ from ..models import gpt as G
 from . import drafters
 from .paged_kv import PagedKVCache
 from .prefix_cache import PrefixCache
+from .tier_store import HostTierStore
 
 __all__ = ["Request", "ServingEngine", "step_input_specs",
            "step_output_specs"]
@@ -467,6 +480,41 @@ class _EngineObs:
         self.g_spec_accept_rate = g(
             "serving_spec_accept_rate",
             "cumulative accepted / drafted draft tokens")
+        # host-DRAM KV tier (round 18; all-zero when disabled)
+        self.tier_spills = c("serving_tier_spills_total",
+                             "KV pages spilled HBM -> host tier "
+                             "(pressure-evicted prefix chains + "
+                             "preemption swap-outs)")
+        self.tier_installs = c("serving_tier_installs_total",
+                               "KV pages installed host tier -> HBM "
+                               "(warm prefix hits + swap-in resumes)")
+        self.tier_bytes = c("serving_tier_bytes_total",
+                            "bytes moved through the host tier, both "
+                            "directions (spill + install + peer "
+                            "fetches served from the tier)")
+        self.tier_evicted = c("serving_tier_evicted_pages_total",
+                              "pages LRU-dropped from the host tier "
+                              "(its byte budget, not pool pressure)")
+        self.g_tier_pages = g("serving_tier_pages",
+                              "KV pages currently held by the host "
+                              "tier")
+        self.g_tier_bytes = g("serving_tier_bytes_held",
+                              "host-DRAM bytes currently held by the "
+                              "tier (vs its byte budget)")
+        self.g_tier_budget = g("serving_tier_budget_bytes",
+                               "the host tier's configured byte "
+                               "budget")
+        self.warm_hit_tokens = c(
+            "serving_prefix_warm_hit_tokens_total",
+            "prefill tokens served by re-installing SPILLED chain "
+            "pages (the warm-hit outcome between hot-hit and miss)")
+        self.swap_outs = c("serving_swap_outs_total",
+                           "preemptions whose victim pages were "
+                           "swapped to the host tier instead of "
+                           "discarded")
+        self.swap_ins = c("serving_swap_ins_total",
+                          "preemption resumes served install-exact "
+                          "from the host tier instead of recomputed")
         # shared-prefix cache (round 10; all-zero when disabled)
         self.prefix_hit_tokens = c("serving_prefix_hit_tokens_total",
                                    "prefill tokens skipped via "
@@ -522,6 +570,9 @@ class _EngineObs:
         # backwards (a Prometheus rate() reads that as a reset)
         self._cache_seen = [0, 0, 0, 0]
         self._prefix_seen = [0, 0, 0, 0, 0, 0]
+        self._tier_seen = [0, 0, 0, 0]
+        self._warm_seen = [0]             # sync_prefix: warm tokens
+        self._swap_seen = [0, 0]          # sync_tier: outs, ins
 
     def sync_cache(self, cache):
         """Fold the allocator's plain-int telemetry into the registry
@@ -566,6 +617,45 @@ class _EngineObs:
         self.g_prefix_hit_ratio.set(
             prefix.hit_tokens_total
             / max(1, prefix.lookup_tokens_total))
+        # warm-hit token delta rides the prefix sync (the counter
+        # lives on the prefix cache, tier or not)
+        seen = self._warm_seen
+        d = prefix.warm_hit_tokens_total - seen[0]
+        if d < 0:
+            d = prefix.warm_hit_tokens_total
+        if d > 0:
+            self.warm_hit_tokens.inc(d)
+        seen[0] = prefix.warm_hit_tokens_total
+
+    def sync_tier(self, tier, swap_outs, swap_ins):
+        """Fold the host tier's plain-int telemetry in, delta-wise
+        like sync_cache (same shared-registry aggregation argument);
+        occupancy gauges carry the current tier state."""
+        vals = (tier.spilled_pages_total, tier.installed_pages_total,
+                tier.bytes_moved_total, tier.evicted_pages_total)
+        ctrs = (self.tier_spills, self.tier_installs, self.tier_bytes,
+                self.tier_evicted)
+        seen = self._tier_seen
+        for i, (ctr, v) in enumerate(zip(ctrs, vals)):
+            d = v - seen[i]
+            if d < 0:                     # tier reset: restart from 0
+                d = v
+            if d > 0:
+                ctr.inc(d)
+            seen[i] = v
+        self.g_tier_pages.set(tier.pages_held)
+        self.g_tier_bytes.set(tier.bytes_held)
+        self.g_tier_budget.set(tier.budget_bytes)
+        seen = self._swap_seen
+        for i, (ctr, v) in enumerate(zip(
+                (self.swap_outs, self.swap_ins),
+                (swap_outs, swap_ins))):
+            d = v - seen[i]
+            if d < 0:
+                d = v
+            if d > 0:
+                ctr.inc(d)
+            seen[i] = v
 
 
 class ServingEngine:
@@ -631,6 +721,13 @@ class ServingEngine:
         tp=1-only this round — the XLA gather path is the default).
     mesh : optional pre-built mesh with a ``tp`` axis (e.g.
         ``parallel.serving_mesh(tp)``); overrides ``tp``.
+    tier_bytes : host-DRAM KV tier budget in bytes (round 18).  > 0
+        attaches a ``HostTierStore``: pressure-evicted refcount-0
+        prefix chains spill to it (and re-install as warm hits),
+        preemption victims swap out (and resume install-exact).
+        None reads ``MXNET_SERVE_TIER_BYTES`` (off unless set); 0
+        disables — the engine then behaves bit-identically to round
+        17 (drop on pressure, recompute on resume).
     rid_start : first request id this engine assigns (a cluster gives
         each replica a disjoint block so rids — and their trace
         swimlanes — are unique cluster-wide).
@@ -648,7 +745,8 @@ class ServingEngine:
                  num_pages=None, pages_per_slot=None, prefill_chunk=8,
                  kv_int8=False, prefix_cache=False, metrics=None,
                  registry=None, rid_start=0, kernel="xla", spec_K=0,
-                 spec_drafter="ngram", spec_ngram=2, tp=1, mesh=None):
+                 spec_drafter="ngram", spec_ngram=2, tp=1, mesh=None,
+                 tier_bytes=None):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -744,10 +842,24 @@ class ServingEngine:
         self.cache = PagedKVCache(cfg, num_pages, page_size,
                                   kv_int8=self.kv_int8,
                                   mesh=self.mesh)
+        # host-DRAM KV tier (round 18): explicit argument >
+        # MXNET_SERVE_TIER_BYTES env > off.  0/None disables — every
+        # pre-tier behavior (drop on pressure, recompute on resume)
+        # is preserved bit for bit with the tier off.
+        if tier_bytes is None:
+            env = os.environ.get("MXNET_SERVE_TIER_BYTES", "")
+            try:
+                tier_bytes = int(env) if env else 0
+            except ValueError:
+                raise ValueError(
+                    "MXNET_SERVE_TIER_BYTES=%r: expected int" % env)
+        self.tier = HostTierStore(tier_bytes) if tier_bytes else None
         # shared-prefix page reuse (round 10): content-keyed trie over
-        # the pool; the allocator's pressure callback evicts
-        # refcount-0 chains before ever refusing a live request
-        self.prefix = PrefixCache(self.cache) if prefix_cache else None
+        # the pool; the allocator's pressure callback evicts (round
+        # 18: spills) refcount-0 chains before ever refusing a live
+        # request
+        self.prefix = PrefixCache(self.cache, tier=self.tier) \
+            if prefix_cache else None
         if self.prefix is not None:
             self.cache.pressure_cb = self.prefix.evict
         self._copy_fn = None              # jitted COW page copy
@@ -774,6 +886,7 @@ class ServingEngine:
                       "dead_rows": 0, "peak_pages": 0,
                       "prefix_hit_tokens": 0, "cow_copies": 0,
                       "spec_drafted": 0, "spec_accepted": 0,
+                      "swap_outs": 0, "swap_ins": 0,
                       "slot_occupancy_sum": 0.0}
         if metrics is None:
             # an explicitly supplied registry is a request for
@@ -903,6 +1016,10 @@ class ServingEngine:
             return
         if req.state == "queued":
             self._queue.remove(req)
+            if self.tier is not None:
+                # a swapped-out victim cancelled while queued must
+                # not squat in the host tier until LRU age-out
+                self.tier.drop(("swap", rid))
         elif req.state == "running":
             self._release(req)
         req.state = "cancelled"
@@ -944,7 +1061,36 @@ class ServingEngine:
                    if r is not None and r is not req]
         if not victims:
             return False
-        victim = max(victims, key=lambda r: r.rid)
+        self._preempt_victim(max(victims, key=lambda r: r.rid))
+        return True
+
+    def _preempt_victim(self, victim):
+        """Evict ``victim`` from its slot and requeue it at the front.
+        With a host tier the victim's written pages are SWAPPED OUT
+        first — the exact pool bytes of positions ``[0, n_cached)``
+        move to host DRAM and resume becomes install-exact instead of
+        recompute-exact (O(transfer), not O(prefill)).  The export
+        must precede ``_release`` so the pages are captured before
+        the free list reclaims them for the very allocation that
+        forced this preemption.  A refused swap (tier full/absent)
+        falls back to the round-7 recompute path; either resume is
+        bit-identical to an undisturbed run under f32 greedy."""
+        swapped = False
+        if self.tier is not None and victim.n_cached > 0:
+            n = -(-victim.n_cached // self.page_size)
+            if n * self.cache.bytes_per_page <= self.tier.budget_bytes:
+                # budget pre-check BEFORE the device gather: a victim
+                # the tier must refuse would otherwise pay a full
+                # export round trip per preemption just to throw the
+                # bytes away (the export layout is exactly the pool
+                # layout, so bytes_per_page predicts the refusal)
+                content = self.cache.export_pages(victim.pages[:n])
+                swapped = self.tier.put(
+                    ("swap", victim.rid), content, n,
+                    meta={"n_cached": victim.n_cached,
+                          "pending": victim.pending})
+            if swapped:
+                self.stats["swap_outs"] += 1
         self._release(victim)
         victim.state = "queued"
         victim.n_prefilled = 0
@@ -959,8 +1105,21 @@ class ServingEngine:
             if profiler.is_recording():
                 self._obs.trace.add_instant(
                     victim.rid, "preempt", now,
-                    args={"committed": len(victim.generated)})
-        return True
+                    args={"committed": len(victim.generated),
+                          "swapped": swapped})
+        return swapped
+
+    def preempt(self, rid):
+        """Force-preempt one RUNNING request through the standard
+        victim path (swap-out when a tier is attached) — the chaos /
+        benchmark / ops lever behind the swap-vs-recompute resume
+        measurement.  Returns True if the pages were swapped out,
+        False for a recompute-resume preemption."""
+        req = self.requests[rid]
+        if req.state != "running":
+            raise ValueError("preempt(%d): request is %s, not running"
+                             % (rid, req.state))
+        return self._preempt_victim(req)
 
     def _cow_page(self, src, dst):
         """Device-copy page ``src`` into ``dst`` across every layer
@@ -1010,6 +1169,12 @@ class ServingEngine:
                 return
             req = self._queue[0]
             inp = req.resume_input
+            if self.tier is not None:
+                swapped = self._swap_in(req, inp, free_slots[0])
+                if swapped == "admitted":
+                    continue
+                if swapped == "stall":
+                    return
             total = -(-min(inp.size + 1, self.max_seq)
                       // self.page_size)
             # shared-prefix match: map cached pages read-only, skip
@@ -1079,6 +1244,69 @@ class ServingEngine:
                     if req.generated:
                         self._obs.trace.add_instant(req.rid, "resume",
                                                     now)
+
+    def _swap_in(self, req, inp, slot):
+        """Install-exact resume (round 18): if ``req`` was preempted
+        with its pages swapped to the host tier, re-install the exact
+        pool bytes and resume at the saved ``(n_cached, pending)``
+        state — no re-prefill, O(transfer).  Returns ``"admitted"``
+        (slot taken, caller continues), ``"stall"`` (the pool cannot
+        hold the swap right now — admission stalls exactly like the
+        round-7 alloc-refused path, the tier entry is kept for the
+        next try), or ``"none"`` (no swap entry: the caller runs the
+        normal match/alloc admission — a swap LRU-evicted from the
+        tier degrades to recompute-exact, never to wrong)."""
+        entry = self.tier.peek(("swap", req.rid))
+        if entry is None:
+            return "none"
+        # same page coverage as the normal admission path: the
+        # chunked-prefill plan (a mid-prefill victim resumes its
+        # remaining prompt rows) assumes every input position's page
+        # already exists — the swapped pages are a PREFIX of that set
+        total = max(entry.n_pages,
+                    -(-min(inp.size + 1, self.max_seq)
+                      // self.page_size))
+        got = self.cache.alloc(total)
+        if got is None:
+            return "stall"
+        entry = self.tier.pop(("swap", req.rid))
+        if entry is None:
+            # evicted between peek and pop (the alloc's own pressure
+            # spills insert ahead of it; peek pinned recency, so this
+            # is a can't-fit-both corner): give the pages back and
+            # recompute
+            self.cache.free(got)
+            return "none"
+        self.cache.install_pages(got[:entry.n_pages], entry.content)
+        self._queue.pop(0)
+        req.pages = got
+        req.shared_pages = set()
+        req.prefix_entries = []
+        req.chain_upto = 0
+        req.slot = slot
+        req.state = "running"
+        req.n_cached = entry.meta["n_cached"]
+        req.pending = entry.meta["pending"]
+        # a decode-phase victim resumes fully prefilled; a victim
+        # caught mid-prefill (pending is None) continues its chunked
+        # prefill from the first unwritten position
+        req.n_prefilled = inp.size if req.pending is not None \
+            else req.n_cached
+        self._slots[slot] = req
+        self.stats["admitted"] += 1
+        self.stats["swap_ins"] += 1
+        if self._obs is not None:
+            now = time.perf_counter()
+            self._obs.admitted.inc()
+            self._obs.h_admission.observe((now - req.wait_start) * 1e3)
+            if profiler.is_recording():
+                self._obs.trace.add_span(
+                    req.rid, "admission_wait", req.wait_start, now)
+                self._obs.trace.add_instant(
+                    req.rid, "resume", now,
+                    args={"swap_in": True,
+                          "pages": len(req.pages)})
+        return "admitted"
 
     def _plan_speculation(self):
         """Phase-A speculation planning: for every running decode row
@@ -1368,6 +1596,9 @@ class ServingEngine:
             obs.sync_cache(self.cache)
             if self.prefix is not None:
                 obs.sync_prefix(self.prefix)
+            if self.tier is not None:
+                obs.sync_tier(self.tier, self.stats["swap_outs"],
+                              self.stats["swap_ins"])
             if tracing:
                 for rid in decode_rids:
                     obs.trace.add_span(rid, "decode", t_step0, now)
@@ -1422,7 +1653,18 @@ class ServingEngine:
             self.prefix.pages_inserted_total = 0
             self.prefix.pages_evicted_total = 0
             self.prefix.cow_total = 0
+            self.prefix.pages_spilled_total = 0
+            self.prefix.pages_restored_total = 0
+            self.prefix.warm_hits_total = 0
+            self.prefix.warm_hit_tokens_total = 0
             self._obs._prefix_seen = [0, 0, 0, 0, 0, 0]
+        if self.tier is not None:
+            self.tier.reset_telemetry()
+            self.stats["swap_outs"] = 0
+            self.stats["swap_ins"] = 0
+            self._obs._tier_seen = [0, 0, 0, 0]
+            self._obs._swap_seen = [0, 0]
+        self._obs._warm_seen = [0]
 
     def metrics(self):
         """JSON-able telemetry snapshot: this engine's counters/gauges,

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["PageStreamer", "PageReceiver", "pages_to_bufs",
-           "bufs_to_pages", "page_wire_bytes"]
+           "bufs_to_pages", "page_wire_bytes", "merge_page_content"]
 
 
 def _page_shapes(cfg, page_size, kv_int8):
@@ -89,6 +89,19 @@ def bufs_to_pages(cache, n: int, bufs: List):
 def page_wire_bytes(cache, n: int) -> int:
     """Bytes ``n`` pages cost on the wire (== their pool bytes)."""
     return n * cache.bytes_per_page
+
+
+def merge_page_content(parts: List) -> List:
+    """Concatenate several ``export_pages``-layout content blocks
+    along the page axis into one block (round 18: a fetch reply — or
+    a warm-hit restore — may mix device-exported hot pages with
+    host-tier pages; the consumer sees one contiguous page run
+    either way)."""
+    if len(parts) == 1:
+        return parts[0]
+    return [{k: np.concatenate([p[li][k] for p in parts])
+             for k in parts[0][li]}
+            for li in range(len(parts[0]))]
 
 
 class PageStreamer:

@@ -65,6 +65,29 @@ worst cost one failed fetch (the requester falls back to a cold
 prefill, still exact).  ``PrefixCache.evict_cb`` is the replica-side
 hook: pressure eviction of a chain entry reports its cumulative key
 so the router index never advertises pages that are gone.
+
+KV tiering (round 18, ROADMAP item 4): with a
+``serving/tier_store.py HostTierStore`` attached (``tier=``),
+pressure eviction SPILLS a refcount-0 chain entry's exact pool bytes
+to host DRAM instead of dropping them — the page returns to the free
+list, the content survives — and ``match`` gains a **warm hit**
+outcome between hot-hit and miss: a chain whose tail (or whole body)
+was spilled is re-installed through the bucketed donated scatter and
+the walk continues as if it had never left.  Spill order is the
+eviction order (LRU refcount-0 leaves first, children before
+parents), so the spilled set is always a contiguous chain TAIL and a
+warm restore can always re-link under its still-hot (or
+just-restored) parent.  A spilled entry leaves the trie dicts; its
+reachability bookkeeping lives in ``_spilled`` (cumulative chain key
+→ token block) + ``_spilled_children`` (parent key → child keys), so
+a tier-side LRU eviction of one spilled page drops exactly its
+now-unreachable spilled descendants and nothing else
+(``_on_tier_evict``).  ``tier_cb(key, tier)`` is the disaggregated
+replica's tier-transition hook — the router's
+:class:`ClusterPrefixIndex` keeps a per-key tier tag (``hbm`` /
+``host``) so spilled chains stay advertised (they are still
+peer-fetchable, served straight from the host tier) instead of being
+dropped from the cluster's knowledge.
 """
 from __future__ import annotations
 
@@ -119,7 +142,8 @@ class PrefixCache:
     its own engine AND its own prefix cache — shared-prefix prefill is
     paid once per replica, never cross-thread)."""
 
-    def __init__(self, cache, page_size: Optional[int] = None):
+    def __init__(self, cache, page_size: Optional[int] = None,
+                 tier=None):
         self.cache = cache
         self.page_size = page_size or cache.page_size
         # (parent_eid, block_bytes) -> _Entry
@@ -128,6 +152,19 @@ class PrefixCache:
         self._children: Dict[int, Dict[bytes, _Entry]] = {}
         self._eid = itertools.count(_ROOT_ID + 1)
         self._tick = itertools.count(1)
+        # host-DRAM page tier (round 18): pressure eviction spills
+        # refcount-0 chains here instead of dropping them; match()
+        # restores spilled tails as warm hits.  None = round-10
+        # drop-on-pressure behavior, bit for bit.
+        self.tier = tier
+        # cumulative chain key -> token block bytes of every SPILLED
+        # entry (reachability model of the host-tier content), plus
+        # the parent-key -> child-keys edges a tier eviction needs to
+        # drop exactly the unreachable descendants
+        self._spilled: Dict[bytes, bytes] = {}
+        self._spilled_children: Dict[bytes, Set[bytes]] = {}
+        if tier is not None:
+            tier.evict_cb = self._on_tier_evict
         # telemetry (host ints, delta-folded into the obs registry)
         self.lookups_total = 0
         self.lookup_tokens_total = 0
@@ -136,12 +173,25 @@ class PrefixCache:
         self.pages_inserted_total = 0
         self.pages_evicted_total = 0
         self.cow_total = 0
+        # tier movement (round 18; zero when tier is None)
+        self.pages_spilled_total = 0
+        self.pages_restored_total = 0
+        self.warm_hits_total = 0
+        self.warm_hit_tokens_total = 0
         # optional eviction hook (round 15, disaggregated serving):
         # called with the dropped entry's cumulative chain key so the
         # replica can report the eviction to the router's
         # ClusterPrefixIndex — the remote-protocol twin of what used
-        # to be an in-process refcount/eviction call
+        # to be an in-process refcount/eviction call.  With a tier
+        # attached it fires only when content is REALLY gone (spill
+        # refused, or tier LRU eviction); a spill/restore reports
+        # through tier_cb instead, because the chain is still
+        # fetchable from host DRAM.
         self.evict_cb = None
+        # optional tier-transition hook (round 18, disaggregated
+        # serving): tier_cb(chain_key, "host"|"hbm") on spill/restore
+        # so the router's index can re-tag instead of forgetting
+        self.tier_cb = None
 
     # ------------------------------------------------------ queries --
     @property
@@ -157,13 +207,32 @@ class PrefixCache:
         return sum(1 for e in self._by_key.values()
                    if e.refs == 0 and e.nchildren == 0)
 
+    @property
+    def spilled_pages(self) -> int:
+        """Chain entries currently living in the host tier (their
+        pool pages are freed; their bytes are one install away)."""
+        return len(self._spilled)
+
     # -------------------------------------------------------- match --
-    def match(self, tokens) -> Tuple[List[_Entry], List[int], int]:
+    def match(self, tokens,
+              restore: bool = True) -> Tuple[List[_Entry], List[int],
+                                             int]:
         """Longest cached chain for ``tokens``: full pages while the
-        trie matches, then at most one partially-matching child (its
-        page is valid through the last common token — the engine COWs
-        it before writing the first divergent one).  Takes one ref per
-        returned entry; the caller owns them until ``release()``.
+        trie matches, then — with a tier attached and ``restore=True``
+        — the consecutive SPILLED continuation re-installed from host
+        DRAM (the warm hit), then at most one partially-matching child
+        (its page is valid through the last common token — the engine
+        COWs it before writing the first divergent one).  Takes one
+        ref per returned entry; the caller owns them until
+        ``release()``.  ``restore=False`` (the fetch server's probe
+        path) walks hot entries only and never allocates.
+
+        Refs are taken AS the walk appends (not in one batch at the
+        end): the restore path allocates pool pages, and that
+        allocation's pressure callback evicts refcount-0 entries — an
+        already-matched entry must be pinned before the walk can
+        trigger pressure, or its page could be recycled out of the
+        returned chain.
 
         Returns ``(entries, pages, matched_tokens)``.
         """
@@ -173,17 +242,44 @@ class PrefixCache:
         pages: List[int] = []
         m = 0
         parent_id = _ROOT_ID
+        parent: Optional[_Entry] = None
         while m + ps <= tokens.size:
             e = self._by_key.get(
                 (parent_id, tokens[m:m + ps].tobytes()))
             if e is None:
                 break
+            e.refs += 1
             entries.append(e)
             pages.append(e.page)
             m += ps
             parent_id = e.eid
+            parent = e
+        if restore and self.tier is not None:
+            try:
+                restored = self._restore_run(tokens, m, parent)
+            except BaseException:
+                # the restore's alloc can raise through the pressure
+                # callback (the same edge round 12's py-ref-leak fix
+                # guards in _admit) — the refs this walk already took
+                # must not leak, or the chain stays pinned
+                # unevictable for the engine's lifetime
+                self.release(entries)
+                raise
+            for e in restored:
+                e.refs += 1
+                entries.append(e)
+                pages.append(e.page)
+                m += ps
+                parent_id = e.eid
+                parent = e
+            if restored:
+                self.warm_hits_total += 1
+                self.warm_hit_tokens_total += len(restored) * ps
         # partial page: the child sharing the longest token prefix
-        # with the remainder (ties broken arbitrarily)
+        # with the remainder (ties broken arbitrarily).  Spilled
+        # siblings are not consulted here — warm hits are whole-page
+        # granularity (a partial page would be COWed right back into
+        # private state, paying an install for at most ps-1 tokens).
         rem = tokens[m:]
         if rem.size > 0:
             best, best_n = None, 0
@@ -194,15 +290,142 @@ class PrefixCache:
                 if n > best_n:
                     best, best_n = e, n
             if best is not None:
+                best.refs += 1
                 entries.append(best)
                 pages.append(best.page)
                 m += best_n
         tick = next(self._tick)
         for e in entries:
-            e.refs += 1
             e.tick = tick
         self.lookups_total += 1
         return entries, pages, m
+
+    def _spilled_run(self, tokens, m: int) -> List[bytes]:
+        """Cumulative chain keys of the consecutive spilled entries
+        continuing ``tokens`` from token offset ``m`` (a multiple of
+        page_size — the end of the hot walk)."""
+        ps = self.page_size
+        run: List[bytes] = []
+        key = tokens[:m].tobytes()
+        while m + ps <= tokens.size:
+            key = key + tokens[m:m + ps].tobytes()
+            if key not in self._spilled:
+                break
+            run.append(key)
+            m += ps
+        return run
+
+    def _restore_run(self, tokens, m: int,
+                     parent: Optional[_Entry]) -> List[_Entry]:
+        """Warm hit: re-install the consecutive spilled continuation
+        of the hot walk (token offset ``m``, last hot entry
+        ``parent``) from the host tier into freshly-allocated pool
+        pages, re-linking the entries into the trie.  One batched
+        donated scatter installs the whole run.  Degrades page by
+        page: the pool may not cover the full run (alloc shrinks it),
+        and a key the tier LRU-evicted mid-flight truncates it —
+        either way the caller simply matches less."""
+        run = self._spilled_run(tokens, m)
+        if not run:
+            return []
+        got = None
+        while run:
+            got = self.cache.alloc(len(run))
+            if got is not None:
+                break
+            run.pop()
+        if not run:
+            return []
+        contents = []
+        for key in run:
+            e = self.tier.pop(("prefix", key))
+            if e is None:
+                break                     # evicted mid-flight: truncate
+            contents.append(e.content)
+        if len(contents) < len(run):
+            self.cache.free(got[len(contents):])
+            got = got[:len(contents)]
+            run = run[:len(contents)]
+            if not run:
+                return []
+        from .page_streamer import merge_page_content
+        try:
+            self.cache.install_pages(got, merge_page_content(contents))
+        except BaseException:
+            # the popped tier bytes are gone and the pool pages were
+            # never filled: give the pages back and retire the popped
+            # keys' reachability records (same semantics as a tier
+            # eviction — their descendants are unreachable too)
+            self.cache.free(got)
+            for key in run:
+                self._on_tier_evict(("prefix", key))
+            raise
+        out: List[_Entry] = []
+        ps = self.page_size
+        for key, page in zip(run, got):
+            blk = self._spilled.pop(key)
+            parent_key = key[:-4 * ps]
+            kids = self._spilled_children.get(parent_key)
+            if kids is not None:
+                kids.discard(key)
+                if not kids:
+                    del self._spilled_children[parent_key]
+            parent_id = parent.eid if parent is not None else _ROOT_ID
+            e = _Entry(next(self._eid), parent, blk, page)
+            e.tick = next(self._tick)
+            self._by_key[(parent_id, blk)] = e
+            self._children.setdefault(parent_id, {})[blk] = e
+            if parent is not None:
+                parent.nchildren += 1
+            out.append(e)
+            parent = e
+            self.pages_restored_total += 1
+            if self.tier_cb is not None:
+                self.tier_cb(key, "hbm")
+        return out
+
+    def probe_depth(self, tokens) -> Tuple[int, int]:
+        """Non-mutating depth probe: ``(hot_pages, warm_pages)`` of
+        the chain covering ``tokens`` — hot entries in the trie plus
+        the consecutive spilled continuation in the host tier.  Takes
+        no refs, restores nothing, allocates nothing (the
+        disaggregated submit path decides fetch-vs-local with this —
+        a remote fetch only wins when it covers strictly more than
+        local HBM + local host DRAM together)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        m = 0
+        parent_id = _ROOT_ID
+        while m + ps <= tokens.size:
+            e = self._by_key.get(
+                (parent_id, tokens[m:m + ps].tobytes()))
+            if e is None:
+                break
+            m += ps
+            parent_id = e.eid
+        hot = m // ps
+        warm = len(self._spilled_run(tokens, m)) \
+            if self.tier is not None else 0
+        return hot, warm
+
+    def spilled_content(self, tokens, from_page: int) -> List:
+        """Host-tier content blocks (one per page, ``export_pages``
+        layout) of the consecutive spilled chain continuing ``tokens``
+        from page index ``from_page`` — the fetch server's tail: a
+        spilled chain stays peer-fetchable WITHOUT any device work or
+        pool allocation on the serving side (the bytes go from host
+        DRAM straight onto the wire)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        out: List = []
+        if self.tier is None:
+            return out
+        for key in self._spilled_run(tokens, from_page
+                                     * self.page_size):
+            e = self.tier.get(("prefix", key))
+            if e is None:
+                break
+            out.append(e.content)
+        return out
 
     def release(self, entries: List[_Entry]):
         for e in entries:
@@ -247,8 +470,10 @@ class PrefixCache:
         out: List[Tuple[int, _Entry]] = []
         parent_id = _ROOT_ID
         parent: Optional[_Entry] = None
+        key_acc = b""
         for j in range(upto_page):
             blk = tokens[j * ps:(j + 1) * ps].tobytes()
+            key_acc = key_acc + blk
             key = (parent_id, blk)
             e = self._by_key.get(key)
             if e is None:
@@ -266,15 +491,38 @@ class PrefixCache:
                     parent.nchildren += 1
                 self.pages_inserted_total += 1
                 out.append((j, e))
+                if self._spilled.pop(key_acc, None) is not None:
+                    # a freshly-donated hot page SHADOWS a spilled
+                    # twin (the chain was spilled, then recomputed by
+                    # a request the match could not serve warm — e.g.
+                    # the tier entry arrived mid-admission): the warm
+                    # walk would never reach the spilled copy again,
+                    # so keep the hot one and release the tier bytes
+                    self.tier.drop(("prefix", key_acc))
+                    pk = key_acc[:-4 * ps]
+                    kids = self._spilled_children.get(pk)
+                    if kids is not None:
+                        kids.discard(key_acc)
+                        if not kids:
+                            del self._spilled_children[pk]
+                    if self.tier_cb is not None:
+                        # the content is hot again — without this the
+                        # router's index tag would stay 'host' forever
+                        # (report_insert ignores already-owned keys)
+                        self.tier_cb(key_acc, "hbm")
             parent_id = e.eid
             parent = e
         return out
 
     # ----------------------------------------------------- eviction --
-    def evict(self, n: int) -> int:
+    def evict(self, n: int, spill: bool = True) -> int:
         """Free up to ``n`` pages back to the pool by retiring LRU
         refcount-0 leaf entries (the ``PagedKVCache`` pressure
-        callback).  Returns how many pages were actually freed.
+        callback).  With a tier attached (and ``spill=True``) each
+        victim's exact pool bytes move to host DRAM first — the page
+        is reclaimed either way, but the content survives one install
+        away instead of one prefill away.  Returns how many pages
+        were actually freed.
 
         The victim search is a linear scan per page freed — acceptable
         because entries are bounded by the page pool (hundreds, not
@@ -290,9 +538,31 @@ class PrefixCache:
                     victim = e
             if victim is None:
                 break
-            self._drop(victim)
+            self._drop(victim, spill=spill)
             freed += 1
         return freed
+
+    def spill(self, n: Optional[int] = None) -> int:
+        """Proactively spill up to ``n`` (default: all) refcount-0
+        chains to the host tier WITHOUT pool pressure — the benchmark
+        and test hook for deterministic tier population (and an ops
+        lever: pre-drain HBM ahead of an expected admission wave).
+        Returns pages spilled; entries whose spill the tier refuses
+        stay hot (this is not an eviction)."""
+        if self.tier is None:
+            return 0
+        spilled = 0
+        budget = len(self._by_key) if n is None else n
+        while spilled < budget:
+            victim = None
+            for e in self._by_key.values():
+                if e.refs == 0 and e.nchildren == 0 and (
+                        victim is None or e.tick < victim.tick):
+                    victim = e
+            if victim is None or not self._spill_entry(victim):
+                break
+            spilled += 1
+        return spilled
 
     def chain_key(self, e: _Entry) -> bytes:
         """The entry's cumulative content key — the same bytes
@@ -305,9 +575,9 @@ class PrefixCache:
             node = node.parent
         return b"".join(reversed(blocks))
 
-    def _drop(self, e: _Entry):
-        if self.evict_cb is not None:
-            self.evict_cb(self.chain_key(e))
+    def _unlink(self, e: _Entry):
+        """Remove ``e`` from the trie dicts and return its page to
+        the pool (shared by the drop and spill paths)."""
         parent_id = e.parent.eid if e.parent is not None else _ROOT_ID
         del self._by_key[(parent_id, e.block)]
         kids = self._children.get(parent_id)
@@ -318,13 +588,105 @@ class PrefixCache:
         if e.parent is not None:
             e.parent.nchildren -= 1
         self.cache.free([e.page])
+
+    def _spill_entry(self, e: _Entry) -> bool:
+        """Move one refcount-0 leaf entry's page to the host tier:
+        export the exact pool bytes, record reachability, unlink, free
+        the page.  The export MUST precede the free — the freed page
+        re-enters the pool immediately and the very allocation whose
+        pressure triggered this spill will scatter new content into
+        it (the round-18 ``_drop`` ordering fix; pinned by the
+        mid-pressure spill regression test)."""
+        if self.tier is None:
+            return False
+        if self.cache.bytes_per_page > self.tier.budget_bytes:
+            # the tier would refuse anyway — skip the device gather
+            # (this runs inside the pressure callback; a wasted
+            # export here prices every allocation under pressure)
+            return False
+        key = self.chain_key(e)
+        content = self.cache.export_pages([e.page])
+        if not self.tier.put(("prefix", key), content, 1):
+            return False
+        self._spilled[key] = e.block
+        parent_key = key[:-4 * self.page_size]
+        self._spilled_children.setdefault(parent_key, set()).add(key)
+        self._unlink(e)
+        self.pages_spilled_total += 1
+        if self.tier_cb is not None:
+            self.tier_cb(key, "host")
+        return True
+
+    def _drop(self, e: _Entry, spill: bool = True):
+        """Retire one refcount-0 leaf entry under pressure: spill to
+        the host tier when possible, hard-drop otherwise.  Any page
+        BYTES the tier is to keep are captured before
+        ``cache.free`` reclaims the page (see ``_spill_entry``); the
+        eviction report — keys only, host state — goes out last."""
+        if spill and self._spill_entry(e):
+            return
+        key = self.chain_key(e)
+        self._unlink(e)
         self.pages_evicted_total += 1
+        # content really gone: unreachable spilled descendants (their
+        # restore path walks through this entry) go with it
+        self._drop_spilled_subtree(key)
+        if self.evict_cb is not None:
+            self.evict_cb(key)
+
+    def _drop_spilled_subtree(self, key: bytes):
+        """Drop every spilled descendant of chain ``key`` (the parent
+        content is gone, so no walk can ever reach them again),
+        reporting each as a real eviction."""
+        stack = list(self._spilled_children.pop(key, ()))
+        while stack:
+            k = stack.pop()
+            if self._spilled.pop(k, None) is None:
+                continue
+            self.tier.drop(("prefix", k))
+            self.pages_evicted_total += 1
+            if self.evict_cb is not None:
+                self.evict_cb(k)
+            stack.extend(self._spilled_children.pop(k, ()))
+
+    def _on_tier_evict(self, tier_key):
+        """The host tier LRU-dropped an entry.  Prefix keys lose
+        their reachability record and their (now-unreachable) spilled
+        descendants; swap keys need nothing — the engine's resume
+        path checks existence and falls back to recompute."""
+        if not (isinstance(tier_key, tuple) and len(tier_key) == 2
+                and tier_key[0] == "prefix"):
+            return
+        key = tier_key[1]
+        if self._spilled.pop(key, None) is None:
+            return
+        parent_key = key[:-4 * self.page_size]
+        kids = self._spilled_children.get(parent_key)
+        if kids is not None:
+            kids.discard(key)
+            if not kids:
+                self._spilled_children.pop(parent_key, None)
+        self.pages_evicted_total += 1
+        if self.evict_cb is not None:
+            self.evict_cb(key)
+        self._drop_spilled_subtree(key)
 
     def clear(self):
-        """Drop every refcount-0 chain (leaf-first); entries still
-        referenced by running requests survive."""
-        while self.evict(len(self._by_key)):
+        """Drop every refcount-0 chain (leaf-first) AND every spilled
+        record; entries still referenced by running requests survive.
+        Never spills — teardown/scale-down must return pool pages,
+        not convert them into host-tier churn (one device export per
+        page for content nobody will read)."""
+        while self.evict(len(self._by_key), spill=False):
             pass
+        for key in list(self._spilled):
+            self._spilled.pop(key, None)
+            if self.tier is not None:
+                self.tier.drop(("prefix", key))
+            self.pages_evicted_total += 1
+            if self.evict_cb is not None:
+                self.evict_cb(key)
+        self._spilled_children.clear()
 
 
 class ClusterPrefixIndex:
@@ -344,29 +706,46 @@ class ClusterPrefixIndex:
         self._mu = threading.Lock()
         self._owner: Dict[bytes, str] = {}
         self._by_owner: Dict[str, Set[bytes]] = {}
+        # per-key tier tag of the OWNER's copy (round 18): "hbm" =
+        # live in the owner's device pool, "host" = spilled to the
+        # owner's host-DRAM tier (still fetchable — the fetch server
+        # answers from the tier without a device round trip).  From a
+        # non-owner worker's seat every indexed copy is a PEER copy;
+        # the tag tells it — and the router's hint — what the fetch
+        # would cost on the owner's side.
+        self._tier: Dict[bytes, str] = {}
         self._cap = int(capacity)
         self.keys_inserted_total = 0
         self.keys_evicted_total = 0
+        self.keys_retagged_total = 0
         self.hints_total = 0
 
     def __len__(self):
         with self._mu:
             return len(self._owner)
 
-    def match(self, keys: List[bytes]) -> Tuple[Optional[str], int]:
+    def match(self, keys: List[bytes]) -> Tuple[Optional[str], int,
+                                                Optional[str]]:
         """Longest consecutive head of ``keys`` held by ONE replica:
-        returns ``(owner, depth_pages)`` (``(None, 0)`` on a cold
-        prefix).  Chains are cumulative, so a single owner covering
-        ``keys[:d]`` holds a contiguous chain from the root."""
+        returns ``(owner, depth_pages, tier)`` (``(None, 0, None)``
+        on a cold prefix).  Chains are cumulative, so a single owner
+        covering ``keys[:d]`` holds a contiguous chain from the root.
+        ``tier`` summarizes the owner-side cost of the whole matched
+        chain: ``"hbm"`` iff every matched key is device-resident,
+        ``"host"`` when any page must come off the owner's host
+        tier."""
         with self._mu:
             owner = self._owner.get(keys[0]) if keys else None
             if owner is None:
-                return None, 0
+                return None, 0, None
+            tier = self._tier.get(keys[0], "hbm")
             d = 1
             while d < len(keys) and self._owner.get(keys[d]) == owner:
+                if self._tier.get(keys[d], "hbm") == "host":
+                    tier = "host"
                 d += 1
             self.hints_total += 1
-            return owner, d
+            return owner, d, tier
 
     def report_insert(self, owner: str, keys: List[bytes]):
         with self._mu:
@@ -376,8 +755,24 @@ class ClusterPrefixIndex:
                     if len(self._owner) >= self._cap:
                         break             # bounded: stop indexing, not
                     self._owner[k] = owner  # serving
-                    mine.add(k)
+                    self._tier[k] = "hbm"   # fresh inserts are computed
+                    mine.add(k)             # pages in the pool
                     self.keys_inserted_total += 1
+
+    def report_tier(self, owner: str, keys: List[bytes], tier: str):
+        """A replica moved chains between its tiers (spill: hbm →
+        host; warm restore: host → hbm).  Only the owner may re-tag —
+        a non-owner's local copy is its own business, the index
+        describes the canonical one."""
+        if tier not in ("hbm", "host"):
+            raise ValueError("report_tier: tier must be 'hbm' or "
+                             "'host', got %r" % (tier,))
+        with self._mu:
+            for k in keys:
+                if self._owner.get(k) == owner \
+                        and self._tier.get(k) != tier:
+                    self._tier[k] = tier
+                    self.keys_retagged_total += 1
 
     def report_evict(self, owner: str, keys: List[bytes]):
         with self._mu:
@@ -385,6 +780,7 @@ class ClusterPrefixIndex:
             for k in keys:
                 if self._owner.get(k) == owner:
                     del self._owner[k]
+                    self._tier.pop(k, None)
                     mine.discard(k)
                     self.keys_evicted_total += 1
 
@@ -394,3 +790,4 @@ class ClusterPrefixIndex:
             for k in self._by_owner.pop(owner, set()):
                 if self._owner.get(k) == owner:
                     del self._owner[k]
+                    self._tier.pop(k, None)

@@ -1,0 +1,226 @@
+"""Host-DRAM KV page tier: spill instead of drop, install instead of
+recompute (round 18, ROADMAP item 4).
+
+At millions-of-users scale the useful prefix set dwarfs device memory.
+Before this round two things happened when HBM ran short: a
+refcount-0 prefix chain under pool pressure was simply DROPPED
+(``prefix_cache.evict`` → ``PagedKVCache.free``) and re-paid as a full
+prefill on the next hit, and a preemption victim's pages were
+discarded and re-paid as a full recompute at resume.  Both costs are
+O(prefill); the bytes they recompute already existed, byte-exact, in
+the pool the moment before.
+
+:class:`HostTierStore` is the second tier under the pool: a
+byte-budgeted LRU of **exact pool-layout page content** on the host —
+the same ``{"kv", ("s")}``-per-layer arrays
+``PagedKVCache.export_pages`` emits and ``install_pages`` consumes,
+which round 15 already made the cluster's unit of transfer.  Spilling
+a page is one bucketed device gather + a host copy; restoring it is
+one bucketed donated scatter — O(transfer) against O(prefill), the
+whole point.  Because the wire layout IS the pool layout, a spilled
+chain also stays peer-fetchable: the disaggregated fetch server
+answers sibling FETCH requests for spilled chains straight from this
+store, no device round trip at all (``cluster._serve_fetches``).
+
+Two entry families share the budget:
+
+* ``("prefix", chain_key)`` — one refcount-0 prefix-cache page,
+  spilled by ``PrefixCache._drop`` under pool pressure and restored by
+  ``PrefixCache.match`` as a **warm hit** (the new outcome between
+  hot-hit and miss).  The trie-structure bookkeeping (which spilled
+  keys are reachable) stays in ``PrefixCache``; this store only holds
+  bytes.
+* ``("swap", rid)`` — a preemption victim's written pages
+  (positions ``[0, n_cached)``) plus the tiny resume meta
+  (``n_cached``, ``pending``), swapped out by
+  ``ServingEngine._preempt_victim`` and swapped back in by ``_admit``
+  as an **install-exact** resume.  A swap entry LRU-evicted before the
+  victim resumes merely falls back to the round-7 recompute-exact
+  path — exactness never depends on the tier.
+
+Eviction is strict LRU over both families.  ``evict_cb(key)`` fires
+AFTER the entry has left the store (reentrancy-safe: the callback may
+``pop`` other keys — ``PrefixCache`` drops a spilled chain's
+now-unreachable descendants this way).  Everything here is plain host
+state on the owning engine's scheduling thread, same single-threaded
+contract as ``PrefixCache``; the only device work is in the caller's
+export/install calls, never in this module.
+
+Accounting is the allocator idiom: plain ints bumped on the host path
+(``spilled_pages_total`` …), delta-folded into the engine registry by
+``_EngineObs.sync_tier`` as the round-8 surface's
+``serving_tier_{spills,installs,bytes}_total`` counters and the
+tier-occupancy gauges.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["HostTierStore", "content_nbytes"]
+
+
+def content_nbytes(content) -> int:
+    """Host bytes of an ``export_pages``-layout content block (the
+    per-layer list of ``{"kv": array, ("s": array)}`` dicts)."""
+    return sum(np.asarray(a).nbytes
+               for layer in content for a in layer.values())
+
+
+class _TierEntry:
+    __slots__ = ("content", "n_pages", "nbytes", "meta")
+
+    def __init__(self, content, n_pages, nbytes, meta):
+        self.content = content            # export_pages layout (host)
+        self.n_pages = n_pages
+        self.nbytes = nbytes
+        self.meta: Optional[dict] = meta  # swap entries: resume state
+
+
+class HostTierStore:
+    """Byte-budgeted LRU of exact pool-layout page bytes in host DRAM.
+
+    ``put`` refuses (returns False) rather than evicting the world
+    when a single entry exceeds the whole budget; the caller then
+    falls back to the pre-tier behavior (drop / recompute).  ``get``
+    and ``peek`` touch LRU recency; ``pop`` removes.  All host-side,
+    single-threaded with the owning engine.
+    """
+
+    def __init__(self, budget_bytes: int,
+                 evict_cb: Optional[Callable[[Any], None]] = None):
+        if budget_bytes < 1:
+            raise ValueError("HostTierStore: budget_bytes must be "
+                             ">= 1 (use tier_bytes=None to disable "
+                             "the tier)")
+        self.budget_bytes = int(budget_bytes)
+        self.evict_cb = evict_cb
+        self._entries: "OrderedDict[Any, _TierEntry]" = OrderedDict()
+        # occupancy is maintained INCREMENTALLY at the five mutation
+        # sites: the engine's per-step gauge sync reads these on the
+        # hot scheduling thread, where an O(entries) scan would price
+        # every step by the tier's size
+        self.bytes_held = 0
+        self.pages_held = 0
+        # host ints, delta-folded into the obs registry (sync_tier)
+        self.spilled_pages_total = 0      # pages put (device -> host)
+        self.installed_pages_total = 0    # pages popped for install
+        self.bytes_moved_total = 0        # bytes through, both ways
+        self.evicted_pages_total = 0      # pages LRU-dropped
+        self.evictions_total = 0          # entries LRU-dropped
+
+    # ------------------------------------------------------ queries --
+    def __len__(self):
+        return len(self._entries)
+
+    def __contains__(self, key):
+        return key in self._entries
+
+    # ------------------------------------------------------- put/get --
+    def put(self, key, content, n_pages: int,
+            meta: Optional[dict] = None) -> bool:
+        """Admit one entry, LRU-evicting until it fits.  Returns False
+        (nothing stored, nothing evicted) when the entry alone
+        overflows the budget — the caller keeps the pre-tier drop/
+        recompute behavior.  Re-putting a live key replaces it."""
+        nbytes = content_nbytes(content)
+        if nbytes > self.budget_bytes:
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes_held -= old.nbytes
+            self.pages_held -= old.n_pages
+        while self.bytes_held + nbytes > self.budget_bytes \
+                and self._entries:
+            self._evict_lru()
+        self._entries[key] = _TierEntry(content, int(n_pages), nbytes,
+                                        meta)
+        self.bytes_held += nbytes
+        self.pages_held += int(n_pages)
+        self.spilled_pages_total += int(n_pages)
+        self.bytes_moved_total += nbytes
+        return True
+
+    def peek(self, key) -> Optional[_TierEntry]:
+        """Entry without install accounting; touches LRU recency (a
+        peeked entry is about to be used — ``_admit`` peeks before it
+        can afford the pool pages, and the pressure spills that alloc
+        triggers must not evict the entry being resumed)."""
+        e = self._entries.get(key)
+        if e is not None:
+            self._entries.move_to_end(key)
+        return e
+
+    def get(self, key) -> Optional[_TierEntry]:
+        """Entry for a host-side read (peer fetch service): touches
+        recency and counts the bytes as moved, entry stays stored."""
+        e = self.peek(key)
+        if e is not None:
+            self.bytes_moved_total += e.nbytes
+        return e
+
+    def pop(self, key) -> Optional[_TierEntry]:
+        """Remove and return an entry for install (host -> device);
+        None if missing (evicted meanwhile — callers degrade to the
+        pre-tier path)."""
+        e = self._entries.pop(key, None)
+        if e is None:
+            return None
+        self.bytes_held -= e.nbytes
+        self.pages_held -= e.n_pages
+        self.installed_pages_total += e.n_pages
+        self.bytes_moved_total += e.nbytes
+        return e
+
+    def drop(self, key) -> bool:
+        """Remove without install accounting (the content is being
+        discarded, not moved: a cancelled swap, an unreachable spilled
+        descendant)."""
+        e = self._entries.pop(key, None)
+        if e is None:
+            return False
+        self.bytes_held -= e.nbytes
+        self.pages_held -= e.n_pages
+        return True
+
+    # ----------------------------------------------------- eviction --
+    def _evict_lru(self):
+        key, e = self._entries.popitem(last=False)
+        self.bytes_held -= e.nbytes
+        self.pages_held -= e.n_pages
+        self.evicted_pages_total += e.n_pages
+        self.evictions_total += 1
+        if self.evict_cb is not None:
+            # AFTER removal so the callback may pop()/drop() other
+            # keys (a spilled chain's descendants) reentrantly
+            self.evict_cb(key)
+
+    def clear(self):
+        """Drop everything without eviction callbacks (engine
+        teardown; the trie bookkeeping is being dropped wholesale by
+        the same caller)."""
+        self._entries.clear()
+        self.bytes_held = 0
+        self.pages_held = 0
+
+    def reset_telemetry(self):
+        """Zero the movement counters (warmup exclusion in benches;
+        held entries and occupancy are untouched)."""
+        self.spilled_pages_total = 0
+        self.installed_pages_total = 0
+        self.bytes_moved_total = 0
+        self.evicted_pages_total = 0
+        self.evictions_total = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries),
+                "pages_held": self.pages_held,
+                "bytes_held": self.bytes_held,
+                "budget_bytes": self.budget_bytes,
+                "spilled_pages_total": self.spilled_pages_total,
+                "installed_pages_total": self.installed_pages_total,
+                "bytes_moved_total": self.bytes_moved_total,
+                "evicted_pages_total": self.evicted_pages_total,
+                "evictions_total": self.evictions_total}

@@ -80,6 +80,10 @@ _SLOW_GROUPS = {
     # because the scenarios pace themselves on the wall clock and
     # replica-thread scheduling jitter must not squeeze f/h)
     "test_serving_traffic": "k",
+    # group l: ~2min — round-18 KV tiering (scripted pressure/spill
+    # scenarios over tight pools; own group so the per-test engine
+    # compiles never squeeze d/f)
+    "test_serving_tier": "l",
 }
 
 

@@ -52,6 +52,12 @@ class FixRouter:
         # clean: the worker's fine arm fences the gen properly
         self.conn.send("fine", {"rid": 7, "gen": 2})
 
+    def send_retag(self):
+        # clean shape mirroring the round-18 `tier` kind: a genless
+        # absolute-state broadcast whose handler reads every key this
+        # site sets (keys + tier) — the schema rule must stay silent
+        self.conn.send("retag", {"keys": [b"k"], "tier": "host"})
+
     def send_requests(self):
         # ping_req's reply path may raise before the reply;
         # echo_req's is the pragma'd twin
@@ -91,6 +97,11 @@ class FixWorker:
             if meta["gen"] < self._fenced.get(meta["rid"], -1):
                 return                # clean: fenced before mutating
             self.state[meta["rid"]] = "ok"
+        elif kind == "retag":
+            # clean: absolute per-key state, no gen to fence (a stale
+            # retag is self-correcting — the round-18 `tier` shape)
+            for k in meta["keys"]:
+                self.state[k] = meta["tier"]
         elif kind == "ghost":
             # fires proto-unknown-kind: no peer ever sends it
             pass

@@ -1,8 +1,10 @@
 """Deterministic interleaving explorer over the serving cluster
 (ISSUE 7, dynamic half).  Slow tier, group h.
 
-The sweep runs >= 200 seeded schedules (5 scripted workloads x 2
-strategies x 20 seeds) through ``tools.analysis.interleave``: every
+The sweep runs >= 200 seeded schedules (6 scripted workloads x 2
+strategies x 20 seeds = 240; round 18 added the tier workload — spill
+racing match racing preemption) through
+``tools.analysis.interleave``: every
 schedule serializes the cluster's threads onto one runnable-at-a-time
 order chosen by the seed, and asserts the same invariants the static
 pass reasons about —
@@ -25,7 +27,7 @@ import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
 
 from tools.analysis.interleave import DeadlockError, run_schedule
 
-SEEDS = 20          # per (workload, strategy) cell; 5 * 2 * 20 = 200
+SEEDS = 20          # per (workload, strategy) cell; 6 * 2 * 20 = 240
 MODES = ("random", "preempt")
 
 
@@ -222,12 +224,66 @@ def wl_prefix_cow(params, cfg, ref):
         cl.close(timeout=60)
 
 
+def wl_tier_spill(params, cfg, ref):
+    """Round 18: spill racing match racing preemption.  One replica,
+    a pool tight enough that concurrent fillers force pressure spills
+    of the cached chain to the host tier WHILE a duplicate prompt
+    re-matches it (warm restore) and slot contention preempts
+    (swap-out → install-exact resume).  Whatever the schedule
+    interleaves — spill-then-match, match-then-spill, preempt in
+    between — every output is exact and nothing leaks (pages, refs,
+    or tier bytes for retired swaps)."""
+    from mxnet_tpu.serving import ServingCluster
+    from mxnet_tpu.serving import cluster as cluster_mod
+    rng = np.random.RandomState(2)
+    pa = rng.randint(1, 90, 16).astype(np.int32)     # 4 full pages
+    fills = [rng.randint(1, 90, 12).astype(np.int32)
+             for _ in range(3)]
+    cl = ServingCluster(params, cfg, replicas=1, num_slots=2,
+                        page_size=4, prefill_chunk=6,
+                        pages_per_slot=6, num_pages=11,
+                        tier_bytes=1 << 20)
+    try:
+        assert cl.replicas[0].engine.tier is not None
+        ra = cl.submit(pa, 4)
+        np.testing.assert_array_equal(cl.result(ra, timeout=300),
+                                      ref(pa, 4))
+        rids = []
+
+        def filler():
+            # pressure: each filler wants 4 pages of the 10-usable
+            # pool while pa's 4-page chain sits cached refcount-0 —
+            # the spills race the warm re-match below
+            for f in fills:
+                rids.append((cl.submit(f, 4), f, 4))
+
+        th = cluster_mod.threading.Thread(target=filler,
+                                          name="tier-filler")
+        th.start()
+        rb = cl.submit(pa, 4)            # re-match: hot, warm, or cold
+        np.testing.assert_array_equal(cl.result(rb, timeout=300),
+                                      ref(pa, 4))
+        th.join(300)
+        for rid, f, n in rids:
+            np.testing.assert_array_equal(cl.result(rid, timeout=300),
+                                          ref(f, n))
+        _check_refcounts(cl)
+        eng = cl.replicas[0].engine
+        # retired/cancelled requests must not squat swap entries
+        assert not any(isinstance(k, tuple) and k[0] == "swap"
+                       for k in eng.tier._entries), \
+            "stale swap entries after drain"
+    finally:
+        cl.close(timeout=60)
+
+
 WORKLOADS = {
     "burst": wl_submit_burst,
     "failover": wl_failover,
     "drain": wl_drain_while_submitting,
     "ttl": wl_ttl_expiry,
     "cow": wl_prefix_cow,
+    "tier": wl_tier_spill,
 }
 
 

@@ -230,23 +230,53 @@ def test_cluster_prefix_index_semantics():
     from mxnet_tpu.serving import ClusterPrefixIndex
     idx = ClusterPrefixIndex()
     k = [b"a", b"ab", b"abc"]
-    assert idx.match(k) == (None, 0)
+    assert idx.match(k) == (None, 0, None)
     idx.report_insert("p0", k[:2])
-    assert idx.match(k) == ("p0", 2)
+    assert idx.match(k) == ("p0", 2, "hbm")
     # first-inserter-wins: p1's duplicate insert does not steal keys
     idx.report_insert("p1", k)
-    assert idx.match(k) == ("p0", 2)      # k[2] now p1's, but chain
+    assert idx.match(k) == ("p0", 2, "hbm")  # k[2] now p1's, but chain
     # eviction only by the owner
     idx.report_evict("p1", [k[0]])
-    assert idx.match(k) == ("p0", 2)
+    assert idx.match(k) == ("p0", 2, "hbm")
     idx.report_evict("p0", [k[0]])
-    assert idx.match(k) == (None, 0)      # chain head gone
+    assert idx.match(k) == (None, 0, None)   # chain head gone
     # a dead replica's keys drop wholesale
     idx.report_insert("p0", k)
     idx.drop_owner("p0")
-    owner, d = idx.match(k)
+    owner, d, _ = idx.match(k)
     assert owner in (None, "p1")          # p1 still owns k[2] only
-    assert idx.match([k[2]]) == ("p1", 1)
+    assert idx.match([k[2]]) == ("p1", 1, "hbm")
+
+
+def test_cluster_prefix_index_tier_tags():
+    """Round 18: per-key tier tags — only the owner may re-tag, a
+    chain with any host-tier page summarizes as 'host', eviction and
+    owner death clear the tags."""
+    from mxnet_tpu.serving import ClusterPrefixIndex
+    idx = ClusterPrefixIndex()
+    k = [b"a", b"ab", b"abc"]
+    idx.report_insert("p0", k)
+    assert idx.match(k) == ("p0", 3, "hbm")
+    # leaf spilled: the chain summary flips to host
+    idx.report_tier("p0", [k[2]], "host")
+    assert idx.match(k) == ("p0", 3, "host")
+    assert idx.match(k[:2]) == ("p0", 2, "hbm")
+    # a non-owner's re-tag is ignored
+    idx.report_tier("p1", [k[0]], "host")
+    assert idx.match(k[:1]) == ("p0", 1, "hbm")
+    # warm restore re-tags back
+    idx.report_tier("p0", [k[2]], "hbm")
+    assert idx.match(k) == ("p0", 3, "hbm")
+    assert idx.keys_retagged_total == 2
+    # a real eviction clears key AND tag; a later insert is hbm again
+    idx.report_tier("p0", [k[2]], "host")
+    idx.report_evict("p0", [k[2]])
+    idx.report_insert("p0", [k[2]])
+    assert idx.match(k) == ("p0", 3, "hbm")
+    import pytest
+    with pytest.raises(ValueError):
+        idx.report_tier("p0", [k[0]], "warm")
 
 
 def test_admit_prefilled_adopts_handoff_exactly():

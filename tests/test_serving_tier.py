@@ -1,0 +1,599 @@
+"""Hierarchical KV tiering (round 18, ISSUE 13): spill instead of
+drop, install instead of recompute.  Slow tier, group l (the fast
+``HostTierStore`` unit tests carry no marker).
+
+Pins:
+
+* spill → warm-hit reinstall is BIT-identical to ``generate`` (f32),
+  including int8-KV scale pages;
+* swap-out preemption resume is install-exact and bit-identical, for
+  decode-phase and mid-prefill victims, f32 and int8-KV;
+* the host tier's byte-budget LRU actually enforces (evicted spills
+  degrade to cold — exact either way) and tier eviction of a chain
+  page drops exactly its unreachable spilled descendants;
+* zero leaked pages/refs/tier entries across
+  spill → tier-evict → reinstall cycles;
+* the ``_drop`` ordering fix: a mid-pressure spill captures page
+  bytes BEFORE the free list recycles the page, so the tier copy
+  never reads pages the triggering allocation already overwrote;
+* the peer-fetch serving path: a spilled chain ships from host DRAM
+  (``spilled_content`` + ``merge_page_content``) and grafts into a
+  sibling engine bit-exactly — the in-process twin of the disagg
+  FETCH path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
+
+
+def _cfg(**kw):
+    from mxnet_tpu.models import gpt
+    base = dict(use_flash=False, remat=False, dropout=0.0,
+                dtype="float32", vocab_size=128, max_len=64)
+    base.update(kw)
+    return gpt.gpt_tiny(**base)
+
+
+def _ref(params, cfg, prompt, n, **kw):
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    return np.asarray(
+        gpt.generate(params, cfg, jnp.asarray(prompt)[None], n,
+                     **kw))[0]
+
+
+def _engine(params, cfg, tier_bytes=1 << 22, **kw):
+    from mxnet_tpu.serving import ServingEngine
+    base = dict(num_slots=2, page_size=4, prefill_chunk=6,
+                pages_per_slot=8, prefix_cache=True,
+                tier_bytes=tier_bytes)
+    base.update(kw)
+    return ServingEngine(params, cfg, **base)
+
+
+# ---------------------------------------------------------------------------
+# HostTierStore unit tests (host-only: FAST tier)
+# ---------------------------------------------------------------------------
+def _content(n_pages=1, fill=0, nbytes_per_page=64):
+    return [{"kv": np.full((n_pages, nbytes_per_page), fill,
+                           np.int8)}]
+
+
+def test_tier_store_lru_budget_enforced():
+    from mxnet_tpu.serving import HostTierStore
+    st = HostTierStore(budget_bytes=3 * 64)
+    assert st.put("a", _content(fill=1), 1)
+    assert st.put("b", _content(fill=2), 1)
+    assert st.put("c", _content(fill=3), 1)
+    assert st.bytes_held == 3 * 64 and len(st) == 3
+    # d evicts the LRU (a)
+    assert st.put("d", _content(fill=4), 1)
+    assert "a" not in st and st.bytes_held == 3 * 64
+    assert st.evictions_total == 1 and st.evicted_pages_total == 1
+    # touching b protects it: e evicts c, not b
+    assert st.peek("b") is not None
+    assert st.put("e", _content(fill=5), 1)
+    assert "b" in st and "c" not in st
+    # a single entry over the whole budget is refused outright
+    assert not st.put("big", _content(n_pages=4), 4)
+    assert "big" not in st and len(st) == 3
+    # pop accounts installs; drop does not
+    e = st.pop("b")
+    assert e.content[0]["kv"][0, 0] == 2
+    assert st.installed_pages_total == 1
+    held = st.bytes_held
+    assert st.drop("d") and st.bytes_held == held - 64
+    assert st.installed_pages_total == 1
+
+
+def test_tier_store_evict_cb_reentrant():
+    """The eviction callback may pop OTHER keys (the prefix cache
+    drops unreachable spilled descendants this way) — the LRU loop
+    must survive the reentrant mutation."""
+    from mxnet_tpu.serving import HostTierStore
+    st = HostTierStore(budget_bytes=4 * 64)
+    dropped = []
+
+    def cb(key):
+        dropped.append(key)
+        st.pop("child-of-%s" % key)       # reentrant removal
+
+    st.evict_cb = cb
+    st.put("r", _content(), 1)
+    st.put("child-of-r", _content(), 1)
+    st.put("x", _content(), 1)
+    st.put("y", _content(), 1)
+    # over budget: evicts "r"; its callback pops "child-of-r" too
+    st.put("z", _content(n_pages=2), 2)
+    assert dropped == ["r"]
+    assert "child-of-r" not in st
+    assert st.bytes_held == sum(e.nbytes
+                                for e in st._entries.values())
+
+
+def test_tier_store_replace_and_meta():
+    from mxnet_tpu.serving import HostTierStore
+    st = HostTierStore(budget_bytes=1 << 12)
+    st.put(("swap", 7), _content(fill=1), 1, meta={"n_cached": 5})
+    st.put(("swap", 7), _content(n_pages=2, fill=2), 2,
+           meta={"n_cached": 9})
+    assert len(st) == 1
+    e = st.pop(("swap", 7))
+    assert e.meta["n_cached"] == 9 and e.n_pages == 2
+    assert st.bytes_held == 0
+
+
+# ---------------------------------------------------------------------------
+# engine-level tiering (slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_int8", [False, True],
+                         ids=["f32", "int8kv"])
+def test_spill_reinstall_bit_identity(kv_int8):
+    """A cached chain spilled to the host tier and warm-restored
+    serves the duplicate prompt bit-identically to ``generate`` —
+    int8-KV moves its f32 scale pages losslessly too."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(0)
+    eng = _engine(params, cfg, kv_int8=kv_int8)
+    prompt = rng.randint(1, 90, 16).astype(np.int32)  # 4 full pages
+    r1 = eng.submit(prompt, 5)
+    out1 = eng.run()[r1]
+    hot_pages = eng.prefix.cached_pages
+    assert hot_pages == 4
+    assert eng.prefix.spill() == 4
+    assert eng.prefix.cached_pages == 0
+    assert eng.prefix.spilled_pages == 4
+    assert eng.cache.pages_in_use == 0                # pool drained
+    assert eng.tier.pages_held == 4
+    r2 = eng.submit(prompt, 5)
+    out2 = eng.run()[r2]
+    np.testing.assert_array_equal(out1, out2)
+    if not kv_int8:
+        np.testing.assert_array_equal(out2, _ref(params, cfg,
+                                                 prompt, 5))
+    # the warm hit restored through the tier, not a recompute
+    assert eng.prefix.pages_restored_total >= 3
+    assert eng.prefix.warm_hits_total == 1
+    assert eng.stats["prefix_hit_tokens"] > 0
+    # nothing leaked: pool pages are exactly the re-cached chain
+    assert eng.prefix.refs_total == 0
+    assert eng.cache.pages_in_use == eng.prefix.cached_pages
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_int8", [False, True],
+                         ids=["f32", "int8kv"])
+def test_swap_resume_exact(kv_int8):
+    """Preemption with the tier on: the victim's pages (and int8
+    scale pages) swap out, resume installs them back, and the final
+    output is bit-identical to the undisturbed oracle — for a
+    decode-phase victim and a mid-prefill victim."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(1)
+    oracle = {}
+
+    def check(eng, rid, prompt, n):
+        out = eng.requests[rid].output
+        key = (prompt.tobytes(), n)
+        if key not in oracle:
+            oracle[key] = _ref(params, cfg, prompt, n)
+        if not kv_int8:
+            np.testing.assert_array_equal(out, oracle[key])
+
+    # decode-phase victim
+    eng = _engine(params, cfg, kv_int8=kv_int8, prefix_cache=False)
+    prompt = rng.randint(1, 90, 13).astype(np.int32)
+    rid = eng.submit(prompt, 8)
+    req = eng.requests[rid]
+    while len(req.generated) < 3:
+        eng.step()
+    pre_preempt = list(req.generated)
+    assert eng.preempt(rid) is True       # swapped
+    assert eng.stats["swap_outs"] == 1
+    eng.run()
+    assert eng.stats["swap_ins"] == 1
+    # install-exact resume: the pre-preemption tokens were not
+    # recomputed, they were already committed; the continuation
+    # matches the oracle bit for bit
+    assert req.generated[:len(pre_preempt)] == pre_preempt
+    check(eng, rid, prompt, 8)
+    assert eng.cache.pages_in_use == 0
+    assert len(eng.tier._entries) == 0    # swap entry consumed
+
+    # mid-prefill victim (pending is None at preemption)
+    eng2 = _engine(params, cfg, kv_int8=kv_int8, prefix_cache=False,
+                   prefill_chunk=4)
+    long_p = rng.randint(1, 90, 17).astype(np.int32)
+    rid2 = eng2.submit(long_p, 4)
+    req2 = eng2.requests[rid2]
+    eng2.step()                           # partial prefill only
+    assert req2.pending is None and 0 < req2.n_cached < long_p.size
+    swapped = eng2.preempt(rid2)
+    assert swapped is True
+    eng2.run()
+    check(eng2, rid2, long_p, 4)
+    assert eng2.stats["swap_ins"] == 1
+    assert eng2.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_swap_entry_evicted_degrades_to_recompute():
+    """A swap entry LRU-aged out of the tier before resume: the
+    request falls back to the round-7 recompute path and stays
+    exact — the tier is a latency tier, never a correctness
+    dependency."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(2)
+    eng = _engine(params, cfg, prefix_cache=False)
+    prompt = rng.randint(1, 90, 9).astype(np.int32)
+    rid = eng.submit(prompt, 6)
+    req = eng.requests[rid]
+    while len(req.generated) < 2:
+        eng.step()
+    assert eng.preempt(rid) is True
+    # age the swap entry out behind the engine's back
+    assert eng.tier.pop(("swap", rid)) is not None
+    eng.run()
+    assert eng.stats["swap_ins"] == 0     # recompute path taken
+    np.testing.assert_array_equal(req.output,
+                                  _ref(params, cfg, prompt, 6))
+    assert eng.cache.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_tier_budget_partial_warm_hit_and_descendant_drop():
+    """A tier too small for the whole chain: the LRU keeps only the
+    newest spills, ``_on_tier_evict`` drops each evicted page's
+    now-unreachable spilled descendants, and the duplicate prompt
+    still completes exactly (partially warm or fully cold)."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(3)
+    eng = _engine(params, cfg)
+    prompt = rng.randint(1, 90, 16).astype(np.int32)  # 4 pages
+    r1 = eng.submit(prompt, 4)
+    out1 = eng.run()[r1]
+    page_bytes = eng.cache.bytes_per_page
+    # shrink the budget to TWO pages, then spill the 4-page chain:
+    # spills run leaf-first, so the two oldest spills (the deepest
+    # pages) are evicted as the shallower ones arrive — and because
+    # a chain restores root-first, every surviving key whose parent
+    # was evicted must be dropped as unreachable
+    eng.tier.budget_bytes = 2 * page_bytes
+    eng.prefix.spill()
+    # reachability invariant: every surviving spilled record's parent
+    # is reachable — root, itself spilled, or still hot in the trie
+    for key in eng.prefix._spilled:
+        parent = key[:-4 * eng.page_size]
+        if parent and parent not in eng.prefix._spilled:
+            hot, _ = eng.prefix.probe_depth(
+                np.frombuffer(key, np.int32))
+            assert hot * eng.page_size * 4 >= len(parent), \
+                "unreachable spilled key survived tier eviction"
+    assert eng.tier.bytes_held <= 2 * page_bytes
+    r2 = eng.submit(prompt, 4)
+    out2 = eng.run()[r2]
+    np.testing.assert_array_equal(out1, out2)
+    assert eng.prefix.refs_total == 0
+    assert eng.cache.pages_in_use == eng.prefix.cached_pages
+
+
+@pytest.mark.slow
+def test_spill_evict_reinstall_cycles_leak_nothing():
+    """Many spill → (tier-evict) → reinstall cycles across several
+    chains: refs, pool pages, spilled records, and tier bytes all
+    reconcile after every drain."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(4)
+    eng = _engine(params, cfg, num_pages=13, tier_bytes=1 << 20)
+    prompts = [rng.randint(1, 90, 8 + 4 * i).astype(np.int32)
+               for i in range(3)]
+    for cycle in range(4):
+        rids = [eng.submit(p, 3) for p in prompts]
+        eng.run()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                eng.requests[rid].output, _ref(params, cfg, p, 3))
+            del eng.requests[rid]
+        if cycle % 2 == 0:
+            eng.prefix.spill()
+        # invariants after every cycle
+        assert eng.prefix.refs_total == 0
+        assert eng.cache.pages_in_use == eng.prefix.cached_pages
+        assert eng.tier.pages_held == eng.prefix.spilled_pages
+        assert eng.tier.bytes_held == sum(
+            e.nbytes for e in eng.tier._entries.values())
+    # teardown path: clear() drops hot AND spilled without spilling
+    eng.prefix.clear()
+    assert eng.cache.pages_in_use == 0
+    assert eng.prefix.spilled_pages == 0
+    assert eng.tier.pages_held == 0
+
+
+@pytest.mark.slow
+def test_mid_pressure_spill_never_reads_recycled_pages():
+    """The ``_drop`` ordering fix (ISSUE 13 small fix): the spill
+    export happens BEFORE ``cache.free`` — the very allocation whose
+    pressure triggered the spill immediately recycles the freed page
+    and overwrites it, so an export-after-free would capture the NEW
+    request's bytes.  Pin: bytes in the tier after a mid-pressure
+    spill equal the chain's pre-spill export, and the later warm hit
+    is bit-exact."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(5)
+    # pool: 8 usable pages; chain A = 4 pages; request B needs all
+    # 8 — pressure must spill A's WHOLE chain and hand its recycled
+    # pages straight to B, whose prefill overwrites them this step
+    eng = _engine(params, cfg, num_pages=9, num_slots=1)
+    pa = rng.randint(1, 90, 16).astype(np.int32)
+    ra = eng.submit(pa, 3)
+    out_a = eng.run()[ra]
+    assert eng.prefix.cached_pages == 4
+    chain_pages = [e.page for e in eng.prefix._by_key.values()]
+    golden = eng.cache.export_pages(sorted(chain_pages))
+    pb = rng.randint(1, 90, 29).astype(np.int32)      # needs 8 pages
+    rb = eng.submit(pb, 3)
+    out_b = eng.run()[rb]
+    np.testing.assert_array_equal(out_b, _ref(params, cfg, pb, 3))
+    # the pressure spilled (not dropped) A's chain...
+    assert eng.prefix.pages_spilled_total == 4
+    assert eng.prefix.spilled_pages == 4
+    # ...and the tier copy carries the PRE-recycle bytes: walk the
+    # chain's content out of the tier and compare each page to the
+    # pre-spill export (golden rows are in sorted-page-id order;
+    # chain_pages[j] is chain position j's page id — _by_key keeps
+    # insertion order, which is root-to-leaf)
+    tier_run = eng.prefix.spilled_content(pa, 0)
+    assert len(tier_run) == 4
+    pos_of_page = {pg: i for i, pg in enumerate(sorted(chain_pages))}
+    for j, content in enumerate(tier_run):
+        gi = pos_of_page[chain_pages[j]]
+        for layer_t, layer_g in zip(content, golden):
+            for k in layer_t:
+                np.testing.assert_array_equal(
+                    layer_t[k][0], layer_g[k][gi],
+                    err_msg="spilled page %d captured recycled "
+                            "bytes" % j)
+    # and the warm hit replays exactly
+    r2 = eng.submit(pa, 3)
+    np.testing.assert_array_equal(eng.run()[r2], out_a)
+
+
+@pytest.mark.slow
+def test_spilled_chain_serves_peer_fetch_exactly():
+    """In-process twin of the disagg FETCH path for spilled chains:
+    engine A spills its chain, ``spilled_content`` ships the host
+    bytes (no pool allocation on A), engine B installs + grafts them
+    and serves the prompt bit-identically — while A's pool stays
+    untouched."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving.page_streamer import (
+        bufs_to_pages, merge_page_content, pages_to_bufs)
+    from mxnet_tpu.serving.prefix_cache import chain_keys
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(6)
+    a = _engine(params, cfg)
+    b = _engine(params, cfg)
+    prompt = rng.randint(1, 90, 16).astype(np.int32)
+    ra = a.submit(prompt, 4)
+    out_a = a.run()[ra]
+    a.prefix.spill()
+    in_use_before = a.cache.pages_in_use
+    # serve the fetch: hot head (none) + spilled tail, straight from
+    # host DRAM, through the same bufs codec the wire uses
+    entries, pages, m = a.prefix.match(prompt, restore=False)
+    assert m == 0 and not pages           # everything spilled
+    a.prefix.release(entries)
+    tail = a.prefix.spilled_content(prompt, 0)
+    assert len(tail) == 4
+    assert a.cache.pages_in_use == in_use_before  # no A-side alloc
+    bufs = pages_to_bufs(merge_page_content(tail))
+    # requester side: install + graft (the _fetch_remote body)
+    n = len(tail)
+    ids = b.cache.alloc(n)
+    b.cache.install_pages(ids, bufs_to_pages(b.cache, n, bufs))
+    created = b.prefix.insert_chain(prompt[:n * b.page_size], ids,
+                                    upto_page=n)
+    b.prefix.release([e for _, e in created])
+    rb = b.submit(prompt, 4)
+    out_b = b.run()[rb]
+    np.testing.assert_array_equal(out_a, out_b)
+    np.testing.assert_array_equal(out_b, _ref(params, cfg, prompt, 4))
+    assert b.stats["prefix_hit_tokens"] > 0
+    assert b.prefix.refs_total == 0
+
+
+@pytest.mark.slow
+def test_match_restore_exception_releases_refs():
+    """The warm-restore path allocates inside match(): an exception
+    through that alloc/install (the pressure callback can raise — the
+    same edge round 12's py-ref-leak fix guards in _admit) must
+    release every ref the walk already took and give back any pages
+    the restore allocated, or the chain pins unevictable forever."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(11)
+    eng = _engine(params, cfg)
+    # two chained prompts: a hot head + a spilled tail, so the match
+    # holds refs on the head when the tail restore blows up
+    pa = rng.randint(1, 90, 16).astype(np.int32)
+    r1 = eng.submit(pa, 4)
+    eng.run()
+    eng.prefix.spill()
+    r2 = eng.submit(pa[:8], 3)            # re-heat the chain head
+    eng.run()
+    h, w = eng.prefix.probe_depth(pa)
+    assert h >= 1 and w >= 1              # mixed hot+spilled chain
+    in_use = eng.cache.pages_in_use
+    orig = eng.cache.install_pages
+
+    def boom(*a, **k):
+        raise RuntimeError("injected install failure")
+
+    eng.cache.install_pages = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.prefix.match(pa)
+    finally:
+        eng.cache.install_pages = orig
+    assert eng.prefix.refs_total == 0, "match leaked refs on the " \
+        "restore exception edge"
+    assert eng.cache.pages_in_use == in_use, \
+        "restore leaked its allocated pages"
+    # the popped keys' records retired with their bytes: a re-match
+    # now serves the hot head and recomputes the tail — still exact
+    r3 = eng.submit(pa, 4)
+    np.testing.assert_array_equal(eng.run()[r3],
+                                  _ref(params, cfg, pa, 4))
+
+
+@pytest.mark.slow
+def test_shadowed_spill_retags_hbm():
+    """insert_chain dropping a spilled twin (the chain was recomputed
+    hot while its bytes sat in the tier) must fire tier_cb('hbm') —
+    otherwise the router's index tag stays 'host' forever, because
+    report_insert ignores keys it already owns."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.serving.prefix_cache import chain_keys
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(12)
+    eng = _engine(params, cfg)
+    moves = []
+    eng.prefix.tier_cb = lambda k, t: moves.append((k, t))
+    prompt = rng.randint(1, 90, 8).astype(np.int32)   # 2 pages
+    r1 = eng.submit(prompt, 3)
+    eng.run()
+    eng.prefix.spill()
+    keys = chain_keys(prompt, eng.page_size)
+    # spills run leaf-first, so the host re-tags arrive deepest-first
+    assert moves == [(k, "host") for k in reversed(keys)]
+    # recompute the chain hot via direct donation (the shadow branch:
+    # the spilled twins exist while the fresh pages insert)
+    pages = eng.cache.alloc(2)
+    created = eng.prefix.insert_chain(prompt, pages, upto_page=2)
+    assert len(created) == 2
+    assert moves[2:] == [(k, "hbm") for k in keys]
+    assert eng.prefix.spilled_pages == 0
+    assert eng.tier.pages_held == 0       # twins' bytes released
+    eng.prefix.release([e for _, e in created])
+
+
+@pytest.mark.slow
+def test_swap_over_budget_skips_export():
+    """A victim the tier must refuse (chain bytes > whole budget)
+    pays NO device export — the budget pre-check runs before the
+    gather — and the preemption degrades to recompute-exact."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(13)
+    eng = _engine(params, cfg, prefix_cache=False,
+                  tier_bytes=1)           # refuses everything
+    prompt = rng.randint(1, 90, 12).astype(np.int32)
+    rid = eng.submit(prompt, 6)
+    req = eng.requests[rid]
+    while len(req.generated) < 2:
+        eng.step()
+    calls = []
+    orig = eng.cache.export_pages
+    eng.cache.export_pages = lambda ids: calls.append(ids) or orig(ids)
+    try:
+        assert eng.preempt(rid) is False
+    finally:
+        eng.cache.export_pages = orig
+    assert calls == [], "over-budget swap still paid the export"
+    eng.run()
+    np.testing.assert_array_equal(req.output,
+                                  _ref(params, cfg, prompt, 6))
+
+
+@pytest.mark.slow
+def test_tier_metrics_reconcile():
+    """The round-8 surface: serving_tier_* counters/gauges reconcile
+    exactly against the store's own accounting after a scripted
+    spill/restore/swap sequence."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu import obs as O
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(7)
+    reg = O.MetricsRegistry()
+    eng = _engine(params, cfg, metrics=True, registry=reg)
+    prompt = rng.randint(1, 90, 12).astype(np.int32)
+    r1 = eng.submit(prompt, 4)
+    eng.run()
+    eng.prefix.spill()
+    r2 = eng.submit(prompt, 4)            # warm restore
+    eng.run()
+    rid = eng.submit(rng.randint(1, 90, 9).astype(np.int32), 6)
+    req = eng.requests[rid]
+    while len(req.generated) < 2:
+        eng.step()
+    eng.preempt(rid)                      # swap out
+    eng.run()                             # swap in + finish
+    snap = reg.snapshot()["counters"]
+    t = eng.tier
+    assert snap["serving_tier_spills_total"] == t.spilled_pages_total
+    assert snap["serving_tier_installs_total"] == \
+        t.installed_pages_total
+    assert snap["serving_tier_bytes_total"] == t.bytes_moved_total
+    assert snap["serving_swap_outs_total"] == 1
+    assert snap["serving_swap_ins_total"] == 1
+    assert snap["serving_prefix_warm_hit_tokens_total"] == \
+        eng.prefix.warm_hit_tokens_total > 0
+    g = reg.snapshot()["gauges"]
+    assert g["serving_tier_pages"] == t.pages_held
+    assert g["serving_tier_bytes_held"] == t.bytes_held
+    assert g["serving_tier_budget_bytes"] == t.budget_bytes
+
+
+@pytest.mark.slow
+def test_tier_off_is_bit_identical_round17_behavior():
+    """tier_bytes=0 (the default): no tier object exists, pressure
+    drops, preemption recomputes — and outputs match the tiered
+    engine's bit for bit (the tier moves latency, never tokens)."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(1, 90, 8 + 2 * i).astype(np.int32)
+               for i in range(4)]
+    outs = {}
+    for tb in (0, 1 << 20):
+        eng = _engine(params, cfg, tier_bytes=tb, num_pages=11,
+                      pages_per_slot=5)
+        assert (eng.tier is None) == (tb == 0)
+        rids = [eng.submit(p, 4) for p in prompts]
+        got = eng.run()
+        outs[tb] = [got[r] for r in rids]
+        assert eng.cache.pages_in_use == eng.prefix.cached_pages
+    for a, b in zip(outs[0], outs[1 << 20]):
+        np.testing.assert_array_equal(a, b)

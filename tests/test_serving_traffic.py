@@ -492,3 +492,75 @@ def test_disagg_add_and_drain_worker():
         assert not cl.drain_worker("decode0", timeout=5)
     finally:
         cl.close()
+
+
+@pytest.mark.slow
+def test_disagg_standby_worker_adopted_by_scale_up():
+    """Round 18 (ROADMAP item-2 remainder): a STANDBY worker is fully
+    handshaken and pre-warmed but invisible — out of routing, out of
+    the healthy gauge, out of chaos's victim set — until
+    ``scale_up()`` adopts it in O(peer-map flip).  The adopted worker
+    then serves traffic bit-exactly; with no standby left, scale_up
+    falls back to spawning."""
+    import time as _time
+    from mxnet_tpu.serving import DisaggServingCluster
+
+    params, cfg = _setup()
+    rng = np.random.RandomState(10)
+    cl = DisaggServingCluster(params, cfg, prefill=1, decode=1,
+                              num_slots=2, page_size=4,
+                              prefill_chunk=6, metrics=True,
+                              watchdog_s=60.0)
+    try:
+        # one warm spare per role (the deployment shape serve_bench
+        # --standby provisions: the role-aware scale_up grows
+        # whichever role's load is higher at the tick, so a single-
+        # role spare could leave the other spawn-priced)
+        assert cl.add_worker("prefill", standby=True) == "prefill1"
+        assert cl.add_worker("decode", standby=True) == "decode1"
+        health = {h["worker"]: h for h in cl.health()}
+        assert health["prefill1"]["alive"]
+        assert health["prefill1"]["standby"]
+        assert health["decode1"]["standby"]
+        # invisible to the healthy-capacity gauge (the autoscaler
+        # must still see only the pre-burst capacity, or it would
+        # never fire the scale-up that adopts a standby)
+        assert cl.registry.snapshot()["gauges"][
+            "cluster_workers_healthy"] == 2
+        # scale_up adopts a parked spare of whichever role it picks —
+        # O(flag flip), not O(spawn+compile)
+        t0 = _time.perf_counter()
+        assert cl.scale_up() is True
+        adopt_s = _time.perf_counter() - t0
+        assert adopt_s < 1.0, \
+            "standby adoption took %.2fs — it spawned instead" \
+            % adopt_s
+        health = {h["worker"]: h for h in cl.health()}
+        adopted = [h for h in health.values()
+                   if h["worker"] in ("prefill1", "decode1")
+                   and not h["standby"]]
+        assert len(adopted) == 1 and not adopted[0]["draining"]
+        assert cl.registry.snapshot()["gauges"][
+            "cluster_workers_healthy"] == 3
+        # direct adoption of the other role's spare works too
+        other_role = "prefill" if adopted[0]["worker"] == "decode1" \
+            else "decode"
+        assert cl.adopt_standby(other_role) == other_role + "1"
+        assert cl.registry.snapshot()["gauges"][
+            "cluster_workers_healthy"] == 4
+        # the adopted workers serve bit-exactly (round-robin lands
+        # every other request on each role's second worker)
+        wl = [(rng.randint(1, 90, 6).astype(np.int32), 4)
+              for _ in range(4)]
+        for p, n in wl:
+            rid = cl.submit(p, n)
+            np.testing.assert_array_equal(
+                cl.result(rid, timeout=300), _ref(params, cfg, p, n))
+        st = cl.cluster_stats()
+        assert st["prefill1"]["steps"] > 0, \
+            "the adopted standby never stepped"
+        # no spares parked anymore: the next adoption attempt misses
+        assert cl.adopt_standby("prefill") is None
+        assert cl.adopt_standby("decode") is None
+    finally:
+        cl.close()

@@ -429,6 +429,64 @@ class TestPylockAutoscalerCoverage:
         assert _rules(fs) == {"py-blocking-under-lock": 1}
 
 
+class TestPylockTierCoverage:
+    """ISSUE 13 satellite: pylocklint's auto-scope reaches the
+    round-18 ``serving/tier_store.py`` (zero findings on the live
+    module is pinned by the repo-wide scan; these prove a violation
+    planted THERE would fire — the coverage is real, not vacuous.
+    The live store is deliberately lock-free on the owning engine's
+    thread, so the plants are the shapes a future 'make it shared'
+    edit would introduce)."""
+
+    def test_planted_guarded_field_fires(self):
+        src = ("import threading\n"
+               "class HostTierStore:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self.bytes_held = 0\n"
+               "    def put(self, n):\n"
+               "        with self._mu:\n"
+               "            self.bytes_held = n\n"
+               "    def pop(self):\n"
+               "        self.bytes_held = 0\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/tier_store.py")
+        assert _rules(fs) == {"py-guarded-field": 1}
+
+    def test_planted_blocking_under_lock_fires(self):
+        # the tier's real future hazard shape: a device transfer
+        # (blocking) while holding a store lock would serialize every
+        # spill behind every restore
+        src = ("import threading, time\n"
+               "class HostTierStore:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "    def put(self, key):\n"
+               "        with self._mu:\n"
+               "            time.sleep(0.1)\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/tier_store.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+    def test_planted_lock_order_cycle_fires(self):
+        src = ("import threading\n"
+               "class HostTierStore:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self._lru_mu = threading.Lock()\n"
+               "    def put(self):\n"
+               "        with self._mu:\n"
+               "            with self._lru_mu:\n"
+               "                pass\n"
+               "    def evict(self):\n"
+               "        with self._lru_mu:\n"
+               "            with self._mu:\n"
+               "                pass\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/tier_store.py")
+        assert "py-lock-order" in _rules(fs)
+
+
 class TestBenchSyncFixtures:
     """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
     unsynced-jit pattern fires once, the pragma'd twin is suppressed,
@@ -593,6 +651,26 @@ class TestHotRegionAdditions:
         ("mxnet_tpu/serving/cluster.py",
          "class DisaggServingCluster:\n"
          " def _handshake_one(self, wh, timeout):\n%s"),
+        # round 18: the KV-tiering hot paths — the whole tier store,
+        # the prefix-cache spill/restore leaves (they run inside the
+        # allocator's pressure callback), and the engine's swap
+        # paths; an in-loop jit or stray sync there prices every
+        # pressure event and every preemption resume
+        ("mxnet_tpu/serving/tier_store.py",
+         "class HostTierStore:\n"
+         " def put(self, key, content, n_pages):\n%s"),
+        ("mxnet_tpu/serving/prefix_cache.py",
+         "class PrefixCache:\n"
+         " def _spill_entry(self, e):\n%s"),
+        ("mxnet_tpu/serving/prefix_cache.py",
+         "class PrefixCache:\n"
+         " def _restore_run(self, tokens, m, parent):\n%s"),
+        ("mxnet_tpu/serving/engine.py",
+         "class ServingEngine:\n"
+         " def _preempt_victim(self, victim):\n%s"),
+        ("mxnet_tpu/serving/engine.py",
+         "class ServingEngine:\n"
+         " def _swap_in(self, req, inp, slot):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)
@@ -965,7 +1043,8 @@ class TestGraphlintLiveRepo:
         assert {"serving_step", "serving_step_pallas",
                 "serving_step_tp", "cow_page_copy", "gpt_generate",
                 "gpt_spec_block", "transformer_train_step",
-                "gpt_train_step", "paged_attention_kernel"} <= progs
+                "gpt_train_step", "paged_attention_kernel",
+                "tier_page_restore"} <= progs
         assert progs == {sp.name for sp in graphlint.live_programs()}
         for name, e in budgets["programs"].items():
             assert e["budget_bytes"] >= e["peak_bytes"], name

@@ -303,6 +303,33 @@ def build_serving_page_install():
                 jax.ShapeDtypeStruct((b,), jnp.int32), content)
 
 
+def build_tier_page_restore():
+    """The KV-tiering single-page install (round 18): a host-tier
+    spill/restore/swap moves pages one (or a small power-of-two run)
+    at a time through the SAME donated scatter family as the
+    round-15 transfer path, but at bucket 1 — the shape every
+    pressure spill's restore and every swap-in resume compiles.  Its
+    donation must alias the pools in place (a copy here would double
+    the pool bytes at every preemption resume) and its peak is
+    budget-gated like the step's."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.paged_kv import _make_install
+    cfg = _gpt_cfg()
+    _, _, num_pages = _serve_geometry(cfg)
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    b = 1
+    fn = _make_install(cfg, True, b)
+    content = [{"kv": jax.ShapeDtypeStruct((b, _PAGE, H, 2 * dh),
+                                           jnp.int8),
+                "s": jax.ShapeDtypeStruct((b, _PAGE, H, 2),
+                                          jnp.float32)}
+               for _ in range(cfg.n_layers)]
+    return fn, (_abstract_pools(cfg, num_pages),
+                jax.ShapeDtypeStruct((b,), jnp.int32), content)
+
+
 def build_cow_page_copy():
     import jax
     import jax.numpy as jnp
@@ -418,6 +445,8 @@ def live_programs() -> List[ProgramSpec]:
         spec("cow_page_copy", build_cow_page_copy, donate=(0,),
              dtype_region="int8", f32_allow={}),
         spec("serving_page_install", build_serving_page_install,
+             donate=(0,), dtype_region="int8", f32_allow={}),
+        spec("tier_page_restore", build_tier_page_restore,
              donate=(0,), dtype_region="int8", f32_allow={}),
         spec("gpt_generate", build_gpt_generate,
              dtype_region="int8", f32_allow=gen_acc),

@@ -88,6 +88,19 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/serving/paged_kv.py",
      r"(?:.*\.)?(export_pages|install_pages)$"),
     ("mxnet_tpu/serving/page_streamer.py", r".*"),
+    # round 18: the KV-tiering hot paths — spill runs inside the
+    # allocator's pressure callback (mid-admission, mid-step phase A),
+    # warm restore + swap-in run inside match()/_admit on the serving
+    # thread; the ONE device round-trip each (export gather / install
+    # scatter) IS the tier transfer — any additional sync, in-loop
+    # jit, or clock mix here prices every pressure event and every
+    # preemption resume
+    ("mxnet_tpu/serving/tier_store.py", r".*"),
+    ("mxnet_tpu/serving/prefix_cache.py",
+     r"(?:.*\.)?(_spill_entry|_restore_run|_spilled_run|spill"
+     r"|probe_depth|spilled_content)$"),
+    ("mxnet_tpu/serving/engine.py",
+     r"(?:.*\.)?(_preempt_victim|_swap_in)$"),
     # round 12: the metrics-registry mutation path — instrument
     # creation and reset run under the registry lock; a device sync or
     # in-loop jit there blocks every scrape and engine step behind it
