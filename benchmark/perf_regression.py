@@ -387,6 +387,40 @@ def bench_gpt_spec_decode():
     return batch / per_tok
 
 
+def bench_bert_pretrain():
+    """Training scale-out gate (round 19, ROADMAP 5): examples/s of
+    the ONE jitted FSDP BERT-base pretrain step at dp=8 — params +
+    optimizer moments sharded by the `parallel/fsdp.py` rule table,
+    batch sharded over dp, gradient sync lowered by GSPMD to the ICI
+    reduce-scatter fused into the sharded optimizer update
+    (train_scale_bench.run_gate_pretrain, full preset).  The run
+    itself HARD-FAILS (RuntimeError) unless the dp=2 f32 loss
+    trajectory through the ICI-allreduce KVStore is bit-identical to
+    single-device accumulation AND the FSDP per-device param+opt
+    bytes are exactly /dp against live addressable_shards — the gate
+    VALUE is only the ex/s.  Direction "higher": v >= lo.
+    Reproducibility enforced like the goodput gate's: the row must
+    carry its seed + config sha or the gate refuses to report.
+    Returns None (a visible SKIP, not a failure) on a single-device
+    host: the gate is a multi-device claim and must not abort the
+    single-chip gates measured alongside it."""
+    import jax
+    if len(jax.devices()) < 2:
+        print("bert_pretrain_ex_s: SKIP — needs >= 2 devices "
+              "(virtual mesh ok: XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8)", flush=True)
+        return None
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import train_scale_bench
+    row = train_scale_bench.run_gate_pretrain("full")
+    if not row.get("cfg_sha") or "seed" not in row:
+        raise RuntimeError(
+            "bert_pretrain_ex_s: result row carries no seed/config "
+            "sha — the measurement is not reproducible; refusing to "
+            "gate it (got keys %s)" % sorted(row))
+    return row["ex_s"]
+
+
 BENCHES = {
     "resnet50_img_s": (bench_resnet, "higher"),
     "bert_base_tok_s": (bench_bert, "higher"),
@@ -408,6 +442,7 @@ BENCHES = {
     "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
     "gpt_serve_tier_hit_ttft_ms": (bench_gpt_serve_tier_hit,
                                    "lower"),
+    "bert_pretrain_ex_s": (bench_bert_pretrain, "higher"),
 }
 
 BAR = 0.15
@@ -449,6 +484,9 @@ def main():
         if only is not None and name not in only:
             continue
         v = fn()
+        if v is None:                  # precondition unmet — visible
+            print("%-24s %10s  [skip]" % (name, "-"), flush=True)
+            continue                   # skip, expected entry untouched
         results[name] = round(v, 1)
         exp = expected.get(name)
         status = "new"

@@ -506,9 +506,41 @@ class HybridBlock(Block):
                 p._finish_deferred_init()
 
     def forward_raw(self, *args):
-        """Run hybrid_forward eagerly with params bound (trace target)."""
+        """Run hybrid_forward eagerly with params bound (trace target).
+
+        Params resolve to the copy on the INPUT's context when the
+        parameter holds one there (reference semantics: multi-context
+        data-parallel training runs each shard against its own device's
+        replica — round 19, the ICI-kvstore Trainer path).  Single-
+        context parameters and the trace path (params swapped to one
+        wrapped entry) keep the first-copy behavior."""
         self._deferred_init_params(*args)
-        params = {k: v.data() for k, v in self._reg_params.items()}
+        ctx = None
+        if args:
+            try:
+                ctx = args[0].context
+            except Exception:
+                ctx = None
+        params = {}
+        jdev = None
+        for k, v in self._reg_params.items():
+            d = v._data.get(ctx) if (ctx is not None and v._data) \
+                else None
+            if d is None and ctx is not None and v._data \
+                    and len(v._data) > 1:
+                # context spellings drift across harnesses (an eager
+                # intermediate on the CPU test mesh reports cpu(i)
+                # while params were initialized under tpu(i)) — the
+                # identity that matters is the underlying jax device
+                try:
+                    jdev = ctx.jax_device if jdev is None else jdev
+                    for c in v._data:
+                        if c.jax_device == jdev:
+                            d = v._data[c]
+                            break
+                except Exception:
+                    d = None
+            params[k] = d if d is not None else v.data()
         return self.hybrid_forward(nd, *args, **params)
 
     def forward(self, *args):

@@ -5,3 +5,4 @@ Reference: ``python/mxnet/kvstore/`` + ``src/kvstore/`` (SURVEY.md §2.1
 """
 from .kvstore import KVStore, KVStoreBase, create
 from . import horovod  # registers the allreduce-semantics backend
+from . import ici      # registers the ICI-allreduce backend (round 19)

@@ -1,0 +1,266 @@
+"""ICI-allreduce KVStore — the ``nccl``/``device`` reduce lowered to
+one compiled mesh collective (ROADMAP item 5, the SNIPPETS.md brief's
+second half: "the ``nccl``/``device`` KVStore types become an
+ICI-allreduce KVStore for data-parallel gradient sync").
+
+The ``device`` store reduces a per-device value list SEQUENTIALLY —
+``v0 + v1.as_in_context(ctx0) + ...`` routes every contribution through
+device 0, N-1 serial transfers deep (``kvstore_local.h``'s CPU tree,
+kept for parity).  ``kvstore_nccl.h`` replaced that with one
+ncclAllReduce; the TPU-native equivalent here assembles the per-device
+buffers into ONE logical array sharded over a ``kv`` mesh axis — zero
+copies: ``jax.make_array_from_single_device_arrays`` adopts each
+device's committed buffer in place — and a single jitted sum over the
+sharded axis, which XLA GSPMD lowers to the ICI all-reduce.  Dispatch
+is async (the jax queue), so gradient sync overlaps the caller's next
+backward exactly like the reference's engine-overlapped push.
+
+Bucketing (the measured perf lever, docs/perf.md "Training
+scale-out"): a multi-key push flattens each device's tensors into flat
+staging buffers and issues ONE collective per ≤``bucket_bytes`` bucket
+instead of one per key — fewer dispatches, bigger messages on the
+wire, and one cached compiled reducer per distinct (devices, flat
+numel, dtype) signature; a steady training loop syncs the same
+gradient set every step, so the cache converges to one program per
+bucket after the first sync.  ``MXNET_KV_BUCKET_BYTES`` (default 4 MiB) sets the threshold;
+``0`` disables fusion (per-key collectives).  Bucketed and unbucketed
+reduce are BIT-identical: the sum is elementwise over the stacked
+device axis, so grouping cannot change any element's reduction order
+(pinned in ``tests/test_train_scale.py``).
+
+Semantics: ``init``/``push``/``pull``/``pushpull``/``broadcast`` and
+the server-side-optimizer path match the ``device`` store (parity
+tests in ``tests/test_dist_kvstore.py``), so ``gluon.Trainer(
+kvstore="ici")`` and Module training pick it up unchanged.  Sparse
+(``row_sparse``) values and gradient compression are N/A here with
+clear errors: a row-sparse union-merge is data-dependent-shape (no
+fixed collective), and 2-bit compression is a host-side wire codec —
+on ICI the raw allreduce is the fast path (use ``device``/``dist_*``
+for those).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Tuple
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .kvstore import KVStore, KVStoreBase, _normalize
+
+__all__ = ["ICIKVStore"]
+
+
+def _env_bucket_bytes() -> int:
+    raw = os.environ.get("MXNET_KV_BUCKET_BYTES", "")
+    if not raw:
+        return 4 << 20
+    try:
+        v = int(raw)
+        if v < 0:
+            raise ValueError(raw)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_KV_BUCKET_BYTES must be a non-negative integer, "
+            "got %r" % raw)
+    return v
+
+
+# one reducer per (devices, rows, numel, dtype) — module-level so the
+# cache survives store instances and the jit construction sits outside
+# any hot loop (the engine _make_copy convention)
+_REDUCERS: Dict[Tuple, object] = {}
+_REDUCERS_MU = threading.Lock()
+
+
+def _reducer(devs: Tuple, numel: int, dtype_str: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    key = (devs, len(devs), numel, dtype_str)
+    with _REDUCERS_MU:
+        fn = _REDUCERS.get(key)
+    if fn is not None:
+        return fn
+    mesh = Mesh(np.array(list(devs)), ("kv",))
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("kv"))
+
+    def _sum(x):
+        # sum over the device-sharded axis == the ICI all-reduce;
+        # keep the input dtype (no silent f32 widening of bf16 grads)
+        return jnp.sum(x, axis=0, dtype=x.dtype)
+
+    fn = (jax.jit(_sum, out_shardings=rep), row)
+    with _REDUCERS_MU:
+        _REDUCERS[key] = fn
+    return fn
+
+
+@KVStoreBase.register("ici", aliases=("ici_allreduce",))
+class ICIKVStore(KVStore):
+    """Single-process multi-device store whose cross-device reduce is
+    ONE compiled mesh collective (type ``ici``)."""
+
+    def __init__(self, bucket_bytes=None):
+        super().__init__("ici")
+        self.bucket_bytes = (_env_bucket_bytes() if bucket_bytes is None
+                             else int(bucket_bytes))
+        # counters are advisory telemetry for the bench/tests; guarded
+        # like every cross-thread-visible mutable field (data-loader
+        # threads push while the main thread pulls)
+        self._mu = threading.Lock()
+        self._collectives = 0
+        self._reduced_bytes = 0
+
+    # -- N/A surface (clear errors, not silent fallbacks) -----------------
+    def set_gradient_compression(self, compression_params):
+        raise MXNetError(
+            "kvstore 'ici': gradient compression is N/A — 2-bit "
+            "compression is a host-side wire codec for TCP parameter "
+            "servers; the ICI allreduce moves raw buffers over the "
+            "interconnect.  Use kvstore 'device' or 'dist_sync' for "
+            "compressed sync.")
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError(
+            "kvstore 'ici': row_sparse_pull is N/A — a row-sparse "
+            "retain is data-dependent-shape and has no fixed-shape "
+            "collective.  Use kvstore 'device' or 'dist_*' for "
+            "row_sparse keys.")
+
+    # -- the collective reduce --------------------------------------------
+    def push(self, key, value, priority=0):
+        """Reduce per-device values with one jitted ICI all-reduce per
+        flat bucket, then apply the updater / store the result (same
+        observable semantics as the ``device`` store's push)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        keys, values = _normalize(key, value)
+        todo: List[Tuple] = []          # (k, vlist) pending reduction
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            if k not in self._data:
+                raise MXNetError("key %s was not initialized" % str(k))
+            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                raise MXNetError(
+                    "kvstore 'ici': push of row_sparse values is N/A "
+                    "(no fixed-shape collective for a union-merge) — "
+                    "use kvstore 'device' or 'dist_*' for key %r"
+                    % (k,))
+            todo.append((k, list(vlist)))
+        for k, reduced in self._reduce_bucketed(todo):
+            stored = self._data[k]
+            if self._updater is not None:
+                # server-side update semantics (update_on_kvstore=True)
+                self._updater(k, reduced, stored)
+            else:
+                stored._set_data(
+                    reduced.as_in_context(stored.context)._data)
+
+    def _reduce_bucketed(self, todo):
+        """Yield ``(key, reduced NDArray)`` for every pending key,
+        fusing keys that share a device signature and dtype into flat
+        buckets of ≤ ``bucket_bytes`` (0 = one collective per key)."""
+        groups: Dict[Tuple, List] = {}
+        for k, vlist in todo:
+            locals_, devs = self._local_partials(vlist)
+            if len(devs) == 1:
+                # single contributing device: nothing to all-reduce
+                yield k, NDArray(locals_[0])
+                continue
+            sig = (devs, str(locals_[0].dtype))
+            groups.setdefault(sig, []).append((k, locals_))
+        for sig, entries in groups.items():
+            devs, _ = sig
+            bucket: List = []
+            bucket_sz = 0
+            for entry in entries:
+                sz = entry[1][0].nbytes
+                if bucket and bucket_sz + sz > max(self.bucket_bytes,
+                                                   sz):
+                    yield from self._reduce_flat(devs, bucket)
+                    bucket, bucket_sz = [], 0
+                bucket.append(entry)
+                bucket_sz += sz
+                if self.bucket_bytes == 0:
+                    yield from self._reduce_flat(devs, bucket)
+                    bucket, bucket_sz = [], 0
+            if bucket:
+                yield from self._reduce_flat(devs, bucket)
+
+    def _local_partials(self, vlist):
+        """Per-device partial sums of a key's value list: entries on
+        the SAME device pre-reduce locally (plain adds, no transfer),
+        so each participating device contributes exactly one buffer —
+        and the dp=2 collective is a single order-free f32 add,
+        bit-identical to single-device accumulation (the parity
+        protocol in tests/test_dist_kvstore.py).
+
+        Grouping keys on the NDArray's declared CONTEXT (the
+        reference's device identity), committing the buffer there
+        first — eager-op results are uncommitted and drift to the
+        default device, which would silently collapse the collective
+        into one local sum."""
+        import jax
+
+        per_dev: Dict = {}
+        dev_order: List = []
+        for v in vlist:
+            d = v.context.jax_device
+            arr = jax.device_put(v._data, d)    # no-op when resident
+            if d in per_dev:
+                per_dev[d] = per_dev[d] + arr
+            else:
+                per_dev[d] = arr
+                dev_order.append(d)
+        return [per_dev[d] for d in dev_order], tuple(dev_order)
+
+    def _reduce_flat(self, devs, bucket):
+        """One collective for one flat bucket: concatenate each
+        device's raveled tensors (device-local), all-reduce the
+        stacked (n_dev, numel) array, split the replicated result back
+        per key."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(devs)
+        sizes = [locals_[0].size for _, locals_ in bucket]
+        shapes = [locals_[0].shape for _, locals_ in bucket]
+        numel = sum(sizes)
+        if len(bucket) == 1:
+            rows = [locals_[i].reshape((1, numel))
+                    for (_, locals_) in bucket for i in range(n)]
+        else:
+            rows = []
+            for i in range(n):
+                flat = jnp.concatenate(
+                    [locals_[i].ravel() for _, locals_ in bucket])
+                rows.append(flat.reshape((1, numel)))
+        fn, row_sharding = _reducer(devs, numel,
+                                    str(rows[0].dtype))
+        stacked = jax.make_array_from_single_device_arrays(
+            (n, numel), row_sharding, rows)
+        reduced = fn(stacked)
+        with self._mu:
+            self._collectives += 1
+            self._reduced_bytes += reduced.nbytes
+        off = 0
+        for (k, _), sz, shape in zip(bucket, sizes, shapes):
+            # deliver each key's slice committed to its first
+            # contributing device (what the `device` store's sequential
+            # reduce produces) — a cheap local pick from the replicated
+            # result, so updater/store paths see single-device arrays
+            part = jax.device_put(
+                reduced[off:off + sz].reshape(shape), devs[0])
+            yield k, NDArray(part)
+            off += sz
+
+    def stats(self):
+        """Telemetry for the bench/tests: collectives issued and
+        reduced payload bytes since construction."""
+        with self._mu:
+            return {"collectives": self._collectives,
+                    "reduced_bytes": self._reduced_bytes}

@@ -26,7 +26,9 @@ from typing import Any, Dict, Optional, Tuple
 _FLASH_FALLBACK_LOGGED = False
 
 __all__ = ["TransformerConfig", "init_params", "forward",
-           "forward_with_aux", "make_train_step", "bert_base", "bert_tiny"]
+           "forward_with_aux", "mlm_loss", "make_train_step",
+           "train_step_input_specs", "train_step_output_specs",
+           "bert_base", "bert_tiny"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -484,9 +486,80 @@ def _constrain_act(x, mesh):
 # train step
 # ---------------------------------------------------------------------------
 
+def mlm_loss(params, batch, rng, cfg: TransformerConfig, mesh=None):
+    """Masked-LM pretraining objective (BERT): mean token NLL over the
+    masked positions (``labels`` -100 ≡ unmasked) plus the MoE
+    auxiliary loss.  ONE implementation reused by every training path
+    — the jitted mesh step below, the per-device-replica KVStore path
+    (``benchmark/train_scale_bench.py`` computes per-shard grads of
+    THIS function and syncs them through the ICI-allreduce store), and
+    the bit-identity tests — so the objectives cannot drift apart."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, aux = forward_with_aux(
+        params, batch["tokens"], cfg,
+        type_ids=batch.get("type_ids"),
+        mask=batch.get("mask"), train=True, rng=rng, mesh=mesh)
+    labels = batch["labels"]
+    valid = (labels >= 0)
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_loss = -jnp.take_along_axis(logp, safe[..., None],
+                                    axis=-1)[..., 0]
+    tok_loss = jnp.where(valid, tok_loss, 0.0)
+    mlm = tok_loss.sum() / jnp.maximum(valid.sum(), 1)
+    return mlm + cfg.moe_aux_weight * aux
+
+
+def train_step_input_specs(cfg: TransformerConfig, dp="dp", tp=None,
+                           fsdp=True):
+    """DECLARED train-step input shardings, mesh-free (the serving
+    engine's ``step_input_specs`` convention, round 14, extended to
+    the train half this round): ``(param_specs_tree, batch_specs,
+    rng_spec)`` for the state/batch/rng arguments of the step
+    ``make_train_step`` builds.
+
+    With ``fsdp=True`` params follow the FSDP rule-table composition
+    (``parallel/fsdp.py`` — dp composed onto the megatron table);
+    otherwise params replicate w.r.t. dp (plain data parallelism) and
+    carry only the megatron tp entries.  Optimizer-state leaves are
+    not declared here: param-shaped moments take their param's spec
+    verbatim and non-param leaves (step counts) replicate — the
+    ``mesh.zero1_sharding``/``init_sharded_opt_state`` contract,
+    asserted against live ``addressable_shards`` in
+    ``tests/test_train_scale.py``.  graphlint's sharding-readiness
+    audit verifies THIS declaration against its own shape-aware
+    derivation from the megatron table (docs/sharding_readiness.md)."""
+    from jax.sharding import PartitionSpec as P
+
+    if fsdp:
+        from ..parallel.fsdp import fsdp_param_specs
+        pspecs = fsdp_param_specs(cfg, dp=dp, tp=tp)
+    else:
+        pspecs = param_specs(cfg, tp=tp)
+    row = P(dp, None)
+    batch = {"tokens": row, "labels": row, "mask": row,
+             "type_ids": row}
+    return pspecs, batch, P()
+
+
+def train_step_output_specs(cfg: TransformerConfig, dp="dp", tp=None,
+                            fsdp=True):
+    """DECLARED output shardings ``(param_specs_tree, loss_spec)``:
+    updated params keep EXACTLY the input placement (the donation
+    contract — a spec change here would force a reshard every step
+    and break the in-place state update graphlint's donation rule
+    pins), the loss replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    pspecs, _, _ = train_step_input_specs(cfg, dp=dp, tp=tp, fsdp=fsdp)
+    return pspecs, P()
+
+
 def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
                     weight_decay=0.01, shard_optimizer=False,
-                    scan_steps=None, scan_superbatch=False):
+                    scan_steps=None, scan_superbatch=False, fsdp=False):
     """Build (init_state, step) for MLM pretraining.
 
     ``step(state, batch, rng) -> (state, loss)`` is jitted; with a mesh it
@@ -506,6 +579,16 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
     server-side PS optimizer update to exactly this): each dp shard
     owns 1/dp of the optimizer state, GSPMD inserts the
     reduce-scatter/all-gather pair around the update.
+
+    ``fsdp=True`` (round 19, ROADMAP item 5) shards the PARAMS as
+    well, by the ``parallel/fsdp.py`` rule table composed onto the
+    megatron specs: each device holds exactly 1/dp of every weight
+    and every param-shaped optimizer moment.  GSPMD all-gathers each
+    weight on use in the forward/backward and — because the grads are
+    pinned to the same sharded specs — lowers the gradient sync to a
+    reduce-scatter fused straight into the sharded optimizer update
+    (no replicated grad ever materializes).  Requires a mesh with a
+    live ``dp`` axis; implies ``shard_optimizer``.
     """
     import jax
     import jax.numpy as jnp
@@ -515,22 +598,22 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
                      b1=0.9, b2=0.999, eps=1e-6)
 
     def loss_fn(params, batch, rng):
-        logits, aux = forward_with_aux(
-            params, batch["tokens"], cfg,
-            type_ids=batch.get("type_ids"),
-            mask=batch.get("mask"), train=True, rng=rng, mesh=mesh)
-        labels = batch["labels"]
-        valid = (labels >= 0)
-        safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        tok_loss = -jnp.take_along_axis(logp, safe[..., None],
-                                        axis=-1)[..., 0]
-        tok_loss = jnp.where(valid, tok_loss, 0.0)
-        mlm = tok_loss.sum() / jnp.maximum(valid.sum(), 1)
-        return mlm + cfg.moe_aux_weight * aux
+        return mlm_loss(params, batch, rng, cfg, mesh=mesh)
 
-    grad_shardings = (param_shardings(cfg, mesh)
-                      if mesh is not None and mesh.size > 1 else None)
+    if fsdp:
+        from ..base import MXNetError
+        from ..parallel.mesh import live_axis
+        from ..parallel.fsdp import fsdp_param_shardings
+        if mesh is None or live_axis(mesh, "dp") is None:
+            raise MXNetError(
+                "make_train_step(fsdp=True) needs a mesh with a live "
+                "'dp' axis (size > 1); got %s"
+                % (dict(mesh.shape) if mesh is not None else None))
+        grad_shardings = fsdp_param_shardings(cfg, mesh)
+        shard_optimizer = True
+    else:
+        grad_shardings = (param_shardings(cfg, mesh)
+                          if mesh is not None and mesh.size > 1 else None)
 
     # NOTE (round 5): constraining grads to the ZeRO-1 dp-composed
     # sharding here instead was tried and REVERTED — under dp·sp·tp it
@@ -593,8 +676,26 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             opt_state = tx.init(params)
         return (params, opt_state)
 
+    if fsdp:
+        # jit with EXPLICIT state shardings: with only donate_argnums
+        # the lowering defers input placements and cannot prove the
+        # in-place aliasing; declaring (params, opt) shardings in/out
+        # makes donation provable at lowering — gated by graphlint's
+        # graph-donation rule on the bert_train_step_fsdp entries.
+        # Batch/rng stay unspecified (None = follow the arrays).
+        from ..parallel.mesh import opt_state_shardings
+        pshapes = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg))
+        state_shardings = (grad_shardings, opt_state_shardings(
+            tx, pshapes, mesh, param_shardings=grad_shardings))
+        jit_kw = dict(donate_argnums=(0,),
+                      in_shardings=(state_shardings, None, None),
+                      out_shardings=(state_shardings, None))
+    else:
+        jit_kw = dict(donate_argnums=(0,))
+
     if scan_steps is None:
-        return init_state, jax.jit(step, donate_argnums=(0,))
+        return init_state, jax.jit(step, **jit_kw)
 
     def multi(state, batch, rng):
         def body(st, i):
@@ -603,7 +704,7 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             return step(st, b, jax.random.fold_in(rng, i))
         return jax.lax.scan(body, state, jnp.arange(scan_steps))
 
-    return init_state, jax.jit(multi, donate_argnums=(0,))
+    return init_state, jax.jit(multi, **jit_kw)
 
 
 

@@ -17,10 +17,34 @@ from .. import ndarray as nd
 
 __all__ = ["Optimizer", "SGD", "Adam", "AdaGrad", "RMSProp", "FTRL", "NAG",
            "Signum", "LAMB", "AdaDelta", "Adamax", "Nadam", "LARS", "Test",
-           "Updater", "get_updater", "create", "register"]
+           "Updater", "get_updater", "create", "register", "state_zeros"]
 
 _REG = Registry("optimizer")
 register = _REG.register
+
+
+def state_zeros(weight, dtype=None):
+    """Optimizer-state allocation matching the weight's PLACEMENT.
+
+    For a single-device weight this is ``nd.zeros(ctx=weight.context)``
+    (the reference behavior).  For a mesh-SHARDED weight (the FSDP /
+    ZeRO world, round 19) the state is materialized directly INTO the
+    weight's sharding — init-then-reshard would peak at full replicated
+    size on one device, defeating the reason the weight is sharded
+    (the same argument as ``parallel/mesh.init_sharded_opt_state``);
+    a single-device state next to a sharded weight would also force a
+    reshard on every ``update``."""
+    data = getattr(weight, "_data", None)
+    dtype = dtype or weight.dtype
+    if data is not None and hasattr(data, "sharding") \
+            and len(getattr(data, "devices", lambda: [None])()) > 1:
+        import jax
+        import jax.numpy as jnp
+        zeros = jax.jit(lambda: jnp.zeros(data.shape, dtype),
+                        out_shardings=data.sharding)()
+        from ..ndarray.ndarray import NDArray
+        return NDArray(zeros)
+    return nd.zeros(weight.shape, ctx=weight.context, dtype=dtype)
 
 
 class Optimizer:
@@ -153,8 +177,7 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return nd.zeros(weight.shape, ctx=weight.context,
-                        dtype=weight.dtype)
+        return state_zeros(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -204,8 +227,7 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return nd.zeros(weight.shape, ctx=weight.context,
-                        dtype=weight.dtype)
+        return state_zeros(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -230,10 +252,7 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context,
-                         dtype=weight.dtype),
-                nd.zeros(weight.shape, ctx=weight.context,
-                         dtype=weight.dtype))
+        return (state_zeros(weight), state_zeros(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -419,8 +438,7 @@ class LARS(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return nd.zeros(weight.shape, ctx=weight.context,
-                        dtype=weight.dtype)
+        return state_zeros(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)

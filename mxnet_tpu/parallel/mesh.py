@@ -176,6 +176,12 @@ def zero1_sharding(leaf, mesh, axis="dp", base=None):
     ndim = getattr(leaf, "ndim", 0)
     entries = list(base) if base is not None else []
     entries = entries[:ndim] + [None] * (ndim - len(entries))
+    # FSDP (round 19): the param's own sharding may already carry the
+    # data axis — then the moment takes the param placement verbatim
+    # (state is ALREADY ÷dp; composing dp twice would be a spec error)
+    for e in entries:
+        if e == axis or (isinstance(e, tuple) and axis in e):
+            return NamedSharding(mesh, P(*entries))
     n = mesh.shape[axis]
     for i in range(ndim):
         if entries[i] is None and leaf.shape[i] > 0 \
@@ -183,6 +189,31 @@ def zero1_sharding(leaf, mesh, axis="dp", base=None):
             entries[i] = axis
             break
     return NamedSharding(mesh, P(*entries))
+
+
+def opt_state_shardings(tx, params, mesh, axis="dp",
+                        param_shardings=None):
+    """Placement tree for ``tx.init(params)`` under ZeRO-1/FSDP:
+    param-shaped state leaves compose the data axis with the param's
+    own sharding (or take it verbatim when it already carries the
+    axis — the FSDP case); non-param leaves (step counts) replicate.
+    ``params`` may be live arrays or abstract shapes — round 19 also
+    hands this tree to ``jax.jit(in_shardings=...)`` so state
+    donation is provable at lowering."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+    if param_shardings is None:
+        return jax.tree_util.tree_map(
+            lambda l: zero1_sharding(l, mesh, axis=axis), shapes)
+    import optax
+    rep = NamedSharding(mesh, P())
+    return optax.tree_map_params(
+        tx,
+        lambda l, s: zero1_sharding(l, mesh, axis=axis, base=s),
+        shapes, param_shardings,
+        transform_non_params=lambda l: rep)
 
 
 def init_sharded_opt_state(tx, params, mesh, axis="dp",
@@ -194,18 +225,7 @@ def init_sharded_opt_state(tx, params, mesh, axis="dp",
     param's own tp/sp sharding; non-param leaves (step counts)
     replicate."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    shapes = jax.eval_shape(tx.init, params)
-    if param_shardings is None:
-        placements = jax.tree_util.tree_map(
-            lambda l: zero1_sharding(l, mesh, axis=axis), shapes)
-    else:
-        import optax
-        rep = NamedSharding(mesh, P())
-        placements = optax.tree_map_params(
-            tx,
-            lambda l, s: zero1_sharding(l, mesh, axis=axis, base=s),
-            shapes, param_shardings,
-            transform_non_params=lambda l: rep)
+    placements = opt_state_shardings(tx, params, mesh, axis=axis,
+                                     param_shardings=param_shardings)
     return jax.jit(tx.init, out_shardings=placements)(params)

@@ -114,12 +114,24 @@ def _jax_dist_init(jax, **kw):
 def host_staged_put(value, sharding):
     """``jax.device_put`` that works for cross-process shardings.
 
-    A sharding spanning processes cannot be fed from a process-local
-    committed array — stage through host numpy (callers must hold
-    identical values on every process, the same synchronized-start
-    contract as the reference's workers)."""
+    A sharding spanning processes cannot be fed to ``device_put`` from
+    a process-local committed array.  Round-19 audit (ROADMAP item 5
+    satellite): a device-resident value no longer round-trips through
+    host numpy for that case either — each local shard is sliced ON
+    DEVICE from the local copy (``make_array_from_callback`` with jax
+    array slices = device-to-device), so sharded params stay
+    device-resident end to end.  Host numpy staging remains only for
+    values that are already host data.  Callers must hold identical
+    values on every process (the same synchronized-start contract as
+    the reference's workers — ``init_params`` is deterministic per
+    key)."""
     import jax
     if jax.process_count() > 1:
+        if isinstance(value, jax.Array) and value.is_fully_addressable:
+            # device-resident: feed each local shard as an on-device
+            # slice of the local copy — no D2H, no host numpy
+            return jax.make_array_from_callback(
+                value.shape, sharding, lambda idx: value[idx])
         import numpy as _np
         value = _np.asarray(value)
     return jax.device_put(value, sharding)

@@ -84,6 +84,11 @@ _SLOW_GROUPS = {
     # scenarios over tight pools; own group so the per-test engine
     # compiles never squeeze d/f)
     "test_serving_tier": "l",
+    # group m: ~2min — round-19 training scale-out (FSDP/ICI-kvstore
+    # exactness + byte-accounting; every config compiles its own
+    # sharded train step on the virtual mesh, so the group is
+    # isolated for the same compile-budget reason as e/g/i)
+    "test_train_scale": "m",
 }
 
 

@@ -29,9 +29,18 @@ def _setup_process():
     process, then jax.distributed via the DMLC env."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+              if "xla_force_host_platform_device_count" not in f]
+    os.environ["XLA_FLAGS"] = " ".join(
+        _flags + ["--xla_force_host_platform_device_count=4"])
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:
+        # jax < 0.5: no jax_num_cpu_devices — the XLA_FLAGS device
+        # count set above applies instead (same dance as conftest.py)
+        pass
     from mxnet_tpu.parallel import multihost
     multihost.initialize()       # DMLC_* env → jax.distributed
 

@@ -70,6 +70,11 @@ def test_gspmd_multiprocess_via_launcher():
          "--expect-dp", repr(expect_dp), "--expect-tf", repr(expect_tf)],
         capture_output=True, text=True, timeout=300,
         env={**os.environ})
+    if "Multiprocess computations aren't implemented on the CPU" \
+            in r.stdout + r.stderr:
+        pytest.skip("this jaxlib build has no cross-process CPU "
+                    "collectives (gloo) — the multi-process GSPMD "
+                    "path needs a real multi-host backend here")
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.count("GSPMD multi-process OK") == 2, \
         r.stdout[-2000:] + r.stderr[-2000:]
@@ -412,6 +417,205 @@ def test_horovod_backend_and_plugin_contract():
     assert kv2.type == "test_external"
     kv2.pushpull("g", mx.nd.ones((1,)))
     assert kv2.calls == ["g"]
+
+
+# -- ICI-allreduce kvstore (round 19, ROADMAP item 5) -----------------------
+
+def _dev_val(shape, val, i, dtype="float32"):
+    """A value COMMITTED to virtual device i (eager-op results are
+    uncommitted and drift to device 0, which would collapse the
+    collective into a local sum — the store handles that too, but the
+    parity tests must exercise the cross-device reduce)."""
+    return mx.nd.array(np.full(shape, val, dtype), ctx=mx.tpu(i))
+
+
+def test_ici_push_pull_semantics_match_device_store():
+    """The ICI type passes the `device` store's push/pull semantics:
+    init / cross-device reduce / pull to any context / pushpull /
+    broadcast — but the reduce is ONE compiled mesh collective, not a
+    sequential as_in_context chain (kv.stats() proves it ran)."""
+    kv = mx.kv.create("ici")
+    assert kv.type == "ici"
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", [_dev_val((4,), i + 1.0, i) for i in range(4)])
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0)
+    assert kv.stats()["collectives"] == 1, kv.stats()
+    # pull to a different context
+    o1 = mx.nd.zeros((4,), ctx=mx.tpu(2))
+    kv.pull("w", out=o1)
+    np.testing.assert_allclose(o1.asnumpy(), 10.0)
+    # pushpull + broadcast ride the same paths as the base store
+    kv.pushpull("w", [_dev_val((4,), 1.0, i) for i in range(2)],
+                out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+    kv.broadcast("b", mx.nd.full((2,), 7.0), out=(o2 := mx.nd.zeros((2,))))
+    np.testing.assert_allclose(o2.asnumpy(), 7.0)
+    # uninitialized key still errors
+    with pytest.raises(mx.MXNetError, match="not initialized"):
+        kv.push("nope", mx.nd.ones((2,)))
+    # aliases registered like device/nccl's
+    assert mx.kv.create("ici_allreduce").type == "ici"
+
+
+def test_ici_server_side_optimizer():
+    """update_on_kvstore parity: the updater applies the optimizer to
+    the collectively-reduced gradient (reference: server-side
+    updater; test_dist_server_side_optimizer's ICI twin)."""
+    kv = mx.kv.create("ici")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init("w", mx.nd.ones((4,)))
+    kv.push("w", [_dev_val((4,), 0.5, 0), _dev_val((4,), 0.5, 1)])
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    # w = 1 - 0.5 * (0.5 + 0.5) = 0.5
+    np.testing.assert_allclose(out.asnumpy(), 0.5, rtol=1e-6)
+    assert kv.stats()["collectives"] == 1
+
+
+def test_ici_row_sparse_and_compression_na():
+    """The N/A surface is CLEAR errors, not silent fallbacks: sparse
+    values have no fixed-shape collective and 2-bit compression is a
+    TCP-wire codec (the raw ICI allreduce is the fast path)."""
+    from mxnet_tpu.ndarray import sparse as _sp
+    kv = mx.kv.create("ici")
+    kv.init("w", mx.nd.zeros((4, 2)))
+    with pytest.raises(mx.MXNetError, match="row_sparse.*N/A"):
+        rs = _sp.RowSparseNDArray(
+            mx.nd.ones((1, 2))._data,
+            {"indices": mx.nd.array([0], dtype="int32")._data}, (4, 2))
+        kv.push("w", [rs, rs])
+    with pytest.raises(mx.MXNetError, match="row_sparse_pull is N/A"):
+        kv.row_sparse_pull("w", out=mx.nd.zeros((4, 2)),
+                           row_ids=mx.nd.array([0]))
+    with pytest.raises(mx.MXNetError, match="compression is N/A"):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_ici_dp2_grad_sync_bit_identity_vs_accumulation():
+    """The dp=2 collective is a single order-free f32 add, so the
+    reduced gradient must be BIT-identical to accumulating both
+    contributions on one device — the exactness protocol the
+    train-scale bench gates a whole loss trajectory on
+    (tests/test_train_scale.py runs the model-level twin)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    g0 = (rng.randn(4096).astype("float32") * 1e-3)
+    g1 = (rng.randn(4096).astype("float32") * 1e-3)
+    kv = mx.kv.create("ici")
+    kv.init("g", mx.nd.zeros((4096,)))
+    kv.push("g", [mx.nd.array(g0, ctx=mx.tpu(0)),
+                  mx.nd.array(g1, ctx=mx.tpu(1))])
+    out = mx.nd.zeros((4096,))
+    kv.pull("g", out=out)
+    acc = np.asarray(jnp.asarray(g0) + jnp.asarray(g1))
+    assert (out.asnumpy() == acc).all()
+    assert kv.stats()["collectives"] == 1
+
+
+def test_ici_bucketing_bit_identical_and_fuses_collectives():
+    """Flat bucketing is a dispatch-count optimization, NOT a numeric
+    one: the sum is elementwise over the stacked device axis, so
+    grouping cannot change any element's reduction order.  Bucketed
+    (one fused collective) and unbucketed (one per key) results must
+    be bitwise equal; a tiny threshold splits buckets without
+    changing bits either."""
+    rng = np.random.RandomState(1)
+    keys = ["a", "b", "c", "d"]
+    raw = {k: [rng.randn(64).astype("float32") for _ in range(3)]
+           for k in keys}
+
+    def run(bucket_bytes):
+        kv = mx.kv.create("ici")
+        kv.bucket_bytes = bucket_bytes
+        for k in keys:
+            kv.init(k, mx.nd.zeros((64,)))
+        kv.push(keys, [[mx.nd.array(v, ctx=mx.tpu(i))
+                        for i, v in enumerate(raw[k])]
+                       for k in keys])
+        outs = {}
+        for k in keys:
+            o = mx.nd.zeros((64,))
+            kv.pull(k, out=o)
+            outs[k] = o.asnumpy()
+        return outs, kv.stats()
+
+    fused, s_fused = run(4 << 20)
+    perkey, s_perkey = run(0)
+    split, s_split = run(600)          # 256B/key -> 2 keys per bucket
+    assert s_fused["collectives"] == 1, s_fused
+    assert s_perkey["collectives"] == len(keys), s_perkey
+    # the PARTIALLY-fused path (a bucket holding 2 of 4 keys) is the
+    # offset-arithmetic case the other two modes never exercise
+    assert s_split["collectives"] == 2, s_split
+    for k in keys:
+        assert (fused[k] == perkey[k]).all(), k
+        assert (fused[k] == split[k]).all(), k
+
+
+def test_ici_single_device_and_duplicate_contexts():
+    """Degenerate shapes: one contributing device needs no collective;
+    values sharing a context pre-reduce locally before the collective
+    (each device contributes exactly one buffer)."""
+    kv = mx.kv.create("ici")
+    kv.init("w", mx.nd.zeros((2,)))
+    kv.push("w", _dev_val((2,), 3.0, 0))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+    assert kv.stats()["collectives"] == 0
+    kv.push("w", [_dev_val((2,), 1.0, 0), _dev_val((2,), 2.0, 0),
+                  _dev_val((2,), 4.0, 1)])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+    assert kv.stats()["collectives"] == 1
+
+
+def test_ici_gluon_trainer_picks_it_up_unchanged():
+    """The Gluon training path consumes the new type through the
+    existing KVStore interface — the reference multi-device idiom
+    (params on a ctx list, per-ctx forward/backward,
+    ``gluon.Trainer(kvstore="ici")``) trains without code changes
+    (the SNIPPETS brief's contract) and the gradient sync actually
+    runs as collectives."""
+    from mxnet_tpu import nd, gluon, autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kvstore.ici import ICIKVStore
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype("float32")
+    W = rng.randn(8, 1).astype("float32")
+    Y = X @ W
+    ctxs = [mx.tpu(0), mx.tpu(1)]
+    # MULTI-layer on purpose: layer 2 consumes an eager intermediate
+    # whose derived context spelling (cpu(i) on the CPU test mesh)
+    # differs from the tpu(i) the params registered under —
+    # forward_raw must still resolve the copy on the input's DEVICE
+    # (the round-19 verify-drive regression)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, use_bias=False),
+                nn.Dense(1, use_bias=False))
+    net.initialize(mx.initializer.Xavier(), ctx=ctxs)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    loss_fn = gluon.loss.L2Loss()
+    first = last = None
+    for _ in range(25):
+        losses = []
+        with autograd.record():
+            for c, sl in zip(ctxs, (slice(0, 16), slice(16, 32))):
+                out = net(nd.array(X[sl], ctx=c))
+                losses.append(loss_fn(out, nd.array(Y[sl], ctx=c)))
+        for L in losses:
+            L.backward()
+        tr.step(32)
+        cur = float(sum(L.mean().asnumpy() for L in losses)) / 2
+        first = cur if first is None else first
+        last = cur
+    assert isinstance(tr._kvstore, ICIKVStore), tr._kvstore
+    assert tr._kvstore.stats()["collectives"] > 0
+    assert last < first * 0.2, (first, last)
 
 
 def test_async_push_overlaps_compute():

@@ -487,6 +487,54 @@ class TestPylockTierCoverage:
         assert "py-lock-order" in _rules(fs)
 
 
+class TestPylockKVStoreCoverage:
+    """ISSUE 14 satellite: pylocklint's auto-scope reaches the
+    round-19 ``mxnet_tpu/kvstore`` package (the ICI-allreduce store's
+    telemetry counters are written under ``self._mu`` from whatever
+    thread pushes; zero findings on the live package is pinned by
+    ``test_pylocklint_zero_findings_even_baselined``, which now scans
+    it — these prove a violation planted THERE would fire, i.e. the
+    coverage is real, not vacuous)."""
+
+    def test_planted_guarded_field_fires(self):
+        src = ("import threading\n"
+               "class ICIKVStore:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self._collectives = 0\n"
+               "    def push(self, key, value):\n"
+               "        with self._mu:\n"
+               "            self._collectives += 1\n"
+               "    def reset(self):\n"
+               "        self._collectives = 0\n")
+        fs = pylocklint.lint_source(src, "mxnet_tpu/kvstore/ici.py")
+        assert _rules(fs) == {"py-guarded-field": 1}
+
+    def test_planted_blocking_under_lock_fires(self):
+        # the store's real hazard shape: dispatching the collective
+        # (a device step) while holding the telemetry lock would
+        # serialize every pushing thread behind the compiled program
+        src = ("import threading, time\n"
+               "class ICIKVStore:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "    def push(self, key, value):\n"
+               "        with self._mu:\n"
+               "            time.sleep(0.5)\n")
+        fs = pylocklint.lint_source(src, "mxnet_tpu/kvstore/ici.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+    def test_live_store_holds_no_lock_across_the_collective(self):
+        """The live push() dispatches the collective OUTSIDE _mu (the
+        lock guards only the counters) — pinned here so a refactor
+        that hoists the lock around _reduce_flat re-fires the planted
+        shape above on the real file."""
+        src = open(os.path.join(
+            REPO_ROOT, "mxnet_tpu/kvstore/ici.py")).read()
+        fs = pylocklint.lint_source(src, "mxnet_tpu/kvstore/ici.py")
+        assert fs == [], [str(f) for f in fs]
+
+
 class TestBenchSyncFixtures:
     """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
     unsynced-jit pattern fires once, the pragma'd twin is suppressed,
@@ -671,6 +719,18 @@ class TestHotRegionAdditions:
         ("mxnet_tpu/serving/engine.py",
          "class ServingEngine:\n"
          " def _swap_in(self, req, inp, slot):\n%s"),
+        # round 19: the training scale-out hot paths — the ICI
+        # KVStore's per-gradient-sync push/bucketing and the FSDP
+        # composition helpers traced inside the sharded train step;
+        # an in-loop jit there recompiles the collective every sync
+        ("mxnet_tpu/kvstore/ici.py",
+         "class ICIKVStore:\n"
+         " def push(self, key, value, priority=0):\n%s"),
+        ("mxnet_tpu/kvstore/ici.py",
+         "class ICIKVStore:\n"
+         " def _reduce_flat(self, devs, bucket):\n%s"),
+        ("mxnet_tpu/parallel/fsdp.py",
+         "def fsdp_param_specs(cfg, dp='dp', tp=None):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)

@@ -151,17 +151,23 @@ class ProgramSpec:
     hot: bool = True
     path: str = ""
     line: int = 0
+    # files that shape the program WITHOUT leaving traceback frames in
+    # the jaxpr (e.g. sharding-spec construction at jit time — the FSDP
+    # rule table); merged into the recorded closure so --changed-only
+    # re-traces on their edits too (round 19)
+    extra_closure: Tuple[str, ...] = ()
 
 
 def spec(name, build, *, donate=(), dtype_region=None, f32_allow=None,
-         hot=True):
+         hot=True, extra_closure=()):
     """Register a program, anchoring findings at the caller's line."""
     frame = sys._getframe(1)
     return ProgramSpec(name=name, build=build, donate=tuple(donate),
                        dtype_region=dtype_region,
                        f32_allow=dict(f32_allow or {}), hot=hot,
                        path=frame.f_code.co_filename,
-                       line=frame.f_lineno)
+                       line=frame.f_lineno,
+                       extra_closure=tuple(extra_closure))
 
 
 # ---------------------------------------------------------------------------
@@ -179,6 +185,13 @@ _GEN_B, _GEN_P, _GEN_NEW = 1, 8, 8
 # manifest rows (both must divide gpt_tiny's 4 heads)
 _TP = 2
 _PER_DEVICE_TPS = (2, 4)
+# FSDP BERT train step (round 19): dp degree of the sharded train
+# registry entries, and the dp size the train-audit's shape-aware
+# derivation divides against (8 = the virtual tier-1 mesh; it must
+# exceed bert_tiny's type_vocab_size=2 so the derivation is forced off
+# type_emb's dim 0, the case the regex table also special-cases)
+_TRAIN_DP = 2
+_AUDIT_DP_SIZE = 8
 
 
 def _gpt_cfg():
@@ -382,6 +395,62 @@ def _train_batch(with_labels):
     return batch
 
 
+def _train_mesh():
+    """The dp mesh the FSDP train registry entries lower through —
+    same virtual-CPU-mesh contract as :func:`_registry_mesh`."""
+    import jax
+    from mxnet_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) < _TRAIN_DP:
+        raise RuntimeError(
+            "graphlint: the bert_train_step_fsdp registry entries need "
+            "a %d-device mesh but only %d device(s) are visible — run "
+            "via `python -m tools.analysis` or set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8"
+            % (_TRAIN_DP, len(jax.devices())))
+    return make_mesh({"dp": _TRAIN_DP},
+                     devices=list(jax.devices())[:_TRAIN_DP])
+
+
+def _bert_fsdp_cfg(param_dtype):
+    from mxnet_tpu.models import transformer as T
+    return T.bert_tiny(use_flash=False, remat=False, dropout=0.0,
+                       dtype=("float32" if param_dtype == "float32"
+                              else "bfloat16"),
+                       param_dtype=param_dtype)
+
+
+def _build_bert_train_fsdp(param_dtype):
+    """The FSDP BERT pretrain step (round 19, ROADMAP 5): the live
+    ``make_train_step(fsdp=True)`` builder lowered through a
+    dp=``_TRAIN_DP`` mesh with params + optimizer moments sharded by
+    the ``parallel/fsdp.py`` rule table.  Donation of the (params,
+    opt_state) tuple must survive the sharded lowering — the state is
+    updated in place every step, and a dropped donation doubles
+    resident training HBM exactly like the serving-pool case.  The
+    abstract state is built from the same ``init_params`` /
+    ``optax.adamw().init`` pair the live ``init_state`` materializes
+    (eval_shape only; the adamw state STRUCTURE does not depend on
+    hyperparameters)."""
+    import jax
+    import optax
+    from mxnet_tpu.models import transformer as T
+    cfg = _bert_fsdp_cfg(param_dtype)
+    _, step = T.make_train_step(cfg, mesh=_train_mesh(), fsdp=True)
+    params = jax.eval_shape(
+        lambda: T.init_params(jax.random.PRNGKey(0), cfg))
+    opt = jax.eval_shape(optax.adamw(1e-4).init, params)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    return step, ((params, opt), _train_batch(True), key)
+
+
+def build_bert_train_step_fsdp():
+    return _build_bert_train_fsdp("float32")
+
+
+def build_bert_train_step_fsdp_bf16():
+    return _build_bert_train_fsdp("bfloat16")
+
+
 def build_transformer_train_step():
     import jax
     from mxnet_tpu.models import transformer as T
@@ -460,6 +529,18 @@ def live_programs() -> List[ProgramSpec]:
         spec("transformer_train_step", build_transformer_train_step,
              donate=(0,)),
         spec("gpt_train_step", build_gpt_train_step),
+        # round 19 (ROADMAP 5): the FSDP BERT pretrain step, lowered
+        # through the dp mesh with rule-table-sharded params + moments
+        # — donation of (params, opt_state) gated like the serving
+        # pools', f32 and bf16-param variants
+        spec("bert_train_step_fsdp", build_bert_train_step_fsdp,
+             donate=(0,),
+             extra_closure=("mxnet_tpu/parallel/fsdp.py",
+                            "mxnet_tpu/parallel/mesh.py")),
+        spec("bert_train_step_fsdp_bf16",
+             build_bert_train_step_fsdp_bf16, donate=(0,),
+             extra_closure=("mxnet_tpu/parallel/fsdp.py",
+                            "mxnet_tpu/parallel/mesh.py")),
     ]
 
 
@@ -813,7 +894,9 @@ def _needs_trace(sp, budgets, only: Set[str]) -> bool:
     closure = (entry or {}).get("closure")
     if not closure:
         return True
-    return bool(set(closure) & only)
+    # extra_closure unions at READ time only — the stored closure
+    # stays a pure trace record (one mechanism, not two)
+    return bool((set(closure) | set(sp.extra_closure)) & only)
 
 
 def run(root: str, only: Optional[Set[str]] = None,
@@ -837,6 +920,12 @@ def run(root: str, only: Optional[Set[str]] = None,
     if step_sp and (only is None
                     or _needs_trace(step_sp[0], budgets, only)):
         findings.extend(sharding_readiness_findings(root))
+    # the train half (round 19) scopes with the FSDP train step the
+    # same way — transformer / parallel.fsdp / analysis-infra changes
+    train_sp = [sp for sp in specs if sp.name == "bert_train_step_fsdp"]
+    if train_sp and (only is None
+                     or _needs_trace(train_sp[0], budgets, only)):
+        findings.extend(train_sharding_readiness_findings(root))
     by_path: Dict[str, List[Finding]] = {}
     for f in findings:
         by_path.setdefault(f.path, []).append(f)
@@ -1069,6 +1158,190 @@ def sharding_readiness_findings(root: str) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# train-step sharding audit (round 19 — the ROADMAP-5 closing criterion)
+# ---------------------------------------------------------------------------
+
+def _train_fsdp_derivation(cfg):
+    """graphlint's OWN shape-aware derivation of the FSDP composition,
+    independent of the ``parallel/fsdp.py`` regex rule table the
+    declaration binds: for every param leaf, ``dp`` lands on the FIRST
+    dim the megatron rule (``models/transformer.py param_specs``)
+    leaves free whose size divides the audit dp degree; a leaf with no
+    free divisible dim composes ``dp`` as a sub-axis of its smallest
+    already-sharded dim (tp partitions first, dp subdivides the
+    shard).  Two independent routes to the same table — a rule-table
+    edit that silently changes a param's placement is a MISMATCH."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.models import transformer as T
+
+    base = T.param_specs(cfg, tp="tp")
+    shapes = jax.eval_shape(
+        lambda: T.init_params(jax.random.PRNGKey(0), cfg))
+    is_p = lambda x: isinstance(x, P)       # noqa: E731
+    base_leaves, treedef = jax.tree_util.tree_flatten(base, is_leaf=is_p)
+    shape_leaves = jax.tree_util.tree_leaves(shapes)
+    assert len(base_leaves) == len(shape_leaves)
+    out = []
+    for spec_, leaf in zip(base_leaves, shape_leaves):
+        ndim = len(leaf.shape)
+        entries = list(spec_)[:ndim]
+        entries += [None] * (ndim - len(entries))
+        for i in range(ndim):
+            if entries[i] is None \
+                    and leaf.shape[i] % _AUDIT_DP_SIZE == 0:
+                entries[i] = "dp"
+                break
+        else:
+            for i in range(ndim):
+                if entries[i] is not None \
+                        and leaf.shape[i] % _AUDIT_DP_SIZE == 0:
+                    cur = entries[i]
+                    entries[i] = (cur + ("dp",)
+                                  if isinstance(cur, tuple)
+                                  else (cur, "dp"))
+                    break
+        out.append(P(*entries))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _train_sharding_rows(cfg):
+    """Audit core for the train step: every declared input spec
+    (``models/transformer.py train_step_input_specs`` — what
+    ``make_train_step(fsdp=True)`` lowers through) verified against
+    the independent derivation; batch rows must shard exactly the
+    batch dim over dp, the rng replicates, and the declared OUTPUT
+    param specs must equal the input ones (the donation / no-reshard
+    contract).  Returns (rows, counts)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.models import transformer as T
+
+    counts = {"covered": 0, "mismatched": 0, "uncovered": 0}
+    rows: List[Tuple[str, str, str, int, str]] = []
+    try:
+        declared, batch_specs, rng_spec = T.train_step_input_specs(
+            cfg, tp="tp")
+    except Exception as e:                  # rule-table gap
+        counts["uncovered"] += 1
+        rows.append(("params", "-", "-", 0,
+                     "UNCOVERED — train_step_input_specs failed: %s"
+                     % e))
+        return rows, counts
+    derived = _train_fsdp_derivation(cfg)
+    shapes = jax.eval_shape(
+        lambda: T.init_params(jax.random.PRNGKey(0), cfg))
+    is_p = lambda x: isinstance(x, P)       # noqa: E731
+    dec_leaves = jax.tree_util.tree_flatten_with_path(
+        declared, is_leaf=is_p)[0]
+    der_leaves = jax.tree_util.tree_flatten_with_path(
+        derived, is_leaf=is_p)[0]
+    shp_leaves = jax.tree_util.tree_flatten_with_path(shapes)[0]
+    seen: Set[Tuple[str, str]] = set()
+    for (dpath, dec), (_, der), (_, leaf) in zip(dec_leaves, der_leaves,
+                                                 shp_leaves):
+        agg = "params" + _agg_path(jax.tree_util.keystr(dpath))
+        shape = "x".join(map(str, leaf.shape)) or "scalar"
+        if (agg, shape) in seen:
+            continue
+        seen.add((agg, shape))
+        decs, ders = _spec_str(dec), _spec_str(der)
+        if decs == ders:
+            status = ("covered: %s — rule table and shape-aware "
+                      "derivation agree" % decs)
+            counts["covered"] += 1
+        else:
+            status = ("MISMATCH — declared %s, derivation says %s"
+                      % (decs, ders))
+            counts["mismatched"] += 1
+        rows.append((agg, shape, str(leaf.dtype), _aval_bytes(leaf),
+                     status))
+    for name, spec_ in sorted(batch_specs.items()):
+        entries = tuple(spec_)
+        ok = (len(entries) >= 1 and entries[0] == "dp"
+              and all(e is None for e in entries[1:]))
+        if ok:
+            status = "covered: %s — batch dim sharded over dp" \
+                % _spec_str(spec_)
+            counts["covered"] += 1
+        else:
+            status = ("MISMATCH — batch inputs must shard exactly the "
+                      "batch dim over dp, declared %s"
+                      % _spec_str(spec_))
+            counts["mismatched"] += 1
+        rows.append(("batch['%s']" % name, "B x T", "-", 0, status))
+    if tuple(rng_spec) == ():
+        rows.append(("rng", "key", "-", 0,
+                     "covered: P() — replicated step key"))
+        counts["covered"] += 1
+    else:
+        rows.append(("rng", "key", "-", 0,
+                     "MISMATCH — the step rng must replicate, "
+                     "declared %s" % _spec_str(rng_spec)))
+        counts["mismatched"] += 1
+    out_p, out_loss = T.train_step_output_specs(cfg, tp="tp")
+    out_ok = (jax.tree_util.tree_structure(
+                  out_p, is_leaf=is_p) == jax.tree_util.tree_structure(
+                  declared, is_leaf=is_p)
+              and all(_spec_str(a) == _spec_str(b) for (_, a), (_, b)
+                      in zip(jax.tree_util.tree_flatten_with_path(
+                                 out_p, is_leaf=is_p)[0],
+                             dec_leaves))
+              and tuple(out_loss) == ())
+    if out_ok:
+        rows.append(("out: (params', loss)", "-", "-", 0,
+                     "covered: params keep the input placement "
+                     "(donation contract), loss replicates"))
+        counts["covered"] += 1
+    else:
+        rows.append(("out: (params', loss)", "-", "-", 0,
+                     "MISMATCH — output params must keep EXACTLY the "
+                     "input placement (a drifted out spec forces a "
+                     "reshard every step and breaks donation)"))
+        counts["mismatched"] += 1
+    return rows, counts
+
+
+def _train_audit_cfg():
+    from mxnet_tpu.models import transformer as T
+    return T.bert_tiny(use_flash=False, remat=False, dropout=0.0)
+
+
+def train_sharding_readiness_findings(root: str) -> List[Finding]:
+    """The train half of ``graph-sharding-readiness`` (round 19): the
+    FSDP train step's DECLARED in/out specs must cover every param
+    (regex rule table agreeing with the shape-aware derivation), shard
+    the batch over dp, replicate the rng, and keep the output params
+    on the input placement."""
+    import inspect
+    from mxnet_tpu.models import transformer as T
+    try:
+        line = inspect.getsourcelines(T.train_step_input_specs)[1]
+    except (OSError, TypeError):
+        line = 1
+    path = "mxnet_tpu/models/transformer.py"
+    findings: List[Finding] = []
+    _, counts = _train_sharding_rows(_train_audit_cfg())
+    if counts["uncovered"]:
+        findings.append(Finding(
+            "graph", "graph-sharding-readiness", path, line,
+            "train_step_input_specs.uncovered",
+            "%d train-step input group(s) have no declared/derivable "
+            "sharding — the FSDP step cannot lower through the mesh "
+            "for them (see docs/sharding_readiness.md)"
+            % counts["uncovered"]))
+    if counts["mismatched"]:
+        findings.append(Finding(
+            "graph", "graph-sharding-readiness", path, line,
+            "train_step_input_specs.mismatch",
+            "%d train-step input/output group(s) declare shardings "
+            "that contradict the FSDP composition of the megatron "
+            "rule table — params would silently reshard (or gather "
+            "full-size) every step" % counts["mismatched"]))
+    return findings
+
+
 def sharding_audit_md(root: str) -> str:
     """The ServingEngine step-program input audit: every input leaf
     with its engine-declared sharding, verified against the megatron
@@ -1126,6 +1399,47 @@ def sharding_audit_md(root: str) -> str:
         "Per-device expected peaks for the sharded step live in",
         "`tools/analysis/hbm_budgets.json` "
         "(`per_device_expected_peak_bytes`).",
+        "",
+    ]
+    t_rows, t_counts = _train_sharding_rows(_train_audit_cfg())
+    lines += [
+        "# Sharding readiness — FSDP BERT train step (round 19)",
+        "",
+        "The train half of the audit (the ROADMAP-5 closing "
+        "criterion): for every",
+        "input of the FSDP pretrain step "
+        "(`models/transformer.py make_train_step(fsdp=True)`,",
+        "bert_tiny shapes, dp composed with tp), the DECLARED "
+        "shardings",
+        "(`train_step_input_specs` / `train_step_output_specs`) "
+        "verified against",
+        "graphlint's own shape-aware derivation from the megatron "
+        "table — dp on the",
+        "first free dim that divides dp=%d, sub-axis composition "
+        "when none is free." % _AUDIT_DP_SIZE,
+        "The `parallel/fsdp.py` regex rule table and this derivation "
+        "are independent",
+        "routes; MISMATCH or UNCOVERED rows fail tier-1 via "
+        "`graph-sharding-readiness`.",
+        "",
+        "| input | shape | dtype | bytes | partition rule |",
+        "|---|---|---|---|---|",
+    ]
+    for agg, shape, dtype, nbytes, status in t_rows:
+        lines.append("| `%s` | %s | %s | %d | %s |"
+                     % (agg, shape, dtype, nbytes, status))
+    lines += [
+        "",
+        "**Summary:** %d covered, UNCOVERED count: %d, mismatched: "
+        "%d.  Params and" % (t_counts["covered"],
+                             t_counts["uncovered"],
+                             t_counts["mismatched"]),
+        "param-shaped optimizer moments hold exactly 1/dp per device "
+        "(asserted against",
+        "live `addressable_shards` in `tests/test_train_scale.py`); "
+        "the batch shards its",
+        "leading dim over dp; updated params keep the input placement "
+        "(donation).",
         "",
     ]
     return "\n".join(lines)

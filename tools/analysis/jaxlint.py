@@ -126,6 +126,13 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/serving/autoscaler.py", r".*"),
     ("mxnet_tpu/serving/chaos.py", r".*"),
     ("benchmark/traffic_trace.py", r".*"),
+    # round 19: the training scale-out hot paths — the ICI-allreduce
+    # KVStore's push/bucketing runs once per gradient sync (an in-loop
+    # jit or stray host sync there serializes every training step
+    # behind the collective), and the FSDP rule-table/composition
+    # helpers are traced inside the sharded train step
+    ("mxnet_tpu/kvstore/ici.py", r".*"),
+    ("mxnet_tpu/parallel/fsdp.py", r".*"),
 ]
 
 # modules whose timestamps must stay on the shared perf_counter clock

@@ -89,8 +89,12 @@ from .findings import Finding, PRAGMA_RE, apply_pragmas
 __all__ = ["PACKAGES", "lint_source", "analyze", "run"]
 
 # repo-relative package roots the pass analyzes as ONE program (the
-# cross-module call graph spans all of them)
-PACKAGES = ["mxnet_tpu/serving", "mxnet_tpu/obs", "mxnet_tpu/io"]
+# cross-module call graph spans all of them).  mxnet_tpu/kvstore joined
+# in round 19: the ICI-allreduce store's telemetry counters are written
+# from data-loader threads while the main thread pulls — the same
+# shared-state discipline the serving layer needs.
+PACKAGES = ["mxnet_tpu/serving", "mxnet_tpu/obs", "mxnet_tpu/io",
+            "mxnet_tpu/kvstore"]
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
 _BLOCKING_QUEUE = {"get", "put"}

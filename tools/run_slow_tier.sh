@@ -1,11 +1,11 @@
 #!/bin/sh
-# Run the slow tier in ten bounded chunks (each <5 min on a 1-vCPU
+# Run the slow tier in bounded chunks (each <5 min on a 1-vCPU
 # host) so the whole tier is verifiable inside standard command
 # timeouts.  Usage: tools/run_slow_tier.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
 sh tools/run_static_analysis.sh --all
-for g in a b c d e f g h i j k l; do
+for g in a b c d e f g h i j k l m; do
     echo "== slow group $g =="
     python -m pytest tests/ -q -m "slow_$g" -p no:cacheprovider "$@"
 done

@@ -51,8 +51,12 @@ if [ "$SCOPE" = "--changed-only" ]; then
     CHANGED_ALL=$( (git diff --name-only HEAD; \
                 git ls-files -o --exclude-standard) 2>/dev/null \
                || true)
+    # mxnet_tpu/parallel and mxnet_tpu/kvstore joined in round 19: the
+    # train half of the audit derives from the FSDP rule table
+    # (parallel/fsdp.py), the ZeRO composition (parallel/mesh.py), and
+    # the ICI-allreduce KVStore rides the same train paths
     CHANGED=$(printf '%s\n' "$CHANGED_ALL" \
-               | grep -E '^(mxnet_tpu/(serving|models)|tools/analysis)/' \
+               | grep -E '^(mxnet_tpu/(serving|models|parallel|kvstore)|tools/analysis)/' \
                || true)
     if [ -n "$CHANGED" ]; then
         echo "== regenerating docs/sharding_readiness.md (serving/" \
