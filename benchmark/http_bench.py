@@ -1,0 +1,616 @@
+"""HTTP front-door load proof (round 20): a many-hundred-connection
+OPEN-LOOP asyncio client replaying the round-16 trace format over REAL
+loopback sockets against :class:`mxnet_tpu.serving.HttpFrontend`.
+
+Every serving number before this round was measured by a Python caller
+in the same process.  This benchmark is the edge half of the story —
+the same seeded burst10x workload ``serve_bench --trace`` replays, but
+arriving as HTTP requests: SSE streams read token-by-token, slow
+clients trickling their reads (server-side write backpressure), a
+mass-disconnect storm slamming every odd-indexed open connection shut
+mid-burst (the cancellation-propagation path under load), and a capped
+tenant exercising the edge token-bucket so the 429 count has an exact
+closed form.
+
+Hard-fail protocol (RuntimeError, not prose) — section ``http_load``:
+
+* **peak concurrency** — at least ``min_concurrent`` connections
+  (200 on mid/full) simultaneously open through the real socket path;
+  an open-loop client never waits for the server, so a too-small peak
+  means the bench lost its load, not that the server was fast.
+* **stream bit-identity** — every COMPLETED stream's token sequence
+  is bit-identical to the single-engine ``generate`` oracle, and every
+  storm victim's partial stream is a strict PREFIX of its oracle
+  continuation (a stream must never have sent a wrong token, even one
+  that was cut off).
+* **zero leaks** — after the storm and the drain no replica holds a
+  page beyond its prefix-cache-owned set and no prefix ref survives;
+  every cluster request landed in ``done`` or ``cancelled``.
+* **quota arithmetic** — the capped tenant (token bucket ``rate=0,
+  burst=B``) gets exactly ``min(K, B)`` acceptances and ``max(0,
+  K - B)`` 429s for its K requests, client-counted AND reconciled
+  against ``http_rejected_quota_total``.
+
+Gate — section ``ttfb`` (``gpt_http_stream_ttfb_ms``,
+``run_gate_ttfb``): time from just before the TCP connect to the first
+SSE token-event byte, for a request whose whole prompt is prefix-HOT
+(the edge-pricing configuration: admission + routing + one COW re-feed
+step + the thread→asyncio bridge + the SSE write, NOT a cold prefill).
+Best-of-reps; the row carries the trace seed + sha
+(``perf_regression.py`` refuses the gate without them, per the
+round-16 convention).
+
+    python benchmark/http_bench.py                # mid preset load proof
+    python benchmark/http_bench.py --quick        # CI smoke (tiny floors)
+    python benchmark/http_bench.py --gate         # TTFB gate only
+    python benchmark/http_bench.py --disagg       # disagg cluster flavor
+
+Loopback pricing caveat (docs/perf.md "HTTP front door"): everything
+here shares one host — the client's asyncio loop, the server's asyncio
+loop, and the engine threads contend for the same cores, and loopback
+TCP has none of a real NIC's latency.  The relative claims (identity,
+leaks, quota arithmetic, backpressure survival) are the product; the
+absolute milliseconds are CPU-floor numbers for the chip session to
+re-price.
+"""
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import serve_bench as SB                        # presets + oracle
+import traffic_trace as TT
+
+KEY_OPEN = "sk-open"
+KEY_CAPPED = "sk-capped"
+
+
+def _keys(capped_burst):
+    """The two-tenant key table: an unlimited tenant carrying the
+    trace load and a hard-burst-budget tenant (rate=0 bucket) whose
+    429 count has the exact closed form the load proof checks."""
+    return {KEY_OPEN: {"tenant": "open"},
+            KEY_CAPPED: {"tenant": "capped", "rate": 0,
+                         "burst": int(capped_burst)}}
+
+
+# --------------------------------------------------------------------------
+# the asyncio client
+# --------------------------------------------------------------------------
+
+class _ConnStats:
+    """Shared client-side accounting (single event loop: no locks)."""
+
+    def __init__(self, trigger_open=None):
+        self.open = 0
+        self.peak = 0
+        # the storm trigger: set by connected() the instant the
+        # number of simultaneously-open connections crosses
+        # ``trigger_open`` — deterministic (no polling race against
+        # a congested event loop)
+        self.trigger_open = trigger_open
+        self.trigger = asyncio.Event()
+        self.status = {}                   # idx -> http status
+        self.tokens = {}                   # idx -> [int, ...]
+        self.done = set()                  # idx with a done event
+        self.ttfb_s = {}                   # idx -> first-token latency
+        self.rejected_429 = {KEY_OPEN: 0, KEY_CAPPED: 0}
+        self.writers = {}                  # idx -> live writer (storm)
+        self.aborted = set()               # idx aborted by the storm
+        self.errors = []
+
+    def connected(self, idx, writer):
+        self.open += 1
+        self.peak = max(self.peak, self.open)
+        self.writers[idx] = writer
+        if self.trigger_open is not None \
+                and self.open >= self.trigger_open:
+            self.trigger.set()
+
+    def closed(self, idx):
+        self.open -= 1
+        self.writers.pop(idx, None)
+
+
+def _parse_sse(buf, stats, idx, t0):
+    """Incremental SSE parse: consume complete events from ``buf``,
+    record token payloads / the done event; returns the remainder.
+    The chunked-transfer framing is stripped by length, not by
+    pattern-matching CRLFs inside payloads."""
+    # strip chunk framing first: hex-length\r\n payload \r\n
+    out = stats.tokens.setdefault(idx, [])
+    while True:
+        nl = buf.find(b"\r\n")
+        if nl < 0:
+            return buf
+        try:
+            n = int(buf[:nl], 16)
+        except ValueError:
+            raise RuntimeError("http_bench: bad chunk length %r"
+                               % buf[:nl])
+        if n == 0:
+            return b""                     # terminal chunk
+        if len(buf) < nl + 2 + n + 2:
+            return buf                     # incomplete chunk
+        payload = buf[nl + 2:nl + 2 + n]
+        buf = buf[nl + 2 + n + 2:]
+        for block in payload.split(b"\n\n"):
+            if not block.strip():
+                continue
+            ev, data = None, None
+            for ln in block.split(b"\n"):
+                if ln.startswith(b"event: "):
+                    ev = ln[7:].decode()
+                elif ln.startswith(b"data: "):
+                    data = json.loads(ln[6:])
+            if ev == "token":
+                if idx not in stats.ttfb_s:
+                    stats.ttfb_s[idx] = time.perf_counter() - t0
+                out.append(int(data["t"]))
+            elif ev == "done":
+                stats.done.add(idx)
+            elif ev == "error":
+                stats.errors.append((idx, data))
+
+
+async def _one_request(idx, at, prompt, n, *, host, port, key, stats,
+                       t0, trickle=False, stream=True):
+    """One open-loop client: sleep to the arrival time, connect, send,
+    read the stream to completion (or until the storm aborts us)."""
+    now = time.perf_counter() - t0
+    if at > now:
+        await asyncio.sleep(at - now)
+    body = json.dumps({"prompt": [int(x) for x in prompt],
+                       "max_new_tokens": int(n),
+                       "stream": bool(stream)}).encode()
+    req = (b"POST /v1/generate HTTP/1.1\r\nHost: bench\r\n"
+           b"Authorization: Bearer " + key.encode() + b"\r\n"
+           b"Content-Type: application/json\r\n"
+           b"Content-Length: %d\r\n\r\n" % len(body)) + body
+    t_req = time.perf_counter()
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+    except OSError as e:
+        stats.status[idx] = -1
+        stats.errors.append((idx, repr(e)))
+        return
+    stats.connected(idx, writer)
+    try:
+        writer.write(req)
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        stats.status[idx] = status
+        if status == 429:
+            stats.rejected_429[key] += 1
+            return
+        if status != 200:
+            stats.errors.append((idx, head.decode("latin-1")))
+            return
+        if not stream:
+            # JSON mode: fixed-length body on a keep-alive connection
+            clen = int([ln.split(b":", 1)[1] for ln in
+                        head.lower().split(b"\r\n")
+                        if ln.startswith(b"content-length:")][0])
+            obj = json.loads(await reader.readexactly(clen))
+            stats.ttfb_s[idx] = time.perf_counter() - t_req
+            stats.tokens[idx] = [int(t) for t in obj["tokens"]]
+            stats.done.add(idx)
+            return
+        buf = b""
+        while True:
+            data = await reader.read(256 if trickle else 65536)
+            if not data:
+                break
+            buf = _parse_sse(buf + data, stats, idx, t_req)
+            if idx in stats.done:
+                break
+            if trickle:
+                # the slow client: tiny reads with pauses — the
+                # server's writer.drain() must absorb this without
+                # stalling anyone else's stream
+                await asyncio.sleep(0.02)
+    except (ConnectionResetError, BrokenPipeError,
+            asyncio.IncompleteReadError, OSError):
+        pass                               # storm victims land here
+    finally:
+        stats.closed(idx)
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+async def _storm(trigger_open, t_deadline, victims, stats, t0):
+    """The mass-disconnect storm: the moment the client holds
+    ``trigger_open`` simultaneously-open connections (i.e. mid-pile-
+    up, when a real incident's give-up wave would hit), abort every
+    open victim connection in one burst (transport ``abort()``: RST,
+    not FIN — the rudest disconnect a client can deliver).
+    ``t_deadline`` is the fallback firing time if the pile-up never
+    crests (the peak-concurrency hard check then fails the run with
+    the better diagnostic)."""
+    del trigger_open                       # wired into stats.trigger
+    try:
+        await asyncio.wait_for(
+            stats.trigger.wait(),
+            max(0.0, t_deadline - (time.perf_counter() - t0)))
+    except asyncio.TimeoutError:
+        pass
+    hit = 0
+    for idx in victims:
+        w = stats.writers.get(idx)
+        if w is not None:
+            stats.aborted.add(idx)
+            w.transport.abort()
+            hit += 1
+    stats.storm_t = time.perf_counter() - t0
+    return hit
+
+
+async def _drive(wl, *, host, port, trigger_open, t_deadline,
+                 victims, trickle_every, capped_every, json_every):
+    stats = _ConnStats(trigger_open=trigger_open)
+    t0 = time.perf_counter()
+    tasks = []
+    for idx, (at, prompt, n) in enumerate(wl):
+        key = KEY_CAPPED if idx % capped_every == 1 else KEY_OPEN
+        trickle = (idx % trickle_every == 3) and idx not in victims
+        stream = not (json_every and idx % json_every == 5
+                      and idx not in victims)
+        tasks.append(asyncio.ensure_future(_one_request(
+            idx, at, prompt, n, host=host, port=port, key=key,
+            stats=stats, t0=t0, trickle=trickle, stream=stream)))
+    storm_task = asyncio.ensure_future(
+        _storm(trigger_open, t_deadline, victims, stats, t0))
+    await asyncio.gather(*tasks)
+    stats.storm_hits = await storm_task
+    return stats
+
+
+# --------------------------------------------------------------------------
+# the load proof
+# --------------------------------------------------------------------------
+
+def run_load(params, cfg, p, trace, *, disagg=False, replicas=2,
+             min_concurrent=200, capped_burst=8, capped_every=8,
+             trickle_every=7, json_every=0, timeout_s=900):
+    """The ``http_load`` section — see the module docstring for the
+    hard-fail protocol.  ``capped_every``: every (i % capped_every ==
+    1)-th request carries the capped tenant's key; with K such
+    requests and burst B the exact expectation is min(K, B) accepted +
+    max(0, K - B) rejected.  Storm victims are the odd-indexed
+    connections still open mid-burst."""
+    from mxnet_tpu.serving import (DisaggServingCluster, HttpFrontend,
+                                   ServingCluster)
+    wl = TT.workload(trace)
+    spec = trace["spec"]
+    geo = SB._engine_geometry(p, wl, section="http")
+    if disagg:
+        cl = DisaggServingCluster(params, cfg, prefill=1, decode=1,
+                                  metrics=True, watchdog_s=120.0,
+                                  **geo)
+    else:
+        cl = ServingCluster(params, cfg, replicas=replicas,
+                            metrics=True, watchdog_s=120.0,
+                            max_queue=10 ** 6, **geo)
+    fe = None
+    try:
+        # pre-warm the step program outside the clock (excluded from
+        # the terminal-state sweep: it never traversed the HTTP edge)
+        warm_rid = cl.submit(wl[0][1], wl[0][2])
+        cl.result(warm_rid, timeout=600)
+        fe = HttpFrontend(cl, keys=_keys(capped_burst),
+                          max_connections=4096).start()
+        victims = {i for i in range(len(wl)) if i % 2 == 1
+                   and i % capped_every != 1}
+        t_wall = time.perf_counter()
+        stats = asyncio.run(_drive(
+            wl, host=fe.host, port=fe.port,
+            trigger_open=min_concurrent,
+            t_deadline=spec["duration_s"] + 30.0,
+            victims=victims, trickle_every=trickle_every,
+            capped_every=capped_every, json_every=json_every))
+        # every cluster request must reach a terminal state: victims'
+        # cancels need a beat to propagate through the workers
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            with cl._lock:
+                live = sum(r.state in ("queued", "running")
+                           for r in cl.requests.values())
+            if not live:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                "http_bench: %d requests never reached a terminal "
+                "state after the replay" % live)
+        wall = time.perf_counter() - t_wall
+
+        # ---- hard check 1: peak concurrency
+        if stats.peak < min_concurrent:
+            raise RuntimeError(
+                "http_bench: peak concurrency %d < required %d — the "
+                "open-loop load never materialized"
+                % (stats.peak, min_concurrent))
+
+        # ---- hard check 2: quota arithmetic, client + server side
+        K = sum(1 for i in range(len(wl)) if i % capped_every == 1)
+        expect_429 = max(0, K - capped_burst)
+        got_429 = stats.rejected_429[KEY_CAPPED]
+        if got_429 != expect_429 or stats.rejected_429[KEY_OPEN]:
+            raise RuntimeError(
+                "http_bench: 429 arithmetic broken — capped tenant "
+                "got %d, expected exactly %d (K=%d, burst=%d); open "
+                "tenant got %d, expected 0"
+                % (got_429, expect_429, K, capped_burst,
+                   stats.rejected_429[KEY_OPEN]))
+        snap = cl.registry.snapshot()["counters"]
+        if int(snap.get("http_rejected_quota_total", 0)) != expect_429:
+            raise RuntimeError(
+                "http_bench: http_rejected_quota_total=%s disagrees "
+                "with the client-counted %d"
+                % (snap.get("http_rejected_quota_total"), expect_429))
+
+        # ---- hard check 3: bit-identity (completed = identical,
+        # aborted = strict prefix; SSE streams carry generated tokens)
+        checked = prefix_checked = 0
+        reqs = [(pr, n) for _, pr, n in wl]
+        oracle = SB._oracle_outputs(params, cfg, reqs)
+        for idx, (at, prompt, n) in enumerate(wl):
+            o_gen = [int(t) for t in oracle[idx][len(prompt):]]
+            got = stats.tokens.get(idx)
+            if idx in stats.done and got is not None:
+                if got != o_gen:
+                    raise RuntimeError(
+                        "http_bench: stream %d diverges from the "
+                        "generate oracle (got %r... expected %r...)"
+                        % (idx, got[:8], o_gen[:8]))
+                checked += 1
+            elif got:                      # aborted mid-stream
+                if got != o_gen[:len(got)]:
+                    raise RuntimeError(
+                        "http_bench: aborted stream %d sent tokens "
+                        "that are NOT a prefix of the oracle" % idx)
+                prefix_checked += 1
+
+        # ---- hard check 4: zero leaks + clean terminal states
+        n_cancelled = n_done = 0
+        with cl._lock:
+            for cr in cl.requests.values():
+                if cr.rid == warm_rid:
+                    continue
+                if cr.state == "done":
+                    n_done += 1
+                elif cr.state == "cancelled":
+                    n_cancelled += 1
+                else:
+                    raise RuntimeError(
+                        "http_bench: request %d ended %r (error=%r) — "
+                        "only done/cancelled are clean outcomes"
+                        % (cr.rid, cr.state, cr.error))
+        if disagg:
+            for name, s in cl.cluster_stats().items():
+                if (s.get("prefix_refs", 0) or s.get("staged_rids", 0)
+                        or s.get("active_requests", 0)
+                        or s.get("pages_in_use", 0)
+                        != s.get("prefix_cached_pages", 0)):
+                    raise RuntimeError(
+                        "http_bench: worker %s leaks after the storm: "
+                        "%r" % (name, s))
+        else:
+            for rep in cl.replicas:
+                eng = rep.engine
+                if eng is None or rep.dead:
+                    continue
+                refs = 0 if eng.prefix is None else \
+                    eng.prefix.refs_total
+                cached = 0 if eng.prefix is None else \
+                    eng.prefix.cached_pages
+                if refs or eng.cache.pages_in_use != cached:
+                    raise RuntimeError(
+                        "http_bench: replica %d leaks after the storm "
+                        "(refs=%d, in_use=%d, cached=%d)"
+                        % (rep.idx, refs, eng.cache.pages_in_use,
+                           cached))
+
+        ttfbs = sorted(v * 1e3 for v in stats.ttfb_s.values())
+        return {
+            "section": "http_load",
+            "config": "%s_%s" % (spec["name"],
+                                 "disagg_p1_d1" if disagg
+                                 else "r%d" % replicas),
+            "seed": spec["seed"], "trace_sha": TT.trace_hash(trace),
+            "arrivals": len(wl), "wall_s": wall,
+            "peak_concurrent": stats.peak,
+            "completed_streams": n_done,
+            "cancelled": n_cancelled,
+            "storm_aborts": stats.storm_hits,
+            "storm_at_s": getattr(stats, "storm_t", None),
+            "capped_requests": K, "capped_burst": capped_burst,
+            "edge_429": got_429, "expected_429": expect_429,
+            "oracle_identical": checked,
+            "oracle_prefix_ok": prefix_checked,
+            "disconnects_counted": int(snap.get(
+                "http_client_disconnects_total", 0)),
+            "cancelled_counted": int(snap.get(
+                "cluster_cancelled_total", 0)),
+            "ttfb_p50_ms": float(np.percentile(ttfbs, 50))
+            if ttfbs else None,
+            "ttfb_p99_ms": float(np.percentile(ttfbs, 99))
+            if ttfbs else None,
+        }
+    finally:
+        if fe is not None:
+            fe.close()
+        cl.close(timeout=120)
+
+
+# --------------------------------------------------------------------------
+# the TTFB gate
+# --------------------------------------------------------------------------
+
+async def _ttfb_once(host, port, prompt, n):
+    body = json.dumps({"prompt": [int(x) for x in prompt],
+                       "max_new_tokens": int(n),
+                       "stream": True}).encode()
+    req = (b"POST /v1/generate HTTP/1.1\r\nHost: gate\r\n"
+           b"Authorization: Bearer " + KEY_OPEN.encode() + b"\r\n"
+           b"Content-Length: %d\r\n\r\n" % len(body)) + body
+    t0 = time.perf_counter()
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(req)
+    await writer.drain()
+    buf = b""
+    ttfb = None
+    try:
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            buf += data
+            if ttfb is None and b"event: token" in buf:
+                ttfb = time.perf_counter() - t0
+            if b"event: done" in buf or b"event: error" in buf:
+                break
+    finally:
+        writer.close()
+    if ttfb is None:
+        raise RuntimeError("http_bench gate: stream closed before the "
+                           "first token event (%r...)" % buf[:200])
+    return ttfb * 1e3
+
+
+def run_gate_ttfb(preset="full", seed=0, reps=5):
+    """The ``gpt_http_stream_ttfb_ms`` gate: best-of-``reps``
+    first-token-byte latency for a prefix-HOT streamed request — the
+    number that prices the HTTP edge itself (auth + parse + submit +
+    route + hot-prefix COW re-feed step + thread→asyncio bridge + SSE
+    frame) rather than a prefill.  Single replica, so the measurement
+    is scheduling-deterministic; the warm-up request both compiles and
+    seeds the prefix cache.  The row carries the trace seed + sha —
+    prompts come from the checked-in trace format, and
+    ``perf_regression.py`` refuses the gate without the provenance."""
+    from mxnet_tpu.serving import HttpFrontend, ServingCluster
+    p = SB.PRESETS[preset]
+    params, cfg = SB._model(p)
+    trace = TT.generate_trace(SB._trace_spec(p, seed))
+    wl = TT.workload(trace)
+    # the longest-prompt event: the hot-vs-cold gap is largest there,
+    # so a broken prefix path shows up as a step change, not noise
+    at, prompt, n = max(wl, key=lambda e: len(e[1]))
+    n = min(n, 8)                          # the gate prices TTFB only
+    geo = SB._engine_geometry(p, wl, section="http-gate")
+    cl = ServingCluster(params, cfg, replicas=1, metrics=True,
+                        max_queue=10 ** 6, **geo)
+    fe = None
+    try:
+        # warm: compile + seed the prefix cache with this exact chain
+        cl.result(cl.submit(prompt, n), timeout=900)
+        fe = HttpFrontend(cl, keys=_keys(8)).start()
+        warm = [asyncio.run(_ttfb_once(fe.host, fe.port, prompt, n))
+                for _ in range(reps)]
+        # cold context row: distinct prompts, no cache seed
+        cold = []
+        for _, pr, nn in wl[1:reps + 1]:
+            if np.array_equal(pr, prompt):
+                continue
+            cold.append(asyncio.run(_ttfb_once(fe.host, fe.port, pr,
+                                               min(nn, 8))))
+        return {
+            "section": "ttfb", "config": "%s_hot_r1" % preset,
+            "seed": seed, "trace_sha": TT.trace_hash(trace),
+            "prompt_len": int(len(prompt)), "reps": reps,
+            "ttfb_warm_ms": min(warm),
+            "ttfb_warm_all_ms": [round(v, 3) for v in warm],
+            "ttfb_cold_ms": min(cold) if cold else None,
+        }
+    finally:
+        if fe is not None:
+            fe.close()
+        cl.close(timeout=120)
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _load_spec(p, seed, base_rate, duration_s):
+    """burst10x trace sized for the connection-count floor: the load
+    proof needs hundreds of concurrent sockets, so the arrival rate
+    runs well past the service rate — the pile-up IS the test."""
+    return TT.burst10x_spec(seed=seed, vocab=p.vocab,
+                            max_total=min(p.max_len,
+                                          max(p.prompt_lens)
+                                          + max(p.out_lens)),
+                            base_rate=base_rate,
+                            duration_s=duration_s)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="mid",
+                    choices=sorted(SB.PRESETS))
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: quick preset, tiny floors")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--base-rate", type=float, default=48.0,
+                    help="trace base arrival rate (the 10x burst "
+                         "multiplies this)")
+    ap.add_argument("--duration-s", type=float, default=4.0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--disagg", action="store_true")
+    ap.add_argument("--min-concurrent", type=int, default=None,
+                    help="hard floor on peak concurrent connections "
+                         "(default: 200, or 8 with --quick)")
+    ap.add_argument("--gate", action="store_true",
+                    help="run only the TTFB gate section")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="append result rows to this JSON file")
+    args = ap.parse_args(argv)
+
+    preset = "quick" if args.quick else args.preset
+    p = SB.PRESETS[preset]
+    rows = []
+    if args.gate:
+        rows.append(run_gate_ttfb(preset, seed=args.seed))
+    else:
+        params, cfg = SB._model(p)
+        if args.quick:
+            spec = _load_spec(p, args.seed, 24.0, 1.5)
+            min_conc = args.min_concurrent or 8
+        else:
+            spec = _load_spec(p, args.seed, args.base_rate,
+                              args.duration_s)
+            min_conc = args.min_concurrent or 200
+        trace = TT.generate_trace(spec)
+        rows.append(run_load(params, cfg, p, trace,
+                             disagg=args.disagg,
+                             replicas=args.replicas,
+                             min_concurrent=min_conc,
+                             json_every=12))
+        rows.append(run_gate_ttfb(preset, seed=args.seed))
+    for r in rows:
+        print(json.dumps(r))
+    if args.out:
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = []
+        with open(args.out, "w") as f:
+            json.dump(prev + rows, f, indent=1)
+        print("rows appended to %s" % args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -387,6 +387,31 @@ def bench_gpt_spec_decode():
     return batch / per_tok
 
 
+def bench_gpt_http_stream_ttfb():
+    """HTTP front-door gate (round 20, ROADMAP 6): time-to-first-
+    token-byte in ms for a streamed ``POST /v1/generate`` whose whole
+    prompt is prefix-HOT, measured from just before the TCP connect
+    to the first SSE token event on a REAL loopback socket
+    (http_bench.run_gate_ttfb, full preset, single replica so the
+    measurement is scheduling-deterministic).  This prices the edge
+    itself — connect + parse + auth + token-bucket + submit + route +
+    one hot-prefix COW re-feed step + the thread→asyncio bridge + the
+    SSE chunk write — NOT a cold prefill; a regression here is the
+    front door getting slower, not the model.  Direction "lower":
+    v <= hi.  Reproducibility enforced like the goodput gate's: the
+    prompt comes from the checked-in trace format and the row must
+    carry its seed + trace sha or the gate refuses to report."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import http_bench
+    row = http_bench.run_gate_ttfb("full")
+    if not row.get("trace_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_http_stream_ttfb_ms: result row carries no trace "
+            "seed/sha — the measurement is not reproducible; "
+            "refusing to gate it (got keys %s)" % sorted(row))
+    return row["ttfb_warm_ms"]
+
+
 def bench_bert_pretrain():
     """Training scale-out gate (round 19, ROADMAP 5): examples/s of
     the ONE jitted FSDP BERT-base pretrain step at dp=8 — params +
@@ -442,6 +467,7 @@ BENCHES = {
     "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
     "gpt_serve_tier_hit_ttft_ms": (bench_gpt_serve_tier_hit,
                                    "lower"),
+    "gpt_http_stream_ttfb_ms": (bench_gpt_http_stream_ttfb, "lower"),
     "bert_pretrain_ex_s": (bench_bert_pretrain, "higher"),
 }
 

@@ -1181,8 +1181,8 @@ def _oracle_outputs(params, cfg, reqs):
 
 def run_trace_replay(params, cfg, p, trace, *, disagg=False,
                      autoscale=True, min_replicas=2, max_replicas=4,
-                     chaos_events=None, chaos_seed=0, slo=None,
-                     verify_oracle=True, standby_prefill=0):
+                     chaos_events=None, chaos_seed=0, chaos_kinds=None,
+                     slo=None, verify_oracle=True, standby_prefill=0):
     """Round-16 headline section: OPEN-LOOP replay of a seeded
     workload trace (diurnal ramp + 10× burst + heavy-tailed lengths,
     ``benchmark/traffic_trace.py``) against the serving cluster, with
@@ -1218,13 +1218,20 @@ def run_trace_replay(params, cfg, p, trace, *, disagg=False,
     slo = slo or TT.SLO(p.slo_ttft_ms, p.slo_tbt_ms)
     geo = _engine_geometry(p, wl, section="trace")
     if chaos_events is None:
-        # the scripted scenario: one replica death mid-burst (a real
-        # SIGKILL for the disagg cluster's worker processes, the
-        # injected-raise failover path for in-process replicas —
-        # prefill-targeted there so the single decode role survives)
-        mid = spec["burst_at_s"] + spec["burst_dur_s"] / 2.0
-        chaos_events = [ChaosEvent(mid, "kill",
-                                   "prefill" if disagg else None)]
+        # the scripted scenario: one fault per kind, spread through
+        # the burst window.  Default ("kill",) = one replica death
+        # mid-burst (a real SIGKILL for the disagg cluster's worker
+        # processes, the injected-raise failover path for in-process
+        # replicas — prefill-targeted there so the single decode role
+        # survives).  Round 20 adds "cancel" — a seeded live request
+        # cancelled end-to-end, the client-disconnect fault the HTTP
+        # front door propagates.
+        kinds = tuple(chaos_kinds) if chaos_kinds else ("kill",)
+        step = spec["burst_dur_s"] / (len(kinds) + 1.0)
+        chaos_events = [
+            ChaosEvent(spec["burst_at_s"] + (i + 1) * step, k,
+                       "prefill" if (disagg and k == "kill") else None)
+            for i, k in enumerate(kinds)]
     if disagg:
         cl = DisaggServingCluster(params, cfg, prefill=2, decode=1,
                                   metrics=True, watchdog_s=60.0,
@@ -1300,11 +1307,13 @@ def run_trace_replay(params, cfg, p, trace, *, disagg=False,
         wall = time.perf_counter() - t0
 
         good, ttfts, worst_tbts = [], [], []
-        completed = failed = 0
+        completed = cancelled = failed = 0
         for rid, (at, prompt, n) in submitted.items():
             cr = cl.requests[rid]
             if cr.state == "done":
                 completed += 1
+            elif cr.state == "cancelled":
+                cancelled += 1            # chaos "cancel" victims
             else:
                 failed += 1
             ok, ttft_ms, tbt_ms = TT.classify_request(
@@ -1318,11 +1327,26 @@ def run_trace_replay(params, cfg, p, trace, *, disagg=False,
         goodput_frac = sum(ok for ok, _ in good) / max(1, arrivals)
         goodput_tok = sum(n for ok, n in good if ok)
         useful = sum(n for _, _, n in wl)
-        if failed or completed != len(submitted):
+        if failed or completed + cancelled != len(submitted):
             raise RuntimeError(
                 "serve_bench --trace: %d/%d submitted requests "
                 "completed (%d failed) — the chaos/scale scenario "
                 "lost requests" % (completed, len(submitted), failed))
+        # cancel reconciliation: every chaos "cancel" that named a
+        # victim ended exactly one request in state "cancelled", and
+        # the metrics counter agrees — no cancel may be lost or
+        # double-fired
+        cancels_applied = sum(1 for e in drv.applied
+                              if e["kind"] == "cancel"
+                              and e["victim"] is not None)
+        n_counter = int(cl.registry.snapshot()["counters"].get(
+            "cluster_cancelled_total", 0))
+        if cancelled != cancels_applied or n_counter != cancelled:
+            raise RuntimeError(
+                "serve_bench --trace: cancel arithmetic broken — "
+                "%d requests cancelled, %d chaos cancels applied, "
+                "cluster_cancelled_total=%d"
+                % (cancelled, cancels_applied, n_counter))
 
         mismatches = 0
         if verify_oracle:
@@ -1331,7 +1355,18 @@ def run_trace_replay(params, cfg, p, trace, *, disagg=False,
             oracle = _oracle_outputs(params, cfg, reqs)
             for (rid, (at, prompt, n)), o in zip(submitted.items(),
                                                  oracle):
-                if not np.array_equal(cl.requests[rid].output, o):
+                cr = cl.requests[rid]
+                if cr.state == "cancelled":
+                    # a cancelled request never finished — but every
+                    # token it DID commit must be a strict prefix of
+                    # the oracle continuation (it must never have
+                    # produced a wrong token, even one that was
+                    # cut off)
+                    got = [int(t) for t in cr.committed]
+                    o_gen = [int(t) for t in o[len(prompt):]]
+                    if got != o_gen[:len(got)]:
+                        mismatches += 1
+                elif not np.array_equal(cr.output, o):
                     mismatches += 1
             if mismatches:
                 raise RuntimeError(
@@ -1410,7 +1445,7 @@ def run_trace_replay(params, cfg, p, trace, *, disagg=False,
             "seed": spec["seed"], "trace_sha": TT.trace_hash(trace),
             "events": len(wl), "arrivals": arrivals,
             "submitted": len(submitted), "rejected": len(rejected),
-            "completed": completed,
+            "completed": completed, "cancelled": cancelled,
             "goodput_frac": goodput_frac,
             "goodput_tok_s": goodput_tok / wall,
             "tok_s": useful / wall, "wall_s": wall,
@@ -1826,6 +1861,15 @@ def main(argv=None):
                          "bit-exactness cross-check")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="victim-draw seed for the chaos schedule")
+    ap.add_argument("--chaos-kinds", default="kill",
+                    metavar="K[,K...]",
+                    help="trace replay: comma list of scripted fault "
+                         "kinds spread through the burst window — "
+                         "kill, stall, reset (disagg), cancel (the "
+                         "round-20 client-disconnect fault: a seeded "
+                         "live request cancelled end-to-end, "
+                         "reconciled against "
+                         "cluster_cancelled_total)")
     ap.add_argument("--min-replicas", type=int, default=2)
     ap.add_argument("--max-replicas", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
@@ -1901,6 +1945,9 @@ def main(argv=None):
             max_replicas=args.max_replicas,
             chaos_events=[] if args.no_chaos else None,
             chaos_seed=args.chaos_seed,
+            chaos_kinds=tuple(
+                k.strip() for k in args.chaos_kinds.split(",")
+                if k.strip()),
             verify_oracle=not args.no_oracle,
             standby_prefill=args.standby)
         rows.append(r)

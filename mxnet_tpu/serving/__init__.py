@@ -114,15 +114,17 @@ from .drafters import ngram_draft
 from .engine import Request, ServingEngine
 from .tier_store import HostTierStore
 from .cluster import (ServingCluster, ClusterRequest, ClusterOverloaded,
-                      RequestExpired, ClusterClosed, ClusterFailed,
-                      DisaggServingCluster, run_worker)
+                      RequestExpired, RequestCancelled, ClusterClosed,
+                      ClusterFailed, DisaggServingCluster, run_worker)
 from .autoscaler import Autoscaler, HistogramWindow
 from .chaos import ChaosDriver, ChaosEvent, chaos_schedule
+from .http_frontend import HttpFrontend, ApiKeyTable
 
 __all__ = ["PagedKVCache", "PrefixCache", "ClusterPrefixIndex",
            "HostTierStore", "Request", "ServingEngine",
            "ServingCluster", "ClusterRequest", "ClusterOverloaded",
-           "RequestExpired", "ClusterClosed", "ClusterFailed",
-           "DisaggServingCluster", "run_worker", "ngram_draft",
-           "Autoscaler", "HistogramWindow",
-           "ChaosDriver", "ChaosEvent", "chaos_schedule"]
+           "RequestExpired", "RequestCancelled", "ClusterClosed",
+           "ClusterFailed", "DisaggServingCluster", "run_worker",
+           "ngram_draft", "Autoscaler", "HistogramWindow",
+           "ChaosDriver", "ChaosEvent", "chaos_schedule",
+           "HttpFrontend", "ApiKeyTable"]

@@ -24,6 +24,8 @@ kind                  ServingCluster (threads)         DisaggServingCluster
 ``reset``             —                                router-side close of
                                                        the control
                                                        connection
+``cancel``            ``cluster.cancel(rid)`` on a seeded live request —
+                      the client-disconnect fault (round 20; both flavors)
 ====================  ===============================  =====================
 
 The driver is POLLED from the replay loop (``poll(now_rel)``), not
@@ -52,9 +54,9 @@ class ChaosEvent:
     __slots__ = ("t", "kind", "target")
 
     def __init__(self, t, kind, target=None):
-        if kind not in ("kill", "stall", "reset"):
+        if kind not in ("kill", "stall", "reset", "cancel"):
             raise ValueError("ChaosEvent: kind must be kill/stall/"
-                             "reset, got %r" % (kind,))
+                             "reset/cancel, got %r" % (kind,))
         self.t = float(t)
         self.kind = kind
         self.target = target
@@ -113,9 +115,37 @@ class ChaosDriver:
 
     # ------------------------------------------------------ victims --
     def _apply(self, ev):
+        if ev.kind == "cancel":
+            return self._apply_cancel(ev)
         if self._disagg:
             return self._apply_disagg(ev)
         return self._apply_inproc(ev)
+
+    def _apply_cancel(self, ev):
+        """Round 20: the client-disconnect fault, cluster-flavor
+        agnostic — ``cancel(rid)`` is public on both.  The victim is
+        a seeded draw over the live (queued/running) requests sorted
+        by rid; ``target`` may pin a specific rid.  The request's
+        pages/slot free immediately (the front door's disconnect
+        path), and the counted outcome rides
+        ``cluster_cancelled_total``."""
+        with self.cluster._lock:
+            live = sorted(rid for rid, cr
+                          in self.cluster.requests.items()
+                          if cr.state in ("queued", "running"))
+        if ev.target is not None:
+            live = [rid for rid in live if rid == ev.target]
+        if not live:
+            return None
+        rid = self.rng.choice(live)
+        try:
+            took = self.cluster.cancel(rid)
+        except KeyError:
+            took = False          # purged between snapshot and cancel
+        # a False cancel means the victim reached a terminal state in
+        # the snapshot→cancel window — report no victim, so the
+        # bench's cancel-reconciliation arithmetic stays exact
+        return rid if took else None
 
     def _pick_replica(self, ev):
         reps = [r for r in self.cluster.replicas

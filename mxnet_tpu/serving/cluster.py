@@ -72,8 +72,8 @@ from .engine import ServingEngine
 from .prefix_cache import chain_keys, ClusterPrefixIndex
 
 __all__ = ["ServingCluster", "ClusterRequest", "ClusterOverloaded",
-           "RequestExpired", "ClusterClosed", "ClusterFailed",
-           "DisaggServingCluster", "run_worker"]
+           "RequestExpired", "RequestCancelled", "ClusterClosed",
+           "ClusterFailed", "DisaggServingCluster", "run_worker"]
 
 # rid blocks: replica i assigns engine rids in [i*RID_BLOCK, ...), so
 # request ids and trace swimlanes stay unique across the cluster
@@ -116,6 +116,12 @@ class RequestExpired(RuntimeError):
     """The request's TTL elapsed before it started decoding."""
 
 
+class RequestCancelled(RuntimeError):
+    """The request was cancelled via ``cancel(rid)`` (round 20: the
+    HTTP front door's client-disconnect propagation) before it
+    finished; its slot and pages were released immediately."""
+
+
 class ClusterClosed(RuntimeError):
     """The cluster is closed (or lost every replica)."""
 
@@ -132,7 +138,8 @@ class ClusterRequest:
                  "deadline", "state", "replica", "engine_rid",
                  "committed", "output", "error", "done_evt",
                  "submit_t", "first_token_t", "token_times",
-                 "affinity_keys", "failovers", "delivered")
+                 "affinity_keys", "failovers", "delivered",
+                 "stream", "listeners", "cancel_req")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, deadline,
                  affinity_keys):
@@ -157,6 +164,14 @@ class ClusterRequest:
         self.affinity_keys = affinity_keys
         self.failovers = 0
         self.delivered = False
+        # the canonical PUBLISHED token stream (round 20): what every
+        # attach_stream listener has been handed so far, across
+        # incarnations — always a prefix of committed + the live
+        # engine request's generated tokens, so a failover resumes
+        # the stream without a gap or a repeat
+        self.stream: List[int] = []
+        self.listeners: List = []
+        self.cancel_req = False
 
 
 class _Replica:
@@ -214,6 +229,11 @@ class _ClusterObs:
                           "submissions refused by backpressure")
         self.expired = c("cluster_requests_expired_total",
                          "requests whose TTL elapsed while waiting")
+        self.cancelled = c("cluster_cancelled_total",
+                           "requests cancelled via cancel(rid) — "
+                           "client disconnects propagated by the "
+                           "HTTP front door, plus chaos 'cancel' "
+                           "actions")
         self.completed = c("cluster_requests_completed_total",
                            "requests finished across all replicas")
         self.failovers = c("cluster_failovers_total",
@@ -513,6 +533,8 @@ class ServingCluster:
         if cr.state == "expired":
             raise RequestExpired("request %d expired before "
                                  "admission" % rid)
+        if cr.state == "cancelled":
+            raise RequestCancelled("request %d was cancelled" % rid)
         raise ClusterFailed("request %d: %r" % (rid, cr.error))
 
     def drain(self, timeout=None):
@@ -526,6 +548,154 @@ class ServingCluster:
             if not cr.done_evt.wait(left):
                 return False
         return True
+
+    # -------------------------------------------- streaming (rnd 20) --
+    def attach_stream(self, rid, cb):
+        """Register a per-request token-stream listener (the HTTP
+        front door's SSE feed).  ``cb`` receives, in order:
+        ``("tokens", [int, ...])`` for each batch of newly committed
+        tokens (the backlog is delivered immediately on attach, so a
+        late attach never misses tokens), then exactly one terminal
+        event — ``("done", output_array)`` or ``("error", exc)``.
+        Callbacks run on cluster worker threads under the cluster
+        lock: they must be quick and non-blocking (the HTTP bridge
+        is one ``call_soon_threadsafe`` enqueue)."""
+        with self._lock:
+            cr = self.requests.get(rid)
+            if cr is None:
+                raise KeyError("attach_stream(%d): unknown rid" % rid)
+            if cr.stream:
+                cb(("tokens", list(cr.stream)))
+            if cr.state in ("queued", "running"):
+                cr.listeners.append(cb)
+            else:
+                cr.delivered = True        # terminal event handed out
+                cb(self._terminal_event(cr))
+
+    @staticmethod
+    def _terminal_event(cr):
+        if cr.state == "done":
+            return ("done", cr.output)
+        if cr.state == "expired":
+            return ("error", RequestExpired(
+                "request %d expired before admission" % cr.rid))
+        if cr.state == "cancelled":
+            return ("error", RequestCancelled(
+                "request %d was cancelled" % cr.rid))
+        return ("error", cr.error if cr.error is not None else
+                ClusterFailed("request %d failed" % cr.rid))
+
+    def _publish_tokens_locked(self, cr, ereq=None):
+        """Hand listeners every not-yet-published token.  The full
+        stream so far is ``committed`` (tokens snapshotted across
+        failovers) plus the LIVE incarnation's ``generated`` — the
+        published prefix is tracked in ``cr.stream``, so failover
+        snapshots (which fold generated into committed) never repeat
+        or drop a token."""
+        full = list(cr.committed)
+        if ereq is not None:
+            full.extend(int(t) for t in ereq.generated)
+        new = full[len(cr.stream):]
+        if new:
+            cr.stream.extend(new)
+            for cb in cr.listeners:
+                cb(("tokens", new))
+
+    def _finish_locked(self, cr):
+        """Terminal transition tail shared by every path that ends a
+        request: flush any unpublished committed tokens, deliver the
+        one terminal stream event, wake ``result()`` waiters.  A
+        stream listener receiving the terminal event IS the delivery
+        — mark the request delivered so ``_purge_locked`` can bound
+        the table (the HTTP path never calls ``result()``; without
+        this a long-running front door would grow ``requests`` with
+        total traffic served)."""
+        self._publish_tokens_locked(cr)
+        if cr.listeners:
+            cr.delivered = True
+        for cb in cr.listeners:
+            cb(self._terminal_event(cr))
+        cr.listeners = []
+        cr.done_evt.set()
+
+    def _publish_running(self, rep):
+        """Per-step token publication for this replica's in-flight
+        requests (the SSE hot path) — a no-op when nobody listens."""
+        with self._lock:
+            for erid, cr in rep.in_flight.items():
+                if not cr.listeners or cr.state != "running":
+                    continue
+                self._publish_tokens_locked(
+                    cr, rep.engine.requests.get(erid))
+
+    # ---------------------------------------------- cancel (rnd 20) --
+    def cancel(self, rid):
+        """Cancel a request end-to-end (the HTTP front door's client-
+        disconnect propagation; also a chaos action).  A WAITING
+        request is dropped immediately; a RUNNING one is flagged and
+        its replica worker releases the slot and pages on its own
+        thread BEFORE its next engine step (the engine is single-
+        threaded state — freeing from here would race the step).
+        Returns True if the cancel took (or will take) effect, False
+        if the request already reached a terminal state — the
+        inherent client race; the finished output stays retrievable."""
+        with self._lock:
+            cr = self.requests.get(rid)
+            if cr is None:
+                raise KeyError("cancel(%d): unknown rid" % rid)
+            if cr.state not in ("queued", "running"):
+                return False
+            if cr.state == "queued":
+                for rep in self.replicas:
+                    try:
+                        rep.inbox.remove(cr)
+                        break
+                    except ValueError:
+                        pass
+                try:
+                    self._orphans.remove(cr)
+                except ValueError:
+                    pass
+                self._cancel_now_locked(cr)
+                return True
+            cr.cancel_req = True
+            rep = self.replicas[cr.replica]
+            rep.wake.set()
+            return True
+
+    def _cancel_now_locked(self, cr):
+        cr.state = "cancelled"
+        self._retire_locked(cr)
+        if self._obs is not None:
+            self._obs.cancelled.inc()
+            self._sync_gauges_locked()
+        self._finish_locked(cr)
+
+    def _sweep_cancels(self, rep):
+        """Apply pending cancels on THIS replica's worker thread,
+        between steps: ``engine.cancel`` frees the slot and recycles
+        the pages immediately, so a disconnected client's pages are
+        back in the pool before the engine's next step completes
+        (the round-20 acceptance criterion, asserted via pool gauges
+        in ``tests/test_http_frontend.py``)."""
+        with self._lock:
+            pend = [(erid, cr) for erid, cr in rep.in_flight.items()
+                    if cr.cancel_req and cr.state == "running"]
+            for erid, cr in pend:
+                del rep.in_flight[erid]
+                ereq = rep.engine.requests.get(erid)
+                if ereq is not None:
+                    # fold the live incarnation's tokens into the
+                    # committed log (the failover snapshot fold) so
+                    # the cancelled request's partial output is
+                    # checkable against the oracle as a strict
+                    # prefix, not an empty list
+                    cr.committed.extend(int(t)
+                                        for t in list(ereq.generated))
+                    cr.token_times.extend(ereq.token_times)
+                    rep.engine.cancel(erid)
+                    del rep.engine.requests[erid]
+                self._cancel_now_locked(cr)
 
     # ------------------------------------------------------ routing --
     def _healthy(self):
@@ -578,10 +748,16 @@ class ServingCluster:
             comp.popleft()
         if len(comp) >= 2 and now > comp[0]:
             # len-1 completion INTERVALS over the observed span —
-            # conservatively low rate, conservatively long hint
+            # conservatively low rate, conservatively long hint.
+            # Clamped ABOVE by the watchdog (round-20 small fix): a
+            # stalled or barely-completing cluster must not advertise
+            # a multi-hour hint — within one watchdog the cluster has
+            # either failed over and drained or the client should
+            # probe again regardless
             rate = (len(comp) - 1) / (now - comp[0])
             excess = waiting - self.max_queue + 1
-            return max(0.001, excess / max(rate, 1e-6))
+            return min(self.watchdog_s,
+                       max(0.001, excess / max(rate, 1e-6)))
         return max(0.001, self.watchdog_s / 4.0)
 
     def _retire_locked(self, cr):
@@ -626,11 +802,14 @@ class ServingCluster:
                 return
             try:
                 self._pump_inbox(rep)
+                self._sweep_cancels(rep)
                 finished = eng.step()
             except Exception as e:                  # replica death
                 self._fail_replica(rep, e)
                 return
             rep.heartbeat = time.perf_counter()
+            if finished is not False:
+                self._publish_running(rep)
             if finished is False:
                 with self._lock:
                     idle = not rep.inbox and not rep.in_flight
@@ -659,6 +838,12 @@ class ServingCluster:
                 if len(eng._queue) >= self.num_slots:
                     return
                 cr = rep.inbox.popleft()
+                if cr.cancel_req:
+                    # cancelled while queued on a failover/drain
+                    # reroute path (a directly-queued cancel leaves
+                    # the inbox inside cancel() itself)
+                    self._cancel_now_locked(cr)
+                    continue
                 now = time.perf_counter()
                 if cr.deadline is not None and now > cr.deadline \
                         and not cr.committed:
@@ -667,7 +852,7 @@ class ServingCluster:
                     if self._obs is not None:
                         self._obs.expired.inc()
                         self._sync_gauges_locked()
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
                     continue
                 prompt = cr.prompt if not cr.committed else \
                     np.concatenate([cr.prompt,
@@ -684,7 +869,7 @@ class ServingCluster:
                     cr.state = "failed"
                     cr.error = e
                     self._retire_locked(cr)
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
                     continue
                 cr.state = "running"
                 cr.replica = rep.idx
@@ -702,6 +887,23 @@ class ServingCluster:
                     or cr.engine_rid != erid:
                 return
             ereq = rep.engine.requests[erid]
+            if cr.cancel_req:
+                # a cancel raced the finishing step: cancel() already
+                # returned True, so cancel WINS (the same rule the
+                # failover path applies — the client is gone and the
+                # finished output has no collector).  Fold the
+                # generated tokens so the oracle prefix checks and a
+                # late stream attach see the truth, then retire as
+                # cancelled — cluster_cancelled_total must agree with
+                # every True cancel() or the bench reconciliation
+                # breaks
+                cr.committed.extend(int(t)
+                                    for t in list(ereq.generated))
+                cr.token_times.extend(ereq.token_times)
+                del rep.engine.requests[erid]
+                self._cancel_now_locked(cr)
+                return
+            self._publish_tokens_locked(cr, ereq)
             cr.output = ereq.output
             cr.state = "done"
             cr.token_times.extend(ereq.token_times)
@@ -719,7 +921,7 @@ class ServingCluster:
                     self._obs.h_ttft.observe(
                         (cr.first_token_t - cr.submit_t) * 1e3)
                 self._sync_gauges_locked()
-            cr.done_evt.set()
+            self._finish_locked(cr)
 
     # ----------------------------------------------------- failover --
     def _fail_replica(self, rep, error):
@@ -764,6 +966,13 @@ class ServingCluster:
             for cr in strays + [cr for _, cr in in_flight]:
                 if cr.state not in ("queued", "running"):
                     continue
+                if cr.cancel_req:
+                    # a cancel raced the failover: the client is gone
+                    # — cancel beats resubmission (recomputing a
+                    # disconnected request's tokens on a survivor
+                    # would be pure waste)
+                    self._cancel_now_locked(cr)
+                    continue
                 done = (cr.eos_id is not None
                         and cr.eos_id in cr.committed) or \
                     len(cr.committed) >= cr.max_new_tokens
@@ -776,7 +985,7 @@ class ServingCluster:
                     self._retire_locked(cr)
                     if obs is not None:
                         obs.completed.inc()
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
                     continue
                 cr.state = "queued"
                 cr.engine_rid = None
@@ -792,7 +1001,7 @@ class ServingCluster:
                     cr.state = "failed"
                     cr.error = error
                     self._retire_locked(cr)
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
                     continue
                 target = self._route_locked(cr)
                 target.inbox.append(cr)
@@ -970,7 +1179,7 @@ class ServingCluster:
                     "request %d: parked for scale-up but the "
                     "autoscaler detached" % cr.rid)
                 self._retire_locked(cr)
-                cr.done_evt.set()
+                self._finish_locked(cr)
 
     # the autoscaler's actuation protocol (shared with
     # DisaggServingCluster): scale_up() -> bool, scale_down() -> bool
@@ -1001,7 +1210,7 @@ class ServingCluster:
                     "cluster closed with the request parked for "
                     "scale-up")
                 self._retire_locked(cr)
-                cr.done_evt.set()
+                self._finish_locked(cr)
         for rep in self.replicas:
             rep.wake.set()
         for rep in self.replicas:
@@ -1065,6 +1274,11 @@ class _DisaggObs:
         c, g, h = registry.counter, registry.gauge, registry.histogram
         self.submitted = c("cluster_requests_submitted_total",
                            "requests accepted by cluster submit()")
+        self.cancelled = c("cluster_cancelled_total",
+                           "requests cancelled via cancel(rid) — "
+                           "client disconnects propagated by the "
+                           "HTTP front door, plus chaos 'cancel' "
+                           "actions")
         self.completed = c("cluster_requests_completed_total",
                            "requests finished across all workers")
         self.failovers = c("cluster_failovers_total",
@@ -1138,7 +1352,7 @@ class DisaggRequest:
                  "phase", "prefill", "decode", "gen", "committed",
                  "output", "error", "done_evt", "submit_t",
                  "first_token_t", "token_times", "failovers",
-                 "delivered")
+                 "delivered", "listeners")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id):
         self.rid = rid
@@ -1161,6 +1375,10 @@ class DisaggRequest:
         self.token_times: List[float] = []
         self.failovers = 0
         self.delivered = False
+        # round 20: attach_stream listeners.  ``committed`` IS the
+        # canonical stream here (it only grows, at the router, under
+        # the router lock) — no separate published-prefix bookkeeping
+        self.listeners: List = []
 
 
 class DisaggServingCluster:
@@ -1416,7 +1634,7 @@ class DisaggServingCluster:
                             if w is not None:
                                 w.outstanding.discard(cr.rid)
                         self._terminal.append(cr.rid)
-                        cr.done_evt.set()
+                        self._finish_locked(cr)
             elif kind == "error":
                 self._fail_worker(wh, RuntimeError(
                     "worker %s: %s" % (wh.name, meta.get("msg"))))
@@ -1428,8 +1646,94 @@ class DisaggServingCluster:
             cr.first_token_t = now
             if self._obs is not None:
                 self._obs.h_ttft.observe((now - cr.submit_t) * 1e3)
-        cr.committed.extend(int(t) for t in toks)
+        new = [int(t) for t in toks]
+        cr.committed.extend(new)
         cr.token_times.extend(now for _ in toks)
+        if new:
+            # round 20: the per-token failover log IS the SSE feed —
+            # every listener sees exactly the tokens a resubmission
+            # would replay, so streams survive worker death
+            for cb in cr.listeners:
+                cb(("tokens", new))
+
+    def _terminal_event(self, cr):
+        if cr.state == "done":
+            return ("done", cr.output)
+        if cr.state == "cancelled":
+            return ("error", RequestCancelled(
+                "request %d was cancelled" % cr.rid))
+        return ("error", cr.error if cr.error is not None else
+                ClusterFailed("request %d failed" % cr.rid))
+
+    def _finish_locked(self, cr):
+        """Terminal transition tail (router lock held): one terminal
+        stream event per request, then wake ``result()`` waiters.  A
+        listener receiving the terminal event IS the delivery — mark
+        delivered so ``_purge_locked`` bounds the table under pure
+        HTTP traffic (same contract as ``ServingCluster``)."""
+        if cr.listeners:
+            cr.delivered = True
+        for cb in cr.listeners:
+            cb(self._terminal_event(cr))
+        cr.listeners = []
+        cr.done_evt.set()
+
+    def attach_stream(self, rid, cb):
+        """Register a per-request token-stream listener — the same
+        contract as ``ServingCluster.attach_stream`` (backlog
+        delivered on attach, then ``("tokens", [...])`` batches and
+        one terminal ``("done", output)`` / ``("error", exc)``).
+        Callbacks run on the router's receive threads under the
+        router lock: keep them to an enqueue."""
+        with self._lock:
+            cr = self.requests.get(rid)
+            if cr is None:
+                raise KeyError("attach_stream(%d): unknown rid" % rid)
+            if cr.committed:
+                cb(("tokens", list(cr.committed)))
+            if cr.state == "running":
+                cr.listeners.append(cb)
+            else:
+                cr.delivered = True        # terminal event handed out
+                cb(self._terminal_event(cr))
+
+    def cancel(self, rid):
+        """Cancel a running request end-to-end (round 20): bump the
+        incarnation gen (fencing every late frame of the old one) and
+        send the gen-fenced ``cancel`` wire kind to BOTH assigned
+        workers, which drop staged pages and force-retire the engine
+        request — pages and slot are recycled without waiting for the
+        generation to finish.  A cancel landing after completion is a
+        no-op returning False (the inherent client race); a repeat
+        cancel, or one for a gen that already died, is likewise
+        harmless — the worker-side fence makes it a no-op."""
+        sends = []
+        with self._lock:
+            cr = self.requests.get(rid)
+            if cr is None:
+                raise KeyError("cancel(%d): unknown rid" % rid)
+            if cr.state != "running":
+                return False
+            cr.gen += 1
+            cr.state = "cancelled"
+            for side in set((cr.prefill, cr.decode)):
+                w = self.workers.get(side)
+                if w is not None:
+                    w.outstanding.discard(cr.rid)
+                    if w.alive:
+                        sends.append((w.conn, (
+                            "cancel", {"rid": cr.rid,
+                                       "below_gen": cr.gen}, [])))
+            if self._obs is not None:
+                self._obs.cancelled.inc()
+                self._obs.g_in_flight.set(
+                    sum(r.state == "running"
+                        for r in self.requests.values()))
+            self._terminal.append(cr.rid)
+            self._purge_locked()
+            self._finish_locked(cr)
+        self._do_sends(sends)
+        return True
 
     def _on_tokens(self, wh, meta):
         with self._lock:
@@ -1489,7 +1793,7 @@ class DisaggServingCluster:
                         for r in self.requests.values()))
             self._terminal.append(cr.rid)
             self._purge_locked()
-            cr.done_evt.set()
+            self._finish_locked(cr)
         self._do_sends(sends)
 
     def _purge_locked(self):
@@ -1535,7 +1839,7 @@ class DisaggServingCluster:
                     "request %d: abandoned %d times (worker data "
                     "plane unreachable)" % (cr.rid, cr.failovers))
                 self._terminal.append(cr.rid)
-                cr.done_evt.set()
+                self._finish_locked(cr)
             else:
                 sends.extend(self._dispatch_locked(cr))
                 if cr.state == "running" and self._obs is not None:
@@ -1627,7 +1931,7 @@ class DisaggServingCluster:
                 "no healthy %s worker" %
                 ("prefill" if pre is None else "decode"))
             self._terminal.append(cr.rid)
-            cr.done_evt.set()
+            self._finish_locked(cr)
             return []
         cr.prefill, cr.decode = pre.name, dec.name
         cr.phase = "prefill"
@@ -1694,6 +1998,8 @@ class DisaggServingCluster:
             self._purge_locked()
         if cr.state == "done":
             return cr.output
+        if cr.state == "cancelled":
+            raise RequestCancelled("request %d was cancelled" % rid)
         raise ClusterFailed("request %d: %r" % (rid, cr.error))
 
     # ---------------------------------------------------- failover ---
@@ -1750,7 +2056,7 @@ class DisaggServingCluster:
                     if self._obs is not None:
                         self._obs.completed.inc()
                     self._terminal.append(cr.rid)
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
                     continue
                 sends.extend(self._dispatch_locked(cr))
                 if cr.state == "running" and self._obs is not None:
@@ -2104,7 +2410,7 @@ class DisaggServingCluster:
                     cr.error = ClusterClosed(
                         "cluster closed with the request in flight")
                     self._terminal.append(cr.rid)
-                    cr.done_evt.set()
+                    self._finish_locked(cr)
         for wh in workers:
             if wh.conn is not None:
                 try:
@@ -2499,6 +2805,15 @@ class _DisaggWorker:
                 dict(meta, prompt=np.frombuffer(bytes(bufs[0]),
                                                 np.int32)))
         elif kind == "abort":
+            self._abort(meta["rid"], meta["below_gen"])
+        elif kind == "cancel":
+            # round 20: client-disconnect propagation.  Same fencing
+            # and cleanup as a failover abort — drop staged pages,
+            # force-retire the engine request (pages + slot recycle
+            # NOW, not at generation end) — but nothing resubmits
+            # afterwards: the router already retired the request.  A
+            # late cancel for a gen that already died is a no-op by
+            # the same fence.
             self._abort(meta["rid"], meta["below_gen"])
         elif kind == "drop":
             key = tuple(meta["srid"])

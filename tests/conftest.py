@@ -89,6 +89,11 @@ _SLOW_GROUPS = {
     # sharded train step on the virtual mesh, so the group is
     # isolated for the same compile-budget reason as e/g/i)
     "test_train_scale": "m",
+    # group n: ~3min — round-20 HTTP/SSE front door (each scenario
+    # runs a live asyncio server thread over a real cluster and paces
+    # on the wall clock; own group so socket/scheduling jitter never
+    # squeezes f/k)
+    "test_http_frontend": "n",
 }
 
 

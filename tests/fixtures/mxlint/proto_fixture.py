@@ -52,6 +52,15 @@ class FixRouter:
         # clean: the worker's fine arm fences the gen properly
         self.conn.send("fine", {"rid": 7, "gen": 2})
 
+    def send_reap(self):
+        # clean shape mirroring the round-20 `cancel` wire kind: a
+        # fire-and-forget sweep carrying `below_gen` whose handler
+        # delegates the fence to a helper (the live cancel/abort arms
+        # call `self._abort(meta["rid"], meta["below_gen"])`) — the
+        # gen-fence rule must follow the gen-derived argument into
+        # the callee and stay silent
+        self.conn.send("reap", {"rid": 10, "below_gen": 3})
+
     def send_retag(self):
         # clean shape mirroring the round-18 `tier` kind: a genless
         # absolute-state broadcast whose handler reads every key this
@@ -97,6 +106,10 @@ class FixWorker:
             if meta["gen"] < self._fenced.get(meta["rid"], -1):
                 return                # clean: fenced before mutating
             self.state[meta["rid"]] = "ok"
+        elif kind == "reap":
+            # clean: the fence lives one call down, keyed off the
+            # gen-derived below_gen argument (the round-20 cancel arm)
+            self._reap(meta["rid"], meta["below_gen"])
         elif kind == "retag":
             # clean: absolute per-key state, no gen to fence (a stale
             # retag is self-correcting — the round-18 `tier` shape)
@@ -120,6 +133,11 @@ class FixWorker:
 
     def compute(self, q):
         return q * 2
+
+    def _reap(self, rid, below_gen):
+        if self._fenced.get(rid, -1) >= below_gen:
+            return                    # zombie sweep: fence holds
+        self.state[rid] = "reaped"
 
 
 class FixResources:

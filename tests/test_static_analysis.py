@@ -535,6 +535,61 @@ class TestPylockKVStoreCoverage:
         assert fs == [], [str(f) for f in fs]
 
 
+class TestPylockHttpFrontendCoverage:
+    """ISSUE 15 satellite: pylocklint's auto-scope (the
+    ``mxnet_tpu/serving`` package glob) reaches the round-20
+    ``http_frontend.py`` — the thread↔asyncio bridge is exactly its
+    beat: cluster threads feed the event loop via
+    ``call_soon_threadsafe`` while the loop thread owns quota state.
+    Zero findings on the live module is pinned below; the planted
+    shapes prove a violation THERE would fire — coverage is real, not
+    vacuous."""
+
+    def test_planted_guarded_field_fires(self):
+        src = ("import threading\n"
+               "class HttpFrontend:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self._active = 0\n"
+               "    def _serve_conn(self, reader, writer):\n"
+               "        with self._mu:\n"
+               "            self._active += 1\n"
+               "    def close(self):\n"
+               "        self._active = 0\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/http_frontend.py")
+        assert _rules(fs) == {"py-guarded-field": 1}
+
+    def test_planted_blocking_under_lock_fires(self):
+        # the front door's real hazard shape: waiting on the cluster
+        # (a blocking result()/submit()) while holding a lock the
+        # completion callback needs would deadlock every stream —
+        # the live module routes ALL cluster calls through the
+        # executor and keeps quota state loop-thread-only
+        src = ("import threading, time\n"
+               "class HttpFrontend:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "    def _run_request(self, rid):\n"
+               "        with self._mu:\n"
+               "            time.sleep(0.5)\n")
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/http_frontend.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+    def test_live_frontend_is_clean(self):
+        """The live module holds no lock across any blocking call
+        (the bridge is one ``call_soon_threadsafe`` per event batch;
+        cluster calls ride the executor) — pinned so a refactor that
+        adds a lock around the bridge re-fires the planted shapes
+        above on the real file."""
+        src = open(os.path.join(
+            REPO_ROOT, "mxnet_tpu/serving/http_frontend.py")).read()
+        fs = pylocklint.lint_source(
+            src, "mxnet_tpu/serving/http_frontend.py")
+        assert fs == [], [str(f) for f in fs]
+
+
 class TestBenchSyncFixtures:
     """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
     unsynced-jit pattern fires once, the pragma'd twin is suppressed,
@@ -731,6 +786,19 @@ class TestHotRegionAdditions:
          " def _reduce_flat(self, devs, bucket):\n%s"),
         ("mxnet_tpu/parallel/fsdp.py",
          "def fsdp_param_specs(cfg, dp='dp', tp=None):\n%s"),
+        # round 20: the HTTP front door's streaming/cancel paths run
+        # on the ONE asyncio event loop thread — an in-loop jit or
+        # stray sync in the SSE pump or the disconnect→cancel path
+        # stalls every open stream at once
+        ("mxnet_tpu/serving/http_frontend.py",
+         "class HttpFrontend:\n"
+         " async def _stream_sse(self, writer, reader, q, rid, "
+         "prompt, req_id):\n%s"),
+        ("mxnet_tpu/serving/http_frontend.py",
+         "class HttpFrontend:\n"
+         " async def _cancel_disconnected(self, rid):\n%s"),
+        ("benchmark/http_bench.py",
+         "def run_load(args):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)
@@ -784,10 +852,24 @@ class TestProtolintLiveRepo:
         for kind in ("submit", "pages", "handoff", "fetch",
                      "fetch_reply", "stats_req", "stats", "abort",
                      "tokens", "done", "hello", "ready", "config",
-                     "peers", "shutdown"):
+                     "peers", "shutdown", "cancel"):
             assert "| `%s` |" % kind in committed, kind
         # the gen-fence column is verified, not decorative
         assert "| NO |" not in committed
+
+    def test_cancel_kind_is_gen_fenced(self):
+        """ISSUE 15: the round-20 client-disconnect ``cancel`` wire
+        kind is audited — router → worker, carrying ``below_gen`` —
+        and the fence column says yes, so a late cancel for a gen
+        that already died is a no-op by checked invariant, not by
+        convention."""
+        committed = open(os.path.join(REPO_ROOT,
+                                      protolint.AUDIT_PATH)).read()
+        row = next(ln for ln in committed.splitlines()
+                   if ln.startswith("| `cancel` |"))
+        assert "router → worker" in row
+        assert "below_gen" in row
+        assert row.rstrip().endswith("| yes |")
         # synthetic in-process kinds never reach the wire table
         assert "| `_wake` |" not in committed
         assert "| `_lost` |" not in committed

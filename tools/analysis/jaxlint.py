@@ -133,6 +133,17 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     # helpers are traced inside the sharded train step
     ("mxnet_tpu/kvstore/ici.py", r".*"),
     ("mxnet_tpu/parallel/fsdp.py", r".*"),
+    # round 20: the HTTP front door's streaming/cancel paths run on
+    # the asyncio event loop thread right next to the serving threads
+    # — ONE loop serves every open connection, so a device sync, an
+    # in-loop jit, or a clock mix in the SSE pump or the disconnect→
+    # cancel path stalls every stream at once (the per-request
+    # cluster work rides the executor, never the loop)
+    ("mxnet_tpu/serving/http_frontend.py",
+     r"(?:.*\.)?(_stream_sse|_respond_json|_run_request"
+     r"|_cancel_disconnected|_serve_conn|_conn_loop"
+     r"|_handle_generate)$"),
+    ("benchmark/http_bench.py", r".*"),
 ]
 
 # modules whose timestamps must stay on the shared perf_counter clock
@@ -141,6 +152,7 @@ CLOCK_MODULES: List[str] = [
     "mxnet_tpu/serving/*.py",
     "mxnet_tpu/profiler.py",
     "benchmark/serve_bench.py",
+    "benchmark/http_bench.py",
 ]
 
 # modules whose perf_counter regions must sync their jitted work

@@ -236,6 +236,42 @@ def launch_serve(args, command):
     return code
 
 
+def launch_http(args, command):
+    """HTTP front-door launcher (round 20): run the streaming
+    HTTP/SSE server ``mxnet_tpu.serving.http_frontend`` as the
+    foreground process.  Any extra command tokens are passed through
+    to the frontend CLI (``--disagg``, ``--replicas N``, ``--keys
+    FILE|JSON``, model geometry flags …); ``-p/--port`` maps onto the
+    listening port (default: MXNET_SERVE_HTTP_PORT or OS-assigned,
+    printed as JSON at startup).  The demo server builds a
+    random-weights model — production embeds
+    :class:`mxnet_tpu.serving.HttpFrontend` over its own cluster and
+    params (see docs/http_api.md)."""
+    command = list(command)
+    if command[:1] == ["--"]:              # argparse.REMAINDER keeps it
+        command = command[1:]
+    # -c entry (not -m): the serving package imports http_frontend at
+    # import time, so runpy would warn about the double module object
+    cmd = [sys.executable, "-c",
+           "import sys; from mxnet_tpu.serving.http_frontend import "
+           "main; sys.exit(main(sys.argv[1:]))"]
+    if args.port:
+        cmd += ["--port", str(args.port)]
+    cmd += command
+    env = dict(os.environ)
+    # the server must import mxnet_tpu wherever the launcher was
+    # invoked from — put the repo root on the child's path
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "") \
+        if env.get("PYTHONPATH") else repo
+    proc = subprocess.Popen(cmd, env=env)
+    try:
+        return proc.wait()
+    except KeyboardInterrupt:
+        proc.send_signal(signal.SIGTERM)
+        return proc.wait()
+
+
 def launch_sge(args, command):
     """SGE launcher (reference: ``dmlc_tracker/sge.py``): submit a job
     ARRAY of num_servers + num_workers tasks via ``qsub``; each task
@@ -287,7 +323,7 @@ def main():
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--launcher", choices=["local", "ssh", "mpi",
                                            "slurm", "sge", "yarn",
-                                           "serve"],
+                                           "serve", "http"],
                     default="local")
     ap.add_argument("--prefill", type=int, default=1,
                     help="serve launcher: prefill worker processes")
@@ -310,9 +346,11 @@ def main():
     ap.add_argument("-p", "--port", type=int, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
-    if not args.command and not (args.launcher == "serve"
-                                 and args.workers_only):
+    if not args.command and args.launcher != "http" \
+            and not (args.launcher == "serve" and args.workers_only):
         ap.error("no command given")
+    if args.launcher == "http":
+        sys.exit(launch_http(args, args.command))
     if args.launcher == "serve":
         sys.exit(launch_serve(args, args.command))
     if args.num_workers is None:
