@@ -23,6 +23,17 @@ Sections (all rows JSON; ``--json`` writes the MULTICHIP_r10 file):
               grad set: collective counts, sync wall time, and the
               bitwise-equality assertion (grouping is a dispatch-count
               lever, not a numeric one).
+  bucket_overlap  (round 21, ``--bucket-overlap``; ``--json`` writes
+              the MULTICHIP_r11 file) the layer-bucketed
+              reduce-scatter overlap mode of the FSDP step
+              (``make_train_step(bucket_overlap=True)`` — per-layer
+              grad shards pinned INSIDE the backward scan, one
+              reduce-scatter bucket per layer) vs the "fused"
+              post-scan reduction, at dp={1,2,4,8}: ex/s per mode,
+              with the run HARD-FAILING unless both modes' loss
+              trajectories and final params are BITWISE identical at
+              every dp (bucketing is a scheduling lever, not a
+              numeric one).  dp=1 is the unsharded baseline row.
 
 CPU-pricing caveat (same as the round-14 tp rows): the 8-device mesh
 here is ``--xla_force_host_platform_device_count`` over ONE host CPU —
@@ -249,10 +260,12 @@ def run_fsdp_bytes(preset="mid", dp=None, seed=0):
 # dp weak-scaling sweep
 # ---------------------------------------------------------------------------
 
-def _measure_step(cfg, mesh, B, T_len, steps, seed, fsdp):
+def _measure_step(cfg, mesh, B, T_len, steps, seed, fsdp,
+                  bucket_overlap=False):
     import jax
     from mxnet_tpu.models import transformer as T
-    init_state, step = T.make_train_step(cfg, mesh=mesh, fsdp=fsdp)
+    init_state, step = T.make_train_step(cfg, mesh=mesh, fsdp=fsdp,
+                                         bucket_overlap=bucket_overlap)
     state = init_state(jax.random.PRNGKey(seed))
     batch = _batch(cfg, B, T_len, seed)
     if mesh is not None and mesh.size > 1:
@@ -387,6 +400,112 @@ def run_bucket_ablation(preset="mid", seed=0, reps=5):
 
 
 # ---------------------------------------------------------------------------
+# layer-bucketed reduce-scatter overlap vs fused post-scan reduction
+# ---------------------------------------------------------------------------
+
+def run_bucket_overlap_sweep(preset="mid", dps=(1, 2, 4, 8), seed=0,
+                             check_steps=3):
+    """Round-21 lever sweep: ``make_train_step(fsdp=True,
+    bucket_overlap=True)`` — per-layer grad shards constrained INSIDE
+    the backward scan, so each layer's reduce-scatter bucket is
+    issuable while the previous layer's backward matmuls run — vs the
+    ``"fused"`` mode (identical math, whole-tree constraint AFTER the
+    scan: everything the scheduler could NOT overlap), at each dp.
+
+    The run HARD-FAILS (RuntimeError) unless the two modes' loss
+    trajectories and every final param leaf are BITWISE identical at
+    every dp — bucketing reorders collective ISSUE slots, never the
+    f32 reduction tree — and only then times both modes (best-of-2,
+    the ``_measure_step`` idiom).  dp=1 is the unsharded non-FSDP
+    baseline row (there is no reduce-scatter to bucket; it anchors
+    the efficiency column).  Same virtual-mesh caveat as the dp
+    sweep: off-chip ex/s prices emulated collectives + core sharing,
+    not ICI, so the MODE DELTA's sign is not a chip prediction — the
+    bit-identity is a placement fact and transfers."""
+    import jax
+    import numpy as np
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.parallel import make_mesh
+
+    cfg, B, T_len, steps = _cfg(preset)
+    rows = []
+    base_ex_s = None
+    for dp in dps:
+        if dp > len(jax.devices()):
+            continue
+        if dp == 1:
+            ex_s, _ = _measure_step(cfg, None, B, T_len, steps, seed,
+                                    fsdp=False)
+            base_ex_s = ex_s
+            rows.append({
+                "section": "train_scale",
+                "config": "bucket_overlap_dp1_baseline",
+                "preset": preset, "seed": seed, "dp": 1,
+                "cfg_sha": _cfg_sha(cfg, B, T_len, steps, seed),
+                "global_batch": B, "per_device_batch": B,
+                "seq_len": T_len, "ex_s": ex_s,
+                "bucket_overlap": None,
+            })
+            continue
+        mesh = make_mesh({"dp": dp}, devices=list(jax.devices())[:dp])
+        batch = _batch(cfg, B * dp, T_len, seed)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sb = NamedSharding(mesh, P("dp"))
+        batch = {k: jax.device_put(v, sb) for k, v in batch.items()}
+
+        def trajectory(mode):
+            init_state, step = T.make_train_step(
+                cfg, mesh=mesh, fsdp=True, bucket_overlap=mode)
+            state = init_state(jax.random.PRNGKey(seed))
+            losses = []
+            for i in range(check_steps):
+                state, loss = step(
+                    state, batch,
+                    jax.random.fold_in(jax.random.PRNGKey(seed + 1), i))
+                losses.append(float(loss))
+            return losses, jax.device_get(state[0])
+
+        bk_losses, bk_params = trajectory(True)
+        fu_losses, fu_params = trajectory("fused")
+        if bk_losses != fu_losses:
+            raise RuntimeError(
+                "bucket_overlap dp=%d: bucketed loss trajectory "
+                "diverged from fused: %r vs %r"
+                % (dp, bk_losses, fu_losses))
+        for a, b in zip(jax.tree_util.tree_leaves(bk_params),
+                        jax.tree_util.tree_leaves(fu_params)):
+            if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+                raise RuntimeError(
+                    "bucket_overlap dp=%d: final params differ "
+                    "(shape %r) between bucketed and fused modes"
+                    % (dp, a.shape))
+        ex_bk, _ = _measure_step(cfg, mesh, B * dp, T_len, steps,
+                                 seed, fsdp=True, bucket_overlap=True)
+        ex_fu, _ = _measure_step(cfg, mesh, B * dp, T_len, steps,
+                                 seed, fsdp=True,
+                                 bucket_overlap="fused")
+        row = {
+            "section": "train_scale",
+            "config": "bucket_overlap_dp%d" % dp,
+            "preset": preset, "seed": seed, "dp": dp,
+            "cfg_sha": _cfg_sha(cfg, B, T_len, steps, seed),
+            "global_batch": B * dp, "per_device_batch": B,
+            "seq_len": T_len,
+            "ex_s_bucketed": ex_bk, "ex_s_fused": ex_fu,
+            "bucketed_vs_fused": ex_bk / ex_fu,
+            "check_steps": check_steps,
+            "bit_identical_vs_fused": True,
+            "virtual_mesh": len(set(
+                d.platform for d in jax.devices())) == 1
+                and jax.devices()[0].platform == "cpu",
+        }
+        if base_ex_s is not None:
+            row["efficiency_vs_dp1"] = ex_bk / (base_ex_s * dp)
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
 # the gate
 # ---------------------------------------------------------------------------
 
@@ -429,6 +548,11 @@ def main(argv=None):
                     choices=sorted(PRESETS))
     ap.add_argument("--dp-sweep", action="store_true")
     ap.add_argument("--bucket-ablation", action="store_true")
+    ap.add_argument("--bucket-overlap", action="store_true",
+                    help="round-21 sweep: layer-bucketed "
+                         "reduce-scatter overlap vs fused post-scan "
+                         "reduction at dp={1,2,4,8} (bitwise "
+                         "hard-gated; --json writes MULTICHIP_r11)")
     ap.add_argument("--exactness", action="store_true")
     ap.add_argument("--fsdp-bytes", action="store_true")
     ap.add_argument("--gate", action="store_true")
@@ -493,6 +617,24 @@ def main(argv=None):
                  r["bucketed_collectives"], r["unbucketed_collectives"],
                  r["bucketed_sync_ms"], r["unbucketed_sync_ms"],
                  r["speedup"]), flush=True)
+    if args.all or args.bucket_overlap:
+        sweep = run_bucket_overlap_sweep(args.preset, seed=args.seed)
+        rows.extend(sweep)
+        for r in sweep:
+            print(json.dumps(r), flush=True)
+        over = [r for r in sweep if r["dp"] > 1]
+        print("bucket-overlap sweep (%s): " % args.preset
+              + ", ".join("dp=%d bucketed %.1f ex/s vs fused %.1f "
+                          "(%.2fx)"
+                          % (r["dp"], r["ex_s_bucketed"],
+                             r["ex_s_fused"], r["bucketed_vs_fused"])
+                          for r in over)
+              + "; bitwise-identical at every dp"
+              + (" — VIRTUAL CPU mesh: shards share one host, so the "
+                 "mode delta prices emulated collectives, not the "
+                 "ICI overlap the mode exists for"
+                 if over and over[-1]["virtual_mesh"] else ""),
+              flush=True)
     if args.gate:
         r = run_gate_pretrain(args.preset, seed=args.seed)
         rows.append(r)

@@ -486,6 +486,145 @@ def _constrain_act(x, mesh):
 # train step
 # ---------------------------------------------------------------------------
 
+def _mlm_head_loss(outer, x, batch, cfg: TransformerConfig):
+    """MLM head + masked-NLL on an encoder output ``x`` — the head and
+    loss arithmetic of :func:`forward_with_aux`/:func:`mlm_loss` over
+    the non-layer params only.  Factored out for the bucketed-overlap
+    train step, whose manual backward needs the head as a separate
+    vjp group (the weight-tied ``tok_emb`` collects grads from both
+    the embed and head groups)."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(cfg.dtype)
+    h = jax.nn.gelu(x @ outer["mlm_dense"].astype(cdt),
+                    approximate=True)
+    h = _layer_norm(h, outer["mlm_ln"]["g"].astype(cdt),
+                    outer["mlm_ln"]["b"].astype(cdt))
+    logits = (h @ outer["tok_emb"].T.astype(cdt)
+              + outer["mlm_bias"].astype(cdt)).astype(jnp.float32)
+    labels = batch["labels"]
+    valid = (labels >= 0)
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_loss = -jnp.take_along_axis(logp, safe[..., None],
+                                    axis=-1)[..., 0]
+    tok_loss = jnp.where(valid, tok_loss, 0.0)
+    return tok_loss.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def _bucketed_loss_and_grads(params, batch, rng, cfg: TransformerConfig,
+                             mesh, grad_shardings, bucketed):
+    """Manual scan-carried forward/backward for the FSDP step's
+    bucketed-overlap mode (ROADMAP item 4, the training half).
+
+    The layer stack runs as ONE ``lax.scan`` forward (saving each
+    layer's input — the remat residual) and one reverse scan backward
+    in which every iteration re-runs its layer's vjp from the saved
+    input.  With ``bucketed=True`` each layer's grads are pinned to
+    their FSDP sharding INSIDE the reverse-scan body, so the dp
+    reduce-scatter for layer L is issued the moment L's grads
+    materialize — a per-layer-bucket collective overlapped with the
+    backward of layer L-1, instead of one fused post-backward sync
+    XLA schedules wherever it likes.  With ``bucketed=False`` (the
+    "fused" comparator) the SAME scan graph defers the whole
+    constraint to after the scan — the only difference between the
+    two programs is collective placement, which is why the
+    bucketed-vs-fused loss trajectory is gated BIT-identical
+    (``tests/test_train_scale.py``; the reduce-scatter computes the
+    same order-free per-shard sum either way).
+
+    Refuses MoE / seq-parallel / pipeline configs — the scan needs a
+    homogeneous dense layer stack and no nested shard_map.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..parallel.pipeline import stack_layer_params
+
+    cdt = jnp.dtype(cfg.dtype)
+    tokens = batch["tokens"]
+    T_len = tokens.shape[1]
+    mask = batch.get("mask")
+    type_ids = batch.get("type_ids")
+    n = cfg.n_layers
+
+    outer = {k: v for k, v in params.items() if k != "layers"}
+    stacked = stack_layer_params(params["layers"])
+    # per-layer dropout keys: the SAME split sequence forward_with_aux
+    # walks, stacked as raw key data so they ride the scan as an array
+    # operand (unused ops when dropout=0, like the sequential path)
+    subs = []
+    r = rng
+    for _ in range(n):
+        r, sub = jax.random.split(r)
+        subs.append(jax.random.key_data(sub))
+    keys = jnp.stack(subs)
+    impl = "rbg" if (cfg.fast_rng and cfg.dropout > 0) \
+        else "threefry2x32"
+
+    def embed_fn(outer):
+        x = outer["tok_emb"][tokens].astype(cdt)
+        x = x + outer["pos_emb"][:T_len][None].astype(cdt)
+        if type_ids is not None:
+            x = x + outer["type_emb"][type_ids].astype(cdt)
+        x = _layer_norm(x, outer["emb_ln"]["g"].astype(cdt),
+                        outer["emb_ln"]["b"].astype(cdt))
+        if mesh is not None:
+            x = _constrain_act(x, mesh)
+        return x
+
+    def layer_body(x, layer, kd):
+        key = jax.random.wrap_key_data(kd, impl=impl)
+        x, _ = _encoder_layer(x, layer, mask, cfg, True, key, mesh)
+        if mesh is not None:
+            x = _constrain_act(x, mesh)
+        return x
+
+    # ---- forward: one scan over the stack, saving layer INPUTS (the
+    # backward's recompute residual — the remat="nothing" memory
+    # profile, carried explicitly instead of via jax.checkpoint) ----
+    x0, embed_vjp = jax.vjp(embed_fn, outer)
+
+    def fwd_body(x, sl):
+        layer, kd = sl
+        return layer_body(x, layer, kd), x
+
+    xL, xs = jax.lax.scan(fwd_body, x0, (stacked, keys))
+
+    loss, head_vjp = jax.vjp(
+        lambda o, x: _mlm_head_loss(o, x, batch, cfg), outer, xL)
+    d_outer_head, dx = head_vjp(jnp.ones((), loss.dtype))
+
+    layer_sh = (jax.tree_util.tree_map(lambda s: s,
+                                       grad_shardings["layers"][0])
+                if grad_shardings is not None else None)
+
+    def bwd_body(dx, sl):
+        layer, kd, x_in = sl
+        _, vjp = jax.vjp(lambda xx, ll: layer_body(xx, ll, kd),
+                         x_in, layer)
+        dx_prev, dlayer = vjp(dx)
+        if bucketed and layer_sh is not None:
+            # THE lever: pin this layer bucket's grads to their FSDP
+            # shards here, inside the reverse scan, so its dp
+            # reduce-scatter issues while the previous layer's
+            # backward still runs
+            dlayer = jax.lax.with_sharding_constraint(dlayer,
+                                                      layer_sh)
+        return dx_prev, dlayer
+
+    dx0, dlayers = jax.lax.scan(bwd_body, dx, (stacked, keys, xs),
+                                reverse=True)
+    d_outer_emb = embed_vjp(dx0)[0]
+    d_outer = jax.tree_util.tree_map(jnp.add, d_outer_head,
+                                     d_outer_emb)
+    grads = dict(d_outer)
+    grads["layers"] = [
+        jax.tree_util.tree_map(lambda a, i=i: a[i], dlayers)
+        for i in range(n)]
+    return loss, grads
+
+
 def mlm_loss(params, batch, rng, cfg: TransformerConfig, mesh=None):
     """Masked-LM pretraining objective (BERT): mean token NLL over the
     masked positions (``labels`` -100 ≡ unmasked) plus the MoE
@@ -559,7 +698,8 @@ def train_step_output_specs(cfg: TransformerConfig, dp="dp", tp=None,
 
 def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
                     weight_decay=0.01, shard_optimizer=False,
-                    scan_steps=None, scan_superbatch=False, fsdp=False):
+                    scan_steps=None, scan_superbatch=False, fsdp=False,
+                    bucket_overlap=False):
     """Build (init_state, step) for MLM pretraining.
 
     ``step(state, batch, rng) -> (state, loss)`` is jitted; with a mesh it
@@ -589,6 +729,20 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
     reduce-scatter fused straight into the sharded optimizer update
     (no replicated grad ever materializes).  Requires a mesh with a
     live ``dp`` axis; implies ``shard_optimizer``.
+
+    ``bucket_overlap=True`` (round 21, ROADMAP item 4's training
+    half; requires ``fsdp=True``) swaps the autodiff backward for the
+    scan-carried manual one (:func:`_bucketed_loss_and_grads`): the
+    layer stack runs as one forward scan + one reverse scan, and each
+    layer's grads are pinned to their FSDP shards INSIDE the reverse
+    scan body, so per-layer-bucket dp reduce-scatters issue as each
+    layer's grads materialize instead of one fused post-backward
+    sync.  ``bucket_overlap="fused"`` builds the SAME scan graph with
+    the constraint deferred to after the scan — the bit-identity
+    comparator the ``test_train_scale.py`` hard gate pins the
+    bucketed path against.  ``False`` (default) keeps the round-20
+    autodiff path untouched.  Dense stacks only (no MoE / pp /
+    seq-parallel — the scan needs homogeneous layers).
     """
     import jax
     import jax.numpy as jnp
@@ -599,6 +753,25 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
 
     def loss_fn(params, batch, rng):
         return mlm_loss(params, batch, rng, cfg, mesh=mesh)
+
+    if bucket_overlap not in (False, True, "fused"):
+        from ..base import MXNetError
+        raise MXNetError(
+            "make_train_step: bucket_overlap must be False, True, or "
+            "'fused', got %r" % (bucket_overlap,))
+    if bucket_overlap:
+        from ..base import MXNetError
+        if not fsdp:
+            raise MXNetError(
+                "make_train_step: bucket_overlap requires fsdp=True "
+                "(the per-layer buckets ARE the FSDP reduce-scatters)")
+        if cfg.n_experts or cfg.seq_parallel or (
+                mesh is not None and "pp" in mesh.axis_names
+                and mesh.shape["pp"] > 1):
+            raise MXNetError(
+                "make_train_step: bucket_overlap needs a homogeneous "
+                "dense layer stack with no nested shard_map — MoE / "
+                "seq_parallel / pp configs use bucket_overlap=False")
 
     if fsdp:
         from ..base import MXNetError
@@ -632,18 +805,43 @@ def make_train_step(cfg: TransformerConfig, mesh=None, learning_rate=1e-4,
             # stays deterministic per (key, step)
             rng = jax.random.wrap_key_data(
                 jax.random.bits(rng, (4,), "uint32"), impl="rbg")
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
-        if grad_shardings is not None:
-            # pin grads to the params' own sharding before the update.
-            # Without this, grads reach tx.update with whatever partial
-            # sharding GSPMD propagated out of the backward (e.g. a pp
-            # dim from the pipeline shard_map), and the transition to
-            # the ZeRO-1 dp-sharded moments triggers "Involuntary full
-            # rematerialization" (replicate-then-reshard).  An explicit
-            # all-gather here is the same data movement without the
-            # wasted remat.
-            grads = jax.lax.with_sharding_constraint(grads,
-                                                     grad_shardings)
+        if bucket_overlap:
+            loss, grads = _bucketed_loss_and_grads(
+                params, batch, rng, cfg, mesh, grad_shardings,
+                bucketed=bucket_overlap is not False
+                and bucket_overlap != "fused")
+            if grad_shardings is not None:
+                if bucket_overlap == "fused":
+                    # the comparator: same scan graph, the whole grad
+                    # tree pinned in one post-backward constraint
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, grad_shardings)
+                else:
+                    # layer buckets were pinned inside the reverse
+                    # scan; only the small outer group (embeddings +
+                    # head) still needs its constraint
+                    outer_sh = {k: v for k, v in grad_shardings.items()
+                                if k != "layers"}
+                    outer_g = {k: v for k, v in grads.items()
+                               if k != "layers"}
+                    outer_g = jax.lax.with_sharding_constraint(
+                        outer_g, outer_sh)
+                    grads = dict(outer_g, layers=grads["layers"])
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch,
+                                                      rng)
+            if grad_shardings is not None:
+                # pin grads to the params' own sharding before the
+                # update.  Without this, grads reach tx.update with
+                # whatever partial sharding GSPMD propagated out of the
+                # backward (e.g. a pp dim from the pipeline shard_map),
+                # and the transition to the ZeRO-1 dp-sharded moments
+                # triggers "Involuntary full rematerialization"
+                # (replicate-then-reshard).  An explicit all-gather
+                # here is the same data movement without the wasted
+                # remat.
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss

@@ -302,7 +302,8 @@ class ServingCluster:
                  affinity_slack=None,
                  affinity_capacity=4096, retain_results=4096,
                  kernel="xla", spec_K=0, spec_drafter="ngram",
-                 spec_ngram=2, tp=1, mesh=None, tier_bytes=None):
+                 spec_ngram=2, tp=1, mesh=None, tier_bytes=None,
+                 overlap=None):
         if replicas < 1:
             raise ValueError("ServingCluster: replicas must be >= 1")
         self.num_slots = num_slots
@@ -378,7 +379,7 @@ class ServingCluster:
             prefix_cache=prefix_cache, metrics=bool(metrics),
             kernel=kernel, spec_K=spec_K, spec_drafter=spec_drafter,
             spec_ngram=spec_ngram, tp=tp, mesh=mesh,
-            tier_bytes=tier_bytes)
+            tier_bytes=tier_bytes, overlap=overlap)
         # kept for add_replica (autoscaler scale-up): a replica added
         # mid-run must be built from the SAME params/config as the
         # originals (references only — params are already placed)
@@ -1156,6 +1157,7 @@ class ServingCluster:
                 "remove_replica(%d): %d prefix refs / %d pages still "
                 "held after drain — scale-down would leak" %
                 (idx, leaked_refs, in_use))
+        eng.close()                       # retire any planner thread
         with self._lock:
             rep.dead = True               # waiting -> 0, never routed
             rep.engine = None             # release pools/params refs
@@ -1216,6 +1218,10 @@ class ServingCluster:
         for rep in self.replicas:
             if rep.thread is not None:
                 rep.thread.join(timeout)
+        for rep in self.replicas:
+            if rep.engine is not None:
+                # overlap engines carry a planner thread; join it out
+                rep.engine.close()
         self._monitor.join(timeout)
 
     def __enter__(self):
@@ -1424,7 +1430,8 @@ class DisaggServingCluster:
                  pages_per_slot=None, prefill_chunk=8, kv_int8=False,
                  kernel="xla", spec_K=0, metrics=None, registry=None,
                  watchdog_s=None, spawn=True, host="127.0.0.1",
-                 port=0, ready_timeout=None, tier_bytes=None):
+                 port=0, ready_timeout=None, tier_bytes=None,
+                 overlap=None):
         if prefill < 1 or decode < 1:
             raise ValueError("DisaggServingCluster: needs >= 1 "
                              "prefill and >= 1 decode worker")
@@ -1441,7 +1448,8 @@ class DisaggServingCluster:
             num_slots=num_slots, page_size=page_size,
             num_pages=num_pages, pages_per_slot=pages_per_slot,
             prefill_chunk=prefill_chunk, kv_int8=kv_int8,
-            kernel=kernel, spec_K=spec_K, tier_bytes=tier_bytes)
+            kernel=kernel, spec_K=spec_K, tier_bytes=tier_bytes,
+            overlap=overlap)
         # mirror of the workers' engine limits, so an invalid request
         # fails the submit() call instead of poisoning a worker
         pps = pages_per_slot if pages_per_slot is not None \
@@ -2860,8 +2868,14 @@ class _DisaggWorker:
     # -- per-step work ----------------------------------------------
     def _admit_ready(self):
         """Decode role: admit handed-off requests whose pages are all
-        installed, as slots free up."""
-        self.receiver.retry_installs()
+        installed, as slots free up.  Installs themselves run AFTER
+        the step (round 21 — off the dispatch critical path, hidden
+        behind the launched step's device time under overlap); when
+        the engine is idle there is nothing to hide behind, so
+        install eagerly here."""
+        if self.eng._inflight is None and not any(
+                s is not None for s in self.eng._slots):
+            self.receiver.retry_installs()
         for key in list(self.receiver.staged_rids):
             if not self.receiver.ready(key):
                 continue
@@ -3082,6 +3096,9 @@ class _DisaggWorker:
                 else prefix.warm_hit_tokens_total,
             "swap_outs": eng.stats["swap_outs"],
             "swap_ins": eng.stats["swap_ins"],
+            "overlap_steps": eng.stats["overlap_steps"],
+            "overlap_fences": eng.stats["overlap_fences"],
+            "host_hidden_ms": eng.stats["host_hidden_ms"],
             # inlined (not eng.tier.stats()): this fn is the
             # stats_req reply path, so the dict build must be
             # call-free — proto-reply-pairing's exception-edge rule
@@ -3152,6 +3169,11 @@ class _DisaggWorker:
                     if self.role == "prefill":
                         self._stream_pages(finished)
                     else:
+                        # staged-page installs land here, AFTER the
+                        # step — overlapped with the dispatched
+                        # step's device time, not serialized between
+                        # admission and dispatch (round 21)
+                        self.receiver.retry_installs()
                         self._flush_tokens(finished)
                 elif not drained:
                     try:

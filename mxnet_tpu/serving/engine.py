@@ -75,6 +75,24 @@ cost becomes O(transfer) instead of O(prefill).  Every tier path
 degrades to the pre-tier behavior when the tier refuses or the entry
 was LRU-aged: exactness NEVER depends on the tier, only latency does.
 
+Round 21 hides the host scheduler behind device execution (ROADMAP
+item 4, ``overlap=True`` / ``MXNET_SERVE_OVERLAP=1``): the step
+program grows a per-row ``tok_src`` selector so a decode row's input
+token can come from the PREVIOUS step's device-resident argmax matrix
+instead of a host-fed value — step N+1 dispatches against step N's
+device output before the host has read step N back — and a planner
+thread builds step N+1's admission / prefix match / page allocation /
+row batch into a second preallocated buffer set while step N runs on
+device.  The host consumes tokens one step behind (stop conditions,
+commits, metrics); a committed stop/eos/cancel/preemption that
+invalidates the speculatively dispatched step reconciles EXACTLY:
+the stale row's writes land at positions beyond every committed read
+range (the same argument that makes preemption recompute-exact), so
+per-row skip suffices, and the only fence is speculative decode
+(drafters need committed host tokens — those steps run serially).
+``overlap=False`` (the default) is bit-for-bit the round-20 engine:
+same compiled program, same host schedule, same commit order.
+
 Exactness: under f32 greedy, engine outputs are token-identical to
 ``models/gpt.py generate`` per request, whatever the batch mix,
 admission order, page reuse, preemptions, swap-outs, kernel choice,
@@ -98,7 +116,9 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
+import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -115,11 +135,14 @@ __all__ = ["Request", "ServingEngine", "step_input_specs",
            "step_output_specs"]
 
 
-def step_input_specs(params, cfg, kv_int8, tp="tp"):
+def step_input_specs(params, cfg, kv_int8, tp="tp", overlap=False):
     """The ENGINE'S DECLARED shardings: a mesh-free ``PartitionSpec``
     pytree for every input of the step program, positionally matching
     ``_make_step``'s ``(params, pools, tokens, row_slot, row_pos,
-    row_live, bt, slot_rows)`` signature.
+    row_live, bt, slot_rows)`` signature — plus, for the ``overlap``
+    variant, the trailing ``(prev_tok, tok_src)`` pair (the previous
+    step's device-resident argmax matrix and the per-row selector
+    into it), both replicated like every other host-shaped input.
 
     * params — the megatron rules via ``models/gpt.py
       decode_param_specs`` (int8 q/s specs derived from the float
@@ -145,9 +168,12 @@ def step_input_specs(params, cfg, kv_int8, tp="tp"):
     if kv_int8:
         pool["s"] = pool_spec
     rep = P()
-    return (G.decode_param_specs(params, cfg, tp=tp),
-            [dict(pool) for _ in range(cfg.n_layers)],
-            rep, rep, rep, rep, rep, rep)
+    out = (G.decode_param_specs(params, cfg, tp=tp),
+           [dict(pool) for _ in range(cfg.n_layers)],
+           rep, rep, rep, rep, rep, rep)
+    if overlap:
+        out = out + (rep, rep)
+    return out
 
 
 def step_output_specs(cfg, kv_int8, tp="tp"):
@@ -271,7 +297,7 @@ def _make_copy(cfg, kv_int8, mesh=None):
 
 def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                kv_int8, kernel="xla", n_sample=1, mesh=None,
-               params=None):
+               params=None, overlap=False):
     """Build (and cache) the jitted unified prefill+decode step.
 
     ``kernel`` selects the decode-attention implementation: ``"xla"``
@@ -294,6 +320,17 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     needed for the spec tree's structure only — float vs weight-only
     int8).
 
+    With ``overlap`` (round 21, latency-hiding scheduling) the
+    program takes two extra inputs: ``prev_tok``, the PREVIOUS step's
+    device-resident ``(S, n_sample)`` argmax matrix, and ``tok_src``,
+    a per-row int32 selector — row r's effective input token is
+    ``prev_tok[tok_src[r], 0]`` when ``tok_src[r] >= 0`` and
+    ``tokens[r]`` otherwise.  That one gather is what takes the host
+    readback off the dispatch critical path: step N+1 launches
+    against step N's output buffer without the host ever seeing it.
+    ``overlap=False`` compiles the EXACT round-20 program (the flag
+    is part of the cache key; no ``where`` enters the graph).
+
     The compiled program is audited by graphlint
     (``tools/analysis/graphlint.py``, tier-1): pool donation is
     verified against the lowering (dropping ``donate_argnums=(1,)``
@@ -304,7 +341,7 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     import jax.numpy as jnp
 
     key = (cfg, num_slots, n_rows, pages_per_slot, page_size,
-           bool(kv_int8), kernel, n_sample, mesh,
+           bool(kv_int8), kernel, n_sample, mesh, bool(overlap),
            None if mesh is None
            else jax.tree_util.tree_structure(params))
     fn = _step_cache.get(key)
@@ -316,8 +353,8 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
     dh = D // H
     T = n_rows
 
-    def step(params, pools, tokens, row_slot, row_pos, row_live, bt,
-             slot_rows):
+    def _body(params, pools, tokens, row_slot, row_pos, row_live, bt,
+              slot_rows):
         x = G._embed(params, tokens, cdt)              # (T, D)
         x = x + params["pos_emb"][row_pos].astype(cdt)
         x = G.T._layer_norm(x, params["emb_ln"]["g"].astype(cdt),
@@ -404,10 +441,30 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
         next_tok = jnp.argmax(slot_logits, axis=-1).astype(jnp.int32)
         return next_tok, new_pools
 
+    if overlap:
+        def step(params, pools, tokens, row_slot, row_pos, row_live,
+                 bt, slot_rows, prev_tok, tok_src):
+            # device-carried inputs: rows with tok_src >= 0 read the
+            # previous step's argmax for that slot straight off the
+            # device (column 0 = the slot's sampling row); everything
+            # else — prefill rows, post-fence decode rows, dead
+            # padding — keeps its host-fed token.  An exact int32
+            # select: carried steps compute bit-identically to the
+            # serial schedule that would have fed the same token.
+            eff = jnp.where(
+                tok_src >= 0,
+                prev_tok[jnp.clip(tok_src, 0, num_slots - 1), 0],
+                tokens)
+            return _body(params, pools, eff, row_slot, row_pos,
+                         row_live, bt, slot_rows)
+    else:
+        step = _body
+
     kw = {}
     if mesh is not None:
         kw = {"in_shardings": _bind(
-                  mesh, step_input_specs(params, cfg, kv_int8)),
+                  mesh, step_input_specs(params, cfg, kv_int8,
+                                         overlap=overlap)),
               "out_shardings": _bind(
                   mesh, step_output_specs(cfg, kv_int8))}
     fn = jax.jit(step, donate_argnums=(1,), **kw)
@@ -415,6 +472,98 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
         _step_cache.pop(next(iter(_step_cache)))
     _step_cache[key] = fn
     return fn
+
+
+class _StepBuffers:
+    """One preallocated set of host-side step inputs.  The engine
+    owns TWO and rotates: while step N (built into set A) executes on
+    device, the planner builds step N+1 into set B — and even the
+    serial path rotates, so no step's host buffers are ever mutated
+    while a dispatch that snapshot them could still be staging
+    (round-21 satellite: no fresh numpy allocations per step)."""
+
+    __slots__ = ("tokens", "row_slot", "row_pos", "row_live",
+                 "tok_src", "slot_rows", "bt")
+
+    def __init__(self, n_rows, num_slots, spec_K, pages_per_slot):
+        T, S = n_rows, num_slots
+        self.tokens = np.zeros(T, np.int32)
+        self.row_slot = np.full(T, S, np.int32)
+        self.row_pos = np.zeros(T, np.int32)
+        self.row_live = np.zeros(T, bool)
+        self.tok_src = np.full(T, -1, np.int32)
+        self.slot_rows = np.zeros((S, 1 + spec_K), np.int32)
+        self.bt = np.zeros((S + 1, pages_per_slot), np.int32)
+
+    def reset(self, num_slots):
+        self.tokens.fill(0)
+        self.row_slot.fill(num_slots)
+        self.row_pos.fill(0)
+        self.row_live.fill(False)
+        self.tok_src.fill(-1)
+        self.slot_rows.fill(0)
+
+
+class _Plan:
+    """One fully-built step: the row batch plus everything the commit
+    needs recorded AT BUILD TIME.  Under overlap the commit runs one
+    step later than the build, after the planner has already advanced
+    ``n_prefilled`` for the NEXT plan — so commits must never read
+    live scheduler positions; they read these records."""
+
+    __slots__ = ("buf", "samplers", "spec_plan", "decode_pos",
+                 "was_decode", "prefill_mid", "n_dec_rows",
+                 "n_pre_rows", "n_rows_used", "decode_rids",
+                 "prefill_spans", "carried", "fenced", "empty",
+                 "pipelined")
+
+    def __init__(self):
+        self.buf = None
+        self.samplers = []          # requests sampling a token
+        self.spec_plan = {}         # rid -> drafts (serial plans only)
+        self.decode_pos = {}        # rid -> its sampling row's pos
+        self.was_decode = {}        # rid -> fed a decode row?
+        self.prefill_mid = []       # (req, n_prefilled) mid-prefill
+        self.n_dec_rows = 0
+        self.n_pre_rows = 0
+        self.n_rows_used = 0
+        self.decode_rids = []       # trace
+        self.prefill_spans = []     # trace: (rid, row_lo, row_hi)
+        self.carried = 0            # rows fed from device prev_tok
+        self.fenced = False         # spec fence: nothing built
+        self.empty = True           # no live rows
+        self.pipelined = False      # built for the overlap path
+
+
+def _planner_main(engine_ref, ctl, go, ready):
+    """Overlap planner thread body.  A module-level function holding
+    only a WEAK engine reference: a bound-method target would keep
+    the engine alive through the thread frame and the finalizer below
+    could never fire.  Protocol: the engine thread sets ``go`` after
+    each commit; the planner builds the next plan under the engine
+    lock, publishes it, and sets ``ready`` (the Event pair is the
+    happens-before edge for the unlocked ``_plan`` handoff)."""
+    while True:
+        go.wait()
+        go.clear()
+        if ctl["stop"]:
+            return
+        eng = engine_ref()
+        if eng is None:
+            return
+        with eng._mu:
+            plan = eng._build_plan(overlap=True)
+        eng._plan = plan
+        ready.set()
+        del eng
+
+
+def _stop_planner(ctl, go):
+    """weakref.finalize target: unpark and retire the planner when
+    the engine is collected (captures the control dict + event, never
+    the engine)."""
+    ctl["stop"] = True
+    go.set()
 
 
 _engine_seq = itertools.count()
@@ -746,7 +895,7 @@ class ServingEngine:
                  kv_int8=False, prefix_cache=False, metrics=None,
                  registry=None, rid_start=0, kernel="xla", spec_K=0,
                  spec_drafter="ngram", spec_ngram=2, tp=1, mesh=None,
-                 tier_bytes=None):
+                 tier_bytes=None, overlap=None):
         if not cfg.causal:
             cfg = dataclasses.replace(cfg, causal=True)
         if num_slots < 1:
@@ -862,6 +1011,15 @@ class ServingEngine:
             if prefix_cache else None
         if self.prefix is not None:
             self.cache.pressure_cb = self.prefix.evict
+        # latency-hiding overlap (round 21): explicit argument >
+        # MXNET_SERVE_OVERLAP env > off.  overlap=False is bit-for-bit
+        # the round-20 serial engine (same step program, same
+        # schedule); overlap=True pipelines the host scheduler with
+        # device execution — see the module docstring.
+        if overlap is None:
+            overlap = os.environ.get("MXNET_SERVE_OVERLAP",
+                                     "0") == "1"
+        self.overlap = bool(overlap)
         self._copy_fn = None              # jitted COW page copy
         if self.prefix is not None:
             # pre-compile the COW program now (scratch-onto-scratch is
@@ -873,7 +1031,8 @@ class ServingEngine:
                                    pages_per_slot, page_size,
                                    self.kv_int8, kernel=self.kernel,
                                    n_sample=1 + self.spec_K,
-                                   mesh=self.mesh, params=self.params)
+                                   mesh=self.mesh, params=self.params,
+                                   overlap=self.overlap)
         self._queue: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * num_slots
         # rid_start: a ServingCluster gives each replica a disjoint
@@ -887,7 +1046,38 @@ class ServingEngine:
                       "prefix_hit_tokens": 0, "cow_copies": 0,
                       "spec_drafted": 0, "spec_accepted": 0,
                       "swap_outs": 0, "swap_ins": 0,
-                      "slot_occupancy_sum": 0.0}
+                      "slot_occupancy_sum": 0.0,
+                      "host_hidden_ms": 0.0, "overlap_steps": 0,
+                      "overlap_fences": 0}
+        # -------- round 21: scheduler/planner shared state ---------
+        # One lock (_mu) guards everything BOTH the engine thread and
+        # the planner thread touch: queue/slots/pages/prefix/stats and
+        # the request fields they mutate.  The plan handoff itself
+        # (_plan / _plan_pending / _inflight*) is engine-thread-owned
+        # or sequenced by the _plan_go/_plan_ready Event pair and
+        # deliberately stays OUTSIDE the lock — pylocklint sees those
+        # groups as consistently unguarded.
+        self._mu = threading.Lock()
+        self._bufs = (
+            _StepBuffers(self.n_rows, num_slots, self.spec_K,
+                         pages_per_slot),
+            _StepBuffers(self.n_rows, num_slots, self.spec_K,
+                         pages_per_slot))
+        self._buf_idx = 0
+        # canonical block table, patched incrementally at page
+        # alloc/free time (satellite: no full rebuild per step); row
+        # num_slots stays all-scratch for dead rows
+        self._bt = np.zeros((num_slots + 1, pages_per_slot), np.int32)
+        self._inflight = None        # _Plan currently on device
+        self._inflight_tok = None    # its device-resident next_tok
+        self._plan = None            # planner -> engine handoff slot
+        self._plan_pending = False   # engine-thread-only flag
+        self._plan_go = threading.Event()
+        self._plan_ready = threading.Event()
+        self._planner = None         # lazily spawned on first overlap
+        self._planner_ctl = None
+        self._finalizer = None
+        self._tok0 = None            # lazy zeros for the first prev_tok
         if metrics is None:
             # an explicitly supplied registry is a request for
             # telemetry; otherwise the env var decides
@@ -927,16 +1117,17 @@ class ServingEngine:
             raise ValueError("submit: %d tokens > cfg.max_len=%d"
                              % (total, self.cfg.max_len))
         now = time.perf_counter()
-        req = Request(rid=self._next_rid, prompt=prompt,
-                      max_new_tokens=int(max_new_tokens),
-                      eos_id=eos_id, submit_t=now, wait_start=now)
-        self._next_rid += 1
-        self.requests[req.rid] = req
-        self._queue.append(req)
-        if self._obs is not None:
-            self._obs.submitted.inc()
-            self._obs.g_queued.set(len(self._queue))
-        return req.rid
+        with self._mu:
+            req = Request(rid=self._next_rid, prompt=prompt,
+                          max_new_tokens=int(max_new_tokens),
+                          eos_id=eos_id, submit_t=now, wait_start=now)
+            self._next_rid += 1
+            self.requests[req.rid] = req
+            self._queue.append(req)
+            if self._obs is not None:
+                self._obs.submitted.inc()
+                self._obs.g_queued.set(len(self._queue))
+            return req.rid
 
     @property
     def free_slots(self):
@@ -973,69 +1164,95 @@ class ServingEngine:
             raise ValueError(
                 "admit_prefilled: %d tokens > max_seq %d / max_len %d"
                 % (total, self.max_seq, self.cfg.max_len))
-        free = [i for i, r in enumerate(self._slots) if r is None]
-        if not free:
-            raise RuntimeError("admit_prefilled: no free slot")
-        n_cached = prompt.size + len(generated) - 1
-        need = -(-n_cached // self.page_size) if n_cached else 0
-        if len(pages) < need:
-            raise ValueError(
-                "admit_prefilled: %d pages cannot cover %d cached "
-                "positions" % (len(pages), n_cached))
-        now = time.perf_counter()
-        if rid is None:
-            rid = self._next_rid
-            self._next_rid += 1
-        req = Request(rid=rid, prompt=prompt,
-                      max_new_tokens=int(max_new_tokens),
-                      eos_id=eos_id, submit_t=now, wait_start=now)
-        req.generated = generated
-        req.pending = generated[-1]
-        req.n_cached = n_cached
-        req.n_prefilled = n_cached
-        req.pages = list(pages)
-        req.slot = free[0]
-        req.state = "running"
-        self.requests[rid] = req
-        self._slots[req.slot] = req
-        self.stats["admitted"] += 1
-        if self._obs is not None:
-            self._obs.submitted.inc()
-            self._obs.admitted.inc()
-            self._obs.g_running.set(
-                sum(r is not None for r in self._slots))
-        return rid
+        with self._mu:
+            free = [i for i, r in enumerate(self._slots)
+                    if r is None]
+            if not free:
+                raise RuntimeError("admit_prefilled: no free slot")
+            n_cached = prompt.size + len(generated) - 1
+            need = -(-n_cached // self.page_size) if n_cached else 0
+            if len(pages) < need:
+                raise ValueError(
+                    "admit_prefilled: %d pages cannot cover %d cached"
+                    " positions" % (len(pages), n_cached))
+            now = time.perf_counter()
+            if rid is None:
+                rid = self._next_rid
+                self._next_rid += 1
+            req = Request(rid=rid, prompt=prompt,
+                          max_new_tokens=int(max_new_tokens),
+                          eos_id=eos_id, submit_t=now, wait_start=now)
+            req.generated = generated
+            req.pending = generated[-1]
+            req.n_cached = n_cached
+            req.n_prefilled = n_cached
+            req.pages = list(pages)
+            req.slot = free[0]
+            req.state = "running"
+            self.requests[rid] = req
+            self._slots[req.slot] = req
+            self._bt_set(req.slot, req.pages)
+            self.stats["admitted"] += 1
+            if self._obs is not None:
+                self._obs.submitted.inc()
+                self._obs.admitted.inc()
+                self._obs.g_running.set(
+                    sum(r is not None for r in self._slots))
+            return rid
 
     def cancel(self, rid):
         """Force-retire a request (frees its slot and pages
         immediately; queued requests are simply dropped).  A cancel
         landing after completion — the inherent client race — is a
         no-op: the finished output stays retrievable."""
-        req = self.requests[rid]
-        if req.state in ("done", "cancelled"):
-            return
-        if req.state == "queued":
-            self._queue.remove(req)
-            if self.tier is not None:
-                # a swapped-out victim cancelled while queued must
-                # not squat in the host tier until LRU age-out
-                self.tier.drop(("swap", rid))
-        elif req.state == "running":
-            self._release(req)
-        req.state = "cancelled"
-        if self._obs is not None:
-            self._obs.cancelled.inc()
-            self._obs.g_queued.set(len(self._queue))
-            self._obs.g_running.set(
-                sum(r is not None for r in self._slots))
-            if profiler.is_recording():
-                self._obs.trace.add_instant(
-                    rid, "retire", time.perf_counter(),
-                    args={"state": "cancelled"})
-                self._obs.trace.flush()
+        with self._mu:
+            req = self.requests[rid]
+            if req.state in ("done", "cancelled"):
+                return
+            if req.state == "queued":
+                self._queue.remove(req)
+                if self.tier is not None:
+                    # a swapped-out victim cancelled while queued must
+                    # not squat in the host tier until LRU age-out
+                    self.tier.drop(("swap", rid))
+            elif req.state == "running":
+                self._release(req)
+            req.state = "cancelled"
+            if self._obs is not None:
+                self._obs.cancelled.inc()
+                self._obs.g_queued.set(len(self._queue))
+                self._obs.g_running.set(
+                    sum(r is not None for r in self._slots))
+                if profiler.is_recording():
+                    self._obs.trace.add_instant(
+                        rid, "retire", time.perf_counter(),
+                        args={"state": "cancelled"})
+                    self._obs.trace.flush()
 
     # ----------------------------------------------------- plumbing --
+    # (Every helper below mutates scheduler state the planner thread
+    # also reads/writes — callers hold the engine lock.)
+
+    def _bt_set(self, slot, pages):
+        """Patch the canonical block table's row for ``slot`` to
+        ``pages`` (satellite: incremental patching, no per-step
+        rebuild).  A local row view keeps the slice stores cheap."""
+        # mxlint: requires(ServingEngine._mu)
+        row = self._bt[slot]
+        n = min(len(pages), row.size)
+        row[:n] = pages[:n]
+        row[n:] = 0
+
+    def _bt_clear(self, slot):
+        # mxlint: requires(ServingEngine._mu)
+        self._bt[slot, :] = 0
+
+    # mxlint: requires(ServingEngine._mu)
     def _release(self, req):
+        if req.slot is not None:
+            # clear the block-table row BEFORE nulling the slot: no
+            # window where a freed page id sits in a live-looking row
+            self._bt_clear(req.slot)
         if req.pages:
             if req.shared_pages:
                 # cache-owned pages stay cached (their refs drop
@@ -1054,6 +1271,7 @@ class ServingEngine:
             self._slots[req.slot] = None
             req.slot = None
 
+    # mxlint: requires(ServingEngine._mu)
     def _preempt_for(self, req):
         """Free one+ pages by preempting the youngest running request
         other than ``req``; returns True if anything was preempted."""
@@ -1064,6 +1282,7 @@ class ServingEngine:
         self._preempt_victim(max(victims, key=lambda r: r.rid))
         return True
 
+    # mxlint: requires(ServingEngine._mu)
     def _preempt_victim(self, victim):
         """Evict ``victim`` from its slot and requeue it at the front.
         With a host tier the victim's written pages are SWAPPED OUT
@@ -1115,11 +1334,13 @@ class ServingEngine:
         benchmark / ops lever behind the swap-vs-recompute resume
         measurement.  Returns True if the pages were swapped out,
         False for a recompute-resume preemption."""
-        req = self.requests[rid]
-        if req.state != "running":
-            raise ValueError("preempt(%d): request is %s, not running"
-                             % (rid, req.state))
-        return self._preempt_victim(req)
+        with self._mu:
+            req = self.requests[rid]
+            if req.state != "running":
+                raise ValueError(
+                    "preempt(%d): request is %s, not running"
+                    % (rid, req.state))
+            return self._preempt_victim(req)
 
     def _cow_page(self, src, dst):
         """Device-copy page ``src`` into ``dst`` across every layer
@@ -1131,6 +1352,7 @@ class ServingEngine:
                                        mesh=self.mesh)
         self.cache.pools = self._copy_fn(self.cache.pools, src, dst)
 
+    # mxlint: requires(ServingEngine._mu)
     def _insert_prefix(self, req):
         """Donate req's freshly-completed, fully-prompt-covered pages
         to the prefix cache (so later requests sharing the prefix skip
@@ -1146,10 +1368,12 @@ class ServingEngine:
             req.prefix_entries.append(entry)
         req.chain_upto = upto
 
+    # mxlint: requires(ServingEngine._mu)
     def _ensure_page(self, req, pos):
         """Make req's block table cover position pos (allocating, or
         preempting another request when the pool is dry)."""
         idx = pos // self.page_size
+        grew = idx >= len(req.pages)
         while idx >= len(req.pages):
             got = self.cache.alloc(1)
             if got is None:
@@ -1159,8 +1383,11 @@ class ServingEngine:
                         "single request — grow num_pages")
                 continue
             req.pages.extend(got)
+        if grew and req.slot is not None:
+            self._bt_set(req.slot, req.pages)
         return True
 
+    # mxlint: requires(ServingEngine._mu)
     def _admit(self):
         while self._queue:
             free_slots = [i for i, r in enumerate(self._slots)
@@ -1232,6 +1459,7 @@ class ServingEngine:
             req.n_cached = skip
             req.pending = None
             self._slots[req.slot] = req
+            self._bt_set(req.slot, req.pages)
             self.stats["admitted"] += 1
             if self._obs is not None:
                 now = time.perf_counter()
@@ -1245,6 +1473,7 @@ class ServingEngine:
                         self._obs.trace.add_instant(req.rid, "resume",
                                                     now)
 
+    # mxlint: requires(ServingEngine._mu)
     def _swap_in(self, req, inp, slot):
         """Install-exact resume (round 18): if ``req`` was preempted
         with its pages swapped to the host tier, re-install the exact
@@ -1293,6 +1522,7 @@ class ServingEngine:
         req.n_prefilled = inp.size if req.pending is not None \
             else req.n_cached
         self._slots[slot] = req
+        self._bt_set(slot, req.pages)
         self.stats["admitted"] += 1
         self.stats["swap_ins"] += 1
         if self._obs is not None:
@@ -1308,6 +1538,7 @@ class ServingEngine:
                           "pages": len(req.pages)})
         return "admitted"
 
+    # mxlint: requires(ServingEngine._mu)
     def _plan_speculation(self):
         """Phase-A speculation planning: for every running decode row
         propose K_eff draft tokens (host-side — the drafters are
@@ -1356,16 +1587,204 @@ class ServingEngine:
 
     # --------------------------------------------------------- step --
     def step(self):
-        """One engine iteration.  Returns the list of request ids that
-        finished during this step (possibly empty); False when there
-        is nothing left to do."""
-        import jax.numpy as jnp
+        """One engine iteration.  Returns the list of request ids
+        whose COMMIT landed during this call (possibly empty); False
+        when there is nothing left to do.  ``overlap=False`` runs the
+        round-20 serial schedule; ``overlap=True`` runs the pipelined
+        schedule — dispatch step N+1 against step N's device-resident
+        tokens, then drain/commit step N — so a request's finish is
+        reported one call after the step that produced its last
+        token."""
+        return self._step_overlap() if self.overlap \
+            else self._step_serial()
 
+    def _step_serial(self):
+        """One fully-serial iteration — the round-20 schedule exactly:
+        build (phases A+B, under the lock), dispatch, block on the
+        readback, commit (phase C, under the lock)."""
         if not self._queue and all(r is None for r in self._slots):
             return False
         obs = self._obs
-        tracing = obs is not None and profiler.is_recording()
         t_step0 = time.perf_counter() if obs is not None else 0.0
+        with self._mu:
+            plan = self._build_plan(overlap=False)
+        if obs is not None:
+            # the step program is the serving layer's "operator": route
+            # its start/stop through the host engine's op-hook choke
+            # point so a recording profiler logs it as a cat-"operator"
+            # event interleaved with the request spans below
+            _HostEngine.get().notify("start", "serving_step")
+        try:
+            next_tok = self._dispatch(plan)
+            # mxlint: allow(host-sync) -- intentional: the ONE device
+            # sync per step; the host scheduler branches on the sampled
+            # tokens (stop conditions, commits) before the next step
+            next_tok = np.asarray(next_tok)
+        finally:
+            if obs is not None:
+                _HostEngine.get().notify("stop", "serving_step")
+        now = time.perf_counter()
+        with self._mu:
+            return self._commit(plan, next_tok, now, t_step0)
+
+    def _step_overlap(self):
+        """One pipelined iteration (round 21).  Call k: take plan k
+        (planner-built while call k-1's dispatch executed, or built
+        inline on a cold start), dispatch it against the in-flight
+        step's device-resident tokens, THEN drain/commit step k-1 —
+        the host-side commit of k-1 and the planner's build of k+1
+        both hide behind step k's device execution."""
+        self._ensure_planner()
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        plan = self._take_plan()
+        if plan is None:
+            return False
+        if plan.fenced:
+            # speculation fence: drafting reads fully-committed host
+            # state, so drain the pipeline and run ONE exact serial
+            # step (full round-20 semantics, spec planning included),
+            # then resume pipelining
+            finished = []
+            old, old_tok = self._inflight, self._inflight_tok
+            self._inflight = None
+            self._inflight_tok = None
+            if old is not None:
+                finished += self._drain(old, old_tok, t0)
+            out = self._step_serial()
+            if out is not False:
+                finished += out
+            self._maybe_plan_ahead()
+            return finished
+        old, old_tok = self._inflight, self._inflight_tok
+        if not plan.empty:
+            if obs is not None:
+                _HostEngine.get().notify("start", "serving_step")
+            try:
+                tdev = self._dispatch(plan)
+            finally:
+                if obs is not None:
+                    _HostEngine.get().notify("stop", "serving_step")
+            self._inflight = plan
+            self._inflight_tok = tdev
+        else:
+            # nothing to dispatch (every live request rides the
+            # in-flight step) — just drain
+            self._inflight = None
+            self._inflight_tok = None
+        finished = self._drain(old, old_tok, t0) if old is not None \
+            else []
+        self._maybe_plan_ahead()
+        return finished
+
+    def _take_plan(self):
+        """Fetch the next plan: the planner's (if one was signalled —
+        the ``_plan_ready`` wait is the happens-before edge for the
+        unlocked handoff), else build inline under the lock (cold
+        start / post-fence).  None means the engine is idle."""
+        if self._plan_pending:
+            self._plan_ready.wait()
+            self._plan_ready.clear()
+            self._plan_pending = False
+            plan = self._plan
+            self._plan = None
+            return plan
+        with self._mu:
+            if self._inflight is None and not self._queue \
+                    and all(r is None for r in self._slots):
+                return None
+            return self._build_plan(overlap=True)
+
+    def _drain(self, plan, tok, t0):
+        """Block on a dispatched step's sampled tokens and commit it.
+        Under overlap this runs AFTER the next step was dispatched —
+        the readback waits out step N's tail while N+1 executes."""
+        # mxlint: allow(host-sync) -- intentional: the ONE device
+        # sync per step — under overlap one step BEHIND dispatch (the
+        # latency-hiding point); the host branches on step N's tokens
+        # (stop conditions, commits) while step N+1 executes
+        next_tok = np.asarray(tok)
+        now = time.perf_counter()
+        with self._mu:
+            return self._commit(plan, next_tok, now, t0)
+
+    def _maybe_plan_ahead(self):
+        """Signal the planner to build the next plan while the
+        just-dispatched step executes.  The pending flag and the go/
+        ready Events sequence the handoff; the work check itself
+        takes the lock (queue/slots are shared)."""
+        with self._mu:
+            work = bool(self._queue) or self._inflight is not None \
+                or any(r is not None for r in self._slots)
+        if work:
+            self._plan_pending = True
+            self._plan_go.set()
+
+    def _ensure_planner(self):
+        """Lazily spawn (or respawn after close()) the planner
+        thread.  A fresh control dict per spawn keeps a stale
+        finalizer from stopping the new thread."""
+        if self._planner is not None and self._planner.is_alive():
+            return
+        ctl = {"stop": False}
+        self._planner_ctl = ctl
+        self._plan_go.clear()
+        self._plan_ready.clear()
+        self._plan_pending = False
+        self._plan = None
+        t = threading.Thread(
+            target=_planner_main,
+            args=(weakref.ref(self), ctl, self._plan_go,
+                  self._plan_ready),
+            daemon=True, name="serving-engine-planner")
+        self._finalizer = weakref.finalize(self, _stop_planner, ctl,
+                                           self._plan_go)
+        self._planner = t
+        t.start()
+
+    def close(self):
+        """Stop the planner thread (idempotent; serial engines no-op).
+        Garbage collection alone also stops it via the finalizer, but
+        an explicit close joins the thread out."""
+        ctl = self._planner_ctl
+        t = self._planner
+        self._planner = None
+        self._planner_ctl = None
+        if ctl is not None:
+            ctl["stop"] = True
+            self._plan_go.set()
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    # mxlint: requires(ServingEngine._mu)
+    def _build_plan(self, overlap=False):
+        """Phases A+B of the engine step — admission, page
+        allocation, speculation planning, and the fixed-shape row
+        batch — built into the next rotated buffer set and recorded
+        as a ``_Plan``.  ``overlap=True`` additionally plans CARRIED
+        decode rows for the in-flight step's samplers: their input
+        token is the in-flight step's device-resident argmax
+        (``tok_src``), their position the in-flight sampling position
+        + 1 — the pipelined dispatch never waits for the readback.
+        Everything the later commit needs is recorded here at build
+        time (the planner may build k+1 before k's commit runs)."""
+        t_b0 = time.perf_counter()
+        hidden = overlap and self._inflight is not None
+        plan = _Plan()
+        plan.pipelined = bool(overlap)
+        inflight = self._inflight if overlap else None
+        if overlap and self.spec_K > 0 and (
+                (inflight is not None and inflight.samplers)
+                or any(r is not None and r.pending is not None
+                       for r in self._slots)):
+            # speculation fence: the drafters read req.generated,
+            # which for any in-flight sampler is one token behind the
+            # device — don't build, let the caller drain and run one
+            # serial step.  Pure-prefill phases (no samplers, no
+            # pending) still pipeline under spec_K > 0.
+            plan.fenced = True
+            self.stats["overlap_fences"] += 1
+            return plan
         self._admit()
 
         # ---- phase A: secure pages.  _ensure_page may PREEMPT the
@@ -1373,14 +1792,32 @@ class ServingEngine:
         # any row is built — a victim preempted here simply has no
         # rows this step (build skips slot-less requests); allocating
         # mid-build could free pages a built row already targets.
+        carried = {}                   # rid -> device-carried position
+        if inflight is not None:
+            for req in inflight.samplers:
+                if req.slot is None or req.state != "running":
+                    continue           # preempted/cancelled mid-flight
+                if len(req.generated) + 1 >= req.max_new_tokens:
+                    # the in-flight token predictably finishes this
+                    # request — its slot idles one step and retires
+                    # at the drain (never decode past the budget)
+                    continue
+                pos = inflight.decode_pos[req.rid] + 1
+                self._ensure_page(req, pos)
+                carried[req.rid] = pos
+        inflight_rids = set() if inflight is None else \
+            {req.rid for req in inflight.samplers}
         for req in list(self._slots):
-            if req is not None and req.pending is not None:
+            if req is not None and req.pending is not None \
+                    and req.rid not in inflight_rids:
                 self._ensure_page(req, req.n_cached)
         # speculation planning (drafting + draft-depth pages) is part
-        # of phase A for the same reason
+        # of phase A for the same reason (pipelined builds reach here
+        # only with spec_K == 0 — the fence above — so this is {})
         spec_plan = self._plan_speculation()
+        plan.spec_plan = spec_plan
         budget = self.prefill_chunk
-        plan = {}                          # rid -> prefill rows planned
+        pre = {}                           # rid -> prefill rows planned
         for req in list(self._slots):
             if req is None or req.pending is not None or budget <= 0:
                 continue
@@ -1391,27 +1828,50 @@ class ServingEngine:
             # (and thus preempt); keep BOTH before this point
             assert (req.n_prefilled + n - 1) // self.page_size \
                 < len(req.pages)
-            plan[req.rid] = n
+            pre[req.rid] = n
             budget -= n
 
-        # ---- phase B: build the fixed-shape row batch ----
+        # ---- phase B: build the fixed-shape row batch into the next
+        # rotated buffer set (satellite: persistent buffers — the set
+        # the in-flight step was staged from is never touched) ----
+        obs = self._obs
+        tracing = obs is not None and profiler.is_recording()
+        buf = self._bufs[self._buf_idx]
+        self._buf_idx ^= 1
+        buf.reset(self.num_slots)
+        np.copyto(buf.bt, self._bt)        # canonical, patched at
+        plan.buf = buf                     # alloc/free — no rebuild
         T, S = self.n_rows, self.num_slots
-        tokens = np.zeros(T, np.int32)
-        row_slot = np.full(T, S, np.int32)     # dead → all-scratch bt row
-        row_pos = np.zeros(T, np.int32)
-        row_live = np.zeros(T, bool)
-        # (S, 1+K) sampling-row matrix: column 0 is the slot's pending
-        # (or last-prefill) row, columns 1.. its draft-verify rows.
-        # Unused entries stay 0 — the program gathers row 0's argmax
-        # there and the host never reads it.
-        slot_rows = np.zeros((S, 1 + self.spec_K), np.int32)
-        samplers = []                      # requests that sample a token
-        decode_rids = []                   # trace: decode-row requests
-        prefill_spans = []                 # trace: (rid, row_lo, row_hi)
-        n_dec_rows = 0
+        tokens, row_slot = buf.tokens, buf.row_slot
+        row_pos, row_live = buf.row_pos, buf.row_live
+        slot_rows, tok_src = buf.slot_rows, buf.tok_src
+        samplers = plan.samplers
         r = 0
+        # carried decode rows (overlap only): input = the in-flight
+        # step's argmax for this slot, read on device via tok_src
+        if inflight is not None:
+            for req in inflight.samplers:
+                if req.rid not in carried or req.slot is None \
+                        or req.state != "running":
+                    continue
+                pos = carried[req.rid]
+                row_slot[r] = req.slot
+                row_pos[r] = pos
+                row_live[r] = True
+                tok_src[r] = req.slot
+                slot_rows[req.slot, 0] = r
+                samplers.append(req)
+                plan.decode_pos[req.rid] = pos
+                plan.was_decode[req.rid] = True
+                plan.carried += 1
+                self.stats["decode_rows"] += 1
+                plan.n_dec_rows += 1
+                if tracing:
+                    plan.decode_rids.append(req.rid)
+                r += 1
         for req in list(self._slots):      # decode (+ draft) rows
-            if req is None or req.pending is None:
+            if req is None or req.pending is None \
+                    or req.rid in inflight_rids:
                 continue
             tokens[r] = req.pending
             row_slot[r] = req.slot
@@ -1419,10 +1879,12 @@ class ServingEngine:
             row_live[r] = True
             slot_rows[req.slot, 0] = r
             samplers.append(req)
+            plan.decode_pos[req.rid] = req.n_cached
+            plan.was_decode[req.rid] = True
             self.stats["decode_rows"] += 1
-            n_dec_rows += 1
+            plan.n_dec_rows += 1
             if tracing:
-                decode_rids.append(req.rid)
+                plan.decode_rids.append(req.rid)
             r += 1
             # draft rows: positions n_cached+1 .. n_cached+K_eff, one
             # verify argmax read back per row.  Their k/v lands in the
@@ -1437,11 +1899,13 @@ class ServingEngine:
                 slot_rows[req.slot, 1 + i] = r
                 r += 1
         for req in list(self._slots):      # chunked prefill rows
-            if req is None or req.pending is not None:
+            if req is None or req.pending is not None \
+                    or req.rid in inflight_rids:
                 continue
             inp = req.resume_input
             p0 = req.n_prefilled
-            for _ in range(plan.get(req.rid, 0)):
+            sampled = False
+            for _ in range(pre.get(req.rid, 0)):
                 p = req.n_prefilled
                 tokens[r] = inp[p]
                 row_slot[r] = req.slot
@@ -1452,60 +1916,92 @@ class ServingEngine:
                 if req.n_prefilled == inp.size:
                     slot_rows[req.slot, 0] = r
                     samplers.append(req)
+                    plan.decode_pos[req.rid] = p
+                    plan.was_decode[req.rid] = False
+                    sampled = True
                 r += 1
+            if not sampled:
+                # still mid-prefill: the commit advances n_cached to
+                # the rows THIS plan wrote (recorded now — by commit
+                # time the planner may have pushed n_prefilled on)
+                plan.prefill_mid.append((req, req.n_prefilled))
             if tracing and req.n_prefilled > p0:
-                prefill_spans.append((req.rid, p0, req.n_prefilled))
+                plan.prefill_spans.append((req.rid, p0,
+                                           req.n_prefilled))
 
-        self.stats["dead_rows"] += T - r
-        self.stats["peak_pages"] = max(self.stats["peak_pages"],
-                                       self.cache.pages_in_use)
-        self.stats["slot_occupancy_sum"] += \
-            sum(r_ is not None for r_ in self._slots) / float(S)
+        plan.n_rows_used = r
+        plan.n_pre_rows = sum(pre.values())
+        plan.empty = r == 0
+        if r or not overlap:
+            # an empty pipelined plan is never dispatched — don't book
+            # a phantom batch (the serial path dispatches dead batches
+            # only when the idle check already found work)
+            self.stats["dead_rows"] += T - r
+            self.stats["peak_pages"] = max(self.stats["peak_pages"],
+                                           self.cache.pages_in_use)
+            self.stats["slot_occupancy_sum"] += \
+                sum(r_ is not None for r_ in self._slots) / float(S)
+        dt = time.perf_counter() - t_b0
+        if hidden:
+            # this build ran while a dispatched step executed on
+            # device: its host time is off the critical path
+            self.stats["host_hidden_ms"] += dt * 1e3
+        return plan
 
-        bt = np.zeros((S + 1, self.pages_per_slot), np.int32)
-        for req in self._slots:
-            if req is not None and req.pages:
-                bt[req.slot, :len(req.pages)] = req.pages
+    def _dispatch(self, plan):
+        """Stage a plan's host buffers and launch the step program
+        (asynchronous — the device array returns immediately).  No
+        lock: the buffers are plan-owned and the pool handoff happens
+        only on the engine thread."""
+        import jax.numpy as jnp
 
-        if obs is not None:
-            # the step program is the serving layer's "operator": route
-            # its start/stop through the host engine's op-hook choke
-            # point so a recording profiler logs it as a cat-"operator"
-            # event interleaved with the request spans below
-            _HostEngine.get().notify("start", "serving_step")
-        try:
+        buf = plan.buf
+        staged = (jnp.asarray(buf.tokens), jnp.asarray(buf.row_slot),
+                  jnp.asarray(buf.row_pos), jnp.asarray(buf.row_live),
+                  jnp.asarray(buf.bt), jnp.asarray(buf.slot_rows))
+        if self.overlap:
+            prev = self._inflight_tok
+            if prev is None:
+                if self._tok0 is None:
+                    self._tok0 = jnp.zeros(
+                        (self.num_slots, 1 + self.spec_K), jnp.int32)
+                prev = self._tok0
             next_tok, self.cache.pools = self._step_fn(
-                self.params, self.cache.pools,
-                jnp.asarray(tokens), jnp.asarray(row_slot),
-                jnp.asarray(row_pos), jnp.asarray(row_live),
-                jnp.asarray(bt), jnp.asarray(slot_rows))
-            # mxlint: allow(host-sync) -- intentional: the ONE device
-            # sync per step; the host scheduler branches on the sampled
-            # tokens (stop conditions, commits) before the next step
-            next_tok = np.asarray(next_tok)
-        finally:
-            if obs is not None:
-                _HostEngine.get().notify("stop", "serving_step")
-        self.stats["steps"] += 1
-        now = time.perf_counter()
+                self.params, self.cache.pools, *staged,
+                prev, jnp.asarray(buf.tok_src))
+        else:
+            next_tok, self.cache.pools = self._step_fn(
+                self.params, self.cache.pools, *staged)
+        return next_tok
 
+    # mxlint: requires(ServingEngine._mu)
+    def _commit(self, plan, next_tok, now, t_step0):
+        """Phase C: consume a completed step's sampled tokens — stop
+        conditions, retirement, metrics.  Under overlap this runs one
+        step after the plan was built (and after the NEXT plan was
+        already built), so it reads no live planner state: every
+        position it needs was recorded on the plan at build time."""
+        obs = self._obs
+        tracing = obs is not None and profiler.is_recording()
+        self.stats["steps"] += 1
+        if plan.pipelined:
+            self.stats["overlap_steps"] += 1
         finished = []
         spec_spans = []                    # trace: (rid, drafted, accepted)
-        for req in samplers:
-            if req.slot is None:           # preempted this step
-                continue
-            was_decode = req.pending is not None
-            # rows written this step are now cached
-            if was_decode:
-                req.n_cached += 1
-            else:
-                req.n_cached = req.n_prefilled
+        for req in plan.samplers:
+            if req.slot is None or req.state != "running":
+                continue                   # preempted/cancelled
+            was_decode = plan.was_decode[req.rid]
+            # rows written this step are now cached (the recorded
+            # sampling position, NOT live scheduler state)
+            req.n_cached = plan.decode_pos[req.rid] + 1
             if self.prefix is not None:
                 # donate completed prompt pages BEFORE a possible
                 # same-step retire releases them
                 self._insert_prefix(req)
             row = next_tok[req.slot]       # (1 + spec_K,) argmaxes
-            drafts = spec_plan.get(req.rid) if was_decode else None
+            drafts = plan.spec_plan.get(req.rid) if was_decode \
+                else None
             if drafts is not None and drafts.size:
                 # greedy verify: row[i] is the target's own argmax
                 # after pending + drafts[:i]; accept the longest
@@ -1564,14 +2060,18 @@ class ServingEngine:
                             req.rid, "retire", now,
                             args={"tokens": len(req.generated)})
         # slots that fed prefill rows but did not finish their input
-        # this step just advance n_cached
-        for req in self._slots:
-            if req is not None and req.pending is None:
-                req.n_cached = req.n_prefilled
-                if self.prefix is not None:
-                    self._insert_prefix(req)
+        # this step just advance n_cached — to the position recorded
+        # at build time (by now the planner may have pushed
+        # n_prefilled past what THIS step's rows actually wrote)
+        for req, p1 in plan.prefill_mid:
+            if req.slot is None or req.state != "running":
+                continue
+            req.n_cached = max(req.n_cached, p1)
+            if self.prefix is not None:
+                self._insert_prefix(req)
 
         if obs is not None:
+            dead = self.n_rows - plan.n_rows_used
             obs.steps.inc()
             obs.h_step.observe((now - t_step0) * 1e3)
             # row-mix counters increment by THIS step's amounts (never
@@ -1579,13 +2079,12 @@ class ServingEngine:
             # registry must aggregate, not clobber); gauges carry the
             # step's prefill-vs-decode mix (plan rows were all fed —
             # the phase-A assert guarantees page coverage)
-            n_pre_rows = sum(plan.values())
-            obs.decode_rows.inc(n_dec_rows)
-            obs.prefill_rows.inc(n_pre_rows)
-            obs.dead_rows.inc(T - r)
-            obs.g_step_decode.set(n_dec_rows)
-            obs.g_step_prefill.set(n_pre_rows)
-            obs.g_step_dead.set(T - r)
+            obs.decode_rows.inc(plan.n_dec_rows)
+            obs.prefill_rows.inc(plan.n_pre_rows)
+            obs.dead_rows.inc(dead)
+            obs.g_step_decode.set(plan.n_dec_rows)
+            obs.g_step_prefill.set(plan.n_pre_rows)
+            obs.g_step_dead.set(dead)
             obs.g_running.set(sum(r_ is not None
                                   for r_ in self._slots))
             obs.g_queued.set(len(self._queue))
@@ -1600,13 +2099,13 @@ class ServingEngine:
                 obs.sync_tier(self.tier, self.stats["swap_outs"],
                               self.stats["swap_ins"])
             if tracing:
-                for rid in decode_rids:
+                for rid in plan.decode_rids:
                     obs.trace.add_span(rid, "decode", t_step0, now)
                 for rid, k_eff, a in spec_spans:
                     obs.trace.add_span(rid, "spec_verify", t_step0,
                                        now, args={"drafted": k_eff,
                                                   "accepted": a})
-                for rid, p0, p1 in prefill_spans:
+                for rid, p0, p1 in plan.prefill_spans:
                     obs.trace.add_span(rid, "prefill[%d:%d)"
                                        % (p0, p1), t_step0, now,
                                        args={"rows": p1 - p0})
@@ -1660,8 +2159,9 @@ class ServingEngine:
             self._obs._prefix_seen = [0, 0, 0, 0, 0, 0]
         if self.tier is not None:
             self.tier.reset_telemetry()
-            self.stats["swap_outs"] = 0
-            self.stats["swap_ins"] = 0
+            with self._mu:
+                self.stats["swap_outs"] = 0
+                self.stats["swap_ins"] = 0
             self._obs._tier_seen = [0, 0, 0, 0]
             self._obs._swap_seen = [0, 0]
         self._obs._warm_seen = [0]

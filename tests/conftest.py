@@ -94,6 +94,11 @@ _SLOW_GROUPS = {
     # on the wall clock; own group so socket/scheduling jitter never
     # squeezes f/k)
     "test_http_frontend": "n",
+    # group o: ~2min — round-21 latency-hiding overlap (every
+    # scenario compiles the tok_src step variant on top of the
+    # serial program, and the disagg case spawns worker processes;
+    # own group so the double compile bill never squeezes d/f/j)
+    "test_serving_overlap": "o",
 }
 
 

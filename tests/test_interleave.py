@@ -1,9 +1,11 @@
 """Deterministic interleaving explorer over the serving cluster
 (ISSUE 7, dynamic half).  Slow tier, group h.
 
-The sweep runs >= 200 seeded schedules (6 scripted workloads x 2
-strategies x 20 seeds = 240; round 18 added the tier workload — spill
-racing match racing preemption) through
+The sweep runs >= 200 seeded schedules (7 scripted workloads x 2
+strategies x 20 seeds = 280; round 18 added the tier workload — spill
+racing match racing preemption; round 21 added the overlap workload —
+planner thread racing steps, submits, and a mid-pipeline cancel)
+through
 ``tools.analysis.interleave``: every
 schedule serializes the cluster's threads onto one runnable-at-a-time
 order chosen by the seed, and asserts the same invariants the static
@@ -27,7 +29,7 @@ import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
 
 from tools.analysis.interleave import DeadlockError, run_schedule
 
-SEEDS = 20          # per (workload, strategy) cell; 6 * 2 * 20 = 240
+SEEDS = 20          # per (workload, strategy) cell; 7 * 2 * 20 = 280
 MODES = ("random", "preempt")
 
 
@@ -57,6 +59,16 @@ def env():
     rid = cl.submit(np.arange(1, 7, dtype=np.int32), 4)
     cl.result(rid, timeout=300)
     cl.close(timeout=60)
+    # the overlap (tok_src) step program is a DIFFERENT compiled
+    # variant — warm it too, same engine geometry as the workloads
+    # (wl_overlap_plan must never compile under the scheduler)
+    from mxnet_tpu.serving import ServingEngine
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        prefill_chunk=6, prefix_cache=True,
+                        overlap=True)
+    eng.submit(np.arange(1, 7, dtype=np.int32), 4)
+    eng.run()
+    eng.close()
 
     refs = {}
 
@@ -277,6 +289,63 @@ def wl_tier_spill(params, cfg, ref):
         cl.close(timeout=60)
 
 
+def wl_overlap_plan(params, cfg, ref):
+    """Round 21: the overlap pipeline's planner thread racing steps,
+    submits, and cancels.  One overlap=True replica — every step's
+    plan is built by the planner under the engine lock while the
+    previous step executes — with a submit burst arriving through a
+    second thread and a cancel landing at whatever pipeline depth the
+    schedule picks.  Every completed request must be exact (the
+    carried-token reconciliation may never leak a speculatively
+    dispatched token into a commit), the cancelled request must
+    retire without leaking its pages, and the drain must leave zero
+    refs — under EVERY schedule."""
+    from mxnet_tpu.serving import ServingCluster
+    from mxnet_tpu.serving import cluster as cluster_mod
+    wl = _prompts_mixed(5)
+    cl = ServingCluster(params, cfg, replicas=1, num_slots=2,
+                        page_size=4, prefill_chunk=6, overlap=True)
+    try:
+        assert cl.replicas[0].engine.overlap
+        first = [cl.submit(p, n) for p, n in wl[:2]]
+        rids = []
+
+        def submitter():
+            for p, n in wl[2:]:
+                rids.append(cl.submit(p, n))
+            # cancel the second request at whatever point this
+            # schedule has the pipeline: queued, planned, dispatched
+            # speculatively, or already done — all must be clean
+            cl.cancel(first[1])
+
+        th = cluster_mod.threading.Thread(target=submitter,
+                                          name="overlap-submitter")
+        th.start()
+        np.testing.assert_array_equal(
+            cl.result(first[0], timeout=300), ref(*wl[0]))
+        th.join(300)
+        for rid, (p, n) in zip(rids, wl[2:]):
+            np.testing.assert_array_equal(cl.result(rid, timeout=300),
+                                          ref(p, n))
+        cr = cl.requests[first[1]]
+        if cr.state == "done":            # finish beat the cancel
+            np.testing.assert_array_equal(
+                cl.result(first[1], timeout=300), ref(*wl[1]))
+        else:
+            assert cr.state == "cancelled"
+            # whatever the pipeline committed before the cancel must
+            # prefix the oracle (a bogus carried token would show up
+            # exactly here)
+            exp = ref(*wl[1])[wl[1][0].size:]
+            got = list(cr.committed)
+            assert got == list(exp[:len(got)])
+        eng = cl.replicas[0].engine
+        assert eng.stats["overlap_steps"] > 0
+        _check_refcounts(cl)
+    finally:
+        cl.close(timeout=60)
+
+
 WORKLOADS = {
     "burst": wl_submit_burst,
     "failover": wl_failover,
@@ -284,6 +353,7 @@ WORKLOADS = {
     "ttl": wl_ttl_expiry,
     "cow": wl_prefix_cow,
     "tier": wl_tier_spill,
+    "overlap": wl_overlap_plan,
 }
 
 

@@ -1,0 +1,328 @@
+"""Latency-hiding overlap (round 21): the double-buffered engine
+pipeline with device-carried sampling must be BIT-IDENTICAL to the
+serial schedule — and to ``models/gpt.py generate`` — under every
+stop condition that can invalidate a speculatively dispatched step.
+
+Exactness pins:
+
+* overlap ON vs OFF, mixed prompt/output lengths, through eos stops,
+  mid-pipeline preemption, and a cancel racing the planner thread —
+  identical states and tokens for every non-cancelled request, zero
+  leaked pages/refs either way;
+* a cancelled request's committed tokens may legitimately differ by
+  pipeline depth (the cancel lands one step earlier or later), but
+  the shorter transcript must prefix the longer — a wrong carried
+  token would break the prefix, not just the length;
+* ``spec_K > 0`` engines fence the pipeline (carried argmaxes can't
+  feed the draft matcher, which needs host tokens) and must degrade
+  to exact serial behaviour;
+* both cluster flavors (replicated ``ServingCluster`` and the
+  process-split ``DisaggServingCluster``) stay generate-identical
+  with ``overlap=True`` threaded through their engine kwargs.
+
+Slow tier, group o (own group: every scenario pays a second compiled
+step variant — the ``tok_src`` program — on top of the serial one).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest device setup)
+
+
+def _cfg(**kw):
+    from mxnet_tpu.models import gpt
+    base = dict(use_flash=False, remat=False, dropout=0.0,
+                dtype="float32", vocab_size=97, max_len=96)
+    base.update(kw)
+    return gpt.gpt_tiny(**base)
+
+
+def _setup(seed=0):
+    import jax
+    from mxnet_tpu.models import transformer as T
+    cfg = _cfg()
+    params = T.init_params(jax.random.PRNGKey(seed), cfg)
+    return params, cfg
+
+
+def _ref(params, cfg, prompt, n):
+    import jax.numpy as jnp
+    from mxnet_tpu.models import gpt
+    return np.asarray(
+        gpt.generate(params, cfg, jnp.asarray(prompt)[None], n))[0]
+
+
+def _mixed(rng, vocab, lens=(3, 11, 7, 19, 5, 13)):
+    return [rng.randint(1, vocab, size=n).astype(np.int32)
+            for n in lens]
+
+
+def _drain_engine(eng, chaos=None, cancel_rid=None):
+    """Step to completion, optionally injecting chaos at a fixed step
+    index (same index whichever schedule the pipeline runs, so serial
+    and overlapped runs face the same script)."""
+    steps = 0
+    while True:
+        if eng.step() is False:
+            break
+        steps += 1
+        if chaos == "preempt" and steps == 3:
+            running = [r for r in eng._slots if r is not None]
+            if running:
+                eng.preempt(running[-1].rid)
+        if chaos == "cancel" and steps == 4 and cancel_rid is not None:
+            eng.cancel(cancel_rid)
+    return steps
+
+
+def _engine_run(params, cfg, overlap, eos=None, chaos=None,
+                spec_K=0):
+    from mxnet_tpu.serving import ServingEngine
+    rng = np.random.RandomState(7)
+    prompts = _mixed(rng, cfg.vocab_size)
+    maxnew = [9, 4, 1, 7, 12, 6]
+    eng = ServingEngine(params, cfg, num_slots=3, page_size=8,
+                        prefill_chunk=6, prefix_cache=True,
+                        spec_K=spec_K, overlap=overlap)
+    rids = [eng.submit(p, m, eos_id=eos)
+            for p, m in zip(prompts, maxnew)]
+    _drain_engine(eng, chaos=chaos, cancel_rid=rids[1])
+    res = {rid: (req.state, list(req.generated))
+           for rid, req in eng.requests.items()}
+    if eng.prefix is not None:
+        eng.prefix.evict(10 ** 9)
+    held = eng.cache.pages_in_use
+    stats = dict(eng.stats)
+    eng.close()
+    return res, held, stats
+
+
+def _assert_equiv(a, b, name):
+    """Serial run ``a`` vs overlapped run ``b``: same states
+    everywhere; exact tokens except for cancelled requests, whose
+    transcripts must be prefix-consistent (pipeline-depth slack)."""
+    assert set(a) == set(b)
+    for rid in a:
+        sa, ga = a[rid]
+        sb, gb = b[rid]
+        assert sa == sb, (name, rid, a, b)
+        if sa == "cancelled":
+            n = min(len(ga), len(gb))
+            assert ga[:n] == gb[:n], (name, rid, ga, gb)
+        else:
+            assert ga == gb, (name, rid, ga, gb)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["plain", "eos", "preempt",
+                                      "cancel"])
+def test_overlap_bit_identical_to_serial(scenario):
+    """The core pin: overlapped engine vs serial engine on the same
+    mixed-length burst, with the speculatively dispatched step
+    invalidated by eos stops, a mid-pipeline preemption, or a cancel
+    racing the planner — identical outcomes, zero leaks, and the
+    overlapped run actually pipelined (overlap_steps > 0) while
+    hiding host time (host_hidden_ms > 0)."""
+    params, cfg = _setup()
+    kw = {"plain": {}, "eos": {"eos": 5},
+          "preempt": {"chaos": "preempt"},
+          "cancel": {"chaos": "cancel"}}[scenario]
+    a, held_a, _ = _engine_run(params, cfg, overlap=False, **kw)
+    b, held_b, st = _engine_run(params, cfg, overlap=True, **kw)
+    _assert_equiv(a, b, scenario)
+    assert held_a == 0 and held_b == 0, (scenario, held_a, held_b)
+    assert st["overlap_steps"] > 0
+    assert st["host_hidden_ms"] > 0.0
+
+
+@pytest.mark.slow
+def test_overlap_matches_generate():
+    """Single-request overlapped decode is token-identical to plain
+    ``generate`` (the carried argmax is the same argmax the host
+    would have fed back)."""
+    from mxnet_tpu.serving import ServingEngine
+    params, cfg = _setup()
+    rng = np.random.RandomState(11)
+    for p, m in zip(_mixed(rng, cfg.vocab_size, (3, 11, 7)),
+                    (8, 5, 6)):
+        ref = _ref(params, cfg, p, m)
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=8,
+                            prefill_chunk=8, overlap=True)
+        rid = eng.submit(p, m)
+        out = eng.run()[rid]
+        eng.close()
+        assert np.array_equal(ref[:out.size], out), (ref, out)
+
+
+@pytest.mark.slow
+def test_overlap_spec_engine_fences_to_serial():
+    """spec_K > 0: the draft matcher needs host-visible tokens, so
+    every decode step with live samplers fences the pipeline — the
+    overlapped engine must produce bit-identical output to the serial
+    one, and the fence counter must prove the fencing actually
+    happened (not that overlap silently disabled itself)."""
+    from mxnet_tpu.serving import ServingEngine
+    params, cfg = _setup()
+    rng = np.random.RandomState(7)
+    prompts = _mixed(rng, cfg.vocab_size)
+    maxnew = [9, 4, 1, 7, 12, 6]
+
+    def run(overlap):
+        eng = ServingEngine(params, cfg, num_slots=3, page_size=8,
+                            prefill_chunk=6, spec_K=2,
+                            overlap=overlap)
+        for p, m in zip(prompts, maxnew):
+            eng.submit(p, m)
+        out = {k: v.tolist() for k, v in eng.run().items()}
+        st = dict(eng.stats)
+        eng.close()
+        return out, st
+
+    sa, _ = run(False)
+    sb, st = run(True)
+    assert sa == sb
+    assert st["overlap_fences"] > 0
+
+
+@pytest.mark.slow
+def test_overlap_eos_invalidates_speculative_step_no_leak():
+    """An eos stop commits one step BEHIND an already-dispatched
+    speculative step: the junk row the dead slot computed must never
+    be committed, the slot's pages must come back, and a follow-up
+    request reusing the slot must still be exact."""
+    from mxnet_tpu.serving import ServingEngine
+    params, cfg = _setup()
+    rng = np.random.RandomState(3)
+    p = rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+    full = _ref(params, cfg, p, 12)[p.size:]
+    eos = int(full[2])                     # stop after 3 tokens
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=8,
+                        prefill_chunk=8, overlap=True)
+    rid = eng.submit(p, 12, eos_id=eos)
+    eng.run()
+    got = list(eng.requests[rid].generated)
+    assert got == [int(t) for t in full[:3]]
+    assert eng.cache.pages_in_use == 0
+    # slot reuse after the invalidated step: fresh request, exact
+    q = rng.randint(1, cfg.vocab_size, 9).astype(np.int32)
+    rid2 = eng.submit(q, 5)
+    out = eng.run()[rid2]
+    assert np.array_equal(out, _ref(params, cfg, q, 5))
+    eng.close()
+
+
+@pytest.mark.slow
+def test_cluster_overlap_identity_and_cancel_race():
+    """Replicated cluster with overlap=True: mixed-length burst is
+    generate-identical, a cancel fired from another thread mid-flight
+    retires cleanly, and the drain leaves zero refs/pages on every
+    replica."""
+    import threading
+    from mxnet_tpu.serving import ServingCluster
+    params, cfg = _setup()
+    rng = np.random.RandomState(5)
+    prompts = _mixed(rng, cfg.vocab_size)
+    maxnew = [6, 4, 8, 5, 7, 3]
+    cl = ServingCluster(params, cfg, replicas=2, num_slots=2,
+                        page_size=8, prefill_chunk=6, overlap=True)
+    try:
+        rids = [cl.submit(p, n) for p, n in zip(prompts, maxnew)]
+        victim = rids[2]
+        th = threading.Thread(target=lambda: cl.cancel(victim))
+        th.start()
+        for rid, p, n in zip(rids, prompts, maxnew):
+            if rid == victim:
+                continue
+            out = cl.result(rid, timeout=300)
+            assert np.array_equal(out, _ref(params, cfg, p, n))
+        th.join(60)
+        cr = cl.requests[victim]
+        assert cr.state in ("done", "cancelled")
+        if cr.state == "done":
+            assert np.array_equal(cl.result(victim, timeout=60),
+                                  _ref(params, cfg, prompts[2],
+                                       maxnew[2]))
+        else:
+            exp = _ref(params, cfg, prompts[2],
+                       maxnew[2])[prompts[2].size:]
+            got = list(cr.committed)
+            assert got == [int(t) for t in exp[:len(got)]]
+        for rep in cl.replicas:
+            eng = rep.engine
+            assert eng.overlap
+            assert eng.stats["overlap_steps"] > 0
+            if eng.prefix is not None:
+                assert eng.prefix.refs_total == 0
+                assert eng.cache.pages_in_use == \
+                    eng.prefix.cached_pages
+    finally:
+        cl.close(timeout=60)
+
+
+@pytest.mark.slow
+def test_disagg_cluster_overlap_identity():
+    """Process-split cluster (1 prefill + 1 decode OS process) with
+    overlap=True threaded through the worker engine kwargs: outputs
+    stay generate-identical, the decode worker actually pipelines
+    (overlap_steps > 0 in its stats snapshot), and no worker leaks
+    pages, refs, or staged streams."""
+    from mxnet_tpu.serving import DisaggServingCluster
+    params, cfg = _setup()
+    rng = np.random.RandomState(9)
+    prompts = _mixed(rng, cfg.vocab_size, (5, 9, 17, 3, 12))
+    nnew = [6, 4, 8, 5, 7]
+    cl = DisaggServingCluster(params, cfg, prefill=1, decode=1,
+                              num_slots=4, page_size=4,
+                              metrics=True, watchdog_s=60.0,
+                              overlap=True)
+    try:
+        rids = [cl.submit(p, n) for p, n in zip(prompts, nnew)]
+        for rid, p, n in zip(rids, prompts, nnew):
+            out = cl.result(rid, timeout=180)
+            assert np.array_equal(out, _ref(params, cfg, p, n))
+        st = cl.cluster_stats()
+        assert st["decode0"]["overlap_steps"] > 0
+        for name, ws in st.items():
+            assert ws["pages_in_use"] - ws["prefix_cached_pages"] \
+                == 0, (name, ws)
+            assert ws["prefix_refs"] == 0, (name, ws)
+            assert ws["staged_rids"] == 0, (name, ws)
+            assert ws["active_requests"] == 0, (name, ws)
+    finally:
+        cl.close()
+
+
+def test_overlap_env_var_and_validation():
+    """Fast tier: ``MXNET_SERVE_OVERLAP`` resolves the default, the
+    explicit kwarg wins over the env, and close() is idempotent —
+    all without compiling anything (no steps run)."""
+    import os
+    from mxnet_tpu.serving import ServingEngine
+    params, cfg = _setup()
+
+    def make(**kw):
+        return ServingEngine(params, cfg, num_slots=2, page_size=8,
+                             prefill_chunk=8, **kw)
+
+    old = os.environ.get("MXNET_SERVE_OVERLAP")
+    try:
+        os.environ["MXNET_SERVE_OVERLAP"] = "1"
+        eng = make()
+        assert eng.overlap
+        eng.close()
+        eng = make(overlap=False)
+        assert not eng.overlap
+        eng.close()
+        os.environ["MXNET_SERVE_OVERLAP"] = "0"
+        eng = make()
+        assert not eng.overlap
+        eng.close()
+        eng = make(overlap=True)
+        assert eng.overlap
+        eng.close()
+        eng.close()                        # idempotent
+    finally:
+        if old is None:
+            os.environ.pop("MXNET_SERVE_OVERLAP", None)
+        else:
+            os.environ["MXNET_SERVE_OVERLAP"] = old

@@ -136,6 +136,27 @@ def test_fsdp_requires_live_dp_axis():
         fsdp_param_shardings(cfg, make_mesh({"tp": 8}))
 
 
+def test_bucket_overlap_validation():
+    """Round 21: bucket_overlap is fenced to the configs where the
+    homogeneous layer scan is sound — requires fsdp, refuses bogus
+    values, MoE stacks, and seq-parallel configs."""
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.parallel import make_mesh
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(mx.MXNetError, match="must be False, True"):
+        T.make_train_step(cfg, mesh=mesh, fsdp=True,
+                          bucket_overlap="yes")
+    with pytest.raises(mx.MXNetError, match="requires fsdp=True"):
+        T.make_train_step(cfg, mesh=mesh, bucket_overlap=True)
+    with pytest.raises(mx.MXNetError, match="homogeneous"):
+        T.make_train_step(_tiny_cfg(n_experts=2), mesh=mesh,
+                          fsdp=True, bucket_overlap=True)
+    with pytest.raises(mx.MXNetError, match="homogeneous"):
+        T.make_train_step(_tiny_cfg(seq_parallel=True), mesh=mesh,
+                          fsdp=True, bucket_overlap=True)
+
+
 def test_optimizer_state_zeros_matches_weight_sharding():
     """optimizer.state_zeros: a mesh-sharded weight gets its moments
     allocated directly INTO the same sharding (no init-then-reshard
@@ -262,6 +283,55 @@ def test_fsdp_trains_like_unsharded():
     np.testing.assert_allclose(fsdp_losses, ref_losses, rtol=2e-3,
                                atol=2e-3)
     assert fsdp_losses[-1] < fsdp_losses[0]
+
+
+@pytest.mark.slow
+def test_bucket_overlap_bitwise_vs_fused_and_tracks_legacy():
+    """Round 21 HARD GATE: the layer-bucketed reduce-scatter step
+    (``bucket_overlap=True``) must be BITWISE identical — losses and
+    every updated weight — to its ``"fused"`` comparator (the same
+    scan graph with the grad constraint deferred to one post-backward
+    sync).  Identical graphs up to collective PLACEMENT is the whole
+    claim: overlap moves the reduce-scatters, it may not change a
+    single bit.  Against the round-20 autodiff path the scan backward
+    is a different (valid) graph, so that comparison is tolerance-
+    based, and training must still descend."""
+    import jax
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.parallel import make_mesh
+    cfg = _tiny_cfg()
+    batch = _mlm_batch(cfg)
+    mesh = make_mesh({"dp": 8})
+
+    def run(bucket_overlap):
+        init_state, step = T.make_train_step(cfg, mesh=mesh,
+                                             fsdp=True,
+                                             learning_rate=1e-3,
+                                             bucket_overlap=
+                                             bucket_overlap)
+        state = init_state(jax.random.PRNGKey(0))
+        losses = []
+        for i in range(4):
+            state, loss = step(state, batch,
+                               jax.random.fold_in(
+                                   jax.random.PRNGKey(1), i))
+            losses.append(float(loss))
+        return losses, jax.device_get(state[0])
+
+    bk_losses, bk_params = run(True)
+    fu_losses, fu_params = run("fused")
+    assert bk_losses == fu_losses, (bk_losses, fu_losses)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(bk_params)
+    flat_f = jax.tree_util.tree_leaves(fu_params)
+    for (path, leaf_b), leaf_f in zip(flat_b, flat_f):
+        assert np.array_equal(np.asarray(leaf_b),
+                              np.asarray(leaf_f)), \
+            "bucketed != fused at %s" % jax.tree_util.keystr(path)
+
+    legacy_losses, _ = run(False)
+    np.testing.assert_allclose(bk_losses, legacy_losses, rtol=2e-3,
+                               atol=2e-3)
+    assert bk_losses[-1] < bk_losses[0], bk_losses
 
 
 @pytest.mark.slow

@@ -256,6 +256,29 @@ def build_serving_step_pallas():
     return _build_serving_step("pallas")
 
 
+def build_serving_step_overlap():
+    """The latency-hiding step variant (round 21): the SAME live
+    ``_make_step`` builder with ``overlap=True`` — two extra inputs
+    (the previous step's device-resident ``(S, n_sample)`` argmax
+    matrix and the per-row ``tok_src`` selector) and one gather +
+    ``where`` at the top of the graph.  Donation of the pools must
+    survive the wrapper (the overlap engine runs EVERY step through
+    this program, fenced steps included), and its peak is gated
+    against its own manifest row — the selector must cost rows, not
+    a second resident pool."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.engine import _make_step
+    cfg = _gpt_cfg()
+    pps, n_rows, _ = _serve_geometry(cfg)
+    fn = _make_step(cfg, _SLOTS, n_rows, pps, _PAGE, True,
+                    kernel="xla", n_sample=1 + _SPEC_K, overlap=True)
+    args = _serving_step_args(cfg) + (
+        jax.ShapeDtypeStruct((_SLOTS, 1 + _SPEC_K), jnp.int32),
+        jax.ShapeDtypeStruct((n_rows,), jnp.int32))
+    return fn, args
+
+
 def _registry_mesh():
     """The tp mesh the sharded registry entry traces over — the same
     virtual CPU mesh the tier-1 conftest and the MULTICHIP dry-runs
@@ -451,6 +474,29 @@ def build_bert_train_step_fsdp_bf16():
     return _build_bert_train_fsdp("bfloat16")
 
 
+def build_bert_train_step_fsdp_bucketed():
+    """The bucketed-overlap FSDP step (round 21): the live
+    ``make_train_step(fsdp=True, bucket_overlap=True)`` — backward
+    runs as a manual ``lax.scan`` over layers with each layer's
+    reduce-scatter carried INSIDE the scan body, so the collective
+    overlaps the next layer's grad math instead of fusing into one
+    tail allreduce.  Donation of (params, opt_state) must survive the
+    scan-carried lowering, and its peak is gated against its own
+    manifest row — the scan carry must not duplicate the grad
+    accumulator."""
+    import jax
+    import optax
+    from mxnet_tpu.models import transformer as T
+    cfg = _bert_fsdp_cfg("float32")
+    _, step = T.make_train_step(cfg, mesh=_train_mesh(), fsdp=True,
+                                bucket_overlap=True)
+    params = jax.eval_shape(
+        lambda: T.init_params(jax.random.PRNGKey(0), cfg))
+    opt = jax.eval_shape(optax.adamw(1e-4).init, params)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    return step, ((params, opt), _train_batch(True), key)
+
+
 def build_transformer_train_step():
     import jax
     from mxnet_tpu.models import transformer as T
@@ -509,6 +555,11 @@ def live_programs() -> List[ProgramSpec]:
              dtype_region="int8", f32_allow=acc),
         spec("serving_step_pallas", build_serving_step_pallas,
              donate=(1,), dtype_region="int8", f32_allow=acc),
+        # round 21: the overlap (tok_src) step variant — every step
+        # of an overlap engine runs through it, so its donation and
+        # budget are gated exactly like the serial program's
+        spec("serving_step_overlap", build_serving_step_overlap,
+             donate=(1,), dtype_region="int8", f32_allow=acc),
         spec("serving_step_tp", build_serving_step_tp, donate=(1,),
              dtype_region="int8", f32_allow=acc),
         spec("cow_page_copy", build_cow_page_copy, donate=(0,),
@@ -539,6 +590,12 @@ def live_programs() -> List[ProgramSpec]:
                             "mxnet_tpu/parallel/mesh.py")),
         spec("bert_train_step_fsdp_bf16",
              build_bert_train_step_fsdp_bf16, donate=(0,),
+             extra_closure=("mxnet_tpu/parallel/fsdp.py",
+                            "mxnet_tpu/parallel/mesh.py")),
+        # round 21: the layer-bucketed reduce-scatter-overlap step —
+        # scan-carried collectives; donation gated like the fused one
+        spec("bert_train_step_fsdp_bucketed",
+             build_bert_train_step_fsdp_bucketed, donate=(0,),
              extra_closure=("mxnet_tpu/parallel/fsdp.py",
                             "mxnet_tpu/parallel/mesh.py")),
     ]

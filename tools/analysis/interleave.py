@@ -27,10 +27,12 @@ explores MANY interleavings:
   detectable by ``tests/test_interleave.py``'s seeded-deadlock toy).
 
 Injection is scoped, not global: :func:`patch` swaps the ``threading``
-and ``time`` module objects *of* ``mxnet_tpu.serving.cluster`` for
-scheduler-aware shims, so jax / engine / numpy internals keep their
-real primitives (the engine is single-threaded per replica by design —
-its interleavings are not the subject).
+and ``time`` module objects *of* ``mxnet_tpu.serving.cluster`` AND
+``mxnet_tpu.serving.engine`` for scheduler-aware shims, so jax /
+numpy internals keep their real primitives.  (The engine joined the
+sweep in round 21: its overlap mode runs a planner thread against
+the engine lock, so planner-vs-step-vs-cancel interleavings are now
+part of the subject — ``wl_overlap_plan``.)
 
 Strategies
 ----------
@@ -531,22 +533,26 @@ class _TimeShim:
 
 
 class patch:
-    """Context manager: swap ``mxnet_tpu.serving.cluster``'s module
-    references to ``threading`` / ``time`` for scheduler shims."""
+    """Context manager: swap ``mxnet_tpu.serving.cluster``'s and
+    ``mxnet_tpu.serving.engine``'s module references to ``threading``
+    / ``time`` for scheduler shims (the engine's overlap planner
+    thread is under sweep since round 21)."""
 
     def __init__(self, sched: Scheduler):
         self.sched = sched
 
     def __enter__(self):
-        from mxnet_tpu.serving import cluster as mod
-        self._mod = mod
-        self._saved = (mod.threading, mod.time)
-        mod.threading = _ThreadingShim(self.sched)
-        mod.time = _TimeShim(self.sched)
+        from mxnet_tpu.serving import cluster, engine
+        self._mods = (cluster, engine)
+        self._saved = [(m.threading, m.time) for m in self._mods]
+        shims = (_ThreadingShim(self.sched), _TimeShim(self.sched))
+        for m in self._mods:
+            m.threading, m.time = shims
         return self.sched
 
     def __exit__(self, *a):
-        self._mod.threading, self._mod.time = self._saved
+        for m, (th, tm) in zip(self._mods, self._saved):
+            m.threading, m.time = th, tm
         return False
 
 

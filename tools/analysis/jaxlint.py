@@ -10,11 +10,16 @@ Rules
     one.  Taint is a simple intra-region dataflow: results of calls to
     compiled-step callables (terminal name matching ``*step_fn``, a
     name bound from ``jax.jit(...)``, or a function defined under
-    ``@jax.jit``) are tainted; taint propagates through subscripts,
-    attributes, arithmetic, and tuple unpacking; a flagged
-    materialization (e.g. ``x = np.asarray(x)``) clears it — the sync
-    happened there, downstream host math is free.  ``jnp.asarray``
-    (host→device) is deliberately NOT a sync.
+    ``@jax.jit``) are tainted; round 21 adds two sources for the
+    overlap split — calls to ``*_dispatch`` (the dispatch helper
+    returns the step program's output un-materialized) and the
+    ``DEVICE_PARAMS`` registry (a hot-region function that RECEIVES a
+    step result as a parameter, like the overlap ``_drain``, declares
+    it there).  Taint propagates through subscripts, attributes,
+    arithmetic, and tuple unpacking; a flagged materialization (e.g.
+    ``x = np.asarray(x)``) clears it — the sync happened there,
+    downstream host math is free.  ``jnp.asarray`` (host→device) is
+    deliberately NOT a sync.
 
 ``retrace``  Retrace/recompile churn: (a) ``jax.jit(...)`` called
     inside a ``for``/``while`` body — the compile cache is keyed on
@@ -59,9 +64,15 @@ __all__ = ["HOT_REGIONS", "CLOCK_MODULES", "lint_source", "run"]
 HOT_REGIONS: List[Tuple[str, str]] = [
     # round 11: the speculation plan/draft path runs once per engine
     # step on the host — it must stay pure host work (no device syncs
-    # beyond step()'s one pragma'd token read-back)
+    # beyond step()'s one pragma'd token read-back).
+    # round 21: the overlap split — plan build (planner thread AND
+    # inline cold path), dispatch, deferred drain/commit, and the
+    # planner kick all run once per step; a stray sync in any of them
+    # un-hides exactly the host latency the pipeline exists to hide
     ("mxnet_tpu/serving/engine.py",
-     r"(?:.*\.)?(step|_plan_speculation)$"),
+     r"(?:.*\.)?(step|_step_serial|_step_overlap|_take_plan|_drain"
+     r"|_maybe_plan_ahead|_build_plan|_dispatch|_commit"
+     r"|_plan_speculation)$"),
     # round 10: the cluster router loop (per-replica worker + routing
     # + completion) and the prefix-cache match/insert/evict paths run
     # once per step / per admission — no host syncs may sneak in.
@@ -162,6 +173,19 @@ BENCH_MODULES: List[str] = [
 ]
 
 STEP_FN_RE = re.compile(r".*step_fn$")
+# round 21: the overlap split routes the raw step-program output
+# through ``_dispatch`` (it stages inputs and returns the jitted call's
+# result WITHOUT materializing) — in hot regions a call to it is a
+# device result exactly like a *step_fn call.  Kept separate from
+# STEP_FN_RE so the bench linter's jit-call heuristic is unchanged.
+DEVICE_OUT_RE = re.compile(r".*(?:step_fn|_dispatch)$")
+# hot-region functions that RECEIVE a step-program result as a
+# parameter (the overlap ``_drain`` gets step N's sampled tokens while
+# step N+1 executes): (repo-relative glob, qualname regex, params) —
+# the named parameters are seeded device-tainted before linting
+DEVICE_PARAMS: List[Tuple[str, str, Tuple[str, ...]]] = [
+    ("mxnet_tpu/serving/engine.py", r"(?:.*\.)?_drain$", ("tok",)),
+]
 _NP_ALIASES = {"np", "numpy", "onp"}
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _WRONG_CLOCKS = {("time", "time"), ("time", "monotonic"),
@@ -210,7 +234,7 @@ class _RegionLinter(ast.NodeVisitor):
             return node.id in self.tainted
         if isinstance(node, ast.Call):
             t = _terminal(node.func)
-            if t and (STEP_FN_RE.match(t) or t in self.jitted):
+            if t and (DEVICE_OUT_RE.match(t) or t in self.jitted):
                 return True
             return any(self._expr_tainted(a) for a in node.args)
         for child in ast.iter_child_nodes(node):
@@ -222,7 +246,7 @@ class _RegionLinter(ast.NodeVisitor):
         if not isinstance(node, ast.Call):
             return False
         t = _terminal(node.func)
-        return bool(t and (STEP_FN_RE.match(t) or t in self.jitted))
+        return bool(t and (DEVICE_OUT_RE.match(t) or t in self.jitted))
 
     # -- taint bookkeeping --------------------------------------------
     def visit_FunctionDef(self, node):
@@ -524,7 +548,12 @@ def lint_source(source: str, rel_path: str,
     if patterns:
         for qualname, fn in _qualname_functions(tree):
             if any(p.match(qualname) for p in patterns):
-                _RegionLinter(rel_path, findings).visit(fn)
+                linter = _RegionLinter(rel_path, findings)
+                for glob, rx, pnames in DEVICE_PARAMS:
+                    if fnmatch.fnmatch(rel_path, glob) and \
+                            re.match(rx, qualname):
+                        linter.tainted.update(pnames)
+                linter.visit(fn)
 
     if clock is None:
         clock = any(fnmatch.fnmatch(rel_path, g) for g in CLOCK_MODULES)

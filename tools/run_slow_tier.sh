@@ -5,7 +5,7 @@
 set -e
 cd "$(dirname "$0")/.."
 sh tools/run_static_analysis.sh --all
-for g in a b c d e f g h i j k l m n; do
+for g in a b c d e f g h i j k l m n o; do
     echo "== slow group $g =="
     python -m pytest tests/ -q -m "slow_$g" -p no:cacheprovider "$@"
 done
