@@ -317,6 +317,55 @@ def bench_gpt_serve_disagg_remote_hit():
     return serve_bench.run_gate_disagg("full")["ttft_remote_hit_ms"]
 
 
+def bench_gpt_serve_put_remote_hit():
+    """Zero-copy put-transport gate (round 22): the SAME remote-hit
+    TTFT measurement as ``gpt_serve_disagg_remote_hit_ttft_ms`` with
+    ``MXNET_SERVE_TRANSPORT=put`` forced, so the pair prices the
+    page-put lever from both sides — this number regressing while the
+    socket one holds means the segment write/mmap-install path got
+    expensive; both regressing means the disagg pipeline did.  The
+    run underneath is the full --transport-ablation reconciliation:
+    it hard-fails unless every streamed page byte rode a put segment
+    and every token matches the socket transport bitwise.  Direction
+    "lower": v <= hi.  Reproducibility enforced like the goodput
+    gate's: the row must carry seed + prompts sha or the gate
+    refuses."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    row = serve_bench.run_gate_put_transport("full")
+    if not row.get("prompts_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_serve_put_remote_hit_ttft_ms: result row carries "
+            "no seed/prompts sha — the measurement is not "
+            "reproducible; refusing to gate it (got keys %s)"
+            % sorted(row))
+    return row["ttft_remote_hit_ms"]
+
+
+def bench_gpt_serve_pallas_tp2_step():
+    """Mesh-lowered kernel gate (round 22): engine-internal step-time
+    p50 of the decode-heavy closed-loop pallas run at tp=2 — the
+    shard_map lowering where each device walks its heads slice of the
+    heads-sharded page pool.  Paired with ``gpt_serve_decode_step_ms``
+    (the tp=1 twin): this number regressing alone means the lowering
+    (replicated block-table prefetch, heads-slice walk, wo psum) got
+    expensive; both regressing means the kernel body did.  Needs >= 2
+    visible devices (RuntimeError otherwise).  Direction "lower":
+    v <= hi.  Only meaningful on chip — off-TPU the kernel interprets
+    and the virtual mesh shares one host.  Reproducibility enforced:
+    the row carries seed + workload sha."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    row = serve_bench.run_gate_pallas_tp_step("full", tp=2)
+    if not row.get("workload_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_serve_pallas_tp2_step_ms: result row carries no "
+            "seed/workload sha — the measurement is not "
+            "reproducible; refusing to gate it (got keys %s)"
+            % sorted(row))
+    return row["step_p50_ms"]
+
+
 def bench_gpt_serve_goodput():
     """Goodput SLO gate (round 16): percent of arrivals that COMPLETE
     within their per-request SLO (TTFT + worst inter-token gap
@@ -494,6 +543,10 @@ BENCHES = {
                                   "lower"),
     "gpt_serve_disagg_remote_hit_ttft_ms":
         (bench_gpt_serve_disagg_remote_hit, "lower"),
+    "gpt_serve_put_remote_hit_ttft_ms":
+        (bench_gpt_serve_put_remote_hit, "lower"),
+    "gpt_serve_pallas_tp2_step_ms":
+        (bench_gpt_serve_pallas_tp2_step, "lower"),
     "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
     "gpt_serve_tier_hit_ttft_ms": (bench_gpt_serve_tier_hit,
                                    "lower"),

@@ -61,7 +61,10 @@ and external measurements subtract cleanly.
   interpreter mode (correctness path, not a perf claim — the printout
   says so); the chip number is the ``gpt_serve_decode_step_ms``
   gate's to pin.  ``--kernel pallas`` additionally routes the
-  headline e2e engine runs through the kernel.
+  headline e2e engine runs through the kernel.  Round 22: combined
+  with ``--tp N`` the ablation runs BOTH kernels at tp=N on the
+  virtual mesh (the mesh-lowered shard_map kernel vs the sharded XLA
+  gather) — it rides the ``--tp`` invocation-topology rule below.
 * ``spec`` (round 11, ``--spec-sweep``) — in-engine speculative
   decode accept×K sweep on the mixed Poisson workload (spec_K =
   0/2/4, tok/s + accept rate + tokens/step per row); ``--spec-K N``
@@ -80,6 +83,18 @@ and external measurements subtract cleanly.
   sharded-collective overhead, not ICI — the per-device-bytes and
   identity columns are the claims; the chip prices the speed.
 
+* ``transport`` (round 22, ``--transport-ablation``) — the
+  disaggregated page transport pair: the SAME cross-process
+  remote-hit measurement as the ``disagg`` gate, once with the
+  zero-copy put transport forced (``MXNET_SERVE_TRANSPORT=put``) and
+  once with socket frames (``=socket``), reporting per-mode
+  remote-hit TTFT, pages/bytes streamed, pages/bytes put, and the
+  per-frame transfer latency — with a cross-mode token-identity
+  check and a counter reconciliation (the put run must move EVERY
+  streamed page through segments; the socket run must put none).
+  Runs ALONE (cross-process clusters own the host).  NOTE the CPU
+  measurement prices a same-host /dev/shm handoff, not ICI — the
+  chip-side number is ``gpt_serve_put_remote_hit_ttft_ms``'s to pin.
 * ``trace`` (round 16, ``--trace burst10x`` or a
   ``traffic_trace.py`` JSON file) — OPEN-LOOP replay of a seeded
   workload trace (diurnal ramp + 10× burst + heavy-tailed lengths)
@@ -279,7 +294,7 @@ def _bucket_width_at(v, bounds):
 def run_engine(params, cfg, p, workload, num_pages=None,
                page_size=None, closed_loop_k=None, metrics=False,
                cross_check=True, kernel="xla", spec_K=0,
-               spec_drafter="ngram", overlap=None):
+               spec_drafter="ngram", overlap=None, tp=1):
     """Open-loop (Poisson ``workload``) or closed-loop (``k`` always in
     flight, workload gives the request shapes) engine run.
 
@@ -304,7 +319,7 @@ def run_engine(params, cfg, p, workload, num_pages=None,
     eng = ServingEngine(params, cfg, metrics=bool(metrics),
                         kernel=kernel, spec_K=spec_K,
                         spec_drafter=spec_drafter, overlap=overlap,
-                        **geo)
+                        tp=tp, **geo)
     # pre-warm the step program outside the clock (and drop the
     # warmup's footprint from the reported stats/registry — the
     # compile time would otherwise own the TTFT tail)
@@ -870,6 +885,195 @@ def run_gate_disagg(preset="full"):
         cl.close()
     _disagg_gate_cache[preset] = out
     return out
+
+
+# ------------------------------------- round-22 page-put transport ---
+
+def run_transport_ablation(p, seed=0):
+    """The ``--transport-ablation`` pair: the run_gate_disagg
+    remote-hit measurement (2 prefill + 1 decode processes, 3
+    cold+remote prompt pairs) executed once per transport —
+    ``MXNET_SERVE_TRANSPORT=socket`` (raw frames) and ``=put``
+    (zero-copy /dev/shm segments) — on the SAME seeded prompts.
+
+    Per-mode rows report remote-hit/cold TTFT, pages/bytes streamed,
+    pages/bytes moved through put segments, and per-frame transfer
+    latency p50.  Three reconciliations hard-fail the section
+    (RuntimeError): the put run must move EVERY streamed page through
+    segments (pages_put == pages_streamed > 0), the socket run must
+    put NONE, and every request's tokens must be bit-identical across
+    the two modes.  NOTE on CPU both modes price a same-host handoff
+    (loopback socket vs shm mmap), not ICI — the chip-side number is
+    the ``gpt_serve_put_remote_hit_ttft_ms`` gate's to pin."""
+    import hashlib
+    from mxnet_tpu.serving import DisaggServingCluster
+    params, cfg = _model(p)
+    rng = np.random.RandomState(seed)
+    P = (max(p.prompt_lens) // p.page_size) * p.page_size
+    N = 4
+    prompts = [rng.randint(1, p.vocab, P).astype(np.int32)
+               for _ in range(3)]
+    sha = hashlib.sha256()
+    for pr in prompts:
+        sha.update(pr.tobytes())
+    geo = _engine_geometry(p, [(0.0, prompts[0], N)],
+                           section="transport")
+    prev = os.environ.get("MXNET_SERVE_TRANSPORT")
+    rows, outs = [], {}
+    try:
+        for mode in ("socket", "put"):
+            os.environ["MXNET_SERVE_TRANSPORT"] = mode
+            cl = DisaggServingCluster(params, cfg, prefill=2,
+                                      decode=1, metrics=True,
+                                      watchdog_s=60.0, **geo)
+            try:
+                cold, remote, toks = [], [], []
+                for pr in prompts:
+                    for leg in (cold, remote):
+                        rid = cl.submit(pr, N)
+                        toks.append(np.asarray(
+                            cl.result(rid, timeout=600)))
+                        cr = cl.requests[rid]
+                        leg.append(
+                            (cr.first_token_t - cr.submit_t) * 1e3)
+                st = cl.cluster_stats()
+            finally:
+                cl.close()
+            outs[mode] = toks
+            hits = sum(v.get("remote_hits", 0) for v in st.values())
+            pages = sum(v.get("pages_streamed", 0)
+                        for v in st.values())
+            put_pages = sum(v.get("pages_put", 0)
+                            for v in st.values())
+            xfer = [ms for v in st.values()
+                    for ms in v.get("transfer_ms", ())]
+            xfer_p50, _ = _lat_stats(xfer)
+            # bytes reconcile EXACTLY: bytes_streamed counts logical
+            # page bytes on the stream AND the fetch-reply path
+            # (identically on both transports), and put_bytes counts
+            # segment bytes for the same two frame kinds — so a put
+            # run that really moved every page frame through
+            # segments shows equality.  pages_streamed alone counts
+            # only the prefill→decode stream (fetch replies ride
+            # fetch_bytes), hence >= on the page counters.
+            bytes_streamed = int(sum(
+                v.get("bytes_streamed", 0) for v in st.values()))
+            put_bytes = int(sum(
+                v.get("put_bytes", 0) for v in st.values()))
+            if mode == "put" and not (
+                    put_pages >= pages > 0
+                    and put_bytes == bytes_streamed):
+                raise RuntimeError(
+                    "serve_bench --transport-ablation: the put run "
+                    "streamed %d page(s) / %d B but the put "
+                    "segments carried %d frame-page(s) / %d B — the "
+                    "zero-copy path did not cover every page frame "
+                    "(same-host eligibility broken?)"
+                    % (pages, bytes_streamed, put_pages, put_bytes))
+            if mode == "socket" and put_pages:
+                raise RuntimeError(
+                    "serve_bench --transport-ablation: the socket "
+                    "run put %d page(s) — MXNET_SERVE_TRANSPORT="
+                    "socket must kill the capability" % put_pages)
+            rows.append({
+                "section": "transport",
+                "config": "transport_%s" % mode,
+                "preset": p.name,
+                "transport": mode, "seed": seed,
+                "prompts_sha": sha.hexdigest()[:16],
+                "prompt_len": P, "remote_hits": hits,
+                "ttft_cold_ms": min(cold),
+                "ttft_remote_hit_ms": min(remote),
+                "pages_streamed": pages,
+                "page_bytes_streamed": bytes_streamed,
+                "pages_put": put_pages,
+                "put_bytes": put_bytes,
+                "transfer_p50_ms": xfer_p50})
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SERVE_TRANSPORT", None)
+        else:
+            os.environ["MXNET_SERVE_TRANSPORT"] = prev
+    mismatches = sum(not np.array_equal(a, b)
+                     for a, b in zip(outs["socket"], outs["put"]))
+    if mismatches:
+        raise RuntimeError(
+            "serve_bench --transport-ablation: %d/%d requests "
+            "diverge between the socket and put transports — the "
+            "bit-identity contract is broken"
+            % (mismatches, len(outs["socket"])))
+    for r in rows:
+        r["identity_checked"] = len(outs["socket"])
+        r["identity_mismatches"] = 0
+    return rows
+
+
+_put_gate_cache = {}
+
+
+def run_gate_put_transport(preset="full", seed=0):
+    """The ``gpt_serve_put_remote_hit_ttft_ms`` gate: remote-hit TTFT
+    (ms) of the run_gate_disagg measurement with the zero-copy put
+    transport FORCED — the one number that prices the
+    device-to-device page path end to end (segment write, handoff,
+    mmap install) against its socket twin
+    ``gpt_serve_disagg_remote_hit_ttft_ms``.  Direction "lower":
+    v <= hi.  Hard-fails unless every streamed page actually rode a
+    put segment and the tokens match the socket transport bitwise
+    (the full --transport-ablation reconciliation runs underneath).
+    The row carries seed + prompts sha for MULTICHIP provenance."""
+    key = (preset, seed)
+    if key in _put_gate_cache:
+        return _put_gate_cache[key]
+    rows = run_transport_ablation(PRESETS[preset], seed=seed)
+    row = next(r for r in rows if r["transport"] == "put")
+    _put_gate_cache[key] = row
+    return row
+
+
+_pallas_tp_gate_cache = {}
+
+
+def run_gate_pallas_tp_step(preset="full", tp=2, seed=0):
+    """The ``gpt_serve_pallas_tp2_step_ms`` gate: engine-internal
+    step-time p50 of the SAME closed-loop decode-heavy pallas run as
+    ``gpt_serve_decode_step_ms``, mesh-lowered at tp=2 (each device
+    walks its heads slice of the heads-sharded pool through the
+    shard_map kernel) — the pair pins the tp lowering from both
+    sides: this number regressing while the tp=1 one holds means the
+    shard_map walk / replicated-table prefetch got expensive; both
+    regressing means the kernel did.  Best-of-3, seed + workload sha
+    carried.  Needs >= tp visible devices (RuntimeError otherwise —
+    off-chip the tests' 8-device virtual mesh provides them).
+    Direction "lower": v <= hi.  Only meaningful on chip — off-TPU
+    the kernel interprets and the mesh shares one host."""
+    import hashlib
+    import jax
+    key = (preset, tp, seed)
+    if key in _pallas_tp_gate_cache:
+        return _pallas_tp_gate_cache[key]
+    if tp > len(jax.devices()):
+        raise RuntimeError(
+            "run_gate_pallas_tp_step: tp=%d but only %d device(s) "
+            "visible — the gate needs the tp-way mesh" %
+            (tp, len(jax.devices())))
+    p = PRESETS[preset]
+    params, cfg = _model(p)
+    wl = _decode_heavy_workload(p, seed=seed)
+    sha = hashlib.sha256()
+    for _, prompt, n in wl:
+        sha.update(prompt.tobytes())
+        sha.update(np.int64(n).tobytes())
+    best = min(
+        (run_engine(params, cfg, p, wl, closed_loop_k=p.num_slots,
+                    metrics=True, cross_check=False, kernel="pallas",
+                    tp=tp)
+         for _ in range(3)),
+        key=lambda r: r["step_p50_ms"])
+    row = {"step_p50_ms": best["step_p50_ms"], "tp": tp,
+           "seed": seed, "workload_sha": sha.hexdigest()[:16]}
+    _pallas_tp_gate_cache[key] = row
+    return row
 
 
 # ---------------------------------------------- round-18 KV tiering ---
@@ -1588,7 +1792,7 @@ def _decode_heavy_workload(p, n=None, seed=0):
             for _ in range(n)]
 
 
-def run_kernel_ablation(params, cfg, p, spec_K=0):
+def run_kernel_ablation(params, cfg, p, spec_K=0, tp=1, seed=0):
     """The kernel-vs-XLA decode-step-time comparison: one closed-loop
     decode-heavy run per kernel (k = num_slots, metrics on, external
     cross-check off), step time from the engine's own
@@ -1596,14 +1800,30 @@ def run_kernel_ablation(params, cfg, p, spec_K=0):
     runs in INTERPRETER mode — correct, but the step time measures
     the interpreter, not the fusion (docs/perf.md 'Paged attention
     kernel'); the chip-side number is the ``gpt_serve_decode_step_ms``
-    gate's to pin."""
-    wl = _decode_heavy_workload(p)
+    gate's to pin.
+
+    Round 22, ``tp>1``: both kernels run mesh-lowered on the tp-way
+    mesh (pallas through the shard_map heads-slice walk) — same
+    workload, same closed loop, so the cell pair prices the lowering
+    against the sharded XLA gather.  Rows carry seed + workload sha
+    (MULTICHIP provenance) and the chip-side pin is
+    ``gpt_serve_pallas_tp2_step_ms``'s."""
+    import hashlib
+    wl = _decode_heavy_workload(p, seed=seed)
+    sha = hashlib.sha256()
+    for _, prompt, n in wl:
+        sha.update(prompt.tobytes())
+        sha.update(np.int64(n).tobytes())
     rows = []
     for kern in ("xla", "pallas"):
         r = run_engine(params, cfg, p, wl,
                        closed_loop_k=p.num_slots, metrics=True,
-                       cross_check=False, kernel=kern, spec_K=spec_K)
-        r.update(section="kernel", config="kernel_%s" % kern)
+                       cross_check=False, kernel=kern, spec_K=spec_K,
+                       tp=tp)
+        r.update(section="kernel", preset=p.name, tp=tp, seed=seed,
+                 workload_sha=sha.hexdigest()[:16],
+                 config="kernel_%s" % kern if tp == 1
+                 else "kernel_%s_tp%d" % (kern, tp))
         rows.append(r)
     return rows
 
@@ -1874,7 +2094,16 @@ def main(argv=None):
     ap.add_argument("--kernel-ablation", action="store_true",
                     help="run the kernel-vs-XLA decode-step-time "
                          "ablation section (closed loop, decode-heavy "
-                         "shapes)")
+                         "shapes); with --tp N both kernels run "
+                         "mesh-lowered at tp=N on the virtual mesh "
+                         "(rides the --tp own-invocation rule)")
+    ap.add_argument("--transport-ablation", action="store_true",
+                    help="run the round-22 socket-vs-put disagg "
+                         "transport pair (same seeded remote-hit "
+                         "measurement per mode, cross-mode token "
+                         "identity + put-coverage reconciliation "
+                         "hard-enforced); runs ALONE like the other "
+                         "cross-process sections")
     ap.add_argument("--overlap-ablation", action="store_true",
                     help="run the round-21 serial-vs-overlapped "
                          "decode-step ablation section (closed loop, "
@@ -1999,6 +2228,30 @@ def main(argv=None):
         # threading, so every other section's numbers would be
         # measured on a different host shape than their recorded
         # baselines
+        if args.kernel_ablation:
+            # round 22: the kernel pair at tp=N (mesh-lowered pallas
+            # vs sharded XLA gather) replaces the identity section —
+            # same topology rule, different question
+            print("--kernel-ablation --tp %d: virtual 8-device mesh "
+                  "active; running the tp-kernel section only"
+                  % args.tp, flush=True)
+            ab = run_kernel_ablation(params, cfg, p,
+                                     spec_K=args.spec_K, tp=args.tp,
+                                     seed=args.seed)
+            rows.extend(ab)
+            for r in ab:
+                print(json.dumps(r), flush=True)
+            ax, ap_ = ab
+            print("kernel tp=%d step p50: %s %.2f ms vs %s %.2f ms "
+                  "(interpreter mode off-TPU — correctness path; the "
+                  "chip prices the fusion via "
+                  "gpt_serve_pallas_tp2_step_ms)"
+                  % (args.tp, ax["kernel"], ax["step_p50_ms"],
+                     ap_["kernel"], ap_["step_p50_ms"]), flush=True)
+            if args.json:
+                with open(args.json, "w") as f:
+                    json.dump(rows, f, indent=1)
+            return 0
         print("--tp: virtual %d-device mesh active; running the tp "
               "section only (other sections need their recorded "
               "single-device topology)" % 8, flush=True)
@@ -2020,6 +2273,35 @@ def main(argv=None):
                  tN["hbm_pool_per_device"]
                  / max(1, t1["hbm_pool_per_device"]),
                  t1["tok_s"], tN["tok_s"]), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rows, f, indent=1)
+        return 0
+
+    if args.transport_ablation:
+        # runs ALONE: two cross-process clusters back to back own the
+        # host; sharing it with the closed-loop sections would
+        # contaminate both sides of the pair
+        tr = run_transport_ablation(p, seed=args.seed)
+        rows.extend(tr)
+        for r in tr:
+            print(json.dumps(r), flush=True)
+        sock = next(r for r in tr if r["transport"] == "socket")
+        put = next(r for r in tr if r["transport"] == "put")
+        print("transport remote-hit TTFT: socket %.2f ms vs put "
+              "%.2f ms (%d pages, %d B; put run moved %d page(s) / "
+              "%d B through /dev/shm segments, transfer p50 %.2f vs "
+              "%.2f ms); %d/%d token-identical across modes "
+              "(same-host shm handoff — the chip prices ICI via "
+              "gpt_serve_put_remote_hit_ttft_ms)"
+              % (sock["ttft_remote_hit_ms"],
+                 put["ttft_remote_hit_ms"], put["pages_streamed"],
+                 put["page_bytes_streamed"], put["pages_put"],
+                 put["put_bytes"], sock["transfer_p50_ms"],
+                 put["transfer_p50_ms"],
+                 put["identity_checked"]
+                 - put["identity_mismatches"],
+                 put["identity_checked"]), flush=True)
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(rows, f, indent=1)
@@ -2173,7 +2455,8 @@ def main(argv=None):
               flush=True)
 
     if args.kernel_ablation:
-        ab = run_kernel_ablation(params, cfg, p, spec_K=args.spec_K)
+        ab = run_kernel_ablation(params, cfg, p, spec_K=args.spec_K,
+                                 seed=args.seed)
         rows.extend(ab)
         for r in ab:
             print(json.dumps(r), flush=True)

@@ -18,9 +18,27 @@ the FlashAttention recurrence over pages instead of k-blocks).  The
 ragged last page is masked by absolute position (``k_pos <= pos`` —
 the same per-row mask ``_attend_rows`` applies), pages past the row's
 length are skipped (``pl.when``), and int8-KV pages dequantize inside
-the loop using the round-4 per-(row, token) scale layout: the k scale
-multiplies the scores, the v scale folds into the softmax weights —
-exactly where ``_attend_rows`` folds them.
+the loop — the k scale multiplies the scores, the v scale folds into
+the softmax weights, exactly where ``_attend_rows`` folds them —
+reading the round-22 TILE-SHAPED scale pages: ``(pages, 2, ps, H)``
+f32 planes (k plane 0, v plane 1), so a page's scales stream as
+``(ps, H)`` blocks with heads on the lane axis instead of the old
+per-column ``(ps, H, 2)`` stripes (``serving/paged_kv.py`` owns the
+layout; the engine's quant/dequant and the wire frames moved with it).
+
+Round 22 — the mesh lowering (``mesh=``): ``paged_attention(...,
+mesh=serving_mesh(tp))`` wraps the same kernel in ``shard_map`` over
+the serving mesh, each device walking its H/tp heads slice of the
+heads-sharded pool (``P(None, None, 'tp', None)``; scale planes shard
+their trailing heads axis) with q sharded on heads and the block
+table/positions REPLICATED into scalar prefetch.  Attention is
+head-local, so the body is reused verbatim with H→H/tp and zero
+collectives inside — the output-projection psum stays the engine's
+(GSPMD inserts it outside the kernel, same as the XLA path).  The
+engine passes its mesh whenever ``kernel="pallas", tp>1``
+(``serving/engine.py``); tp∈{2,4} greedy token identity vs tp=1 and
+``generate`` is pinned in ``tests/test_serving_tp.py`` and the
+mesh-vs-reference parity in ``tests/test_paged_attention.py``.
 
 Numerics: online softmax normalizes ONCE at the end (acc / l) where
 the jnp reference normalizes the probabilities before the V dot, and
@@ -38,8 +56,10 @@ Chip status: NOT chip-measured this round (no TPU session).  The
 interpreter path is the tier-1 correctness oracle; on CPU it runs the
 grid as a compiled loop (~10x slower than the XLA gather at mid-preset
 shapes — the fusion win is an HBM-traffic argument that only a chip
-can price).  Refresh ``gpt_serve_decode_step_ms`` with
-``perf_regression.py --update`` at the next chip session.
+can price).  Refresh ``gpt_serve_decode_step_ms`` (tp=1) and
+``gpt_serve_pallas_tp2_step_ms`` (the mesh lowering) with
+``perf_regression.py --update`` at the next chip session —
+docs/perf.md "Chip-readiness" has the full order.
 """
 from __future__ import annotations
 
@@ -71,8 +91,12 @@ def _kernel(bt_ref, pos_ref, q_ref, kv_ref, *rest, page_size, dh,
         s_ref = None
         o_ref, m_ref, l_ref, acc_ref = rest
 
-    j = pl.program_id(1)
-    nj = pl.num_programs(1)
+    # grid (T, NH, PP): rows, head BLOCKS, pages — the page walk is
+    # innermost so the online-softmax scratch accumulates over j for a
+    # fixed (row, head-block) and every ref below sees one HB-sized
+    # heads slice
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
     pos = pos_ref[pl.program_id(0)]
 
     @pl.when(j == 0)
@@ -87,35 +111,38 @@ def _kernel(bt_ref, pos_ref, q_ref, kv_ref, *rest, page_size, dh,
     # for unallocated tail entries is the scratch page 0)
     @pl.when(j * page_size <= pos)
     def _page():
-        kv = kv_ref[0]                       # (ps, H, 2*dh) cdt|int8
-        q = q_ref[0]                         # (H, dh) cdt
+        kv = kv_ref[0]                       # (ps, HB, 2*dh) cdt|int8
+        q = q_ref[0]                         # (HB, dh) cdt
         cdt = q.dtype
         k = kv[:, :, :dh].astype(cdt)
         v = kv[:, :, dh:].astype(cdt)
-        # scores: contraction over dh, batched over heads → (H, ps)
+        # scores: contraction over dh, batched over heads → (HB, ps)
         s = jax.lax.dot_general(
             k, q, (((2,), (1,)), ((1,), (0,))),
             preferred_element_type=jnp.float32)
         if int8:
-            # k scale multiplies the scores (round-4 layout, the same
-            # fold point as _attend_rows)
-            s = s * s_ref[0][:, :, 0].T
+            # k scale multiplies the scores (the same fold point as
+            # _attend_rows).  s_ref[0] is the page's retiled scale
+            # block (2, ps, HB): plane 0 = k scales, plane 1 = v —
+            # each plane streams as aligned (sublane=ps, lane=HB)
+            # tiles instead of the old per-column (.., HB, 2) rows
+            s = s * s_ref[0][0].T
         s = s / jnp.sqrt(jnp.float32(dh))
         k_pos = j * page_size + jnp.arange(page_size)
         s = jnp.where(k_pos[None, :] <= pos, s, -1e30)
 
-        m_prev = m_ref[:, :1]                # (H, 1)
+        m_prev = m_ref[:, :1]                # (HB, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)               # (H, ps) f32
+        p = jnp.exp(s - m_new)               # (HB, ps) f32
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:, :1] = l_ref[:, :1] * alpha + \
             jnp.sum(p, axis=-1, keepdims=True)
         if int8:
             # v scale folds into the softmax weights before the V dot
-            p = p * s_ref[0][:, :, 1].T
+            p = p * s_ref[0][1].T
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(cdt), v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # (H, dh)
+            preferred_element_type=jnp.float32)  # (HB, dh)
         m_ref[:, :1] = m_new
 
     @pl.when(j == nj - 1)
@@ -143,26 +170,40 @@ def _build(T, H, dh, PP, page_size, num_pages, kv_dtype, q_dtype,
     if fn is not None:
         return fn
 
-    def page_map(t, j, bt, pos):
-        return (bt[t * PP + j], 0, 0, 0)
+    # head blocking (round 22): walk the heads axis in VREG-shaped
+    # blocks — 8 heads (the f32 sublane count) when H divides, the
+    # whole axis otherwise (small-model/test shapes).  Keeps the kv
+    # block's trailing (HB, 2*dh) tile at the 8×128 register shape
+    # and bounds per-step VMEM at HB·(ps·2dh + dh) instead of
+    # H·(ps·2dh + dh) however many heads this shard holds.
+    HB = 8 if H % 8 == 0 else H
+    NH = H // HB
+
+    def page_map(t, h, j, bt, pos):
+        return (bt[t * PP + j], 0, h, 0)
 
     in_specs = [
-        pl.BlockSpec((1, H, dh), lambda t, j, bt, pos: (t, 0, 0)),
-        pl.BlockSpec((1, page_size, H, 2 * dh), page_map),
+        pl.BlockSpec((1, HB, dh), lambda t, h, j, bt, pos: (t, h, 0)),
+        pl.BlockSpec((1, page_size, HB, 2 * dh), page_map),
     ]
-    scratch = [pltpu.VMEM((H, 1), jnp.float32),
-               pltpu.VMEM((H, 1), jnp.float32),
-               pltpu.VMEM((H, dh), jnp.float32)]
+    scratch = [pltpu.VMEM((HB, 1), jnp.float32),
+               pltpu.VMEM((HB, 1), jnp.float32),
+               pltpu.VMEM((HB, dh), jnp.float32)]
     if int8:
-        in_specs.append(pl.BlockSpec((1, page_size, H, 2), page_map))
+        # retiled scale block: (2, ps, HB) — two (ps, heads) planes
+        # indexed by the SAME page map, heads axis last (aligned
+        # lanes; paged_kv.py module docstring)
+        in_specs.append(pl.BlockSpec(
+            (1, 2, page_size, HB),
+            lambda t, h, j, bt, pos: (bt[t * PP + j], 0, 0, h)))
     body = functools.partial(_kernel, page_size=page_size, dh=dh,
                              int8=int8)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(T, PP),
+        grid=(T, NH, PP),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, dh),
-                               lambda t, j, bt, pos: (t, 0, 0)),
+        out_specs=pl.BlockSpec((1, HB, dh),
+                               lambda t, h, j, bt, pos: (t, h, 0)),
         scratch_shapes=scratch,
     )
     fn = pl.pallas_call(
@@ -178,7 +219,7 @@ def _build(T, H, dh, PP, page_size, num_pages, kv_dtype, q_dtype,
 
 
 def paged_attention(q, pool_kv, pool_s, block_tables, row_pos, *,
-                    page_size, interpret=None):
+                    page_size, interpret=None, mesh=None):
     """Single-token attention over paged K/V via block-table walk.
 
     Parameters
@@ -187,13 +228,24 @@ def paged_attention(q, pool_kv, pool_s, block_tables, row_pos, *,
     pool_kv : (num_pages, page_size, H, 2*dh) page pool — the
         ``PagedKVCache`` layout (k and v halves fused on the last
         axis); cfg dtype, or int8 when ``pool_s`` is given.
-    pool_s : (num_pages, page_size, H, 2) f32 dequant scales for the
-        int8-KV pool (``models/gpt.py _kv_quantize`` layout), or None.
+    pool_s : (num_pages, 2, page_size, H) f32 dequant scales for the
+        int8-KV pool (``models/gpt.py _kv_quantize`` values in the
+        round-22 tile-shaped plane layout — plane 0 k, plane 1 v),
+        or None.
     block_tables : (T, PP) int32 per-ROW page ids; entry j covers
         positions [j*page_size, (j+1)*page_size).  Unused tail entries
         should point at the scratch page 0.
     row_pos : (T,) int32 per-row absolute positions — each row attends
         to positions <= its own (the continuous-batching mask).
+    mesh : optional serving mesh with a live ``tp`` axis (round 22).
+        The call is then lowered through ``shard_map``: each device
+        walks only its H/tp heads slice of the heads-sharded pools
+        (``P(None, None, 'tp', None)`` kv / ``P(None, None, None,
+        'tp')`` scales), with the block table and positions
+        replicated.  Attention is collective-free per head — the
+        kernel body is REUSED with H → H/tp and the wo psum stays
+        outside — so the lowering adds no communication.  ``None``
+        (or a trivial tp=1 mesh) is the single-device path.
 
     Returns (T, H, dh) f32.  ``interpret=None`` auto-selects
     interpreter mode off-TPU (the tier-1 CPU path).
@@ -209,13 +261,42 @@ def paged_attention(q, pool_kv, pool_s, block_tables, row_pos, *,
         raise ValueError("paged_attention: pool page_size %d != %d"
                          % (pool_kv.shape[1], page_size))
     int8 = pool_s is not None
-    fn = _build(T, H, dh, PP, page_size, num_pages, pool_kv.dtype,
-                q.dtype, int8, bool(interpret))
     bt = block_tables.reshape(-1).astype(jnp.int32)
     pos = row_pos.astype(jnp.int32)
+
+    tp_axis = None
+    if mesh is not None:
+        from ..parallel.mesh import live_axis
+        tp_axis = live_axis(mesh, "tp")
+    if tp_axis is None:
+        fn = _build(T, H, dh, PP, page_size, num_pages, pool_kv.dtype,
+                    q.dtype, int8, bool(interpret))
+        if int8:
+            return fn(bt, pos, q, pool_kv, pool_s)
+        return fn(bt, pos, q, pool_kv)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    tp = int(mesh.shape["tp"])
+    if H % tp:
+        raise ValueError("paged_attention: H=%d not divisible by "
+                         "tp=%d" % (H, tp))
+    fn = _build(T, H // tp, dh, PP, page_size, num_pages,
+                pool_kv.dtype, q.dtype, int8, bool(interpret))
+    in_specs = [P(), P(), P(None, "tp", None),
+                P(None, None, "tp", None)]
+    args = [bt, pos, q, pool_kv]
     if int8:
-        return fn(bt, pos, q, pool_kv, pool_s)
-    return fn(bt, pos, q, pool_kv)
+        in_specs.append(P(None, None, None, "tp"))
+        args.append(pool_s)
+    # check_vma off: the pallas_call's output carries no replication
+    # info for the checker to verify — the out spec is the contract
+    sm = shard_map_compat(fn, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=P(None, "tp", None),
+                          check_vma=False)
+    return sm(*args)
 
 
 def paged_attention_reference(q, pool_kv, pool_s, block_tables,
@@ -237,7 +318,10 @@ def paged_attention_reference(q, pool_kv, pool_s, block_tables,
         .reshape(T * H, L, 2 * dh)
     cs = None
     if pool_s is not None:
-        cs = pool_s[block_tables].transpose(0, 3, 1, 2, 4) \
+        # retiled plane layout (num_pages, 2, ps, H): gather gives
+        # (T, PP, 2, ps, H) — reorder back to _attend_rows' per-token
+        # (.., L, 2) scale pairs
+        cs = pool_s[block_tables].transpose(0, 4, 1, 3, 2) \
             .reshape(T * H, L, 2)
     pos_r = jnp.repeat(row_pos, H)
     out = _attend_rows(q.reshape(T * H, dh), ckv, cs, pos_r, dh)

@@ -1325,12 +1325,13 @@ class _WorkerHandle:
     __slots__ = ("name", "role", "proc", "conn", "data_host",
                  "data_port", "last_seen", "dead", "draining",
                  "outstanding", "stats", "stats_evt", "stats_sid",
-                 "error", "recv_thread")
+                 "error", "recv_thread", "pid")
 
     def __init__(self, name, role):
         self.name = name
         self.role = role
         self.proc = None
+        self.pid = None                   # from hello (put-segment sweep)
         self.conn = None
         self.data_host = None
         self.data_port = None
@@ -1557,6 +1558,7 @@ class DisaggServingCluster:
             name = meta["name"]
             wh = self.workers[name]
             wh.conn = conn
+            wh.pid = meta.get("pid")
             pm, pb = self._params_frames
             conn.send("config",
                       {"cfg": self.cfg, "role": wh.role,
@@ -2023,6 +2025,15 @@ class DisaggServingCluster:
             wh.error = error
             self._standby.discard(wh.name)
             self.index.drop_owner(wh.name)
+            # a SIGKILLed worker cannot sweep its own unreceived put
+            # segments (its orderly-exit sweep never ran) — reclaim
+            # them by its pid; a receiver mid-open just sees ENOENT,
+            # which reads as the sender's death (it IS dead)
+            pid = wh.pid or (wh.proc.pid if wh.proc is not None
+                             else None)
+            if pid is not None:
+                from .transport import put_sweep
+                put_sweep(pid)
             if self._obs is not None:
                 self._obs.failovers.inc()
                 self._obs.g_workers.set(self._serving_count())
@@ -2175,6 +2186,7 @@ class DisaggServingCluster:
             name = meta.get("name") if kind == "hello" else None
             if name == wh.name:
                 conn = cand
+                wh.pid = meta.get("pid")
             elif name:
                 # a sibling joiner beat us to the accept queue: park
                 # its hello'd connection for ITS add_worker call
@@ -2425,6 +2437,7 @@ class DisaggServingCluster:
                     wh.conn.send("shutdown", {})
                 except OSError:
                     pass
+        from .transport import put_sweep
         for wh in workers:
             if wh.proc is not None:
                 wh.proc.join(timeout=timeout)
@@ -2433,6 +2446,12 @@ class DisaggServingCluster:
                     wh.proc.join(timeout=5)
             if wh.conn is not None:
                 wh.conn.close()
+            # belt over the workers' own exit sweeps: a worker that
+            # died uncleanly leaves pid-prefixed segments behind
+            pid = wh.pid or (wh.proc.pid if wh.proc is not None
+                             else None)
+            if pid is not None:
+                put_sweep(pid)
         with self._lock:
             early = list(self._early_hellos.values())
             self._early_hellos.clear()
@@ -2550,6 +2569,8 @@ class _DisaggWorker:
         self.remote_hit_tokens = 0
         self.remote_hits_host_tier = 0
         self.fetch_bytes = 0
+        self.pages_put_total = 0          # pages sent via put segments
+        self.put_bytes_total = 0
         self._fetch_seq = 0               # fetch/reply correlation
         # rid -> lowest still-valid gen (per-request fence): a
         # fenced-out zombie prefill's late frames must be DROPPED —
@@ -2575,12 +2596,20 @@ class _DisaggWorker:
     def _peer_handler(self, conn):
         """One accepted peer connection: prefill→decode page streams
         and sibling FETCH requests; frames are enqueued with the conn
-        so the main loop can reply in order."""
+        so the main loop can reply in order.  The FIRST frame out is
+        our transport caps (round 22) — the connector's ``wait_caps``
+        relies on it preceding any reply."""
+        try:
+            conn.send_caps()
+        except OSError:
+            return
         while True:
             got = conn.recv()
             if got is None:
                 return
             kind, meta, bufs = got
+            if kind == "caps":
+                continue                  # recorded on conn by recv
             if kind == "fetch":
                 self.fetch_inbox.put((meta, bufs, conn))
                 # wake token: an idle main loop is parked on the
@@ -2617,8 +2646,50 @@ class _DisaggWorker:
         if conn is None or conn.closed:
             p = self.peers[owner]
             conn = connect(p["host"], p["port"], timeout=10.0)
+            # caps handshake (round 22): advertise ours, learn theirs
+            # (the acceptor's caps frame is its first) — a timeout
+            # just means a socket-only peer, never a failure
+            try:
+                conn.send_caps()
+                conn.wait_caps(timeout=5.0)
+            except OSError:
+                conn.close()              # died mid-handshake
+                raise
             self._peer_conns[owner] = conn
         return conn
+
+    def _send_pages_frame(self, conn, kind, meta, bufs):
+        """Send a page-carrying frame (``pages`` stream or
+        ``fetch_reply``) over the negotiated transport: a /dev/shm
+        put when both ends advertised same-host ``put_pages``, else
+        inline socket bytes — the segment holds EXACTLY the bytes the
+        socket body would, so the two paths are bit-identical on
+        install.  Raises OSError like ``conn.send`` (callers' peer
+        failover paths apply unchanged)."""
+        from .transport import put_capability, put_eligible, put_write
+        if bufs and put_eligible(put_capability(), conn.peer_put):
+            path, sizes = put_write(bufs)
+            try:
+                conn.send(kind, dict(
+                    meta, put={"path": path, "sizes": sizes}), ())
+            except BaseException:
+                # the peer never got the frame: the segment has no
+                # unlinker left — reclaim it before re-raising into
+                # the caller's drop/abandon path
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                raise
+            # receipt is invisible to the sender (the receiver
+            # unlinks at open); a receiver that dies between our send
+            # and its open strands the segment — our pid-prefixed
+            # name makes it sweepable (put_sweep at our exit, or the
+            # router's by-pid sweep if WE are the one killed)
+            self.pages_put_total += int(meta.get("n", len(bufs)))
+            self.put_bytes_total += sum(sizes)
+        else:
+            conn.send(kind, meta, bufs)
 
     def _serve_fetches(self):
         """Answer queued sibling FETCH requests (also called while
@@ -2670,10 +2741,11 @@ class _DisaggWorker:
                 # cold prefill instead of eating its fetch timeout
                 n_full, reply_bufs = 0, []
             try:
-                conn.send("fetch_reply",
-                          {"n": n_full, "fid": meta.get("fid"),
-                           "t_send": time.perf_counter()},
-                          reply_bufs)
+                self._send_pages_frame(
+                    conn, "fetch_reply",
+                    {"n": n_full, "fid": meta.get("fid"),
+                     "t_send": time.perf_counter()},
+                    reply_bufs)
                 self.fetch_bytes += sum(
                     memoryview(b).nbytes for b in reply_bufs)
             except OSError:
@@ -2689,7 +2761,7 @@ class _DisaggWorker:
         accounting only: a spilled peer chain serves from its host
         tier without a device gather, and the per-tier hit counters
         are how the tier-sweep benchmark prices that difference."""
-        from .page_streamer import bufs_to_pages
+        from .page_streamer import bufs_to_pages, _release
         self._fetch_seq += 1
         fid = self._fetch_seq
         try:
@@ -2717,6 +2789,7 @@ class _DisaggWorker:
                 return 0
             kind, meta, bufs = got
             if kind != "fetch_reply" or meta.get("fid") != fid:
+                _release(bufs)            # stale put reply: unmap it
                 continue                  # stale/uncorrelated frame
             break
         n = meta["n"]
@@ -2725,9 +2798,11 @@ class _DisaggWorker:
         ps = self.eng.page_size
         ids = self.eng.cache.alloc(n)
         if ids is None:
+            _release(bufs)                # put segment: unmap now
             return 0                      # pool too tight: stay cold
         self.eng.cache.install_pages(
             ids, bufs_to_pages(self.eng.cache, n, bufs))
+        _release(bufs)
         created = self.eng.prefix.insert_chain(
             tokens[:n * ps], ids, upto_page=n)
         created_idx = {j for j, _ in created}
@@ -2837,6 +2912,8 @@ class _DisaggWorker:
             self.peers = meta["peers"]
         elif kind == "stats_req":
             self._send_stats(sid=meta.get("sid"))
+        elif kind == "caps":
+            pass                          # recorded on the conn by recv
         elif kind == "_wake":
             pass                          # fetch_inbox wake token
         elif kind in ("shutdown", "_lost"):
@@ -2938,10 +3015,11 @@ class _DisaggWorker:
             if out is not None and dec is not None:
                 start, n, bufs = out
                 try:
-                    dec.send("pages",
-                             {"srid": (st["rid"], st["gen"]),
-                              "start": start, "n": n,
-                              "t_send": time.perf_counter()}, bufs)
+                    self._send_pages_frame(
+                        dec, "pages",
+                        {"srid": (st["rid"], st["gen"]),
+                         "start": start, "n": n,
+                         "t_send": time.perf_counter()}, bufs)
                 except OSError:
                     self._drop_peer(st["meta"]["decode"])
                     dec = None            # gap in the stream: abandon
@@ -3116,6 +3194,12 @@ class _DisaggWorker:
             + self.fetch_bytes,
             "pages_streamed": self.streamer.pages_streamed_total,
             "pages_installed": self.receiver.pages_installed_total,
+            # round 22 put-transport accounting: logical page bytes
+            # above count IDENTICALLY on both transports (the perf
+            # counters measure pages moved, not socket bytes); these
+            # say how many rode /dev/shm puts instead of the socket
+            "pages_put": self.pages_put_total,
+            "put_bytes": self.put_bytes_total,
             # send-then-clear: the router OBSERVES every sample it
             # receives into the transfer histogram, so samples must
             # travel exactly once (re-sending a sliding window would
@@ -3193,6 +3277,11 @@ class _DisaggWorker:
             self.router.close()
             for c in self._peer_conns.values():
                 c.close()
+            # reclaim any put segment we wrote whose receiver never
+            # opened it (peer died mid-flight): our pid prefixes
+            # every segment name
+            from .transport import put_sweep
+            put_sweep()
 
 
 def _disagg_worker_entry(name, role, router_host, router_port):

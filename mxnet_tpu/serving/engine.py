@@ -149,7 +149,10 @@ def step_input_specs(params, cfg, kv_int8, tp="tp", overlap=False):
       rules);
     * pools — heads-sharded pages, ``PagedKVCache.POOL_SPEC``
       (= P(None, None, 'tp', None) on the (pages, page_size, H, 2*dh)
-      layout; the f32 scale pool shards its H axis identically);
+      layout; the f32 scale pool shards the same heads axis, which
+      the round-22 tile-shaped retile moved last —
+      ``PagedKVCache.S_POOL_SPEC`` = P(None, None, None, 'tp') on
+      (pages, 2, page_size, H));
     * everything host-built (token rows, slot/pos/live vectors, block
       tables, sampling-row matrix) — replicated.
 
@@ -166,7 +169,8 @@ def step_input_specs(params, cfg, kv_int8, tp="tp", overlap=False):
                     for a in PagedKVCache.POOL_SPEC])
     pool = {"kv": pool_spec}
     if kv_int8:
-        pool["s"] = pool_spec
+        pool["s"] = P(*[tp if a == "tp" else a
+                        for a in PagedKVCache.S_POOL_SPEC])
     rep = P()
     out = (G.decode_param_specs(params, cfg, tp=tp),
            [dict(pool) for _ in range(cfg.n_layers)],
@@ -190,7 +194,8 @@ def step_output_specs(cfg, kv_int8, tp="tp"):
                     for a in PagedKVCache.POOL_SPEC])
     pool = {"kv": pool_spec}
     if kv_int8:
-        pool["s"] = pool_spec
+        pool["s"] = P(*[tp if a == "tp" else a
+                        for a in PagedKVCache.S_POOL_SPEC])
     return (P(), [dict(pool) for _ in range(cfg.n_layers)])
 
 
@@ -383,7 +388,12 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
             if kv_int8:
                 kvq, skv = G._kv_quantize(k, v)        # (T, H, 2dh/2)
                 pool_kv = pool["kv"].at[page, off].set(kvq)
-                pool_s = pool["s"].at[page, off].set(skv)
+                # retiled scale planes (paged_kv.py): the (N, 2, ps,
+                # H) pool takes row r's scales at [page_r, :, off_r]
+                # — a (T, 2, H) update, so _kv_quantize's (T, H, 2)
+                # transposes once here
+                pool_s = pool["s"].at[page, :, off].set(
+                    skv.transpose(0, 2, 1))
                 new_pools.append({"kv": pool_kv, "s": pool_s})
             else:
                 newkv = jnp.concatenate([k, v], axis=-1).astype(cdt)
@@ -394,10 +404,13 @@ def _make_step(cfg, num_slots, n_rows, pages_per_slot, page_size,
                 # fused block-table walk (kernels/paged_attention.py):
                 # pages stream HBM->VMEM per grid step, online-softmax
                 # accumulation, int8 dequant in the inner loop — no
-                # gathered view is ever materialized
+                # gathered view is ever materialized.  With a mesh the
+                # call shard_maps over tp: each device walks its own
+                # H/tp heads slice of the pools (round 22)
                 from ..kernels.paged_attention import paged_attention
                 attn = paged_attention(q, pool_kv, pool_s, row_pages,
-                                       row_pos, page_size=page_size)
+                                       row_pos, page_size=page_size,
+                                       mesh=mesh)
             else:
                 # block-table gather + _attend_rows — ONE copy of the
                 # gather lives in kernels/paged_attention.py, shared
@@ -866,8 +879,10 @@ class ServingEngine:
         too big for one chip serves; f32-greedy outputs stay
         token-identical to ``tp=1`` and to ``generate`` (pinned by
         ``tests/test_serving_tp.py``).  Requires ``cfg.n_heads % tp
-        == 0`` and ``kernel="xla"`` (the Pallas kernel path is
-        tp=1-only this round — the XLA gather path is the default).
+        == 0``.  Both kernels serve tp>1: the XLA gather shards
+        through GSPMD, and (round 22) the Pallas block-table walk is
+        shard_map-lowered so each device walks its own H/tp heads
+        slice — speculation (``spec_K``) composes with both.
     mesh : optional pre-built mesh with a ``tp`` axis (e.g.
         ``parallel.serving_mesh(tp)``); overrides ``tp``.
     tier_bytes : host-DRAM KV tier budget in bytes (round 18).  > 0
@@ -924,17 +939,18 @@ class ServingEngine:
         if tp < 1:
             raise ValueError("ServingEngine: tp must be >= 1")
         if tp > 1:
+            # capability check (round 22): the Pallas walk is mesh-
+            # lowered — any kernel serves tp>1 provided the heads
+            # axis divides (each device walks H/tp heads of the
+            # heads-sharded pools; shard_map needs a whole number of
+            # heads per device).  The old blanket pallas×tp>1 error
+            # is gone; n_heads % tp is the one genuine requirement
+            # either kernel has.
             if cfg.n_heads % tp:
                 raise ValueError(
                     "ServingEngine: n_heads=%d not divisible by "
                     "tp=%d — the KV pools shard the heads axis"
                     % (cfg.n_heads, tp))
-            if kernel == "pallas":
-                raise ValueError(
-                    "ServingEngine: kernel='pallas' is tp=1-only "
-                    "this round (the fused block-table walk is not "
-                    "mesh-lowered); use the default XLA gather path "
-                    "for tp>1")
             if isinstance(params, dict) and any(
                     "moe" in layer for layer in params.get("layers",
                                                            ())):

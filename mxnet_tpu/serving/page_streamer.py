@@ -7,9 +7,17 @@ decode replica — so page transfer is pipelined with prefill chunks and
 decode-side installation overlaps the tail of prefill instead of
 starting after it.  The decode replica owns a :class:`PageReceiver`:
 arriving page content is installed into the local ``PagedKVCache`` as
-pool space allows (held as host bytes when the pool is momentarily
-dry), and a request is admitted the moment its final page and handoff
-metadata are in.
+pool space allows, and a request is admitted the moment its final
+page and handoff metadata are in.
+
+Hold representation (round 22): a frame the pool cannot absorb yet is
+held as its ``(n, bufs)`` tuple UNCHANGED — whatever buffer flavor
+the transport delivered (socket bytearrays, or zero-copy
+:class:`~.transport.PutBufs` views into a shared put segment).  There
+is deliberately NO downgrade copy into fresh host bytes: a put-path
+frame stays mapped until installed, and every exit edge (install,
+abort) releases it via its ``release`` hook so segment lifetime is
+bounded by staging lifetime, not by GC.
 
 Wire layout (the ``PAGES`` frame): raw buffers in pool order — for
 each layer, the ``kv`` page block then (under int8-KV) the ``s``
@@ -36,8 +44,18 @@ def _page_shapes(cfg, page_size, kv_int8):
     out = [("kv", (page_size, H, 2 * dh),
             "int8" if kv_int8 else str(cfg.dtype))]
     if kv_int8:
-        out.append(("s", (page_size, H, 2), "float32"))
+        # round-22 tile-shaped scale planes (paged_kv.py): the wire
+        # layout IS the pool layout, so the retile travels as-is
+        out.append(("s", (2, page_size, H), "float32"))
     return out
+
+
+def _release(bufs):
+    """Release transport-owned buffers (put segments carry a
+    ``release`` hook; plain socket bytearrays have none)."""
+    rel = getattr(bufs, "release", None)
+    if rel is not None:
+        rel()
 
 
 def _raw(a) -> memoryview:
@@ -191,13 +209,15 @@ class PageReceiver:
             n, bufs = st.held[0]
             ids = self.engine.cache.alloc(n)
             if ids is None:
-                return                    # pool dry: hold host-side
+                return                    # pool dry: hold as received
             content = bufs_to_pages(self.engine.cache, n, bufs)
             self.engine.cache.install_pages(ids, content)
+            del content                   # last array refs before release
             st.installed.extend(ids)
             st.next_idx += n
             st.held.pop(0)
             self.pages_installed_total += n
+            _release(bufs)
 
     def ready(self, rid: int) -> bool:
         """All pages installed + handoff metadata present?"""
@@ -227,6 +247,8 @@ class PageReceiver:
             return 0
         if st.installed:
             self.engine.cache.free(st.installed)
+        for _, bufs in st.held:           # put segments: unmap now
+            _release(bufs)
         return len(st.installed)
 
     @property

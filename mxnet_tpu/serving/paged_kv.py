@@ -3,7 +3,7 @@
 Layout (per decoder layer):
 
     kv pool : (num_pages, page_size, H, 2*dh)   cfg.dtype | int8
-    s pool  : (num_pages, page_size, H, 2)      f32        (kv_int8)
+    s pool  : (num_pages, 2, page_size, H)      f32        (kv_int8)
 
 i.e. each page holds ``page_size`` consecutive token positions of ONE
 sequence, all heads, k and v halves fused in the last axis — the same
@@ -30,10 +30,19 @@ sequence before any mask exposes it (the same pointer-only argument
 as speculative rollback; pinned by the forced-retire test in
 ``tests/test_serving.py``).
 
-int8-KV uses the per-(row, token) symmetric-s8 scale layout that
-``models/gpt.py _kv_quantize`` emits (round 4) — the s pool is the
-paged arrangement of the contiguous ``{"kv", "s"}`` cache's scale
-buffer.
+int8-KV uses the per-(row, token) symmetric-s8 scales that
+``models/gpt.py _kv_quantize`` emits (round 4), but paged in a
+TILE-SHAPED arrangement (round 22): the s pool is (num_pages, **2**,
+page_size, H) — a page's scales are two (page_size, H) planes (k
+scales, then v scales) instead of per-column (.., H, 2) rows.  On the
+8×128 VREG the trailing two axes of every pool block are what Mosaic
+tiles; the old layout put a length-2 axis on the lanes (one useful
+column per 128-wide register row), the plane layout streams a page's
+scales as the same aligned (sublane=tokens, lane=heads) tiles as the
+kv block.  The transpose in/out of ``_kv_quantize``'s (T, H, 2) order
+happens once at the engine's scatter and in the reference gather —
+the wire/export layout follows the pool layout, so disagg transfer
+stays exact pool bytes.
 
 Tensor parallelism (round 14): with ``mesh=`` (a ``parallel/mesh.py``
 mesh carrying a ``tp`` axis) every pool is laid out heads-sharded —
@@ -164,8 +173,12 @@ class PagedKVCache:
     the engine's step program; reassign it after every donated call."""
 
     # heads-sharded pool placement: the one genuinely tp-sharded
-    # tensor in the serving step program (docs/sharding_readiness.md)
+    # tensor in the serving step program (docs/sharding_readiness.md).
+    # The f32 scale pool shards the SAME heads axis, which after the
+    # round-22 tile-shaped retile is its LAST axis (num_pages, 2,
+    # page_size, H) — hence a separate spec.
     POOL_SPEC = (None, None, "tp", None)
+    S_POOL_SPEC = (None, None, None, "tp")
 
     def __init__(self, cfg, num_pages, page_size, kv_int8=False,
                  mesh=None):
@@ -185,7 +198,7 @@ class PagedKVCache:
         H = cfg.n_heads
         dh = cfg.d_model // H
         cdt = jnp.dtype(cfg.dtype)
-        place = lambda x: x                  # noqa: E731
+        place = lambda x, spec=None: x       # noqa: E731
         if mesh is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -196,10 +209,10 @@ class PagedKVCache:
                 raise ValueError(
                     "PagedKVCache: n_heads=%d not divisible by tp=%d "
                     "(pages shard the heads axis)" % (H, self.tp))
-            sharded = NamedSharding(mesh, P(*self.POOL_SPEC))
 
-            def place(x):
-                return jax.device_put(x, sharded)
+            def place(x, spec=self.POOL_SPEC):
+                return jax.device_put(
+                    x, NamedSharding(mesh, P(*spec)))
         self.pools = []
         for _ in range(cfg.n_layers):
             if kv_int8:
@@ -207,7 +220,8 @@ class PagedKVCache:
                     "kv": place(jnp.zeros(
                         (num_pages, page_size, H, 2 * dh), jnp.int8)),
                     "s": place(jnp.zeros(
-                        (num_pages, page_size, H, 2), jnp.float32)),
+                        (num_pages, 2, page_size, H), jnp.float32),
+                        self.S_POOL_SPEC),
                 })
             else:
                 self.pools.append({

@@ -29,11 +29,33 @@ Byte accounting: every connection counts ``bytes_sent`` /
 ``bytes_received`` (header + buffers), which the workers roll up into
 the router's ``cluster_page_bytes_streamed_total`` counter — the
 prefill-once perf claim is *measured* in bytes moved, not asserted.
+
+Round 22 — the ``put_pages`` capability: page-sized payloads
+(``pages`` streams, ``fetch_reply`` bodies) between SAME-HOST workers
+skip the socket body entirely.  The sender lands the raw pool bytes in
+one ``/dev/shm`` segment (:func:`put_write`) and sends only a control
+frame naming it (``meta["put"]``); :meth:`Connection.recv`
+materializes the segment as zero-copy memoryviews (:class:`PutBufs`)
+and unlinks the file at open, so on-disk segments exist only while a
+frame is in flight.  Whether the path is live is NEGOTIATED: each side
+of a data-plane connection sends a ``caps`` frame right after
+connect/accept (:func:`put_capability`), and a sender puts only when
+both sides advertise ``put_pages`` with the same host token — anything
+else falls back to inline socket bytes, bit-identically (the segment
+holds EXACTLY the bytes the socket body would).  ``/dev/shm`` + the
+engine's donated install scatter (a ``jax.device_put`` of the mapped
+pages) is the single-host stand-in for a true device-to-device ICI
+put; docs/perf.md prices the two honestly.  ``MXNET_SERVE_TRANSPORT``
+gates it: ``auto`` (default), ``socket`` (never advertise), ``put``
+(advertise + assert used; tests force the path with it).
 """
 from __future__ import annotations
 
+import mmap
+import os
 import select
 import socket
+import tempfile
 import threading
 from typing import Callable, List, Optional, Tuple
 
@@ -42,7 +64,138 @@ import numpy as np
 from ..parallel.dist import recv_frame, send_frame
 
 __all__ = ["Connection", "Listener", "tree_to_frames",
-           "frames_to_tree", "connect"]
+           "frames_to_tree", "connect", "put_capability",
+           "put_write", "put_read", "put_sweep", "PutBufs",
+           "PUT_DIR", "PUT_STATS"]
+
+# --------------------------------------------------------------------------
+# zero-copy same-host page puts (round 22)
+# --------------------------------------------------------------------------
+
+PUT_DIR = "/dev/shm" if os.path.isdir("/dev/shm") \
+    else tempfile.gettempdir()
+_PUT_PREFIX = "mxserve-put-"
+
+# module-level open/release accounting: tests pin releases == opens
+# (every materialized segment is explicitly released after install or
+# abort — no held segment leaks past its staging record)
+PUT_STATS = {"writes": 0, "opens": 0, "releases": 0}
+
+
+def put_capability() -> Optional[dict]:
+    """The capability dict this process advertises on data-plane
+    connections, or ``None`` when the put path is disabled
+    (``MXNET_SERVE_TRANSPORT=socket``).  The host token scopes the
+    shared-memory domain: two workers may put to each other only when
+    their tokens match (same kernel, same ``/dev/shm``)."""
+    mode = os.environ.get("MXNET_SERVE_TRANSPORT", "auto")
+    if mode == "socket":
+        return None
+    return {"put_pages": True, "host": socket.gethostname(),
+            "dir": PUT_DIR}
+
+
+def put_eligible(mine: Optional[dict],
+                 theirs: Optional[dict]) -> bool:
+    """Both ends advertised ``put_pages`` from the same shm domain?"""
+    return (mine is not None and theirs is not None
+            and bool(theirs.get("put_pages"))
+            and mine.get("host") == theirs.get("host")
+            and mine.get("dir") == theirs.get("dir"))
+
+
+def put_write(bufs) -> Tuple[str, List[int]]:
+    """Land raw buffers in one fresh shm segment; returns ``(path,
+    sizes)`` for the control frame.  The file name carries the
+    writer's pid so a supervisor can sweep a killed worker's
+    unreceived segments (:func:`put_sweep`)."""
+    fd, path = tempfile.mkstemp(
+        prefix="%s%d-" % (_PUT_PREFIX, os.getpid()), dir=PUT_DIR)
+    sizes = []
+    try:
+        with os.fdopen(fd, "wb") as f:
+            for b in bufs:
+                mv = memoryview(b)
+                sizes.append(mv.nbytes)
+                f.write(mv)
+    except BaseException:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
+    PUT_STATS["writes"] += 1
+    return path, sizes
+
+
+class PutBufs(list):
+    """Received put-segment payload: zero-copy memoryviews into one
+    shared mapping.  :meth:`release` drops the views and closes the
+    map — the backing file was already unlinked at open, so release
+    returns the memory to the kernel.  If an installer still exports
+    a view (a device array aliasing host memory), closing degrades to
+    dropping our references and the map closes with the last view."""
+
+    def __init__(self, views: List[memoryview], mm_obj, base):
+        super().__init__(views)
+        self._mm = mm_obj
+        self._base = base
+        self.released = False
+
+    def release(self):
+        if self.released:
+            return
+        self.released = True
+        PUT_STATS["releases"] += 1
+        try:
+            for v in self:
+                v.release()
+            self._base.release()
+            self._mm.close()
+        except BufferError:
+            pass                          # exported view: GC closes it
+        self._mm = self._base = None
+        self[:] = []
+
+
+def put_read(path: str, sizes: List[int]) -> PutBufs:
+    """Open + map + UNLINK a put segment: the unlink is immediate so
+    the filesystem namespace only ever holds in-flight segments; the
+    mapping keeps the bytes alive until :meth:`PutBufs.release`."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        mm_obj = mmap.mmap(fd, 0, prot=mmap.PROT_READ)
+    finally:
+        os.close(fd)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass                              # already swept: bytes live on
+    base = memoryview(mm_obj)
+    views, off = [], 0
+    for n in sizes:
+        views.append(base[off:off + n])
+        off += n
+    PUT_STATS["opens"] += 1
+    return PutBufs(views, mm_obj, base)
+
+
+def put_sweep(pid: Optional[int] = None) -> int:
+    """Unlink leftover put segments — ours at orderly shutdown, or a
+    KILLED worker's (by its pid) from the supervising router: a
+    segment written microseconds before SIGKILL has no receiver left
+    to unlink it.  Returns files removed."""
+    import glob
+    pat = os.path.join(PUT_DIR, "%s%s-*" % (
+        _PUT_PREFIX, pid if pid is not None else os.getpid()))
+    n = 0
+    for p in glob.glob(pat):
+        try:
+            os.unlink(p)
+            n += 1
+        except OSError:
+            pass
+    return n
 
 
 class Connection:
@@ -56,6 +209,11 @@ class Connection:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.closed = False
+        # peer transport capability (round 22): recv records the
+        # peer's `caps` frame here; senders consult it via
+        # `put_eligible(put_capability(), conn.peer_put)`
+        self.peer_put: Optional[dict] = None
+        self.caps_seen = False
 
     def send(self, kind: str, meta: Optional[dict] = None, bufs=()):
         """Send one message; raises ``OSError`` on a dead peer (the
@@ -88,7 +246,44 @@ class Connection:
         self.bytes_received += sum(len(b) for b in bufs)
         if not isinstance(meta, dict) or "kind" not in meta:
             return None                   # foreign frame: drop the conn
+        if meta["kind"] == "caps":
+            # handshake frame: record and surface (callers treat
+            # unknown kinds as no-ops; wait_caps spins on caps_seen)
+            self.peer_put = meta.get("put")
+            self.caps_seen = True
+        put = meta.get("put") if meta["kind"] != "caps" else None
+        if put is not None:
+            # put-transport frame: the body rides a shm segment, not
+            # the socket — map it (and unlink NOW) so downstream code
+            # sees ordinary zero-copy buffers
+            try:
+                bufs = put_read(put["path"], put["sizes"])
+            except OSError:
+                return None               # sender died mid-put: as EOF
+            self.bytes_received += sum(v.nbytes for v in bufs)
         return meta["kind"], meta, bufs
+
+    def send_caps(self):
+        """Advertise this end's transport capability — the FIRST frame
+        each side sends on a data-plane connection."""
+        self.send("caps", {"put": put_capability()})
+
+    def wait_caps(self, timeout: float = 5.0) -> Optional[dict]:
+        """Connector-side half of the handshake: the acceptor's first
+        frame is always its ``caps`` (sent before its handler can
+        reply to anything), so one recv resolves it.  Returns the
+        peer capability (or ``None`` on timeout/EOF — treated as a
+        socket-only peer)."""
+        import time
+        deadline = time.perf_counter() + timeout
+        while not self.caps_seen:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                return None
+            got = self.recv(timeout=left)
+            if got in (None, "timeout"):
+                return None
+        return self.peer_put
 
     def close(self):
         self.closed = True

@@ -34,8 +34,10 @@ def _mk(T=6, H=2, dh=8, ps=4, PP=3, NP=11, int8=False, seed=0,
     if int8:
         pool = jnp.asarray(rng.randint(-127, 128, (NP, ps, H, 2 * dh)),
                            jnp.int8)
+        # round-22 tile-shaped scale layout: (NP, 2, ps, H) planes
+        # (k plane 0, v plane 1) — see serving/paged_kv.py
         scale = jnp.asarray(
-            np.abs(rng.randn(NP, ps, H, 2)) * 0.02 + 1e-4, jnp.float32)
+            np.abs(rng.randn(NP, 2, ps, H)) * 0.02 + 1e-4, jnp.float32)
     else:
         pool = jnp.asarray(rng.randn(NP, ps, H, 2 * dh),
                            jnp.dtype(dtype))
@@ -139,6 +141,31 @@ def test_kernel_rejects_bad_pool_geometry():
         PA.paged_attention(q, pool, None, bt,
                            jnp.zeros(q.shape[0], jnp.int32),
                            page_size=8, interpret=True)  # pool is ps=4
+
+
+def test_kernel_mesh_tp_parity():
+    """Round 22: the shard_map lowering (``mesh=``) — each device
+    walking its 1/tp heads slice of the heads-sharded pool — matches
+    the single-device reference at the same page-boundary positions,
+    f32 and int8 (the retiled scale planes shard their trailing heads
+    axis).  The lowering is the unit under test; the kernel body is
+    pinned above."""
+    from mxnet_tpu.kernels import paged_attention as PA
+    from mxnet_tpu.parallel.mesh import serving_mesh
+    import jax.numpy as jnp
+
+    mesh = serving_mesh(2)
+    pos = jnp.asarray([0, 3, 4, 8, 5, 11], jnp.int32)
+    for int8 in (False, True):
+        q, pool, scale, bt = _mk(T=6, int8=int8)
+        out = PA.paged_attention(q, pool, scale, bt, pos, page_size=4,
+                                 interpret=True, mesh=mesh)
+        ref = PA.paged_attention_reference(q, pool, scale, bt, pos,
+                                           page_size=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=_RTOL, atol=_ATOL)
+        # heads really shard: 2 devices, half the heads each
+        assert len(out.addressable_shards) == 2
 
 
 # ---------------------------------------------------------------------------

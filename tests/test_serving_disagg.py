@@ -226,6 +226,146 @@ def test_transport_tree_roundtrip():
                           tree["layers"][1]["w"])
 
 
+def test_put_transport_segment_roundtrip():
+    """Round 22 put-path primitives: ``put_write`` lands buffers in
+    one pid-prefixed shm segment, ``put_read`` maps them back
+    byte-identical AND unlinks at open (on-disk segments exist only
+    in flight), ``release`` is idempotent and balances the open
+    counter, and ``put_sweep`` reclaims an unreceived segment by its
+    writer's pid."""
+    import glob
+    from mxnet_tpu.serving.transport import (
+        PUT_DIR, PUT_STATS, put_read, put_sweep, put_write)
+
+    bufs = [np.arange(64, dtype=np.float32).tobytes(),
+            np.arange(5, dtype=np.int8).tobytes()]
+    path, sizes = put_write(bufs)
+    assert os.path.exists(path) and sizes == [256, 5]
+    assert str(os.getpid()) in os.path.basename(path)
+    got = put_read(path, sizes)
+    assert not os.path.exists(path)       # unlinked AT open
+    assert bytes(got[0]) == bufs[0] and bytes(got[1]) == bufs[1]
+    opens, rels = PUT_STATS["opens"], PUT_STATS["releases"]
+    got.release()
+    got.release()                         # idempotent
+    assert PUT_STATS["releases"] == rels + 1
+    assert PUT_STATS["opens"] == opens
+    # a never-received segment sweeps by pid (the SIGKILL-recovery
+    # path the router runs for a killed worker)
+    path2, _ = put_write(bufs)
+    assert put_sweep(os.getpid()) >= 1
+    assert not os.path.exists(path2)
+    assert not glob.glob(os.path.join(
+        PUT_DIR, "mxserve-put-%d-*" % os.getpid()))
+
+
+def test_put_capability_negotiation():
+    """Eligibility is strictly both-sides-advertised + same shm
+    domain; MXNET_SERVE_TRANSPORT=socket kills the advertisement
+    entirely (the negotiated fallback every mismatch takes)."""
+    from mxnet_tpu.serving.transport import (put_capability,
+                                             put_eligible)
+    mine = put_capability()
+    assert mine is not None and mine["put_pages"]
+    assert put_eligible(mine, dict(mine))
+    assert not put_eligible(mine, None)
+    assert not put_eligible(None, dict(mine))
+    assert not put_eligible(mine, dict(mine, host="elsewhere"))
+    assert not put_eligible(mine, dict(mine, put_pages=False))
+    old = os.environ.get("MXNET_SERVE_TRANSPORT")
+    os.environ["MXNET_SERVE_TRANSPORT"] = "socket"
+    try:
+        assert put_capability() is None
+    finally:
+        if old is None:
+            del os.environ["MXNET_SERVE_TRANSPORT"]
+        else:
+            os.environ["MXNET_SERVE_TRANSPORT"] = old
+
+
+def test_put_transport_conn_handshake_and_frames():
+    """A live socket pair: caps frames record the peer capability on
+    the connection, a put-carrying frame materializes as zero-copy
+    views (body bytes NOT on the socket), and the receiver's recv
+    unlinked the segment."""
+    from mxnet_tpu.serving.transport import (Connection, Listener,
+                                             connect, put_write)
+    accepted, frames = [], []
+    evt = threading.Event()
+
+    def handler(conn):
+        conn.send_caps()
+        accepted.append(conn)
+        evt.set()
+        while True:
+            got = conn.recv()
+            if got is None:
+                return
+            frames.append(got)
+
+    lis = Listener().start(handler)
+    try:
+        c = connect(lis.host, lis.port)
+        c.send_caps()
+        caps = c.wait_caps(timeout=5.0)
+        assert caps is not None and caps["put_pages"]
+        assert evt.wait(5.0)
+        payload = [b"x" * 4096, b"y" * 128]
+        path, sizes = put_write(payload)
+        before = c.bytes_sent
+        c.send("pages", {"srid": (1, 0), "start": 0, "n": 1,
+                         "put": {"path": path, "sizes": sizes}}, ())
+        # body did NOT ride the socket: only the header went out
+        assert c.bytes_sent == before
+        srv = accepted[0]
+        deadline = time.time() + 5
+        while len(frames) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.peer_put is not None   # our caps recorded over there
+        kind, meta, bufs = frames[-1]
+        assert kind == "pages" and meta["n"] == 1
+        assert bytes(bufs[0]) == payload[0]
+        assert bytes(bufs[1]) == payload[1]
+        assert not os.path.exists(path)   # receiver unlinked at open
+        bufs.release()
+    finally:
+        lis.close()
+
+
+def test_page_receiver_releases_held_put_segments():
+    """The unified hold representation: a pool-dry hold keeps the
+    transport's buffers AS DELIVERED (no downgrade copy), and abort
+    releases put-backed holds — segment lifetime is bounded by
+    staging lifetime."""
+    from mxnet_tpu.serving.page_streamer import PageReceiver
+
+    class _Bufs(list):
+        def __init__(self, it):
+            super().__init__(it)
+            self.released = False
+
+        def release(self):
+            self.released = True
+
+    class _Cache:
+        def alloc(self, n):
+            return None                   # pool permanently dry
+
+        def free(self, ids):
+            pass
+
+    class _Eng:
+        cache = _Cache()
+
+    rec = PageReceiver(_Eng())
+    held = _Bufs([b"a", b"b"])
+    rec.on_pages((7, 0), 0, 1, held)
+    assert rec._staged[(7, 0)].held[0][1] is held   # no copy
+    assert not held.released
+    rec.abort((7, 0))
+    assert held.released
+
+
 def test_cluster_prefix_index_semantics():
     from mxnet_tpu.serving import ClusterPrefixIndex
     idx = ClusterPrefixIndex()
@@ -543,6 +683,57 @@ def test_disagg_preemption_resume_exact():
         _leak_check(cl)
     finally:
         cl.close()
+
+
+@pytest.mark.slow
+def test_disagg_put_vs_socket_transport_bit_identical():
+    """Round 22 tentpole pin: the same workload forced over the
+    /dev/shm put transport and over plain socket frames produces
+    BIT-IDENTICAL outputs (both equal to ``generate``), the put run
+    really put (pages_put == pages_streamed on the prefill side, 0 on
+    the socket run), zero page/ref leaks on both ends, and zero put
+    segments left on disk after either run."""
+    import glob
+    from mxnet_tpu.serving.transport import PUT_DIR
+
+    params, cfg = _tiny()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, int(P)).astype(np.int32)
+               for P in (5, 9, 17, 3)]
+    nnew = [6, 4, 8, 5]
+    outs = {}
+    old = os.environ.get("MXNET_SERVE_TRANSPORT")
+    try:
+        for mode in ("put", "socket"):
+            os.environ["MXNET_SERVE_TRANSPORT"] = mode
+            cl = _cluster(params, cfg, prefill=1, decode=1)
+            try:
+                rids = [cl.submit(p, n)
+                        for p, n in zip(prompts, nnew)]
+                outs[mode] = [cl.result(r, timeout=180)
+                              for r in rids]
+                st = cl.cluster_stats()
+                if mode == "put":
+                    assert st["prefill0"]["pages_put"] == \
+                        st["prefill0"]["pages_streamed"] > 0
+                    assert st["prefill0"]["put_bytes"] > 0
+                else:
+                    assert st["prefill0"]["pages_put"] == 0
+                assert st["decode0"]["pages_installed"] > 0
+                _leak_check(cl)
+            finally:
+                cl.close()
+            assert not glob.glob(
+                os.path.join(PUT_DIR, "mxserve-put-*")), \
+                "put segments left on disk after %s run" % mode
+    finally:
+        if old is None:
+            os.environ.pop("MXNET_SERVE_TRANSPORT", None)
+        else:
+            os.environ["MXNET_SERVE_TRANSPORT"] = old
+    for a, b, p, n in zip(outs["put"], outs["socket"], prompts, nnew):
+        assert np.array_equal(a, b)       # transport-invariant bytes
+        assert np.array_equal(a, _gen_ref(params, cfg, p, n))
 
 
 @pytest.mark.slow

@@ -166,6 +166,86 @@ def test_tp2_int8_kv_agreement():
 
 
 @pytest.mark.slow
+def test_pallas_tp_token_identical_vs_xla_and_generate():
+    """Round 22 chip-ready pin: the PALLAS-kernel engine at tp∈{2,4}
+    — ``paged_attention`` shard_map-lowered over the serving mesh,
+    each device walking its 1/tp heads slice of the sharded pool,
+    attention collective-free per head — decodes TOKEN-IDENTICALLY
+    (f32 greedy) to the tp=1 XLA engine and to ``generate`` through
+    mixed lengths and an in-flight join.  Interpreter-mode pallas on
+    the virtual mesh: the lowering is the thing under test, the
+    kernel body is the tier-1-pinned one."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup()
+    shapes = [(5, 8), (3, 12), (9, 4), (2, 6)]
+
+    def run(tp, kernel):
+        rng = np.random.RandomState(0)
+        eng = ServingEngine(params, cfg, num_slots=3, page_size=4,
+                            prefill_chunk=6, tp=tp, kernel=kernel)
+        reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32),
+                            N), N) for P, N in shapes[:3]]
+        for _ in range(3):
+            eng.step()
+        P, N = shapes[3]
+        reqs.append((eng.submit(
+            rng.randint(1, 90, P).astype(np.int32), N), N))
+        got = eng.run()
+        outs = [(got[rid], eng.requests[rid].prompt, N)
+                for rid, N in reqs]
+        assert eng.cache.pages_in_use == 0
+        return outs
+
+    base = run(1, "xla")
+    for tp in (2, 4):
+        for (op, prompt, N), (ox, _, _) in zip(run(tp, "pallas"),
+                                               base):
+            np.testing.assert_array_equal(op, ox)   # pallas tpN == xla tp1
+            np.testing.assert_array_equal(
+                op, _ref(params, cfg, prompt, N))   # == generate
+
+
+@pytest.mark.slow
+def test_pallas_tp2_speculation_and_int8():
+    """The pallas×tp capability COMPOSES: spec_K=1 draft rows ride the
+    shard_map-lowered kernel token-identically to generate (no gate —
+    draft rows are just extra T rows in the same grid), and int8-KV
+    pages with the retiled (pages, 2, ps, H) scale planes dequantize
+    inside the sharded walk with the same greedy agreement the XLA
+    tp=2 path pins."""
+    from mxnet_tpu.serving import ServingEngine
+
+    params, cfg = _setup(seed=3)
+    rng = np.random.RandomState(1)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=4,
+                        prefill_chunk=6, tp=2, spec_K=1,
+                        kernel="pallas")
+    reqs = [(eng.submit(rng.randint(1, 90, P).astype(np.int32), N), N)
+            for P, N in [(5, 10), (3, 12)]]
+    outs = eng.run()
+    assert eng.stats["spec_drafted"] > 0
+    for rid, N in reqs:
+        np.testing.assert_array_equal(
+            outs[rid], _ref(params, cfg, eng.requests[rid].prompt, N))
+
+    params8, cfg8 = _setup(seed=11, vocab_size=512, d_model=128,
+                           n_heads=4, n_layers=3, d_ff=256)
+    rng = np.random.RandomState(4)
+    eng = ServingEngine(params8, cfg8, num_slots=2, page_size=4,
+                        kv_int8=True, prefill_chunk=8, tp=2,
+                        kernel="pallas")
+    reqs = [eng.submit(rng.randint(1, 500, P).astype(np.int32), 12)
+            for P in (5, 7)]
+    outs = eng.run()
+    for rid in reqs:
+        ref = _ref(params8, cfg8, eng.requests[rid].prompt, 12,
+                   kv_int8=True)
+        assert (outs[rid] == ref).mean() >= 0.9, (outs[rid], ref)
+    assert len(eng.cache.pools[0]["s"].addressable_shards) == 2
+
+
+@pytest.mark.slow
 def test_tp2_speculation_token_identical():
     """In-engine speculation rides the sharded step unchanged: draft
     rows feed the same mesh-lowered program, per-row verify/commit and
@@ -234,8 +314,11 @@ def test_tp2_per_device_bytes_halve():
 @pytest.mark.slow
 def test_tp_validation():
     """Clear errors at the boundary: a tp that does not divide the
-    heads, the tp=1-only Pallas kernel path, a mesh without a 'tp'
-    axis, tp/mesh disagreement, and a tp past the visible devices."""
+    heads, a mesh without a 'tp' axis, tp/mesh disagreement, and a tp
+    past the visible devices.  Round 22: the old blanket
+    pallas×tp>1 error is GONE — the capability check is mesh present
+    + heads divisible, and a pallas tp=2 engine constructs (the
+    identity pins below prove it decodes)."""
     from mxnet_tpu.base import MXNetError
     from mxnet_tpu.parallel.mesh import make_mesh, serving_mesh
     from mxnet_tpu.serving import ServingEngine
@@ -243,9 +326,16 @@ def test_tp_validation():
     params, cfg = _setup()
     with pytest.raises(ValueError, match="n_heads"):
         ServingEngine(params, cfg, num_slots=1, page_size=4, tp=3)
-    with pytest.raises(ValueError, match="pallas.*tp=1"):
-        ServingEngine(params, cfg, num_slots=1, page_size=4, tp=2,
+    # heads-divisibility is kernel-independent (the pallas shard_map
+    # walks H/tp heads per device; 4 heads over tp=3 has no whole
+    # slice either way)
+    with pytest.raises(ValueError, match="n_heads"):
+        ServingEngine(params, cfg, num_slots=1, page_size=4, tp=3,
                       kernel="pallas")
+    # mesh-lowered pallas is a supported combination now
+    eng_p = ServingEngine(params, cfg, num_slots=1, page_size=4,
+                          tp=2, kernel="pallas")
+    assert eng_p.tp == 2 and eng_p.mesh is not None
     with pytest.raises(ValueError, match="no 'tp' axis"):
         ServingEngine(params, cfg, num_slots=1, page_size=4,
                       mesh=make_mesh({"dp": -1}))
@@ -348,9 +438,10 @@ def test_step_input_specs_mesh_free():
     specs = step_input_specs(params, cfg, kv_int8=True)
     pspec, pools = specs[0], specs[1]
     assert len(specs) == 8
-    # pools: heads axis (index 2) over tp, nothing else
+    # pools: heads axis over tp, nothing else — index 2 on the kv
+    # layout, index 3 on the retiled (pages, 2, ps, H) scale planes
     assert all(pool["kv"] == P(None, None, "tp", None)
-               and pool["s"] == P(None, None, "tp", None)
+               and pool["s"] == P(None, None, None, "tp")
                for pool in pools)
     assert len(pools) == cfg.n_layers
     # host-built rows replicate

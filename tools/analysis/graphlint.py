@@ -213,7 +213,8 @@ def _abstract_pools(cfg, num_pages):
     dh = cfg.d_model // H
     return [{"kv": jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2 * dh),
                                         jnp.int8),
-             "s": jax.ShapeDtypeStruct((num_pages, _PAGE, H, 2),
+             # round-22 tile-shaped scale planes (serving/paged_kv.py)
+             "s": jax.ShapeDtypeStruct((num_pages, 2, _PAGE, H),
                                        jnp.float32)}
             for _ in range(cfg.n_layers)]
 
@@ -315,6 +316,54 @@ def build_serving_step_tp():
     return fn, args
 
 
+def build_serving_step_pallas_tp():
+    """Round 22: the PALLAS serving step lowered through the tp mesh
+    — ``paged_attention`` shard_map'ed so each device walks its 1/tp
+    heads slice of the heads-sharded pool (attention collective-free
+    per head; the wo psum stays outside the kernel).  Donation of the
+    sharded pools must survive BOTH the shard_map and the pallas_call
+    inside it, and the per-device peak divides like the XLA tp
+    entry's."""
+    from mxnet_tpu.serving.engine import _make_step
+    cfg = _gpt_cfg()
+    pps, n_rows, _ = _serve_geometry(cfg)
+    args = _serving_step_args(cfg)
+    fn = _make_step(cfg, _SLOTS, n_rows, pps, _PAGE, True,
+                    kernel="pallas", n_sample=1 + _SPEC_K,
+                    mesh=_registry_mesh(), params=args[0])
+    return fn, args
+
+
+def build_serving_page_install_put():
+    """The put-transport install (round 22): page content that
+    arrived as zero-copy ``/dev/shm`` views rides a ``device_put``
+    into the SAME donated whole-page scatter the socket path runs —
+    one program for both transports is the bit-identity argument.
+    Registered separately so the zero-copy path's donation is gated
+    on its own: a regression that copies the pools here would erase
+    exactly the bytes the put saved."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.paged_kv import _make_install
+    cfg = _gpt_cfg()
+    _, _, num_pages = _serve_geometry(cfg)
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    b = 4
+    base = _make_install(cfg, True, b)
+    fn = jax.jit(
+        lambda pools, ids, content: base(
+            pools, ids, jax.tree_util.tree_map(jnp.asarray, content)),
+        donate_argnums=(0,))
+    content = [{"kv": jax.ShapeDtypeStruct((b, _PAGE, H, 2 * dh),
+                                           jnp.int8),
+                "s": jax.ShapeDtypeStruct((b, 2, _PAGE, H),
+                                          jnp.float32)}
+               for _ in range(cfg.n_layers)]
+    return fn, (_abstract_pools(cfg, num_pages),
+                jax.ShapeDtypeStruct((b,), jnp.int32), content)
+
+
 def build_serving_page_install():
     """The disaggregated page-install scatter (round 15): received
     page content lands in the donated pools in place — same
@@ -332,7 +381,7 @@ def build_serving_page_install():
     fn = _make_install(cfg, True, b)
     content = [{"kv": jax.ShapeDtypeStruct((b, _PAGE, H, 2 * dh),
                                            jnp.int8),
-                "s": jax.ShapeDtypeStruct((b, _PAGE, H, 2),
+                "s": jax.ShapeDtypeStruct((b, 2, _PAGE, H),
                                           jnp.float32)}
                for _ in range(cfg.n_layers)]
     return fn, (_abstract_pools(cfg, num_pages),
@@ -359,7 +408,7 @@ def build_tier_page_restore():
     fn = _make_install(cfg, True, b)
     content = [{"kv": jax.ShapeDtypeStruct((b, _PAGE, H, 2 * dh),
                                            jnp.int8),
-                "s": jax.ShapeDtypeStruct((b, _PAGE, H, 2),
+                "s": jax.ShapeDtypeStruct((b, 2, _PAGE, H),
                                           jnp.float32)}
                for _ in range(cfg.n_layers)]
     return fn, (_abstract_pools(cfg, num_pages),
@@ -562,10 +611,23 @@ def live_programs() -> List[ProgramSpec]:
              donate=(1,), dtype_region="int8", f32_allow=acc),
         spec("serving_step_tp", build_serving_step_tp, donate=(1,),
              dtype_region="int8", f32_allow=acc),
+        # round 22: the mesh-lowered PALLAS step — the chip-ready
+        # data path; donation through shard_map + pallas_call gated
+        # like the XLA tp entry, per-device peak recorded ÷tp
+        spec("serving_step_pallas_tp2", build_serving_step_pallas_tp,
+             donate=(1,), dtype_region="int8", f32_allow=acc,
+             extra_closure=("mxnet_tpu/parallel/mesh.py",)),
         spec("cow_page_copy", build_cow_page_copy, donate=(0,),
              dtype_region="int8", f32_allow={}),
         spec("serving_page_install", build_serving_page_install,
              donate=(0,), dtype_region="int8", f32_allow={}),
+        # round 22: the same install scatter as the put transport
+        # drives it (device_put of mapped segment views)
+        spec("serving_page_install_put",
+             build_serving_page_install_put,
+             donate=(0,), dtype_region="int8", f32_allow={},
+             extra_closure=("mxnet_tpu/serving/transport.py",
+                            "mxnet_tpu/serving/page_streamer.py")),
         spec("tier_page_restore", build_tier_page_restore,
              donate=(0,), dtype_region="int8", f32_allow={}),
         spec("gpt_generate", build_gpt_generate,
@@ -910,11 +972,12 @@ def _per_device_expected_peaks(sp, peak: int) -> Optional[Dict]:
     point it pins is that the DOMINANT resident state (pools +
     weights) divides by tp.
 
-    Recorded only for the mesh-lowerable entries: the Pallas step is
-    tp=1-only this round (the engine rejects kernel='pallas' with
-    tp>1), so advertising ÷tp numbers for it would describe an
-    unreachable configuration."""
-    if sp.name not in ("serving_step", "serving_step_tp"):
+    Recorded for every mesh-lowerable step entry — round 22 made the
+    Pallas step one of them (``paged_attention`` shard_maps over the
+    mesh, each device walking its 1/tp heads slice), so its manifest
+    row carries ÷tp numbers like the XLA entries'."""
+    if sp.name not in ("serving_step", "serving_step_tp",
+                       "serving_step_pallas_tp2"):
         return None
     import jax
     from jax.sharding import PartitionSpec as P
@@ -1095,7 +1158,11 @@ def _sharding_rows(cfg):
     rules = _partition_rules(cfg)
     args = _serving_step_args(cfg)
     declared = E.step_input_specs(args[0], cfg, kv_int8=True)
-    heads_axis = 2              # pools: (pages, page_size, H, 2*dh)
+    # per-pool-key heads axis: kv (pages, page_size, H, 2*dh) shards
+    # axis 2; the round-22 tile-shaped scale planes (pages, 2,
+    # page_size, H) shard axis 3 — graphlint derives the expectation
+    # from the pool layout itself, independent of the engine's table
+    heads_axis_by_key = {"kv": 2, "s": 3}
 
     rows: List[Tuple[str, str, str, int, str]] = []
     counts = {"covered": 0, "derived": 0, "uncovered": 0,
@@ -1152,6 +1219,8 @@ def _sharding_rows(cfg):
                     counts["derived"] += 1
             elif name == "pools":
                 entries = tuple(spec)
+                m = re.search(r"\['(kv|s)'\]$", ks)
+                heads_axis = heads_axis_by_key[m.group(1)] if m else 2
                 ok = (len(entries) > heads_axis
                       and entries[heads_axis] == "tp"
                       and all(e is None for i, e in enumerate(entries)

@@ -155,6 +155,17 @@ HOT_REGIONS: List[Tuple[str, str]] = [
      r"|_cancel_disconnected|_serve_conn|_conn_loop"
      r"|_handle_generate)$"),
     ("benchmark/http_bench.py", r".*"),
+    # round 22: the zero-copy put transport and its cluster data-plane
+    # callers run per page frame between the prefill and decode engine
+    # loops — segment write/mmap-read and the caps/put framing must
+    # stay pure host work (the device hand-off is the install scatter,
+    # already covered via paged_kv.install_pages), and the peer-fetch
+    # / stream / fetch-serve methods that choose the transport sit on
+    # the worker main loop where a stray sync stalls decode admission
+    ("mxnet_tpu/serving/transport.py", r".*"),
+    ("mxnet_tpu/serving/cluster.py",
+     r"(?:.*\.)?(_send_pages_frame|_serve_fetches|_stream_pages"
+     r"|_fetch_remote|_peer_handler|_peer_conn)$"),
 ]
 
 # modules whose timestamps must stay on the shared perf_counter clock

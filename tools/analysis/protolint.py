@@ -652,6 +652,28 @@ class _Program:
                     recv = node.func.value
                     meta = node.args[1] if len(node.args) > 1 else None
                     bufs = node.args[2] if len(node.args) > 2 else None
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and \
+                        node.func.attr == "_send_pages_frame" and \
+                        len(node.args) >= 3:
+                    # round 22: the put-or-socket page-frame wrapper —
+                    # semantically `args[0].send(args[1], args[2],
+                    # args[3])`, with the transport choosing between
+                    # inline bufs and a shm-segment `put` meta key
+                    kind = _str_const(node.args[1])
+                    recv = node.args[0]
+                    meta = node.args[2]
+                    bufs = node.args[3] if len(node.args) > 3 else None
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and \
+                        node.func.attr == "send_caps":
+                    # round 22: the data-plane capability handshake —
+                    # `conn.send("caps", {"put": put_capability()})`
+                    # wrapped in transport.Connection.send_caps
+                    kind = "caps"
+                    recv = node.func.value
+                    meta = ast.Dict(keys=[ast.Constant("put")],
+                                    values=[ast.Constant(None)])
                 elif isinstance(node, ast.Tuple) and \
                         len(node.elts) == 2 and \
                         isinstance(node.elts[1], ast.Tuple) and \
@@ -824,6 +846,13 @@ def _contains_reply_send(stmt: ast.AST, reply: str) -> bool:
         if isinstance(n, ast.Call) and isinstance(
                 n.func, ast.Attribute) and n.func.attr == "send" \
                 and n.args and _str_const(n.args[0]) == reply:
+            return True
+        # transport-selecting wrapper: the kind rides in arg 1
+        # (`self._send_pages_frame(conn, "fetch_reply", meta, bufs)`)
+        if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and \
+                n.func.attr == "_send_pages_frame" and \
+                len(n.args) >= 2 and _str_const(n.args[1]) == reply:
             return True
         if isinstance(n, ast.Tuple) and len(n.elts) >= 2 and \
                 _str_const(n.elts[0]) == reply and isinstance(
@@ -1451,6 +1480,26 @@ def protocol_audit_md(root: str) -> str:
         "(`_send_stats`",
         "replies unconditionally; the periodic rate limit lives in "
         "`_maybe_send_stats`).",
+        "",
+        "Zero-copy page puts (round 22): `caps` is the FIRST frame "
+        "both directions on",
+        "every worker ↔ worker data-plane connection and advertises "
+        "the `put_pages`",
+        "capability (`transport.put_capability`).  When both ends "
+        "advertise it for the",
+        "same host+segment dir, `pages` and `fetch_reply` bufs ride "
+        "a `/dev/shm`",
+        "segment named in the meta `put` key instead of socket "
+        "frames — the receiver",
+        "mmaps and unlinks the segment at open, so on-disk segments "
+        "≈ frames in",
+        "flight, and `transport.put_sweep(pid)` reclaims a killed "
+        "sender's leftovers.",
+        "Everything above the transport (kinds, meta schema, gen "
+        "fences, reply",
+        "pairings, stale-frame drops) is bit-identical across the "
+        "two paths;",
+        "`MXNET_SERVE_TRANSPORT=socket` forces the frame path.",
         "",
     ]
     return "\n".join(lines)
