@@ -342,6 +342,32 @@ def bench_gpt_serve_put_remote_hit():
     return row["ttft_remote_hit_ms"]
 
 
+def bench_gpt_serve_trace_overhead():
+    """Observability-tax gate (round 23): percent tok/s cost of
+    default-on tracing — per-worker flight-recorder rings, span
+    batches shipped to the router on stats ticks, the router's span
+    store — on the seeded closed-loop disagg pair
+    (serve_bench.run_gate_trace_overhead, full preset).  The run
+    underneath hard-fails unless the toggle demonstrably took on both
+    sides (the on run ships spans and holds a live flight ring; the
+    off run does neither) and both runs are token-BIT-identical — the
+    off path must be the untraced path, not a cheaper trace.  The
+    gate VALUE is only the tax.  Direction "lower": v <= hi; noise on
+    a loaded host runs a few percent either way, so the budget is
+    sized as a ceiling on the emit paths, not a micro-benchmark.
+    Reproducibility enforced like the goodput gate's: the row must
+    carry seed + prompts sha or the gate refuses."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import serve_bench
+    row = serve_bench.run_gate_trace_overhead("full")
+    if not row.get("prompts_sha") or "seed" not in row:
+        raise RuntimeError(
+            "gpt_serve_trace_overhead_pct: result row carries no "
+            "seed/prompts sha — the measurement is not reproducible; "
+            "refusing to gate it (got keys %s)" % sorted(row))
+    return row["trace_overhead_pct"]
+
+
 def bench_gpt_serve_pallas_tp2_step():
     """Mesh-lowered kernel gate (round 22): engine-internal step-time
     p50 of the decode-heavy closed-loop pallas run at tp=2 — the
@@ -547,6 +573,8 @@ BENCHES = {
         (bench_gpt_serve_put_remote_hit, "lower"),
     "gpt_serve_pallas_tp2_step_ms":
         (bench_gpt_serve_pallas_tp2_step, "lower"),
+    "gpt_serve_trace_overhead_pct":
+        (bench_gpt_serve_trace_overhead, "lower"),
     "gpt_serve_goodput": (bench_gpt_serve_goodput, "higher"),
     "gpt_serve_tier_hit_ttft_ms": (bench_gpt_serve_tier_hit,
                                    "lower"),

@@ -108,14 +108,30 @@ and external measurements subtract cleanly.
   ALONE (it owns the replica topology); the row carries the trace
   seed + sha256 so ``MULTICHIP_r08.json`` reproduces from the
   checked-in seed (docs/perf.md "Traffic realism").
+* ``trace_overhead`` (round 23, ``--trace-overhead``) — the
+  observability-tax pair: the SAME seeded closed-loop disagg
+  measurement run with the flight recorder + span shipping at their
+  defaults ("on") and with ``MXNET_SERVE_FLIGHT_SLOTS=0`` +
+  ``MXNET_SERVE_SPANS=0`` exported before the cluster spawns
+  ("off"), cross-mode token identity hard-enforced (the tracing-off
+  serving path must be BIT-identical — tracing may cost time, never
+  tokens) plus a both-ways toggle reconciliation (the on run must
+  actually ship spans; the off run must ship none).  The on row's
+  ``trace_overhead_pct`` is the ``gpt_serve_trace_overhead_pct``
+  gate.  Runs ALONE (cross-process clusters own the host).
+  ``--chrome-trace FILE --disagg`` additionally profiles the disagg
+  section's Poisson run and dumps the ONE merged chrome trace —
+  router (real pid) + per-worker + transport swimlanes on the
+  handshake-reconciled clock — with a lane-coverage smoke check.
 
 The ``gpt_serve_mixed_tok_s`` / ``gpt_serve_p99_ms`` /
 ``gpt_serve_metrics_overhead_pct`` / ``gpt_serve_prefix_hit_ttft_ms``
-/ ``gpt_serve_decode_step_ms`` / ``gpt_serve_goodput`` gates
+/ ``gpt_serve_decode_step_ms`` / ``gpt_serve_goodput`` /
+``gpt_serve_trace_overhead_pct`` gates
 (benchmark/perf_regression.py) run ``run_gate()`` /
 ``run_gate_telemetry()`` / ``run_gate_prefix()`` /
-``run_gate_decode_step()`` / ``run_gate_goodput()`` below on the
-full-size preset.
+``run_gate_decode_step()`` / ``run_gate_goodput()`` /
+``run_gate_trace_overhead()`` below on the full-size preset.
 """
 import argparse
 import dataclasses
@@ -1028,6 +1044,149 @@ def run_gate_put_transport(preset="full", seed=0):
     rows = run_transport_ablation(PRESETS[preset], seed=seed)
     row = next(r for r in rows if r["transport"] == "put")
     _put_gate_cache[key] = row
+    return row
+
+
+# ----------------------------------- round-23 observability overhead ---
+
+
+def run_trace_overhead(p, seed=0):
+    """The ``--trace-overhead`` pair (round 23): one seeded
+    closed-loop measurement on the cross-process cluster (2 prefill +
+    1 decode workers, sequential submits — every request's full
+    lifecycle prices the span/flight emit paths), run twice:
+
+    * ``on``  — observability at its defaults: every worker records
+      into its flight ring and ships span batches on the stats tick;
+      the router folds them into the span store.
+    * ``off`` — ``MXNET_SERVE_FLIGHT_SLOTS=0`` and
+      ``MXNET_SERVE_SPANS=0`` exported BEFORE the cluster constructs,
+      so the spawned worker processes inherit the kill switch.
+
+    Two reconciliations hard-fail the section (RuntimeError): the
+    toggle must demonstrably TAKE on both sides (the on run ships >0
+    spans and exposes a live flight path via debug_status; the off
+    run ships none and exposes no path), and every request's tokens
+    must be bit-identical across the modes — tracing may cost time,
+    never tokens.  ``trace_overhead_pct`` = wall-clock tax of the on
+    run vs the off run; the gated budget is
+    ``gpt_serve_trace_overhead_pct`` (direction "lower")."""
+    import hashlib
+    from mxnet_tpu.serving import DisaggServingCluster
+    params, cfg = _model(p)
+    rng = np.random.RandomState(seed)
+    P = (max(p.prompt_lens) // p.page_size) * p.page_size
+    N = 8
+    prompts = [rng.randint(1, p.vocab, P).astype(np.int32)
+               for _ in range(3)]
+    sha = hashlib.sha256()
+    for pr in prompts:
+        sha.update(pr.tobytes())
+    geo = _engine_geometry(p, [(0.0, prompts[0], N)],
+                           section="trace-overhead")
+    env_keys = ("MXNET_SERVE_FLIGHT_SLOTS", "MXNET_SERVE_SPANS")
+    prev = {k: os.environ.get(k) for k in env_keys}
+    rows, outs = [], {}
+    try:
+        for mode in ("on", "off"):
+            for k in env_keys:
+                if mode == "off":
+                    os.environ[k] = "0"
+                else:
+                    os.environ.pop(k, None)   # library defaults
+            cl = DisaggServingCluster(params, cfg, prefill=2,
+                                      decode=1, metrics=True,
+                                      watchdog_s=60.0, **geo)
+            try:
+                toks, rids = [], []
+                t0 = time.perf_counter()
+                for _ in range(2):            # each prompt cold+hit
+                    for pr in prompts:
+                        rid = cl.submit(pr, N)
+                        rids.append(rid)
+                        toks.append(np.asarray(
+                            cl.result(rid, timeout=600)))
+                wall = time.perf_counter() - t0
+                ttft = [(cl.requests[rid].first_token_t
+                         - cl.requests[rid].submit_t) * 1e3
+                        for rid in rids]
+                # toggle reconciliation: spans ride the 0.25 s stats
+                # tick, so poll past one tick before concluding
+                deadline = time.perf_counter() + 10.0
+                while True:
+                    n_spans = sum(
+                        len(cl.request_trace(rid)["spans"])
+                        for rid in rids)
+                    if n_spans or time.perf_counter() > deadline:
+                        break
+                    time.sleep(0.05)
+                flight_path = cl.debug_status()["flight"]["path"]
+            finally:
+                cl.close()
+            outs[mode] = toks
+            if mode == "on" and not (n_spans and flight_path):
+                raise RuntimeError(
+                    "serve_bench --trace-overhead: the on run shipped "
+                    "%d span(s), flight path %r — observability was "
+                    "not actually live on the measured path"
+                    % (n_spans, flight_path))
+            if mode == "off" and (n_spans or flight_path):
+                raise RuntimeError(
+                    "serve_bench --trace-overhead: the off run "
+                    "shipped %d span(s), flight path %r — the env "
+                    "kill switch did not reach the workers"
+                    % (n_spans, flight_path))
+            p50, p99 = _lat_stats(ttft)
+            rows.append({
+                "section": "trace_overhead",
+                "config": "trace_%s" % mode,
+                "preset": p.name, "obs": mode, "seed": seed,
+                "prompts_sha": sha.hexdigest()[:16],
+                "prompt_len": P, "requests": len(rids),
+                "tok_s": len(rids) * N / wall, "wall_s": wall,
+                "ttft_p50_ms": p50, "ttft_p99_ms": p99,
+                "spans_shipped": int(n_spans),
+                "flight_live": flight_path is not None})
+    finally:
+        for k in env_keys:
+            if prev[k] is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prev[k]
+    mismatches = sum(not np.array_equal(a, b)
+                     for a, b in zip(outs["on"], outs["off"]))
+    if mismatches:
+        raise RuntimeError(
+            "serve_bench --trace-overhead: %d/%d requests diverge "
+            "between observability on and off — the tracing-off "
+            "serving path must be bit-identical"
+            % (mismatches, len(outs["on"])))
+    by = {r["obs"]: r for r in rows}
+    pct = 100.0 * (by["off"]["tok_s"] / by["on"]["tok_s"] - 1.0)
+    for r in rows:
+        r["trace_overhead_pct"] = pct
+        r["identity_checked"] = len(outs["on"])
+        r["identity_mismatches"] = 0
+    return rows
+
+
+_trace_overhead_gate_cache = {}
+
+
+def run_gate_trace_overhead(preset="full", seed=0):
+    """The ``gpt_serve_trace_overhead_pct`` gate: tok/s tax of
+    default-on observability (flight ring + span shipping + router
+    span store) on the seeded closed-loop disagg pair, in percent.
+    Direction "lower": v <= hi.  Hard-fails unless the toggle took on
+    both sides and the two runs were token-bit-identical (the full
+    --trace-overhead reconciliation runs underneath).  The row
+    carries seed + prompts sha for MULTICHIP provenance."""
+    key = (preset, seed)
+    if key in _trace_overhead_gate_cache:
+        return _trace_overhead_gate_cache[key]
+    rows = run_trace_overhead(PRESETS[preset], seed=seed)
+    row = next(r for r in rows if r["obs"] == "on")
+    _trace_overhead_gate_cache[key] = row
     return row
 
 
@@ -2104,6 +2263,15 @@ def main(argv=None):
                          "identity + put-coverage reconciliation "
                          "hard-enforced); runs ALONE like the other "
                          "cross-process sections")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="run the round-23 observability-tax pair "
+                         "(same seeded closed-loop disagg run with "
+                         "flight recorder + span shipping on vs "
+                         "killed via MXNET_SERVE_FLIGHT_SLOTS=0 / "
+                         "MXNET_SERVE_SPANS=0, cross-mode token "
+                         "identity + toggle reconciliation "
+                         "hard-enforced); runs ALONE like the other "
+                         "cross-process sections")
     ap.add_argument("--overlap-ablation", action="store_true",
                     help="run the round-21 serial-vs-overlapped "
                          "decode-step ablation section (closed loop, "
@@ -2154,7 +2322,11 @@ def main(argv=None):
                          "combined chrome-trace (op events + request "
                          "lifecycle spans) to FILE (renamed from "
                          "--trace in round 16 — --trace now replays "
-                         "workload traces)")
+                         "workload traces).  With --disagg the dump "
+                         "instead covers the disagg Poisson run: ONE "
+                         "merged trace with router, per-worker, and "
+                         "transport swimlanes on the "
+                         "handshake-reconciled clock (round 23)")
     ap.add_argument("--trace", default=None, metavar="FILE|burst10x",
                     help="run the round-16 trace-replay section "
                          "ALONE: open-loop replay of a workload "
@@ -2307,6 +2479,30 @@ def main(argv=None):
                 json.dump(rows, f, indent=1)
         return 0
 
+    if args.trace_overhead:
+        # runs ALONE for the same reason as --transport-ablation: two
+        # cross-process clusters back to back own the host, and the
+        # pair's delta IS the number — background sections would
+        # drown it
+        tr = run_trace_overhead(p, seed=args.seed)
+        rows.extend(tr)
+        for r in tr:
+            print(json.dumps(r), flush=True)
+        on = next(r for r in tr if r["obs"] == "on")
+        off = next(r for r in tr if r["obs"] == "off")
+        print("trace overhead: obs-on %.0f tok/s vs obs-off %.0f "
+              "tok/s (%.1f%% tax; %d spans shipped, flight ring "
+              "live); %d/%d token-identical across modes (the gated "
+              "budget is gpt_serve_trace_overhead_pct)"
+              % (on["tok_s"], off["tok_s"],
+                 on["trace_overhead_pct"], on["spans_shipped"],
+                 on["identity_checked"] - on["identity_mismatches"],
+                 on["identity_checked"]), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rows, f, indent=1)
+        return 0
+
     if args.trace:
         # the trace-replay section runs ALONE: it owns the replica
         # topology (autoscaler!) and its goodput numbers assume the
@@ -2420,11 +2616,13 @@ def main(argv=None):
         # runs inside run_engine and raises on >10% p99 divergence)
         t = run_engine(params, cfg, p, wl, num_pages=pages,
                        metrics=True)
-        if args.chrome_trace:
+        if args.chrome_trace and not args.disagg:
             # a SEPARATE profiled run produces the dump: tracing has
             # its own per-step cost (event construction + locked
             # appends) that must not contaminate the telemetry row's
-            # overhead number above
+            # overhead number above.  With --disagg the dump is the
+            # disagg section's MERGED trace instead — one file per
+            # invocation, one dump
             from mxnet_tpu import profiler
             profiler.set_config(filename=args.chrome_trace)
             profiler.set_state("run")
@@ -2585,6 +2783,15 @@ def main(argv=None):
               "(%.2fx) on a %d-token prompt fetched cross-process"
               % (dg["ttft_remote_hit_ms"], dg["ttft_cold_ms"],
                  dg["speedup"], dg["prompt_len"]), flush=True)
+        if args.chrome_trace:
+            # round 23: the merged-dump smoke — profile the Poisson
+            # run so worker span batches (shipped on stats ticks,
+            # clock-corrected by the handshake ping-pong) land in ONE
+            # router-side trace next to the router's own real-pid
+            # request lanes
+            from mxnet_tpu import profiler
+            profiler.set_config(filename=args.chrome_trace)
+            profiler.set_state("run")
         d = run_disagg(params, cfg, p, wl_d, prefill=2, decode=1,
                        seed=args.seed)
         d.update(section="disagg", config="disagg_p2_d1")
@@ -2599,6 +2806,53 @@ def main(argv=None):
                  d["prefix_remote_hits"],
                  d["prefix_remote_hit_tokens"],
                  d["prefilled_once_margin_tokens"]), flush=True)
+        if args.chrome_trace:
+            import hashlib
+            from mxnet_tpu.obs.trace import LANE_PID_BASE
+            profiler.set_state("stop")
+            path = profiler.dump()
+            with open(path) as f:
+                evs = json.load(f)["traceEvents"]
+            lanes = sorted({e["args"]["name"] for e in evs
+                            if e.get("ph") == "M"
+                            and e.get("name") == "process_name"
+                            and e.get("pid", 0) >= LANE_PID_BASE})
+            worker_lanes = [l for l in lanes if l != "transport"]
+            router_evs = sum(e.get("pid", 0) < LANE_PID_BASE
+                             for e in evs)
+            # lane-coverage smoke: the acceptance shape is router +
+            # every worker + (when pages moved cross-process) the
+            # transport lane, all in one file
+            if len(worker_lanes) < 3 or not router_evs:
+                raise RuntimeError(
+                    "serve_bench --disagg --chrome-trace: merged "
+                    "dump has worker lanes %r and %d router-pid "
+                    "events — expected all 3 workers plus the "
+                    "router's own lane" % (lanes, router_evs))
+            if d["prefix_remote_hits"] and "transport" not in lanes:
+                raise RuntimeError(
+                    "serve_bench --disagg --chrome-trace: %d remote "
+                    "hits moved pages cross-process but no transport "
+                    "swimlane reached the merged dump"
+                    % d["prefix_remote_hits"])
+            sha = hashlib.sha256()
+            for _, pr, _ in wl_d:
+                sha.update(np.asarray(pr, np.int32).tobytes())
+            mrow = {"section": "disagg",
+                    "config": "disagg_chrome_trace",
+                    "preset": p.name, "seed": args.seed,
+                    "prompts_sha": sha.hexdigest()[:16],
+                    "trace_file": path,
+                    "trace_events": len(evs),
+                    "router_events": int(router_evs),
+                    "merged_lanes": lanes}
+            rows.append(mrow)
+            print(json.dumps(mrow), flush=True)
+            print("merged chrome trace written to %s: %d events; "
+                  "router lane + swimlanes %s (seed %d, prompts sha "
+                  "%s)" % (path, len(evs), ", ".join(lanes),
+                           args.seed, mrow["prompts_sha"]),
+                  flush=True)
 
     if args.json:
         with open(args.json, "w") as f:

@@ -23,7 +23,10 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_MS_BUCKETS, sanitize_name)
 from .prometheus import (default_registry, engine_registries,
                          prometheus_text, register_engine_registry)
-from .trace import RequestTraceEmitter, REQ_TID_BASE
+from .trace import (RequestTraceEmitter, REQ_TID_BASE, SpanBuffer,
+                    MergedTraceEmitter, LANE_PID_BASE)
+from .flight import (FlightRecorder, flight_path, read_flight,
+                     flight_recover, flight_sweep)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -31,4 +34,7 @@ __all__ = [
     "default_registry", "engine_registries", "prometheus_text",
     "register_engine_registry",
     "RequestTraceEmitter", "REQ_TID_BASE",
+    "SpanBuffer", "MergedTraceEmitter", "LANE_PID_BASE",
+    "FlightRecorder", "flight_path", "read_flight",
+    "flight_recover", "flight_sweep",
 ]

@@ -139,11 +139,15 @@ class ClusterRequest:
                  "committed", "output", "error", "done_evt",
                  "submit_t", "first_token_t", "token_times",
                  "affinity_keys", "failovers", "delivered",
-                 "stream", "listeners", "cancel_req")
+                 "stream", "listeners", "cancel_req", "trace_id")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, deadline,
-                 affinity_keys):
+                 affinity_keys, trace_id=None):
         self.rid = rid
+        # edge-minted trace context (round 23): defaults to a
+        # rid-derived id so direct submit() callers trace too
+        self.trace_id = trace_id if trace_id is not None \
+            else "rid%d" % rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -441,7 +445,8 @@ class ServingCluster:
             eng.reset_metrics()
 
     # ------------------------------------------------------- intake --
-    def submit(self, prompt, max_new_tokens, eos_id=None, ttl_s=None):
+    def submit(self, prompt, max_new_tokens, eos_id=None, ttl_s=None,
+               trace_id=None):
         """Queue a request; returns its cluster rid immediately.
         Raises :class:`ClusterOverloaded` when the bounded admission
         queue is full and :class:`ClusterClosed` after close()."""
@@ -502,7 +507,7 @@ class ServingCluster:
                 else time.perf_counter() + float(ttl_s)
             cr = ClusterRequest(self._next_rid, prompt,
                                 int(max_new_tokens), eos_id, deadline,
-                                keys)
+                                keys, trace_id=trace_id)
             self._next_rid += 1
             self.requests[cr.rid] = cr
             rep = self._route_locked(cr)
@@ -862,7 +867,7 @@ class ServingCluster:
                 try:
                     erid = eng.submit(
                         prompt, cr.max_new_tokens - len(cr.committed),
-                        eos_id=cr.eos_id)
+                        eos_id=cr.eos_id, trace_id=cr.trace_id)
                 except Exception as e:
                     # a request THIS engine rejects (submit() already
                     # pre-validated, so this is belt-and-braces) fails
@@ -1243,9 +1248,29 @@ class ServingCluster:
                      "error": repr(r.error) if r.error else None}
                     for r in self.replicas]
 
-    @property
-    def registry(self):
-        return self._obs.registry if self._obs is not None else None
+    def debug_status(self):
+        """Ops introspection snapshot for ``GET /debug/statusz``
+        (round 23) — the in-process flavor's counterpart of
+        :meth:`DisaggServingCluster.debug_status`: live topology plus
+        in-flight request states.  JSON-able, read-only."""
+        now = time.perf_counter()
+        with self._lock:
+            reqs = []
+            for cr in self.requests.values():
+                if cr.state not in ("queued", "running"):
+                    continue
+                reqs.append({
+                    "rid": cr.rid, "trace_id": cr.trace_id,
+                    "state": cr.state, "replica": cr.replica,
+                    # the canonical PUBLISHED stream length — survives
+                    # failover (committed snapshots + live tokens)
+                    "tokens": len(cr.stream),
+                    "failovers": cr.failovers,
+                    "ttft_ms": None if cr.first_token_t is None
+                    else (cr.first_token_t - cr.submit_t) * 1e3,
+                    "age_s": now - cr.submit_t})
+            return {"kind": "inproc", "closed": self._closed,
+                    "replicas": self.health(), "requests": reqs}
 
     def metrics(self):
         """JSON-able snapshot: router counters + per-replica engine
@@ -1318,6 +1343,11 @@ class _DisaggObs:
                             help="page-frame send -> installed in the "
                                  "decode pool (same-host monotonic "
                                  "clock)")
+        # round 23: router-lane request spans (submit instant, TTFT
+        # span) in the same merged chrome trace the worker spans land
+        # in — the router process IS the recording process
+        from ..obs.trace import RequestTraceEmitter
+        self.trace = RequestTraceEmitter()
 
 
 class _WorkerHandle:
@@ -1325,7 +1355,8 @@ class _WorkerHandle:
     __slots__ = ("name", "role", "proc", "conn", "data_host",
                  "data_port", "last_seen", "dead", "draining",
                  "outstanding", "stats", "stats_evt", "stats_sid",
-                 "error", "recv_thread", "pid")
+                 "error", "recv_thread", "pid", "clock_offset",
+                 "clock_rtt", "flight_tail")
 
     def __init__(self, name, role):
         self.name = name
@@ -1344,6 +1375,15 @@ class _WorkerHandle:
         self.stats_sid = None             # awaited stats_req id
         self.error = None
         self.recv_thread = None
+        # round 23: ping-pong clock model (worker perf_counter minus
+        # router perf_counter, min-RTT sample) — corrects this
+        # worker's shipped span times onto the router timeline
+        self.clock_offset = 0.0
+        self.clock_rtt = None             # best (lowest) RTT seen, s
+        # round 23: recovered flight-recorder tail after this worker
+        # died (the post-mortem evidence _fail_worker pulled from its
+        # crash-durable ring)
+        self.flight_tail = None
 
     @property
     def alive(self):
@@ -1359,10 +1399,16 @@ class DisaggRequest:
                  "phase", "prefill", "decode", "gen", "committed",
                  "output", "error", "done_evt", "submit_t",
                  "first_token_t", "token_times", "failovers",
-                 "delivered", "listeners")
+                 "delivered", "listeners", "trace_id")
 
-    def __init__(self, rid, prompt, max_new_tokens, eos_id):
+    def __init__(self, rid, prompt, max_new_tokens, eos_id,
+                 trace_id=None):
         self.rid = rid
+        # round 23 trace context: minted at the HTTP edge (the
+        # X-Request-Id) or defaulted here; carried in the meta of
+        # every request-bearing wire kind and stamped on every span
+        self.trace_id = trace_id if trace_id is not None \
+            else "rid%d" % rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -1478,6 +1524,23 @@ class DisaggServingCluster:
         # worker-reported cumulative stats, delta-folded into the
         # router registry (same idiom as _EngineObs.sync_cache)
         self._stat_seen: Dict[str, Dict[str, float]] = {}
+        # -- round 23 observability state ---------------------------
+        # router-side crash-durable flight ring (workers get their
+        # own in-process), merged cross-process trace emitter, the
+        # per-rid span store behind GET /debug/trace/<rid>, and the
+        # TTFT sliding window behind the statusz SLO burn gauges
+        from ..obs.flight import FlightRecorder
+        from ..obs.trace import MergedTraceEmitter
+        self._flight = FlightRecorder()
+        self._merged = MergedTraceEmitter()   # internally locked
+        self._span_store: "collections.OrderedDict[int, list]" = \
+            collections.OrderedDict()
+        self._span_store_cap = 512
+        self._flight_tails: Dict[str, list] = {}
+        self._clock_seq = itertools.count(1)
+        self._ttft_window: "collections.deque" = collections.deque()
+        self._slo_ttft_ms = _env_default(
+            "MXNET_SERVE_SLO_TTFT_MS", 1000.0)
         self.workers: Dict[str, _WorkerHandle] = {}
         # pre-provisioned standby workers (round 18): fully handshaken
         # (engine built + pre-warmed) but held out of routing AND out
@@ -1587,8 +1650,28 @@ class DisaggServingCluster:
                 target=self._recv_loop, args=(wh,), daemon=True,
                 name="disagg-recv-" + wh.name)
             wh.recv_thread.start()
+        # clock-offset ping burst AFTER recv threads start: the
+        # worker is in its run() loop by now, so replies ride the
+        # normal inbox->_handle->send path and land in _recv_loop
+        for wh in self.workers.values():
+            self._clock_ping(wh)
         if self._obs is not None:
             self._obs.g_workers.set(self._serving_count())
+
+    def _clock_ping(self, wh, n=5):
+        """Ping-pong clock-offset burst (round 23): each ``clock_req``
+        echoes back with the worker's ``perf_counter`` read; the
+        min-RTT sample (``_on_clock``) estimates this worker's clock
+        offset from the router.  Same-host workers share
+        CLOCK_MONOTONIC, so the estimate validates at ~0 there and
+        becomes load-bearing for off-host workers."""
+        for _ in range(n):
+            try:
+                wh.conn.send("clock_req",
+                             {"seq": next(self._clock_seq),
+                              "t0": time.perf_counter()})
+            except OSError:
+                return                    # monitor will fail it over
 
     def _serving_count(self):
         """Workers counted as serving capacity: alive and not parked
@@ -1632,6 +1715,10 @@ class DisaggServingCluster:
                                        meta["tier"])
             elif kind == "stats":
                 self._on_stats(wh, meta)
+            elif kind == "spans":
+                self._on_spans(wh, meta)
+            elif kind == "clock":
+                self._on_clock(wh, meta)
             elif kind == "reqfail":
                 with self._lock:
                     cr = self.requests.get(meta["rid"])
@@ -1654,8 +1741,25 @@ class DisaggServingCluster:
         """Append newly streamed tokens (router lock held)."""
         if toks and cr.first_token_t is None:
             cr.first_token_t = now
+            ttft_ms = (now - cr.submit_t) * 1e3
             if self._obs is not None:
-                self._obs.h_ttft.observe((now - cr.submit_t) * 1e3)
+                self._obs.h_ttft.observe(ttft_ms)
+                if profiler.is_recording():
+                    # router-lane TTFT span: the worker/transport
+                    # spans shipped for this rid nest inside it in
+                    # the merged dump (flushed by the caller outside
+                    # the router lock)
+                    self._obs.trace.add_span(
+                        cr.rid, "ttft", cr.submit_t, now,
+                        args={"trace_id": cr.trace_id,
+                              "prefill": cr.prefill,
+                              "decode": cr.decode})
+            # SLO burn window (round 23 statusz): (arrival, ttft_ms)
+            # samples pruned to the longest burn window
+            self._ttft_window.append((now, ttft_ms))
+            while self._ttft_window and \
+                    now - self._ttft_window[0][0] > 300.0:
+                self._ttft_window.popleft()
         new = [int(t) for t in toks]
         cr.committed.extend(new)
         cr.token_times.extend(now for _ in toks)
@@ -1733,7 +1837,9 @@ class DisaggServingCluster:
                     if w.alive:
                         sends.append((w.conn, (
                             "cancel", {"rid": cr.rid,
-                                       "below_gen": cr.gen}, [])))
+                                       "below_gen": cr.gen,
+                                       "trace_id": cr.trace_id},
+                            [])))
             if self._obs is not None:
                 self._obs.cancelled.inc()
                 self._obs.g_in_flight.set(
@@ -1743,6 +1849,7 @@ class DisaggServingCluster:
             self._purge_locked()
             self._finish_locked(cr)
         self._do_sends(sends)
+        self._flight.record("cancel", rid=rid, trace_id=cr.trace_id)
         return True
 
     def _on_tokens(self, wh, meta):
@@ -1752,6 +1859,8 @@ class DisaggServingCluster:
                     or cr.state != "running":
                 return
             self._commit_tokens_locked(cr, meta["toks"], time.perf_counter())
+        if self._obs is not None:
+            self._obs.trace.flush()       # outside the router lock
 
     def _on_handed(self, wh, meta):
         """Prefill finished and handed off to the decode worker.
@@ -1805,6 +1914,10 @@ class DisaggServingCluster:
             self._purge_locked()
             self._finish_locked(cr)
         self._do_sends(sends)
+        self._flight.record("done", rid=meta.get("rid"),
+                            worker=wh.name)
+        if self._obs is not None:
+            self._obs.trace.flush()       # outside the router lock
 
     def _purge_locked(self):
         excess = len(self._terminal) - self._retain
@@ -1882,6 +1995,58 @@ class DisaggServingCluster:
                 and meta["sid"] == wh.stats_sid:
             wh.stats_evt.set()
 
+    def _on_clock(self, wh, meta):
+        """One ``clock_req`` -> ``clock`` ping-pong sample (round 23):
+        ``offset = t_worker - (t0 + rtt/2)`` — the worker's clock
+        read, centered on the round trip.  Min-RTT filtering keeps
+        the sample least contaminated by queueing delay; correction
+        is ``t_router = t_worker - offset``."""
+        now = time.perf_counter()
+        try:
+            t0 = float(meta["t0"])
+            tw = float(meta["t_worker"])
+        except (KeyError, TypeError, ValueError):
+            return
+        rtt = max(0.0, now - t0)
+        if wh.clock_rtt is None or rtt < wh.clock_rtt:
+            wh.clock_rtt = rtt
+            wh.clock_offset = tw - (t0 + rtt / 2.0)
+
+    def _on_spans(self, wh, meta):
+        """Fold a worker's shipped span batch (the ``spans`` wire
+        kind, riding its stats tick) onto the router timeline: times
+        corrected by the worker's clock offset, stored per-rid for
+        ``GET /debug/trace/<rid>``, and — while a profiler session is
+        recording — emitted into the ONE merged chrome trace under
+        the worker's (or the shared ``transport``) swimlane."""
+        spans = meta.get("spans") or ()
+        if not spans:
+            return
+        off = wh.clock_offset
+        with self._lock:
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                rec = dict(s, worker=wh.name, offset_s=off)
+                lst = self._span_store.get(rec.get("rid"))
+                if lst is None:
+                    self._span_store[rec.get("rid")] = lst = []
+                    while len(self._span_store) > \
+                            self._span_store_cap:
+                        self._span_store.popitem(last=False)
+                lst.append(rec)
+        if profiler.is_recording():
+            # outside the router lock — the merged emitter carries
+            # its own lock, and a profiler flush must never extend a
+            # critical section every recv thread contends on
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                lane = "transport" \
+                    if s.get("cat") == "transport" else wh.name
+                self._merged.add(lane, s, off)
+            self._merged.flush()
+
     # ------------------------------------------------------ intake ---
     def _pick(self, role, exclude=()):
         """Least-outstanding over healthy workers of ``role``, ties
@@ -1903,8 +2068,13 @@ class DisaggServingCluster:
         tied = [w for w in cands if len(w.outstanding) == lo]
         return tied[cur % len(tied)]
 
-    def submit(self, prompt, max_new_tokens, eos_id=None):
-        """Queue a request; returns its rid immediately."""
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               trace_id=None):
+        """Queue a request; returns its rid immediately.
+        ``trace_id`` (round 23) is the cross-process trace context —
+        the HTTP front door passes its ``X-Request-Id`` so edge,
+        router, worker, and transport spans correlate; unset, the
+        request traces under a ``rid<N>`` default."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("submit: empty prompt")
@@ -1918,7 +2088,8 @@ class DisaggServingCluster:
             if self._closed:
                 raise ClusterClosed("submit() after close()")
             cr = DisaggRequest(self._next_rid, prompt,
-                               int(max_new_tokens), eos_id)
+                               int(max_new_tokens), eos_id,
+                               trace_id=trace_id)
             self._next_rid += 1
             self.requests[cr.rid] = cr
             if self._obs is not None:
@@ -1928,6 +2099,14 @@ class DisaggServingCluster:
                         for r in self.requests.values()))
             sends = self._dispatch_locked(cr)
         self._do_sends(sends)
+        self._flight.record("submit", rid=cr.rid,
+                            trace_id=cr.trace_id, prefill=cr.prefill,
+                            decode=cr.decode)
+        if self._obs is not None and profiler.is_recording():
+            self._obs.trace.add_instant(
+                cr.rid, "submit", cr.submit_t,
+                args={"trace_id": cr.trace_id})
+            self._obs.trace.flush()
         return cr.rid
 
     def _dispatch_locked(self, cr):
@@ -1968,7 +2147,8 @@ class DisaggServingCluster:
                 "max_new": cr.max_new_tokens - len(cr.committed),
                 "eos": cr.eos_id, "decode": dec.name,
                 "hint": hint, "hint_depth": depth,
-                "hint_tier": tier if hint is not None else None}
+                "hint_tier": tier if hint is not None else None,
+                "trace_id": cr.trace_id}
         return [(pre.conn, ("submit", meta,
                             [np.ascontiguousarray(inp).data]))]
 
@@ -2034,6 +2214,15 @@ class DisaggServingCluster:
             if pid is not None:
                 from .transport import put_sweep
                 put_sweep(pid)
+                # round 23 forensics: the victim's span buffer died
+                # with it, but its flight-recorder ring is
+                # crash-durable (mmap, page cache) — recover the tail
+                # by the same pid key the put sweep uses
+                from ..obs.flight import flight_recover
+                tail = flight_recover(pid, unlink=True)
+                if tail:
+                    wh.flight_tail = tail
+                    self._flight_tails[wh.name] = tail
             if self._obs is not None:
                 self._obs.failovers.inc()
                 self._obs.g_workers.set(self._serving_count())
@@ -2085,6 +2274,24 @@ class DisaggServingCluster:
         except Exception:
             pass
         self._do_sends(sends)
+        self._flight.record("worker_dead", worker=wh.name,
+                            error=repr(error))
+        tail = wh.flight_tail
+        if tail and profiler.is_recording():
+            # fold the victim's final events into the live merged
+            # trace as instants on its swimlane — the chaos test's
+            # checked artifact
+            for ev in tail:
+                self._merged.add_flight(wh.name, ev,
+                                        wh.clock_offset)
+            self._merged.flush()
+
+    def flight_tail(self, name):
+        """The recovered flight-recorder tail of a dead worker
+        (seq-ordered event dicts), or ``None`` — post-mortem
+        debugging surface, also summarized in ``debug_status()``."""
+        with self._lock:
+            return self._flight_tails.get(name)
 
     def _monitor_loop(self):
         period = max(0.05, min(0.5, self.watchdog_s / 4.0))
@@ -2143,6 +2350,98 @@ class DisaggServingCluster:
                      "pid": None if w.proc is None else w.proc.pid,
                      "error": repr(w.error) if w.error else None}
                     for w in self.workers.values()]
+
+    # --------------------------------------- ops introspection (23) --
+    def _slo_locked(self, now):
+        """SLO burn-rate gauges from the router's TTFT window: the
+        fraction of recent requests over the
+        ``MXNET_SERVE_SLO_TTFT_MS`` budget, expressed as a burn rate
+        against the 1% error budget of a 99% objective (>1.0 means
+        the window is eating budget faster than it refills)."""
+        budget_ms = self._slo_ttft_ms
+        windows = {}
+        # zip, not ((label, win), …): a 2-tuple whose second element
+        # is a ("str", …) tuple reads as a queued wire send to
+        # protolint's model — keep ops plumbing out of the protocol
+        for label, win_s in zip(("1m", "5m"), (60.0, 300.0)):
+            n = bad = 0
+            for t, ms in self._ttft_window:
+                if now - t <= win_s:
+                    n += 1
+                    bad += ms > budget_ms
+            frac = bad / n if n else 0.0
+            windows[label] = {"requests": n, "over_budget": bad,
+                              "bad_fraction": frac,
+                              "burn_rate": frac / 0.01}
+        return {"ttft_budget_ms": budget_ms, "windows": windows}
+
+    def debug_status(self):
+        """One-call ops snapshot behind ``GET /debug/statusz``: live
+        topology, per-worker health + clock offsets + cached stats
+        (tier occupancy included), in-flight request states, SLO burn
+        gauges, and the flight-recorder state."""
+        now = time.perf_counter()
+        with self._lock:
+            workers = []
+            for w in self.workers.values():
+                st = w.stats or {}
+                tail = self._flight_tails.get(w.name)
+                workers.append({
+                    "worker": w.name, "role": w.role,
+                    "alive": w.alive, "dead": w.dead,
+                    "standby": w.name in self._standby,
+                    "draining": w.draining,
+                    "outstanding": len(w.outstanding),
+                    "heartbeat_age_s": now - w.last_seen,
+                    "pid": w.pid or (w.proc.pid
+                                     if w.proc is not None else None),
+                    "clock_offset_us": None if w.clock_rtt is None
+                    else w.clock_offset * 1e6,
+                    "clock_rtt_us": None if w.clock_rtt is None
+                    else w.clock_rtt * 1e6,
+                    "active_requests": st.get("active_requests"),
+                    "pages_in_use": st.get("pages_in_use"),
+                    "free_pages": st.get("free_pages"),
+                    "tier": st.get("tier"),
+                    "flight_tail_events": None if tail is None
+                    else len(tail),
+                    "error": repr(w.error) if w.error else None})
+            reqs = [{"rid": r.rid, "trace_id": r.trace_id,
+                     "state": r.state, "phase": r.phase,
+                     "prefill": r.prefill, "decode": r.decode,
+                     "gen": r.gen, "committed": len(r.committed),
+                     "failovers": r.failovers,
+                     "age_s": now - r.submit_t,
+                     "ttft_ms": None if r.first_token_t is None
+                     else (r.first_token_t - r.submit_t) * 1e3}
+                    for r in self.requests.values()
+                    if r.state == "running"]
+            slo = self._slo_locked(now)
+            recovered = sorted(self._flight_tails)
+        return {"kind": "disagg", "closed": self._closed,
+                "workers": workers, "in_flight": reqs, "slo": slo,
+                "flight": {"path": self._flight.path,
+                           "recovered": recovered}}
+
+    def request_trace(self, rid):
+        """Everything the router knows about one request's timeline:
+        its record (state/assignment/timing) plus every span workers
+        shipped for it (clock-corrected store behind
+        ``GET /debug/trace/<rid>``).  KeyError on a rid the router
+        has never seen."""
+        with self._lock:
+            cr = self.requests.get(rid)
+            router = None if cr is None else {
+                "rid": cr.rid, "trace_id": cr.trace_id,
+                "state": cr.state, "phase": cr.phase,
+                "prefill": cr.prefill, "decode": cr.decode,
+                "gen": cr.gen, "committed": len(cr.committed),
+                "failovers": cr.failovers, "submit_t": cr.submit_t,
+                "first_token_t": cr.first_token_t}
+            spans = [dict(s) for s in self._span_store.get(rid, ())]
+        if router is None and not spans:
+            raise KeyError("request_trace(%r): unknown rid" % (rid,))
+        return {"rid": rid, "router": router, "spans": spans}
 
     @property
     def registry(self):
@@ -2297,6 +2596,7 @@ class DisaggServingCluster:
             target=self._recv_loop, args=(wh,), daemon=True,
             name="disagg-recv-" + wh.name)
         wh.recv_thread.start()
+        self._clock_ping(wh)
         with self._lock:
             if standby:
                 # fully warm, deliberately invisible: stays draining
@@ -2438,20 +2738,33 @@ class DisaggServingCluster:
                 except OSError:
                     pass
         from .transport import put_sweep
+        from ..obs.flight import flight_sweep
         for wh in workers:
             if wh.proc is not None:
                 wh.proc.join(timeout=timeout)
                 if wh.proc.is_alive():
                     wh.proc.terminate()
                     wh.proc.join(timeout=5)
+            # drain the recv thread BEFORE closing the conn: the
+            # worker's last-gasp frames (its final span ship) are
+            # still in the socket buffer, and the thread exits on the
+            # EOF the dead worker left only after folding them —
+            # closing first would drop the trace tail of every
+            # sub-tick run
+            if wh.recv_thread is not None and \
+                    wh.recv_thread is not threading.current_thread():
+                wh.recv_thread.join(timeout=5)
             if wh.conn is not None:
                 wh.conn.close()
             # belt over the workers' own exit sweeps: a worker that
-            # died uncleanly leaves pid-prefixed segments behind
+            # died uncleanly leaves pid-prefixed segments (and its
+            # flight ring) behind
             pid = wh.pid or (wh.proc.pid if wh.proc is not None
                              else None)
             if pid is not None:
                 put_sweep(pid)
+                flight_sweep(pid)
+        self._flight.close(unlink=True)
         with self._lock:
             early = list(self._early_hellos.values())
             self._early_hellos.clear()
@@ -2579,6 +2892,15 @@ class _DisaggWorker:
         self._fenced: Dict[int, int] = {}
         self.transfer_ms: List[float] = []
         self._last_stats = 0.0
+        # round 23 observability: the crash-durable flight ring
+        # (recovered by the router if we are SIGKILLed) and the span
+        # staging buffer shipped to the router on the stats tick
+        from ..obs.flight import FlightRecorder
+        from ..obs.trace import SpanBuffer
+        self._flight = FlightRecorder()
+        self._spans = SpanBuffer()
+        self._decode_t0: Dict[int, float] = {}   # rid -> admit time
+        self._flight.record("ready", worker=name, role=role)
         self._running = True
         threading.Thread(target=self._router_recv, daemon=True,
                          name="disagg-router-recv").start()
@@ -2744,6 +3066,7 @@ class _DisaggWorker:
                 self._send_pages_frame(
                     conn, "fetch_reply",
                     {"n": n_full, "fid": meta.get("fid"),
+                     "trace_id": meta.get("trace_id"),
                      "t_send": time.perf_counter()},
                     reply_bufs)
                 self.fetch_bytes += sum(
@@ -2752,7 +3075,7 @@ class _DisaggWorker:
                 pass                      # requester died: their loss
 
     def _fetch_remote(self, owner, tokens, timeout=15.0,
-                      peer_tier=None):
+                      peer_tier=None, trace_id=None):
         """Fetch the longest cached chain for ``tokens`` from a
         sibling replica and graft it into the local trie.  A miss (or
         a dead/slow peer) degrades to a cold local prefill — the
@@ -2766,7 +3089,7 @@ class _DisaggWorker:
         fid = self._fetch_seq
         try:
             conn = self._peer_conn(owner)
-            conn.send("fetch", {"fid": fid},
+            conn.send("fetch", {"fid": fid, "trace_id": trace_id},
                       [np.ascontiguousarray(tokens).data])
         except (OSError, KeyError):
             return 0
@@ -2836,6 +3159,12 @@ class _DisaggWorker:
                 # wants this gen — admitting it would resurrect a
                 # fenced zombie (proto-gen-fence checked invariant)
                 return
+            t_recv = time.perf_counter()
+            tid = meta.get("trace_id")
+            self._flight.record("submit_recv", rid=meta["rid"],
+                                gen=meta["gen"], trace_id=tid)
+            self._spans.instant(meta["rid"], "submit_recv", t_recv,
+                                trace_id=tid)
             if meta.get("hint") and self.eng.prefix is not None:
                 # round 18: the local depth a fetch must beat counts
                 # BOTH tiers — hot trie pages and spilled (host-tier)
@@ -2845,12 +3174,24 @@ class _DisaggWorker:
                 # (probe_depth takes no refs and restores nothing).
                 hot, warm = self.eng.prefix.probe_depth(inp)
                 if meta["hint_depth"] > hot + warm:
-                    self._fetch_remote(meta["hint"], inp,
-                                       peer_tier=meta.get("hint_tier"))
+                    t0f = time.perf_counter()
+                    got = self._fetch_remote(
+                        meta["hint"], inp,
+                        peer_tier=meta.get("hint_tier"),
+                        trace_id=tid)
+                    # the remote-hit transfer, visible INSIDE this
+                    # request's TTFT span in the merged dump
+                    self._spans.span(
+                        meta["rid"], "fetch", t0f,
+                        time.perf_counter(), trace_id=tid,
+                        cat="transport",
+                        args={"owner": meta["hint"],
+                              "hit_tokens": got})
             try:
                 erid = self.eng.submit(
                     inp, 1 if self.role == "prefill"
-                    else meta["max_new"], eos_id=meta["eos"])
+                    else meta["max_new"], eos_id=meta["eos"],
+                    trace_id=tid)
             except Exception as e:
                 # a request THIS engine rejects fails alone — it must
                 # not take the worker (and every other request on it)
@@ -2861,7 +3202,8 @@ class _DisaggWorker:
                 return
             self.by_erid[erid] = {"rid": meta["rid"],
                                   "gen": meta["gen"],
-                                  "meta": meta, "inp": inp}
+                                  "meta": meta, "inp": inp,
+                                  "t0": t_recv}
             self.by_rid[meta["rid"]] = erid
             self._reported[meta["rid"]] = 0
         elif kind == "pages":
@@ -2877,8 +3219,18 @@ class _DisaggWorker:
                 # stream must not take down the whole worker
                 self.receiver.abort(key)
                 return
-            self.transfer_ms.append(
-                (time.perf_counter() - meta["t_send"]) * 1e3)
+            now = time.perf_counter()
+            self.transfer_ms.append((now - meta["t_send"]) * 1e3)
+            self._flight.record("pages_recv", rid=key[0],
+                                start=meta["start"], n=meta["n"])
+            # the prefill->decode page transfer as a transport-lane
+            # span: t0 is the SENDER's t_send on the same-host
+            # monotonic clock (the h_transfer convention)
+            self._spans.span(key[0], "transfer", meta["t_send"], now,
+                             trace_id=meta.get("trace_id"),
+                             cat="transport",
+                             args={"start": meta["start"],
+                                   "pages": meta["n"]})
         elif kind == "handoff":
             key = tuple(meta["srid"])
             if key[1] < self._fenced.get(key[0], -1):
@@ -2887,8 +3239,17 @@ class _DisaggWorker:
                 key, meta["total"],
                 dict(meta, prompt=np.frombuffer(bytes(bufs[0]),
                                                 np.int32)))
+            self._flight.record("handoff_recv", rid=key[0],
+                                total=meta["total"])
+            self._spans.instant(key[0], "handoff_recv",
+                                time.perf_counter(),
+                                trace_id=meta.get("trace_id"))
         elif kind == "abort":
+            # flight record AFTER the fenced abort: protolint's
+            # gen-fence rule wants no state touched before the fence
             self._abort(meta["rid"], meta["below_gen"])
+            self._flight.record("abort", rid=meta["rid"],
+                                below_gen=meta["below_gen"])
         elif kind == "cancel":
             # round 20: client-disconnect propagation.  Same fencing
             # and cleanup as a failover abort — drop staged pages,
@@ -2898,6 +3259,8 @@ class _DisaggWorker:
             # late cancel for a gen that already died is a no-op by
             # the same fence.
             self._abort(meta["rid"], meta["below_gen"])
+            self._flight.record("cancel", rid=meta["rid"],
+                                trace_id=meta.get("trace_id"))
         elif kind == "drop":
             key = tuple(meta["srid"])
             if key[1] < self._fenced.get(key[0], -1):
@@ -2912,6 +3275,18 @@ class _DisaggWorker:
             self.peers = meta["peers"]
         elif kind == "stats_req":
             self._send_stats(sid=meta.get("sid"))
+        elif kind == "clock_req":
+            # ping-pong clock-offset probe (round 23): echo the
+            # router's t0 with OUR clock read, immediately — any
+            # extra queueing here inflates the RTT estimate, and the
+            # router's min-RTT filter discards the sample
+            try:
+                self.router.send("clock",
+                                 {"seq": meta["seq"],
+                                  "t0": meta["t0"],
+                                  "t_worker": time.perf_counter()})
+            except OSError:
+                self._running = False
         elif kind == "caps":
             pass                          # recorded on the conn by recv
         elif kind == "_wake":
@@ -2937,6 +3312,7 @@ class _DisaggWorker:
             self.by_erid.pop(erid)
             self.by_rid.pop(rid, None)
             self._reported.pop(rid, None)
+            self._decode_t0.pop(rid, None)
             self.streamer.drop(erid)
             if erid in self.eng.requests:
                 self.eng.cancel(erid)
@@ -2966,6 +3342,11 @@ class _DisaggWorker:
             self.by_erid[erid] = {"rid": rid, "gen": gen,
                                   "meta": meta}
             self.by_rid[rid] = erid
+            t_admit = time.perf_counter()
+            self._decode_t0[rid] = t_admit
+            self._flight.record("admit", rid=rid, gen=gen)
+            self._spans.instant(rid, "admit_prefilled", t_admit,
+                                trace_id=meta.get("trace_id"))
             # report from zero: the handoff tokens travel to the
             # router in OUR stream (single FIFO connection), not the
             # prefill worker's — cross-connection ordering is the
@@ -3019,7 +3400,10 @@ class _DisaggWorker:
                         dec, "pages",
                         {"srid": (st["rid"], st["gen"]),
                          "start": start, "n": n,
+                         "trace_id": st["meta"].get("trace_id"),
                          "t_send": time.perf_counter()}, bufs)
+                    self._flight.record("pages_sent", rid=st["rid"],
+                                        start=start, n=n)
                 except OSError:
                     self._drop_peer(st["meta"]["decode"])
                     dec = None            # gap in the stream: abandon
@@ -3040,7 +3424,9 @@ class _DisaggWorker:
                             {"srid": (st["rid"], st["gen"]),
                              "total": total, "toks": toks,
                              "max_new": st["meta"]["max_new"],
-                             "eos": st["meta"]["eos"]},
+                             "eos": st["meta"]["eos"],
+                             "trace_id":
+                                 st["meta"].get("trace_id")},
                             [np.ascontiguousarray(st["inp"]).data])
                     except OSError:
                         # the decode side never got the handoff:
@@ -3070,6 +3456,16 @@ class _DisaggWorker:
                                                st["gen"])})
                         except OSError:
                             pass
+                t1 = time.perf_counter()
+                tid = st["meta"].get("trace_id")
+                self._spans.span(st["rid"], "prefill",
+                                 st.get("t0", t1), t1, trace_id=tid,
+                                 args={"toks": len(toks),
+                                       "pages": total,
+                                       "handed": remaining > 0})
+                self._flight.record(
+                    "handoff_sent" if remaining > 0 else "done",
+                    rid=st["rid"], total=total)
                 self._report_inserts(req,
                                      st.get("final_chain_upto", 0))
                 self.streamer.drop(erid)
@@ -3107,6 +3503,17 @@ class _DisaggWorker:
                 self.router.send("done", {"rid": rid,
                                           "gen": st["gen"],
                                           "toks": new})
+                # decode span closes with the request: its token
+                # count equals the committed stream the router saw
+                # for this incarnation (decode reports from zero) —
+                # the trace-merge reconciliation the slow tier pins
+                t1 = time.perf_counter()
+                self._spans.span(
+                    rid, "decode", self._decode_t0.pop(rid, t1), t1,
+                    trace_id=st["meta"].get("trace_id"),
+                    args={"toks": len(req.generated)})
+                self._flight.record("done", rid=rid,
+                                    toks=len(req.generated))
                 self.by_erid.pop(erid, None)
                 self.by_rid.pop(rid, None)
                 self._reported.pop(rid, None)
@@ -3140,6 +3547,15 @@ class _DisaggWorker:
         if time.perf_counter() - self._last_stats < 0.25:
             return
         self._send_stats()
+        # span shipping rides the same tick but NOT _send_stats
+        # itself: that is the stats_req reply path and must stay
+        # call-free (proto-reply-pairing)
+        spans = self._spans.drain()
+        if spans:
+            try:
+                self.router.send("spans", {"spans": spans})
+            except OSError:
+                self._running = False
 
     def _send_stats(self, sid=None):
         """Send one stats frame NOW.  This is the `stats_req` →
@@ -3250,6 +3666,9 @@ class _DisaggWorker:
                     s is not None for s in self.eng._slots)
                 if busy:
                     finished = self.eng.step()
+                    self._flight.record(
+                        "step", active=len(self.by_erid),
+                        finished=len(finished or ()))
                     if self.role == "prefill":
                         self._stream_pages(finished)
                     else:
@@ -3273,10 +3692,25 @@ class _DisaggWorker:
                 pass
             raise
         finally:
+            # last-gasp span ship: a worker shut down between 0.25 s
+            # stats ticks (every sub-second run) still delivers its
+            # staged spans — without this a short-lived cluster's
+            # merged trace shows the router talking to silence
+            spans = self._spans.drain()
+            if spans:
+                try:
+                    self.router.send("spans", {"spans": spans})
+                except OSError:
+                    pass
             self.listener.close()
             self.router.close()
             for c in self._peer_conns.values():
                 c.close()
+            # orderly exit needs no forensics: unlink our flight
+            # ring (a SIGKILL skips this finally — that file IS the
+            # evidence the router recovers)
+            self._flight.record("exit")
+            self._flight.close(unlink=True)
             # reclaim any put segment we wrote whose receiver never
             # opened it (peer died mid-flight): our pid prefixes
             # every segment name

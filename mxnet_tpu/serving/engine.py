@@ -235,6 +235,10 @@ class Request:
     submit_t: float = 0.0
     wait_start: float = 0.0               # submit or last preemption
     token_times: List[float] = dataclasses.field(default_factory=list)
+    # edge-minted trace context (round 23): the HTTP front door's
+    # X-Request-Id, stamped into lifecycle trace instants so the edge
+    # access log and the engine swimlane correlate by one string
+    trace_id: Optional[str] = None
 
     @property
     def resume_input(self):
@@ -1114,7 +1118,8 @@ class ServingEngine:
         self.retire_cb = None
 
     # ------------------------------------------------------- intake --
-    def submit(self, prompt, max_new_tokens, eos_id=None):
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               trace_id=None):
         """Queue a request; returns its id.  prompt: (P,) ints."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -1136,7 +1141,8 @@ class ServingEngine:
         with self._mu:
             req = Request(rid=self._next_rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
-                          eos_id=eos_id, submit_t=now, wait_start=now)
+                          eos_id=eos_id, submit_t=now, wait_start=now,
+                          trace_id=trace_id)
             self._next_rid += 1
             self.requests[req.rid] = req
             self._queue.append(req)
@@ -1240,9 +1246,12 @@ class ServingEngine:
                 self._obs.g_running.set(
                     sum(r is not None for r in self._slots))
                 if profiler.is_recording():
+                    cargs = {"state": "cancelled"}
+                    if req.trace_id:
+                        cargs["trace_id"] = req.trace_id
                     self._obs.trace.add_instant(
                         rid, "retire", time.perf_counter(),
-                        args={"state": "cancelled"})
+                        args=cargs)
                     self._obs.trace.flush()
 
     # ----------------------------------------------------- plumbing --
@@ -2049,8 +2058,10 @@ class ServingEngine:
                 elif not req.generated:
                     obs.h_ttft.observe((now - req.submit_t) * 1e3)
                     if tracing:
-                        obs.trace.add_instant(req.rid, "first_token",
-                                              now)
+                        obs.trace.add_instant(
+                            req.rid, "first_token", now,
+                            args={"trace_id": req.trace_id}
+                            if req.trace_id else None)
             done = False
             for tok in commit:
                 req.generated.append(tok)
@@ -2072,9 +2083,11 @@ class ServingEngine:
                 if obs is not None:
                     obs.finished.inc()
                     if tracing:
-                        obs.trace.add_instant(
-                            req.rid, "retire", now,
-                            args={"tokens": len(req.generated)})
+                        rargs = {"tokens": len(req.generated)}
+                        if req.trace_id:
+                            rargs["trace_id"] = req.trace_id
+                        obs.trace.add_instant(req.rid, "retire", now,
+                                              args=rargs)
         # slots that fed prefill rows but did not finish their input
         # this step just advance n_cached — to the position recorded
         # at build time (by now the planner may have pushed

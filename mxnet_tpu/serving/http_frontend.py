@@ -469,6 +469,23 @@ class HttpFrontend:
                 if want_close:
                     return
                 continue
+            if path == "/debug/statusz" \
+                    or path.startswith("/debug/trace/"):
+                # ops introspection (round 23): same operator surface
+                # class as /metrics — GET-only, unauthenticated,
+                # read-only snapshots off the executor
+                if method != "GET":
+                    await self._send_json(
+                        writer, 405, {"error": "GET only"},
+                        req_id=req_id, close=True)
+                    return
+                if path == "/debug/statusz":
+                    await self._handle_statusz(writer, req_id)
+                else:
+                    await self._handle_trace(writer, path, req_id)
+                if want_close:
+                    return
+                continue
             if path != "/v1/generate":
                 await self._send_json(
                     writer, 404, {"error": "unknown path %s" % path},
@@ -503,6 +520,53 @@ class HttpFrontend:
     def _in_executor(self, fn, *args):
         return asyncio.get_running_loop().run_in_executor(
             None, fn, *args)
+
+    # ----------------------------------------------- debug (rnd 23) --
+    async def _handle_statusz(self, writer, req_id):
+        """``GET /debug/statusz``: live topology, per-worker health /
+        clock offsets / tier occupancy, in-flight request states, and
+        SLO burn gauges — whatever snapshot the attached cluster
+        flavor provides."""
+        fn = getattr(self.cluster, "debug_status", None)
+        if fn is None:
+            await self._send_json(
+                writer, 404,
+                {"error": "cluster has no debug_status surface",
+                 "request_id": req_id}, req_id=req_id, close=True)
+            return
+        status = await self._in_executor(fn)
+        status["request_id"] = req_id
+        await self._send_json(writer, 200, status, req_id=req_id)
+
+    async def _handle_trace(self, writer, path, req_id):
+        """``GET /debug/trace/<rid>``: the router's view of one
+        request's timeline plus every span workers shipped for it."""
+        tail = path[len("/debug/trace/"):]
+        try:
+            rid = int(tail)
+        except ValueError:
+            await self._send_json(
+                writer, 400,
+                {"error": "bad rid %r" % tail, "request_id": req_id},
+                req_id=req_id, close=True)
+            return
+        fn = getattr(self.cluster, "request_trace", None)
+        if fn is None:
+            await self._send_json(
+                writer, 404,
+                {"error": "cluster has no request_trace surface",
+                 "request_id": req_id}, req_id=req_id, close=True)
+            return
+        try:
+            trace = await self._in_executor(fn, rid)
+        except KeyError:
+            await self._send_json(
+                writer, 404,
+                {"error": "unknown rid %d" % rid,
+                 "request_id": req_id}, req_id=req_id, close=True)
+            return
+        trace["request_id"] = req_id
+        await self._send_json(writer, 200, trace, req_id=req_id)
 
     # ------------------------------------------------------ generate --
     async def _handle_generate(self, reader, writer, headers, req_id):
@@ -608,15 +672,27 @@ class HttpFrontend:
             if tenant is not None:
                 tenant.in_flight -= 1
 
-    def _submit(self, prompt, max_new, eos_id, ttl_s):
-        kw = {} if ttl_s is None else {"ttl_s": float(ttl_s)}
+    def _submit(self, prompt, max_new, eos_id, ttl_s, req_id):
+        # the edge mints the trace context: X-Request-Id IS the
+        # trace_id, so the access log, the engine trace instants, and
+        # the cluster-wide merged trace all correlate by one string
+        kw = {"trace_id": req_id}
+        if ttl_s is not None:
+            kw["ttl_s"] = float(ttl_s)
         try:
             return self.cluster.submit(prompt, max_new,
                                        eos_id=eos_id, **kw)
         except TypeError:
-            # the disagg cluster has no TTL support — the edge quota
-            # is the admission bound there
-            return self.cluster.submit(prompt, max_new, eos_id=eos_id)
+            # older cluster flavors: shed optional kwargs (disagg has
+            # no TTL support; pre-round-23 clusters no trace_id),
+            # never the request
+            try:
+                kw.pop("ttl_s", None)
+                return self.cluster.submit(prompt, max_new,
+                                           eos_id=eos_id, **kw)
+            except TypeError:
+                return self.cluster.submit(prompt, max_new,
+                                           eos_id=eos_id)
 
     async def _run_request(self, writer, reader, prompt, max_new,
                            eos_id, ttl_s, stream, req_id):
@@ -624,7 +700,8 @@ class HttpFrontend:
         loop = asyncio.get_running_loop()
         try:
             rid = await self._in_executor(
-                lambda: self._submit(prompt, max_new, eos_id, ttl_s))
+                lambda: self._submit(prompt, max_new, eos_id, ttl_s,
+                                     req_id))
         except ClusterOverloaded as e:
             if obs is not None:
                 obs.rej_quota.inc()

@@ -496,6 +496,74 @@ def test_healthz_and_metrics_fast():
         fe.close()
 
 
+def test_debug_statusz_and_trace_fast():
+    """Round-23 ops surface at the edge: ``GET /debug/statusz`` and
+    ``GET /debug/trace/<rid>`` relay the cluster's snapshots with the
+    response's own X-Request-Id stamped in, 404 an unknown rid (the
+    cluster's KeyError), 400 a non-integer rid, 405 non-GET, and 404
+    when the attached cluster has no debug surface at all."""
+    fake = _FakeCluster()
+    fake.debug_status = lambda: {
+        "kind": "fake", "closed": False, "workers": [],
+        "in_flight": [], "slo": {"windows": []},
+        "flight": {"path": None, "recovered": []}}
+
+    def request_trace(rid):
+        if rid != 100:
+            raise KeyError(rid)
+        return {"rid": rid, "router": {"state": "running"},
+                "spans": [{"name": "prefill", "worker": "w0"}]}
+    fake.request_trace = request_trace
+    fe = HttpFrontend(fake, keys={"sk": {}}).start()
+    try:
+        s = _connect(fe)
+        s.sendall(_request_bytes(path="/debug/statusz", method="GET"))
+        st, h, rest = _recv_head(s)
+        assert st == 200
+        body, rest = _read_n(s, rest, int(h["content-length"]))
+        obj = json.loads(body)
+        assert obj["kind"] == "fake" and obj["workers"] == []
+        assert obj["request_id"] == h["x-request-id"]
+        # keep-alive: the trace surface rides the same socket
+        s.sendall(_request_bytes(path="/debug/trace/100",
+                                 method="GET"))
+        st, h, rest = _recv_head(s)
+        assert st == 200
+        body, rest = _read_n(s, rest, int(h["content-length"]))
+        obj = json.loads(body)
+        assert obj["rid"] == 100
+        assert obj["spans"][0]["worker"] == "w0"
+        assert obj["request_id"] == h["x-request-id"]
+        s.close()
+
+        def one(raw):
+            c = _connect(fe)
+            try:
+                c.sendall(raw)
+                return _recv_head(c)[0]
+            finally:
+                c.close()
+
+        assert one(_request_bytes(path="/debug/trace/999",
+                                  method="GET")) == 404
+        assert one(_request_bytes(path="/debug/trace/xyz",
+                                  method="GET")) == 400
+        assert one(_request_bytes(path="/debug/statusz",
+                                  body=b"{}")) == 405
+    finally:
+        fe.close()
+    # a cluster flavor without the surface: a clean 404, not a 500
+    bare = _FakeCluster()
+    fe = HttpFrontend(bare, keys={"sk": {}}).start()
+    try:
+        s = _connect(fe)
+        s.sendall(_request_bytes(path="/debug/statusz", method="GET"))
+        assert _recv_head(s)[0] == 404
+        s.close()
+    finally:
+        fe.close()
+
+
 def test_oversized_head_answered_not_dropped():
     """A request head past the 256 KiB stream limit gets a 400, not a
     silent connection drop (every malformed input answers with a

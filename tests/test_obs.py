@@ -8,9 +8,26 @@
 * Prometheus exposition format; native decode-counter reset;
   MXEngineStats; training MetricsCallback / Monitor integration.
 
+Round 23 — cluster-wide distributed tracing + flight recorder:
+
+* crash-durable flight-recorder ring mechanics (wraparound,
+  truncation, disabled path) plus real-SIGKILL forensics: a child
+  process records and dies by signal 9; the parent recovers the tail;
+* worker span shipping folded onto the router timeline — trace-merge
+  reconciliation on a live cross-process cluster (spans stored
+  per-rid, clock offsets measured, merged chrome dump with
+  per-worker + transport swimlanes next to the router's lanes);
+* the ops surfaces: ``debug_status`` / ``request_trace`` behind the
+  HTTP front door's ``/debug/statusz`` + ``/debug/trace/<rid>``.
+
 Pure-python instrument tests run in the fast tier; tests that step the
 serving engine are slow (group d, with the rest of serving)."""
 import json
+import os
+import signal
+import subprocess
+import sys
+import time
 
 import numpy as np
 import pytest
@@ -19,6 +36,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import native, obs, profiler
 from mxnet_tpu.obs import (Counter, Gauge, Histogram, MetricsRegistry,
                            REQ_TID_BASE)
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # ---------------------------------------------------------------------------
@@ -739,3 +758,344 @@ def test_cluster_router_prefix_metrics_scrape_and_trace(tmp_path):
     assert "failover" in inst and "resubmit" in inst
     fo = [e for e in evs if e.get("name") == "failover"]
     assert all(e["tid"] >= REQ_TID_BASE for e in fo)
+
+
+# ---------------------------------------------------------------------------
+# round 23 — flight recorder (fast tier)
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_roundtrip_wraparound_truncation(tmp_path):
+    """Ring mechanics: seq-ordered readback, wraparound keeping only
+    the LAST n_slots events, oversized payloads truncated to a stub
+    (never a torn slot), orderly close unlinking the file."""
+    from mxnet_tpu.obs import flight
+    rec = flight.FlightRecorder(slots=8, dir=str(tmp_path), pid=11)
+    assert rec.enabled and os.path.exists(rec.path)
+    for i in range(12):
+        assert rec.record("ev", i=i, rid=100 + i) == i + 1
+    evs = flight.read_flight(rec.path)
+    # 12 records through 8 slots: seqs 5..12 survive, in order
+    assert [e["seq"] for e in evs] == list(range(5, 13))
+    assert [e["i"] for e in evs] == list(range(4, 12))
+    assert all(e["kind"] == "ev" and e["rid"] == 100 + e["i"]
+               for e in evs)
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts) and all(t > 0 for t in ts)
+    # oversized payload: replaced by a {"kind", "trunc"} stub that
+    # still parses (the reader must never see half a JSON document)
+    rec.record("big", blob="x" * 4096)
+    assert rec.dropped == 1
+    last = flight.read_flight(rec.path)[-1]
+    assert last["kind"] == "big" and last["trunc"] > 4096
+    path = rec.path
+    rec.close(unlink=True)
+    assert not os.path.exists(path)
+    # recover-by-pid on a missing file: None, not a raise
+    assert flight.flight_recover(11, dir=str(tmp_path)) is None
+
+
+def test_flight_disabled_is_inert(tmp_path, monkeypatch):
+    """slots=0 (arg or env) creates no file and record() is a no-op —
+    the tracing-off path must do no I/O at all."""
+    from mxnet_tpu.obs import flight
+    rec = flight.FlightRecorder(slots=0, dir=str(tmp_path))
+    assert not rec.enabled and rec.path is None
+    assert rec.record("ev", x=1) is None
+    assert os.listdir(str(tmp_path)) == []
+    monkeypatch.setenv("MXNET_SERVE_FLIGHT_SLOTS", "0")
+    rec2 = flight.FlightRecorder(dir=str(tmp_path))
+    assert not rec2.enabled
+    assert os.listdir(str(tmp_path)) == []
+    rec.close()
+    rec2.close()
+
+
+def test_flight_recover_after_real_sigkill(tmp_path):
+    """THE forensics pin: a child process records lifecycle events and
+    dies by SIGKILL mid-flight — no atexit, no flush, no finally.  The
+    parent recovers the tail by pid: mmap stores into the page cache
+    are the durability mechanism."""
+    from mxnet_tpu.obs import flight
+    # the child loads flight.py by path (stdlib-only module): the test
+    # exercises the crash path, not the package import
+    src = os.path.join(REPO_DIR, "mxnet_tpu", "obs", "flight.py")
+    child = (
+        "import importlib.util, os, signal\n"
+        "spec = importlib.util.spec_from_file_location('f', %r)\n"
+        "f = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(f)\n"
+        "rec = f.FlightRecorder(slots=16, dir=%r)\n"
+        "for i in range(40):\n"
+        "    rec.record('tick', i=i)\n"
+        "rec.record('about_to_die', rid=7)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+        % (src, str(tmp_path)))
+    proc = subprocess.Popen([sys.executable, "-c", child])
+    proc.wait(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    evs = flight.flight_recover(proc.pid, dir=str(tmp_path),
+                                unlink=True)
+    assert evs, "SIGKILLed child left no recoverable ring"
+    # the last 16 of 41 records survive, tail intact and ordered
+    assert len(evs) == 16
+    assert [e["seq"] for e in evs] == list(range(26, 42))
+    assert evs[-1]["kind"] == "about_to_die" and evs[-1]["rid"] == 7
+    assert all(e["kind"] == "tick" for e in evs[:-1])
+    # unlink=True consumed the file
+    assert flight.flight_recover(proc.pid, dir=str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# round 23 — span shipping + merged trace (fast tier)
+# ---------------------------------------------------------------------------
+
+def test_span_buffer_wire_shape_cap_and_disable():
+    from mxnet_tpu.obs.trace import SpanBuffer
+    sb = SpanBuffer(cap=3)
+    sb.span(1, "prefill", 1.0, 2.0, trace_id="req-a",
+            args={"toks": 4})
+    sb.instant(1, "submit_recv", 0.5, cat="transport")
+    assert sb.drain() == [
+        {"rid": 1, "name": "prefill", "ph": "X", "t0": 1.0,
+         "t1": 2.0, "cat": "serving", "trace_id": "req-a",
+         "args": {"toks": 4}},
+        {"rid": 1, "name": "submit_recv", "ph": "i", "t": 0.5,
+         "cat": "transport"}]
+    assert sb.drain() == []                 # drained
+    # over cap: new entries dropped and counted, never grown
+    for i in range(5):
+        sb.instant(i, "x", float(i))
+    assert len(sb.drain()) == 3 and sb.dropped == 2
+    off = SpanBuffer(cap=0)
+    assert not off.enabled
+    off.span(1, "a", 0.0, 1.0)
+    off.instant(1, "b", 0.0)
+    assert off.drain() == []
+
+
+def test_merged_trace_lanes_offsets_and_flight_fold(tmp_path):
+    """Router-side merge: wire spans from two 'workers' and a
+    transport span land under synthetic chrome pids with
+    process_name metadata; timestamps are corrected by each lane's
+    clock offset; a recovered flight event folds in as an instant on
+    the victim's lane."""
+    from mxnet_tpu.obs.trace import (LANE_PID_BASE,
+                                     MergedTraceEmitter)
+    m = MergedTraceEmitter()
+    # while NOT recording: batches are dropped, never retained
+    m.add("w0", {"rid": 1, "name": "prefill", "ph": "X",
+                 "t0": 1.0, "t1": 2.0})
+    assert m.flush() is False and m._pending == []
+    profiler.set_config(filename=str(tmp_path / "m.json"))
+    profiler.set_state("run")
+    try:
+        m.add("w0", {"rid": 1, "name": "prefill", "ph": "X",
+                     "t0": 1.0, "t1": 2.0, "trace_id": "e-1"},
+              offset_s=0.25)
+        m.add("w1", {"rid": 1, "name": "decode", "ph": "X",
+                     "t0": 3.0, "t1": 4.5}, offset_s=-0.5)
+        m.add("transport", {"rid": 1, "name": "transfer", "ph": "X",
+                            "t0": 2.0, "t1": 2.1,
+                            "cat": "transport"})
+        m.add_flight("w0", {"kind": "step", "t": 5.0, "seq": 9,
+                            "rid": 1, "active": 2})
+        m.add("w0", {"rid": "garbage"})     # malformed: dropped
+        assert m.flush() is True
+    finally:
+        profiler.set_state("stop")
+    evs = json.load(open(profiler.dump()))["traceEvents"]
+    names = {e["args"]["name"]: e["pid"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert set(names) == {"w0", "w1", "transport"}
+    assert all(pid >= LANE_PID_BASE for pid in names.values())
+    by_name = {e["name"]: e for e in evs if e.get("ph") != "M"}
+    # clock correction: ts = (t - offset) * 1e6 on the router clock
+    assert by_name["prefill"]["ts"] == pytest.approx(0.75e6)
+    assert by_name["prefill"]["dur"] == pytest.approx(1.0e6)
+    assert by_name["prefill"]["args"]["trace_id"] == "e-1"
+    assert by_name["decode"]["ts"] == pytest.approx(3.5e6)
+    assert by_name["transfer"]["cat"] == "transport"
+    fl = by_name["flight:step"]
+    assert fl["ph"] == "i" and fl["cat"] == "flight"
+    assert fl["pid"] == names["w0"]
+    assert fl["args"]["seq"] == 9 and fl["args"]["active"] == 2
+    assert by_name["prefill"]["pid"] == names["w0"]
+    assert by_name["decode"]["pid"] == names["w1"]
+
+
+# ---------------------------------------------------------------------------
+# round 23 — cross-process trace merge + ops surface (slow tier)
+# ---------------------------------------------------------------------------
+
+def _tiny_disagg():
+    import jax
+    from mxnet_tpu.models import gpt as G
+    cfg = G.gpt_tiny(dtype="float32")
+    params = G.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _disagg(params, cfg, **kw):
+    from mxnet_tpu.serving import DisaggServingCluster
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("metrics", True)
+    kw.setdefault("watchdog_s", 60.0)
+    return DisaggServingCluster(params, cfg, **kw)
+
+
+def _wait_spans(cl, rid, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while True:
+        try:
+            spans = cl.request_trace(rid)["spans"]
+        except KeyError:
+            spans = []
+        if spans or time.perf_counter() > deadline:
+            return spans
+        time.sleep(0.05)
+
+
+@pytest.mark.slow
+def test_disagg_trace_merge_reconciles(tmp_path):
+    """Trace-merge reconciliation on a LIVE cluster: worker spans
+    shipped on stats ticks land in the router's per-rid store stamped
+    with worker name + clock offset and the edge-minted trace_id; the
+    merged chrome dump holds router, per-worker, and transport
+    swimlanes in ONE file; statusz reports measured clock offsets."""
+    params, cfg = _tiny_disagg()
+    rng = np.random.RandomState(0)
+    ps = 4
+    shared = rng.randint(1, cfg.vocab_size, 2 * ps).astype(np.int32)
+    prompts = [np.concatenate(
+        [shared, rng.randint(1, cfg.vocab_size, 3).astype(np.int32)])
+        for _ in range(4)]
+    profiler.set_config(filename=str(tmp_path / "merged.json"))
+    profiler.set_state("run")
+    try:
+        cl = _disagg(params, cfg, prefill=2, decode=1, page_size=ps)
+        try:
+            rids = [cl.submit(p, 4, trace_id="edge-%d" % i)
+                    for i, p in enumerate(prompts)]
+            for rid in rids:
+                cl.result(rid, timeout=180)
+            # reconciliation: every request's DECODE span closes with
+            # a token count equal to the committed stream the router
+            # returned (spans ride the 0.25 s stats tick — poll)
+            deadline = time.perf_counter() + 30
+            decode_spans = {}
+            while len(decode_spans) < len(rids) \
+                    and time.perf_counter() < deadline:
+                for rid in rids:
+                    for s in _wait_spans(cl, rid, timeout=0):
+                        if s["name"] == "decode" and "args" in s:
+                            decode_spans[rid] = s
+                time.sleep(0.05)
+            assert len(decode_spans) == len(rids), decode_spans
+            for rid, s in decode_spans.items():
+                assert s["args"]["toks"] == 4
+                assert s["t1"] >= s["t0"]
+            spans = cl.request_trace(rids[-1])["spans"]
+            # every span is stamped with its shipping worker and that
+            # worker's measured clock offset, and carries the
+            # edge-minted trace context
+            workers = {s["worker"] for s in spans}
+            assert workers <= set(cl.workers)
+            assert all(np.isfinite(s["offset_s"]) for s in spans)
+            assert any(s.get("trace_id") == "edge-%d" % (len(rids) - 1)
+                       for s in spans)
+            names = {s["name"] for s in spans}
+            assert "submit_recv" in names
+            # statusz: topology + per-worker clock model + flight ring
+            ds = cl.debug_status()
+            assert ds["kind"] == "disagg" and not ds["closed"]
+            assert len(ds["workers"]) == 3
+            for w in ds["workers"]:
+                assert w["alive"] and not w["dead"]
+                assert w["clock_offset_us"] is not None
+                assert w["clock_rtt_us"] > 0
+            assert ds["flight"]["path"]
+            assert "windows" in ds["slo"]
+            # request_trace on an unknown rid: KeyError, not a row
+            with pytest.raises(KeyError):
+                cl.request_trace(10 ** 9)
+        finally:
+            cl.close()
+    finally:
+        profiler.set_state("stop")
+    evs = json.load(open(profiler.dump()))["traceEvents"]
+    from mxnet_tpu.obs.trace import LANE_PID_BASE
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"
+             and e["pid"] >= LANE_PID_BASE}
+    # all three workers shipped spans into the ONE dump; the shared
+    # prefix crossed processes, so the transport lane is present too
+    assert {"prefill0", "prefill1", "decode0"} <= lanes, lanes
+    assert "transport" in lanes, lanes
+    router_evs = [e for e in evs if e.get("pid", 0) < LANE_PID_BASE
+                  and e.get("cat") == "serving"]
+    assert router_evs, "router's own lanes missing from the dump"
+    # reconciled clock: the corrected worker lanes overlap the
+    # router's own span window (submit → ttft-span end).  Router
+    # instants all sit at submit time — first-request compile puts
+    # worker activity well after them — so the comparison must use
+    # span ENDS (ts + dur): the router's ttft span stretches to the
+    # first commit, past the worker's prefill start.  A broken offset
+    # sign would shove the lanes a whole 2*offset outside the window.
+    t_router0 = min(e["ts"] for e in router_evs if "ts" in e)
+    t_router1 = max(e["ts"] + e.get("dur", 0.0)
+                    for e in router_evs if "ts" in e)
+    t_lanes = [e["ts"] for e in evs
+               if e.get("pid", 0) >= LANE_PID_BASE and "ts" in e]
+    assert min(t_lanes) <= t_router1 and t_router0 <= max(t_lanes)
+
+
+@pytest.mark.slow
+def test_disagg_sigkill_flight_tail_recovered():
+    """Chaos forensics pin: SIGKILL a decode worker mid-decode; the
+    router recovers the victim's flight-recorder tail (the black box
+    an os._exit/SIGKILL leaves in /dev/shm), folds it into the trace
+    surfaces, and every request still completes on the survivor."""
+    params, cfg = _tiny_disagg()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           int(P)).astype(np.int32)
+               for P in (5, 9, 14, 21)]
+    nnew = [32] * 4
+    cl = _disagg(params, cfg, prefill=1, decode=2, watchdog_s=30.0)
+    try:
+        rids = [cl.submit(p, n, trace_id="chaos-%d" % i)
+                for i, (p, n) in enumerate(zip(prompts, nnew))]
+        deadline = time.perf_counter() + 90
+        while time.perf_counter() < deadline:
+            with cl._lock:
+                if any(r.state == "running" and r.phase == "decode"
+                       and 0 < len(r.committed) < r.max_new_tokens
+                       for r in cl.requests.values()):
+                    break
+            time.sleep(0.005)
+        cl.kill_worker("decode0")
+        for rid in rids:
+            cl.result(rid, timeout=180)
+        snap = cl.registry.snapshot()["counters"]
+        assert snap["cluster_failovers_total"] >= 1
+        ds = cl.debug_status()
+        assert "decode0" in ds["flight"]["recovered"]
+        victim = next(w for w in ds["workers"]
+                      if w["worker"] == "decode0")
+        assert victim["dead"]
+        assert victim["flight_tail_events"] > 0
+        # the recovered tail is the victim's own totally-ordered
+        # event stream: per-step records with monotone seqs
+        tail = cl._flight_tails["decode0"]
+        seqs = [e["seq"] for e in tail]
+        assert seqs == sorted(seqs)
+        kinds = {e["kind"] for e in tail}
+        assert "step" in kinds, kinds
+        # the victim's ring file was consumed by the recovery sweep
+        from mxnet_tpu.obs import flight
+        pid = victim["pid"]
+        assert pid is not None
+        assert flight.flight_recover(pid) in (None, [])
+    finally:
+        cl.close()

@@ -667,6 +667,76 @@ class TestPylockHttpFrontendCoverage:
         assert fs == [], [str(f) for f in fs]
 
 
+class TestPylockObsFlightCoverage:
+    """Round 23 satellite: pylocklint covers the crash-durable flight
+    ring and the worker span buffer — both emit from HOT paths (wire
+    recv threads, the engine step loop), so their locks must stay
+    memory-only.  Zero findings on the live ``mxnet_tpu/obs`` package
+    is pinned by the repo-wide scan; the plants prove the violations
+    the observability layer COULD regress into would fire there."""
+
+    def test_planted_flight_sync_under_lock_fires(self):
+        # THE tempting flight-ring bug: "make it durable" by msync
+        # (or any syscall) inside record()'s lock — every wire recv
+        # and engine step would then serialize behind a disk flush.
+        # Page-cache durability is the design; a sync is a regression.
+        src = ("import threading, time\n"
+               "class FlightRecorder:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def record(self, kind):\n"
+               "        with self._lock:\n"
+               "            time.sleep(0)\n")
+        fs = pylocklint.lint_source(src, "mxnet_tpu/obs/flight.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+    def test_planted_span_ship_under_lock_fires(self):
+        # the span-shipping hazard: draining the buffer is fine, but
+        # waiting for the router's ship ack while still holding the
+        # buffer lock would stall every concurrent span/instant emit
+        # behind the socket round-trip — the live worker drains under
+        # the lock, ships outside
+        src = ("import threading\n"
+               "class SpanBuffer:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n"
+               "        self._acked = threading.Event()\n"
+               "    def ship(self):\n"
+               "        with self._mu:\n"
+               "            self._acked.wait()\n")
+        fs = pylocklint.lint_source(src, "mxnet_tpu/obs/trace.py")
+        assert _rules(fs) == {"py-blocking-under-lock": 1}
+
+    def test_planted_guarded_seq_fires(self):
+        # the ring's seq counter is lock-guarded (slot index and slot
+        # head derive from it); an unguarded fast-path increment is a
+        # torn-slot generator under concurrent recorders
+        src = ("import threading\n"
+               "class FlightRecorder:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._seq = 0\n"
+               "    def record(self, kind):\n"
+               "        with self._lock:\n"
+               "            self._seq += 1\n"
+               "    def reset(self):\n"
+               "        self._seq = 0\n")
+        fs = pylocklint.lint_source(src, "mxnet_tpu/obs/flight.py")
+        assert _rules(fs) == {"py-guarded-field": 1}
+
+    def test_live_obs_emit_paths_are_clean(self):
+        """The live recorder/buffer/merger hold their locks over
+        memory-only work (json.dumps + buffer stores; the profiler
+        hand-off is a locked list append) — pinned so a refactor that
+        adds a flush or a send under either lock re-fires the planted
+        shapes on the real files."""
+        for rel in ("mxnet_tpu/obs/flight.py",
+                    "mxnet_tpu/obs/trace.py"):
+            src = open(os.path.join(REPO_ROOT, rel)).read()
+            fs = pylocklint.lint_source(src, rel)
+            assert fs == [], (rel, [str(f) for f in fs])
+
+
 class TestBenchSyncFixtures:
     """jaxlint bench-no-sync (ISSUE 7 satellite): the timed-region /
     unsynced-jit pattern fires once, the pragma'd twin is suppressed,

@@ -166,6 +166,17 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/serving/cluster.py",
      r"(?:.*\.)?(_send_pages_frame|_serve_fetches|_stream_pages"
      r"|_fetch_remote|_peer_handler|_peer_conn)$"),
+    # round 23: the flight recorder's emit path runs at every wire
+    # send/recv, page install, and step boundary in BOTH router and
+    # worker processes, and the span-ship/merge paths ride the worker
+    # stats tick and the router recv loop — a device sync, in-loop
+    # jit, or clock mix in any of them prices every hot-path event
+    # (the recorder's mmap store must stay pure host work)
+    ("mxnet_tpu/obs/flight.py", r".*"),
+    ("mxnet_tpu/obs/trace.py", r".*"),
+    ("mxnet_tpu/serving/cluster.py",
+     r"(?:.*\.)?(_on_spans|_on_clock|_clock_ping|_maybe_send_stats"
+     r"|_commit_tokens_locked|_slo_locked)$"),
 ]
 
 # modules whose timestamps must stay on the shared perf_counter clock

@@ -1479,7 +1479,25 @@ def protocol_audit_md(root: str) -> str:
         "instead of waiting out its timeout), `stats_req` → `stats` "
         "(`_send_stats`",
         "replies unconditionally; the periodic rate limit lives in "
-        "`_maybe_send_stats`).",
+        "`_maybe_send_stats`),",
+        "`clock_req` → `clock` (the router's handshake ping-pong "
+        "clock probe: the",
+        "worker echoes `t0` with its own `t_worker` immediately, so "
+        "the router's",
+        "min-RTT filter can estimate each worker's perf_counter "
+        "offset for the",
+        "merged-trace clock reconciliation, round 23).",
+        "",
+        "Distributed tracing (round 23): every request-bearing kind "
+        "(`submit`,",
+        "`pages`/`handoff`, `fetch`/`fetch_reply`, `cancel`) carries "
+        "the edge-minted",
+        "`trace_id` in its meta; workers stamp their spans with it "
+        "and ship drained",
+        "span batches router-ward as the fire-and-forget `spans` "
+        "kind on the stats",
+        "tick (NOT inside `_send_stats` — the `stats_req` reply path "
+        "stays call-free).",
         "",
         "Zero-copy page puts (round 22): `caps` is the FIRST frame "
         "both directions on",
