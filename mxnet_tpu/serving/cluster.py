@@ -1272,6 +1272,10 @@ class ServingCluster:
             return {"kind": "inproc", "closed": self._closed,
                     "replicas": self.health(), "requests": reqs}
 
+    @property
+    def registry(self):
+        return self._obs.registry if self._obs is not None else None
+
     def metrics(self):
         """JSON-able snapshot: router counters + per-replica engine
         snapshots."""

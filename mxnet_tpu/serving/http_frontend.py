@@ -409,6 +409,13 @@ class HttpFrontend:
             except OSError:
                 pass
             writer.close()
+            try:
+                # close() only schedules the close — wait for the
+                # transport to drain so refused connections can't
+                # pile up half-closed under an overload burst
+                await writer.wait_closed()
+            except OSError:
+                pass
             return
         self._active += 1
         if obs is not None:
@@ -424,6 +431,10 @@ class HttpFrontend:
             if obs is not None:
                 obs.g_conns.set(self._active)
             writer.close()
+            try:
+                await writer.wait_closed()
+            except OSError:
+                pass
 
     async def _conn_loop(self, reader, writer):
         """Keep-alive loop: one request head at a time; SSE responses

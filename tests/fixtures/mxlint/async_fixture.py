@@ -1,0 +1,143 @@
+"""Planted asyncio event-loop hazards for asynclint (analyzer 7).
+
+Never imported or executed: tests/test_static_analysis.py feeds this
+file to ``asynclint.lint_source`` and asserts each rule fires EXACTLY
+ONCE on its plant, that each pragma twin stays quiet, and that the
+clean shapes at the bottom — the blessed front-door idioms (executor
+hop, call_soon_threadsafe reference bridge, awaited/cancelled tasks,
+try/finally writer settle) — never fire.
+"""
+import asyncio
+import queue
+import threading
+import time
+
+
+class FixAsync:
+    def __init__(self, engine):
+        self.engine = engine
+        self.jobs = queue.Queue()          # thread-side work queue
+        self.lock = threading.Lock()
+        self.tasks = []
+
+    async def _work(self):
+        await asyncio.sleep(0)
+
+    # ------------------------------------------ async-blocking-call --
+    async def plant_blocking(self):
+        time.sleep(0.1)                    # stalls every connection
+
+    async def twin_blocking(self):
+        # mxlint: allow(async-blocking-call) -- suppressed twin:
+        # intended-sync pause, the loop is not serving yet
+        time.sleep(0.1)
+
+    # ------------------------------------ async-unawaited-coroutine --
+    async def plant_unawaited(self):
+        self._work()                       # coroutine object dropped
+
+    async def twin_unawaited(self):
+        # mxlint: allow(async-unawaited-coroutine) -- suppressed twin
+        self._work()
+
+    # ----------------------------------------- async-task-exception --
+    async def plant_task(self):
+        t = asyncio.ensure_future(self._work())
+
+    async def twin_task(self):
+        # mxlint: allow(async-task-exception) -- suppressed twin:
+        # fire-and-forget probe, exceptions intentionally dropped
+        t = asyncio.ensure_future(self._work())
+
+    # ------------------------------------- async-threadsafe-boundary --
+    async def plant_boundary(self):
+        q = asyncio.Queue()
+
+        def feed(evt):                     # runs on the engine thread
+            q.put_nowait(evt)              # loop-owned, no marshal
+
+        self.engine.attach_stream(1, feed)
+        await q.get()
+
+    async def twin_boundary(self):
+        q = asyncio.Queue()
+
+        def feed(evt):
+            # mxlint: allow(async-threadsafe-boundary)
+            # -- suppressed twin: single-producer bench harness,
+            # the loop is parked while this feeds
+            q.put_nowait(evt)
+
+        self.engine.attach_stream(2, feed)
+        await q.get()
+
+    # ---------------------------------------- async-writer-lifecycle --
+    async def plant_writer(self, host):
+        reader, writer = await asyncio.open_connection(host, 80)
+        writer.close()                     # close() only schedules
+
+    async def twin_writer(self, host):
+        # mxlint: allow(async-writer-lifecycle) -- suppressed twin:
+        # probe socket, the transport is abandoned on purpose
+        reader, writer = await asyncio.open_connection(host, 80)
+        writer.close()
+
+    # --------------------------------------- async-lock-across-await --
+    async def plant_lock(self):
+        with self.lock:
+            await asyncio.sleep(0)         # loop can interleave here
+
+    async def twin_lock(self):
+        # mxlint: allow(async-lock-across-await) -- suppressed twin:
+        # no second coroutine ever takes this lock
+        with self.lock:
+            await asyncio.sleep(0)
+
+    # ------------------------------------------------- clean shapes --
+    async def clean_executor_hop(self):
+        # blocking queue get rides the executor: no coroutine taint
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._pull)
+
+    def _pull(self):
+        return self.jobs.get()             # executor thread: fine
+
+    async def clean_boundary_bridge(self):
+        loop = asyncio.get_running_loop()
+        q = asyncio.Queue()
+
+        def feed(evt):
+            # the blessed bridge: put_nowait crosses the boundary as
+            # a REFERENCE — the call happens on the loop
+            loop.call_soon_threadsafe(q.put_nowait, evt)
+
+        self.engine.attach_stream(3, feed)
+        await q.get()
+
+    async def clean_task_awaited(self):
+        t = asyncio.ensure_future(self._work())
+        await t
+
+    async def clean_task_cancelled(self):
+        t = asyncio.ensure_future(self._work())
+        try:
+            await asyncio.sleep(0)
+        finally:
+            t.cancel()                     # finally covers all edges
+
+    async def clean_task_escapes(self):
+        self.tasks.append(asyncio.ensure_future(self._work()))
+
+    async def clean_writer_settled(self, host):
+        reader, writer = await asyncio.open_connection(host, 80)
+        try:
+            writer.write(b"ping")
+            await writer.drain()
+        finally:
+            writer.close()
+            await writer.wait_closed()
+
+    async def clean_lock_released_before_await(self):
+        with self.lock:
+            self.tasks.clear()             # no await under the lock
+        await asyncio.sleep(0)

@@ -619,6 +619,18 @@ def test_env_knob_validation():
         del os.environ["MXNET_SERVE_HTTP_MAX_BODY"]
 
 
+def test_clusters_expose_registry():
+    """``HttpFrontend.__init__`` reads ``cluster.registry``
+    unconditionally — BOTH cluster flavors must expose it (round 24:
+    the in-proc property had been dropped in a refactor, so the front
+    door crashed at construction over a real ``ServingCluster``)."""
+    import inspect
+    from mxnet_tpu.serving import DisaggServingCluster, ServingCluster
+    for cls in (ServingCluster, DisaggServingCluster):
+        assert isinstance(
+            inspect.getattr_static(cls, "registry", None), property), cls
+
+
 # ---------------------------------------------------------------------------
 # slow tier (group n): real clusters over real sockets
 # ---------------------------------------------------------------------------

@@ -26,8 +26,9 @@ import time
 
 import pytest
 
-from tools.analysis import (abi, graphlint, jaxlint, native_lint,
-                            protolint, pylocklint)
+from tools.analysis import (abi, asynclint, envlint, graphlint,
+                            jaxlint, native_lint, protolint,
+                            pylocklint)
 from tools.analysis.findings import (Finding, apply_pragmas,
                                      load_baseline, split_new)
 from tools.analysis.runner import (BINDINGS, HEADER, REPO_ROOT,
@@ -946,6 +947,15 @@ class TestHotRegionAdditions:
          " async def _cancel_disconnected(self, rid):\n%s"),
         ("benchmark/http_bench.py",
          "def run_load(args):\n%s"),
+        # round 24: the round-23 debug endpoints run on the same
+        # event-loop thread as every SSE stream — an in-loop jit in
+        # statusz/trace handling stalls all of them at once
+        ("mxnet_tpu/serving/http_frontend.py",
+         "class HttpFrontend:\n"
+         " async def _handle_statusz(self, writer, req_id):\n%s"),
+        ("mxnet_tpu/serving/http_frontend.py",
+         "class HttpFrontend:\n"
+         " async def _handle_trace(self, writer, path, req_id):\n%s"),
     ]
 
     @pytest.mark.parametrize("rel,template", CASES)
@@ -1308,6 +1318,230 @@ class TestProtolintWalkerEdges:
             "        self.conns[0] = conn\n"
             "        return conn\n", "m.py", roles={})
         assert fs == [], [str(f) for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# asynclint (ISSUE 19): live repo, forced-fix guards, fixtures
+# ---------------------------------------------------------------------------
+HTTP_FRONTEND = "mxnet_tpu/serving/http_frontend.py"
+
+
+class TestAsynclintLiveRepo:
+    def test_asynclint_zero_findings_even_baselined(self):
+        """ISSUE 19 acceptance criterion: the asyncio event-loop
+        audit reports ZERO findings with an EMPTY baseline over
+        serving/ + obs/ — nothing grandfathered."""
+        fs = asynclint.run(REPO_ROOT)
+        assert fs == [], "\n".join(str(f) for f in fs)
+
+    def test_asynclint_guards_the_503_wait_closed_fix(self):
+        """The forced fix, edge 1: the 503 connection-cap path must
+        drain the refused transport (close() only schedules the
+        close).  Reverting it to the bare close()+return re-fires
+        async-writer-lifecycle on that exit edge."""
+        src = open(os.path.join(REPO_ROOT, HTTP_FRONTEND)).read()
+        fix = (
+            "            writer.close()\n"
+            "            try:\n"
+            "                # close() only schedules the close — "
+            "wait for the\n"
+            "                # transport to drain so refused "
+            "connections can't\n"
+            "                # pile up half-closed under an overload "
+            "burst\n"
+            "                await writer.wait_closed()\n"
+            "            except OSError:\n"
+            "                pass\n"
+            "            return")
+        assert fix in src
+        broken = src.replace(
+            fix, "            writer.close()\n            return", 1)
+        fs = [f for f in asynclint.lint_source(broken, HTTP_FRONTEND)
+              if f.rule == "async-writer-lifecycle"]
+        assert len(fs) == 1 and fs[0].symbol.endswith(
+            "_serve_conn.writer"), [str(f) for f in fs]
+
+    def test_asynclint_guards_the_finally_wait_closed_fix(self):
+        """The forced fix, edge 2: _serve_conn's finally settles the
+        writer for every normal and exception edge of the connection
+        loop.  Dropping the wait_closed there re-fires the rule on
+        the fall-through path."""
+        src = open(os.path.join(REPO_ROOT, HTTP_FRONTEND)).read()
+        fix = ("            writer.close()\n"
+               "            try:\n"
+               "                await writer.wait_closed()\n"
+               "            except OSError:\n"
+               "                pass")
+        assert src.count(fix) == 1
+        broken = src.replace(fix, "            writer.close()", 1)
+        fs = [f for f in asynclint.lint_source(broken, HTTP_FRONTEND)
+              if f.rule == "async-writer-lifecycle"]
+        assert len(fs) == 1 and fs[0].symbol.endswith(
+            "_serve_conn.writer"), [str(f) for f in fs]
+
+    def test_changed_only_trigger_gating(self, monkeypatch):
+        """--changed-only: asynclint re-analyzes only when serving/,
+        obs/, or tools/analysis/ change; any other change set skips
+        the pass entirely."""
+        assert asynclint.triggered(None)
+        assert asynclint.triggered({HTTP_FRONTEND})
+        assert asynclint.triggered({"mxnet_tpu/obs/trace.py"})
+        assert asynclint.triggered({"tools/analysis/asynclint.py"})
+        assert not asynclint.triggered({"README.md",
+                                        "mxnet_tpu/models/gpt.py"})
+
+        def boom(*a, **kw):
+            raise AssertionError("analyzed despite no trigger")
+
+        monkeypatch.setattr(asynclint, "analyze", boom)
+        assert asynclint.run(REPO_ROOT, only={"README.md"}) == []
+
+
+class TestAsyncFixtures:
+    """Every asynclint rule fires exactly once as seeded in
+    fixtures/mxlint/async_fixture.py, pragma twins stay suppressed,
+    the blessed clean shapes (executor hop, threadsafe reference
+    bridge, awaited/cancelled/escaping tasks, try/finally writer
+    settle) stay silent, and the baseline suppresses by key."""
+
+    CLEAN = ("clean_executor_hop", "_pull", "clean_boundary_bridge",
+             "clean_task_awaited", "clean_task_cancelled",
+             "clean_task_escapes", "clean_writer_settled",
+             "clean_lock_released_before_await")
+
+    @pytest.fixture(scope="class")
+    def findings(self):
+        src = open(os.path.join(FIXTURES, "async_fixture.py")).read()
+        return asynclint.lint_source(src, "async_fixture.py")
+
+    def test_each_rule_fires_exactly_once(self, findings):
+        assert _rules(findings) == {
+            "async-blocking-call": 1,        # time.sleep in a coro
+            "async-unawaited-coroutine": 1,  # dropped coroutine call
+            "async-task-exception": 1,       # never-settled task
+            "async-threadsafe-boundary": 1,  # engine-thread put_nowait
+            "async-writer-lifecycle": 1,     # close() w/o wait_closed
+            "async-lock-across-await": 1,    # threading lock + await
+        }, [str(f) for f in findings]
+
+    def test_findings_name_their_sites(self, findings):
+        by_rule = {f.rule: f for f in findings}
+        assert by_rule["async-blocking-call"].symbol == \
+            "FixAsync.plant_blocking"
+        assert "time.sleep" in by_rule["async-blocking-call"].message
+        assert by_rule["async-unawaited-coroutine"].symbol == \
+            "FixAsync.plant_unawaited"
+        assert by_rule["async-task-exception"].symbol == \
+            "FixAsync.plant_task.t"
+        assert by_rule["async-threadsafe-boundary"].symbol == \
+            "FixAsync.plant_boundary.feed"
+        assert "call_soon_threadsafe" in \
+            by_rule["async-threadsafe-boundary"].message
+        assert by_rule["async-writer-lifecycle"].symbol == \
+            "FixAsync.plant_writer.writer"
+        assert "wait_closed" in \
+            by_rule["async-writer-lifecycle"].message
+        assert by_rule["async-lock-across-await"].symbol == \
+            "FixAsync.plant_lock"
+
+    def test_pragma_suppressed_twins(self, findings):
+        src = open(os.path.join(FIXTURES, "async_fixture.py")).read()
+        lines = {(f.rule, f.line) for f in findings}
+        hit = 0
+        for i, text in enumerate(src.splitlines(), 1):
+            if "suppressed twin" in text:
+                hit += 1
+                assert not any(ln in (i, i + 1, i + 2, i + 3)
+                               for _, ln in lines), \
+                    "twin at line %d surfaced" % i
+        assert hit >= 6                   # one twin per rule
+
+    def test_clean_shapes_silent(self, findings):
+        import ast
+        src = open(os.path.join(FIXTURES, "async_fixture.py")).read()
+        spans = {}
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                spans[node.name] = (node.lineno, node.end_lineno)
+        for name in self.CLEAN:
+            assert name in spans, "fixture lost clean shape %s" % name
+        for f in findings:
+            for name in self.CLEAN:
+                lo, hi = spans[name]
+                assert not (lo <= f.line <= hi), \
+                    "%s seeded clean but got %s" % (name, f)
+
+    def test_baseline_suppresses(self, findings):
+        baseline = {f.key for f in findings
+                    if f.rule == "async-blocking-call"}
+        new, old = split_new(findings, baseline)
+        assert _rules(old) == {"async-blocking-call": 1}
+        assert "async-blocking-call" not in _rules(new)
+
+
+# ---------------------------------------------------------------------------
+# envlint (ISSUE 19 satellite): env-var documentation drift
+# ---------------------------------------------------------------------------
+class TestEnvlint:
+    def test_every_env_read_documented(self):
+        """Every literal MXNET_* key read anywhere in mxnet_tpu/ has
+        a row in docs/env_vars.md — zero drift, nothing baselined."""
+        fs = envlint.run(REPO_ROOT)
+        assert fs == [], "\n".join(str(f) for f in fs)
+
+    def test_doc_key_parse_sees_the_table(self):
+        doc = open(os.path.join(REPO_ROOT, envlint.DOC)).read()
+        keys = envlint.documented_keys(doc)
+        # spot-check rows from four different table sections
+        for k in ("MXNET_EAGER_JIT", "MXNET_SERVE_OVERLAP",
+                  "MXNET_SERVE_FLIGHT_SLOTS", "MXNET_TEST_SEED"):
+            assert k in keys, k
+
+    def test_planted_undocumented_read_fires(self):
+        """The drift proof: an env read with no doc row fires
+        env-doc-drift once, at the read site, naming the key — for
+        every read shape the scanner models."""
+        doc = envlint.documented_keys(
+            open(os.path.join(REPO_ROOT, envlint.DOC)).read())
+        shapes = [
+            'import os\nV = os.environ.get("MXNET_NEW_KNOB", "0")\n',
+            'import os\nV = os.environ["MXNET_NEW_KNOB"]\n',
+            'import os\nV = "MXNET_NEW_KNOB" in os.environ\n',
+            'from mxnet_tpu.base import env_int\n'
+            'V = env_int("MXNET_NEW_KNOB", 3)\n',
+        ]
+        for src in shapes:
+            fs = envlint.lint_source(src, "mxnet_tpu/serving/x.py",
+                                     doc)
+            assert _rules(fs) == {"env-doc-drift": 1}, (src, fs)
+            assert fs[0].symbol == "MXNET_NEW_KNOB"
+        # ...and a documented read of the same shape stays silent
+        ok = envlint.lint_source(
+            'import os\nV = os.environ.get("MXNET_NEW_KNOB")\n',
+            "mxnet_tpu/serving/x.py", doc | {"MXNET_NEW_KNOB"})
+        assert ok == []
+
+    def test_pragma_suppresses_intended_undocumented(self):
+        fs = envlint.lint_source(
+            "import os\n"
+            "# mxlint: allow(env-doc-drift) -- internal-only knob\n"
+            'V = os.environ.get("MXNET_SECRET_KNOB")\n',
+            "mxnet_tpu/serving/x.py", set())
+        assert fs == []
+
+    def test_changed_only_trigger_gating(self, monkeypatch):
+        assert envlint.triggered(None)
+        assert envlint.triggered({"mxnet_tpu/base.py"})
+        assert envlint.triggered({"docs/env_vars.md"})
+        assert envlint.triggered({"tools/analysis/envlint.py"})
+        assert not envlint.triggered({"README.md", "docs/perf.md"})
+
+        def boom(*a, **kw):
+            raise AssertionError("analyzed despite no trigger")
+
+        monkeypatch.setattr(envlint, "analyze", boom)
+        assert envlint.run(REPO_ROOT, only={"README.md"}) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,6 @@
 """mxlint — the repo-native static-analysis suite (ISSUE 4 + 7 + 8).
 
-Six analyzers, each a module here, all runnable as tier-1 tests
+Seven analyzers, each a module here, all runnable as tier-1 tests
 (``tests/test_static_analysis.py``) and as a CLI
 (``python -m tools.analysis``, ``--changed-only`` for the seconds-fast
 iteration scope, ``--format json`` for CI annotation):
@@ -30,7 +30,19 @@ iteration scope, ``--format json`` for CI annotation):
   gen fence as a checked invariant, request/reply pairing on every
   exit edge, and Process/Connection/Listener lifecycle (the
   ``py-ref-leak`` exit-edge machinery generalized to OS resources),
-  plus the checked-in protocol audit (``docs/protocol.md``).
+  plus the checked-in protocol audit (``docs/protocol.md``);
+* :mod:`.asynclint` — asyncio event-loop hazards in the HTTP/SSE
+  front door (``mxnet_tpu/serving`` + ``obs``): a call-graph model of
+  every ``async def`` with the thread↔loop boundary made explicit
+  (executor hops and ``call_soon_threadsafe`` terminate taint) —
+  blocking primitives reachable from coroutines, dropped coroutines
+  and lost task exceptions, loop-owned state mutated from engine
+  threads, StreamWriter close()+wait_closed() on every exit edge, and
+  threading locks held across awaits.
+
+Riding along, :mod:`.envlint`: every literal ``MXNET_*`` env read in
+``mxnet_tpu/`` must have a row in ``docs/env_vars.md``
+(``env-doc-drift``).
 
 The dynamic half of ISSUE 7 lives in :mod:`.interleave`: a loom-lite
 deterministic scheduler that serializes the serving cluster's threads

@@ -1,0 +1,880 @@
+"""asyncio event-loop hazard analyzer (mxlint analyzer 7 — ISSUE 19
+tentpole).
+
+The HTTP/SSE front door (``mxnet_tpu/serving/http_frontend.py``) is
+~970 lines of hand-rolled asyncio: coroutines, executor hops for the
+sync cluster RPCs, and ``call_soon_threadsafe`` bridges carrying
+engine-thread events onto the loop.  pylocklint audits the *thread*
+side of that code and protolint the wire, but nothing machine-checked
+the event-loop contracts themselves — a blocking call in a coroutine
+stalls every connection at once, a dropped task swallows its
+exception forever, and a plain ``put_nowait`` from the engine thread
+corrupts loop-owned state.  This pass builds an AST + cross-module
+call-graph model of every ``async def`` in ``mxnet_tpu/serving`` and
+``mxnet_tpu/obs`` with the thread↔loop boundary made explicit:
+function references passed to ``run_in_executor`` / ``_in_executor``
+/ ``Thread(target=)`` / ``attach_stream`` run on executor or engine
+threads (coroutine taint TERMINATES there, thread-context taint
+STARTS there); references passed to ``call_soon`` /
+``call_soon_threadsafe`` / ``add_done_callback`` run on the loop.
+
+Rules
+-----
+``async-blocking-call``  A blocking primitive — ``time.sleep``,
+    ``queue.Queue`` get/put, ``.acquire()`` on a ``threading``
+    lock, ``.result()`` on a ``concurrent.futures`` future (a name
+    assigned from ``X.submit(...)`` or the direct
+    ``submit(...).result()`` chain), socket recv/send/connect,
+    ``open()``, or a sync cluster RPC (``*.cluster.submit(...)``) —
+    reached directly or transitively from a coroutine without a
+    ``run_in_executor`` hop.  One such call stalls the whole loop:
+    every open connection, every SSE stream.  Intended-sync sites
+    take a pragma with justification.
+
+``async-unawaited-coroutine``  A call that resolves to an ``async
+    def`` used as a bare expression statement — the coroutine object
+    is created and dropped, its body never runs, and Python's
+    "coroutine was never awaited" warning fires (at best) long after
+    the bug.  Await it, gather it, or wrap it in a task.
+
+``async-task-exception``  A ``create_task``/``ensure_future`` result
+    that is neither stored-and-settled (awaited, ``.cancel()``-ed, or
+    given ``add_done_callback``) on every exit edge — exception edges
+    included — nor escaped (returned / stored into an attribute,
+    subscript, or container / passed on).  A garbage-collected task's
+    exception is silently lost; a bare ``ensure_future(...)``
+    expression statement is the degenerate case.
+
+``async-threadsafe-boundary``  Code reachable from a non-loop thread
+    (an executor hop target, a ``Thread(target=)``, an engine
+    ``attach_stream`` callback) mutating loop-owned state —
+    ``put_nowait`` on an ``asyncio.Queue``, ``.set()`` on an
+    ``asyncio.Event``, or ``loop.call_soon`` — without going through
+    ``call_soon_threadsafe``.  asyncio's structures are not
+    thread-safe; the engine→SSE bridge is the live instance (it
+    passes ``q.put_nowait`` as a *reference* to
+    ``call_soon_threadsafe``, which is the clean shape).
+
+``async-writer-lifecycle``  An ``asyncio.StreamWriter`` — the
+    ``open_connection`` result or the writer parameter of the
+    ``start_server`` callback — must reach ``close()`` **and**
+    ``await wait_closed()``, or escape (returned / stored into owned
+    state), on EVERY exit edge including exceptions.  ``close()``
+    alone only schedules the close: the connection-reset path never
+    drains, and under load the half-closed transports pile up.  This
+    generalizes protolint's ``py-resource-lifecycle`` exit-edge walk
+    to async defs (``try/finally`` settling covers the try's edges;
+    a ``try`` with a real handler protects its body).  Passing the
+    writer to a helper is a borrow, not a settle — the obligation
+    stays with the originator.
+
+``async-lock-across-await``  A held ``threading.Lock``/``RLock``
+    (``with lock:`` containing an ``await``) spanning an await point
+    inside a coroutine: the loop can interleave another coroutine
+    that blocks on the same lock — deadlocking the loop thread
+    against itself, which no watchdog can preempt.
+
+Approximations (documented, in the pylocklint tradition):
+
+* Receivers are typed by constructor assignment (locals, enclosing
+  defs, and ``self.X = ctor()`` class attributes); untyped receivers
+  never flag — ``.get()`` on a dict is not ``.get()`` on a
+  ``queue.Queue``, and ``.result()`` on an already-done asyncio task
+  is not a blocking future wait.  Precise, not complete.
+* Lambda bodies are not walked: a lambda handed to ``_in_executor``
+  runs on the executor by construction, and classifying every other
+  lambda's eventual calling context is guesswork.
+* Calls resolve through ``self``, enclosing defs, module level, and
+  unique bare names only — ambiguous names contribute no edge.
+* A coroutine *called* from a thread-context function is a dropped
+  coroutine, not thread-executed code; thread taint does not
+  propagate into async defs.
+
+Scoping: ``--changed-only`` re-analyzes when serving/, obs/, or
+``tools/analysis/`` change (tier-1 always runs full scope), reporting
+restricted to changed files like pylocklint.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_pragmas
+
+PACKAGES = ["mxnet_tpu/serving", "mxnet_tpu/obs"]
+
+# --changed-only trigger scope (tools/analysis/ included: an analyzer
+# edit must re-run its own analysis)
+TRIGGER_PREFIXES = ("mxnet_tpu/serving/", "mxnet_tpu/obs/",
+                    "tools/analysis/")
+
+# receiver types by constructor (dotted name of the ctor call)
+_CTOR_TYPES = {
+    "queue.Queue": "thread_queue",
+    "queue.LifoQueue": "thread_queue",
+    "queue.PriorityQueue": "thread_queue",
+    "queue.SimpleQueue": "thread_queue",
+    "asyncio.Queue": "aio_queue",
+    "threading.Event": "thread_event",
+    "asyncio.Event": "aio_event",
+    "threading.Lock": "thread_lock",
+    "threading.RLock": "thread_lock",
+    "asyncio.Lock": "aio_lock",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+}
+
+# function-reference args to these calls run on a non-loop thread
+# (executor pool, engine thread) — coroutine taint terminates, thread
+# taint starts
+_THREAD_REGISTRARS = {"run_in_executor", "_in_executor", "submit",
+                      "Thread", "attach_stream", "start_new_thread"}
+# ...and to these they run ON the loop (no threadsafe marshalling
+# needed; not an executor hop either)
+_LOOP_REGISTRARS = {"call_soon", "call_soon_threadsafe", "call_later",
+                    "call_at", "add_done_callback"}
+
+# calls that cannot raise for exit-edge purposes (protolint's
+# whitelist + the asyncio lifecycle calls themselves)
+_SAFE_NAME_CALLS = {"len", "min", "max", "int", "float", "bool",
+                    "str", "repr", "list", "tuple", "set", "dict",
+                    "sorted", "enumerate", "zip", "abs", "range",
+                    "isinstance", "id", "getattr", "hasattr", "sum",
+                    "any", "all", "print", "type", "next"}
+_SAFE_ATTR_CALLS = {"get", "append", "appendleft", "pop", "popleft",
+                    "discard", "add", "items", "values", "keys",
+                    "update", "extend", "clear", "perf_counter",
+                    "release", "copy", "setdefault", "put",
+                    "put_nowait", "set", "is_set", "getpid", "close",
+                    "cancel", "done", "cancelled", "get_extra_info",
+                    "is_closing", "set_result", "inc", "observe",
+                    "record", "debug", "info", "warning", "error"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _mentions(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _may_raise(stmt: ast.AST) -> Optional[int]:
+    """Line of the first call in ``stmt`` that can raise."""
+    for n in ast.walk(stmt):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name) and f.id in _SAFE_NAME_CALLS:
+            continue
+        if isinstance(f, ast.Attribute) and f.attr in _SAFE_ATTR_CALLS:
+            continue
+        return n.lineno
+    return None
+
+
+def _try_protects(stmt: ast.Try) -> bool:
+    """A try with a handler that does not just re-raise redirects its
+    body's exception edges — execution continues after the try."""
+    for h in stmt.handlers:
+        if not (len(h.body) == 1 and isinstance(h.body[0], ast.Raise)
+                and h.body[0].exc is None):
+            return True
+    return False
+
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _own_nodes(fnnode: ast.AST) -> List[ast.AST]:
+    """Every AST node executed as part of THIS function's body —
+    nested defs and lambdas excluded (their bodies run later, in a
+    context of their own)."""
+    out: List[ast.AST] = []
+
+    def walk(n):
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, _DEFS + (ast.Lambda,)):
+                continue
+            out.append(c)
+            walk(c)
+    walk(fnnode)
+    return out
+
+
+def _is_task_ctor(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr in ("create_task", "ensure_future")
+    if isinstance(call.func, ast.Name):
+        return call.func.id in ("create_task", "ensure_future")
+    return False
+
+
+# ---------------------------------------------------------------------------
+# program model
+# ---------------------------------------------------------------------------
+class _Fn:
+    __slots__ = ("qual", "mod", "cls", "name", "node", "parent",
+                 "is_async", "locals", "edges", "coro", "thread",
+                 "loop_cb", "server_cb")
+
+    def __init__(self, qual, mod, cls, name, node, parent):
+        self.qual = qual
+        self.mod = mod
+        self.cls = cls                  # enclosing class name or None
+        self.name = name
+        self.node = node
+        self.parent = parent            # enclosing fn qual or None
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.locals: Dict[str, str] = {}   # name -> receiver type
+        self.edges: Set[str] = set()       # direct synchronous calls
+        self.coro = self.is_async          # runs on the loop, awaited
+        self.thread = False                # reachable from a thread
+        self.loop_cb = False               # scheduled ON the loop
+        self.server_cb = False             # asyncio.start_server cb
+
+    @property
+    def display(self) -> str:
+        return self.qual.split("::", 1)[1]
+
+
+class _Module:
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, rel)
+
+
+class _Program:
+    def __init__(self, modules: Dict[str, str]):
+        self.modules = {rel: _Module(rel, src)
+                        for rel, src in sorted(modules.items())}
+        self.fns: Dict[str, _Fn] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        # (mod, cls) -> {attr: receiver type} from self.X = ctor()
+        self.cls_types: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.findings: List[Finding] = []
+        for mod in self.modules.values():
+            self._collect(mod)
+        for fn in self.fns.values():
+            self._type_locals(fn)
+        for fn in list(self.fns.values()):
+            self._scan_calls(fn)
+        self._propagate()
+
+    def _add(self, rule, mod, line, symbol, msg):
+        self.findings.append(Finding("async", rule, mod, line,
+                                     symbol, msg))
+
+    # -- collection --------------------------------------------------
+    def _collect(self, mod: _Module):
+        def add(node, cls, parent):
+            if parent:
+                qual = "%s.%s" % (parent, node.name)
+            else:
+                qual = "%s::%s%s" % (mod.rel, cls + "." if cls else "",
+                                     node.name)
+            fn = _Fn(qual, mod.rel, cls, node.name, node, parent)
+            self.fns[qual] = fn
+            self.by_name.setdefault(node.name, []).append(qual)
+            for child in node.body:
+                walk_stmt(child, cls, qual)
+
+        def walk_stmt(node, cls, parent):
+            if isinstance(node, _DEFS):
+                add(node, cls, parent)
+            elif isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    walk_stmt(child, node.name, None)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    for child in getattr(node, attr, ()):
+                        walk_stmt(child, cls, parent)
+                for h in getattr(node, "handlers", ()):
+                    for child in h.body:
+                        walk_stmt(child, cls, parent)
+
+        for node in mod.tree.body:
+            walk_stmt(node, None, None)
+
+    # -- receiver typing ---------------------------------------------
+    def _value_type(self, v: ast.AST) -> Optional[str]:
+        if not isinstance(v, ast.Call):
+            return None
+        ctor = _dotted(v.func)
+        if ctor in _CTOR_TYPES:
+            return _CTOR_TYPES[ctor]
+        if isinstance(v.func, ast.Attribute):
+            a = v.func.attr
+            if a == "submit":
+                return "cfuture"        # concurrent.futures future
+            if a in ("run_in_executor", "_in_executor"):
+                return "aio_future"     # awaitable — not blocking
+            if a in ("create_task", "ensure_future"):
+                return "task"
+        return None
+
+    def _type_locals(self, fn: _Fn):
+        for n in _own_nodes(fn.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            t = self._value_type(n.value)
+            if t is None:
+                continue
+            for tgt in n.targets:
+                if isinstance(tgt, ast.Name):
+                    fn.locals[tgt.id] = t
+                elif (isinstance(tgt, ast.Attribute)
+                      and isinstance(tgt.value, ast.Name)
+                      and tgt.value.id == "self" and fn.cls):
+                    self.cls_types.setdefault(
+                        (fn.mod, fn.cls), {})[tgt.attr] = t
+
+    def recv_type(self, fn: _Fn, node: ast.AST) -> Optional[str]:
+        """Type of a receiver expression: fn locals, enclosing defs,
+        then ``self.X`` class attributes.  None = unknown (no rule
+        fires on it)."""
+        if isinstance(node, ast.Name):
+            cur: Optional[_Fn] = fn
+            while cur is not None:
+                if node.id in cur.locals:
+                    return cur.locals[node.id]
+                cur = self.fns.get(cur.parent) if cur.parent else None
+            return None
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            cls = self._cls_of(fn)
+            if cls:
+                return self.cls_types.get((fn.mod, cls),
+                                          {}).get(node.attr)
+        return None
+
+    def _cls_of(self, fn: _Fn) -> Optional[str]:
+        cur: Optional[_Fn] = fn
+        while cur is not None:
+            if cur.cls:
+                return cur.cls
+            cur = self.fns.get(cur.parent) if cur.parent else None
+        return None
+
+    # -- call resolution ---------------------------------------------
+    def resolve(self, fn: _Fn, func: ast.AST) -> Optional[str]:
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            cls = self._cls_of(fn)
+            if cls:
+                qual = "%s::%s.%s" % (fn.mod, cls, func.attr)
+                if qual in self.fns:
+                    return qual
+            return None
+        if isinstance(func, ast.Name):
+            cur: Optional[_Fn] = fn
+            while cur is not None:       # enclosing nested defs
+                qual = "%s.%s" % (cur.qual, func.id)
+                if qual in self.fns:
+                    return qual
+                cur = self.fns.get(cur.parent) if cur.parent else None
+            qual = "%s::%s" % (fn.mod, func.id)
+            if qual in self.fns:
+                return qual
+            cands = self.by_name.get(func.id, [])
+            if len(cands) == 1:          # unique bare name only
+                return cands[0]
+        return None
+
+    def _ref_targets(self, fn: _Fn, args) -> List[str]:
+        """Function references among call ARGS (not called here —
+        registered to run elsewhere)."""
+        out = []
+        for a in args:
+            if isinstance(a, (ast.Name, ast.Attribute)):
+                qual = self.resolve(fn, a)
+                if qual is not None:
+                    out.append(qual)
+        return out
+
+    def _scan_calls(self, fn: _Fn):
+        for n in _own_nodes(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            callee_name = f.attr if isinstance(f, ast.Attribute) \
+                else (f.id if isinstance(f, ast.Name) else None)
+            args = list(n.args) + [k.value for k in n.keywords]
+            if callee_name in _THREAD_REGISTRARS:
+                for qual in self._ref_targets(fn, args):
+                    tgt = self.fns[qual]
+                    if not tgt.is_async:  # coroutines aren't run by
+                        tgt.thread = True  # the thread, see docstring
+                continue                  # hop: no synchronous edge
+            if callee_name in _LOOP_REGISTRARS:
+                for qual in self._ref_targets(fn, args):
+                    self.fns[qual].loop_cb = True
+                continue
+            if callee_name == "start_server":
+                for qual in self._ref_targets(fn, args):
+                    self.fns[qual].server_cb = True
+                continue
+            qual = self.resolve(fn, f)
+            if qual is not None:
+                fn.edges.add(qual)
+
+    def _propagate(self):
+        # coroutine reachability: async defs taint their synchronous
+        # direct callees (executor hops already cut the edge)
+        work = [q for q, f in self.fns.items() if f.coro]
+        while work:
+            fn = self.fns[work.pop()]
+            for q in fn.edges:
+                tgt = self.fns[q]
+                if not tgt.coro:
+                    tgt.coro = True
+                    work.append(q)
+        # thread reachability: registrar targets taint their sync
+        # callees; never propagates into async defs
+        work = [q for q, f in self.fns.items() if f.thread]
+        while work:
+            fn = self.fns[work.pop()]
+            for q in fn.edges:
+                tgt = self.fns[q]
+                if not tgt.is_async and not tgt.thread:
+                    tgt.thread = True
+                    work.append(q)
+
+
+# ---------------------------------------------------------------------------
+# rule passes
+# ---------------------------------------------------------------------------
+def _blocking_pass(prog: _Program):
+    for qual in sorted(prog.fns):
+        fn = prog.fns[qual]
+        if not fn.coro:
+            continue
+        where = "coroutine" if fn.is_async else \
+            "function reachable from a coroutine"
+        for n in _own_nodes(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            d = _dotted(f)
+            prim = None
+            if d == "time.sleep" or d.endswith(".time.sleep"):
+                prim = "time.sleep() blocks the loop"
+            elif isinstance(f, ast.Name) and f.id == "open":
+                prim = "open() is synchronous file I/O"
+            elif isinstance(f, ast.Attribute):
+                t = prog.recv_type(fn, f.value)
+                if f.attr in ("get", "put") and t == "thread_queue":
+                    prim = ("queue.Queue.%s() parks the loop thread "
+                            "on a threading condition" % f.attr)
+                elif f.attr == "acquire" and t == "thread_lock":
+                    prim = ("threading lock .acquire() blocks the "
+                            "loop thread")
+                elif f.attr == "result" and (
+                        t == "cfuture"
+                        or (isinstance(f.value, ast.Call)
+                            and isinstance(f.value.func,
+                                           ast.Attribute)
+                            and f.value.func.attr == "submit")):
+                    prim = ("Future.result() blocks until the "
+                            "executor finishes")
+                elif f.attr in ("recv", "recv_into", "sendall",
+                                "connect", "accept") and \
+                        t == "socket":
+                    prim = "blocking socket %s()" % f.attr
+                elif f.attr == "submit" and \
+                        "cluster" in _dotted(f.value):
+                    prim = ("sync cluster RPC %s() holds the loop "
+                            "for the full round trip" % d)
+            if prim is None:
+                continue
+            prog._add(
+                "async-blocking-call", fn.mod, n.lineno, fn.display,
+                "%s in %s %s — %s; hop it through "
+                "run_in_executor (or mark the intended-sync site "
+                "with a pragma)" % (d, where, fn.display, prim))
+
+
+def _unawaited_pass(prog: _Program):
+    for qual in sorted(prog.fns):
+        fn = prog.fns[qual]
+        for n in _own_nodes(fn.node):
+            if not (isinstance(n, ast.Expr)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            callee = prog.resolve(fn, n.value.func)
+            if callee is not None and prog.fns[callee].is_async:
+                prog._add(
+                    "async-unawaited-coroutine", fn.mod, n.lineno,
+                    fn.display,
+                    "%s(...) is a coroutine call whose value is "
+                    "dropped — the body never runs; await it, "
+                    "gather it, or wrap it in a task"
+                    % prog.fns[callee].display)
+
+
+def _threadsafe_pass(prog: _Program):
+    for qual in sorted(prog.fns):
+        fn = prog.fns[qual]
+        if not fn.thread:
+            continue
+        for n in _own_nodes(fn.node):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)):
+                continue
+            f = n.func
+            t = prog.recv_type(fn, f.value)
+            bad = None
+            if f.attr == "put_nowait" and t == "aio_queue":
+                bad = "asyncio.Queue.put_nowait"
+            elif f.attr == "set" and t == "aio_event":
+                bad = "asyncio.Event.set"
+            elif f.attr == "call_soon":
+                bad = "loop.call_soon"
+            if bad is None:
+                continue
+            prog._add(
+                "async-threadsafe-boundary", fn.mod, n.lineno,
+                fn.display,
+                "%s runs on a non-loop thread but mutates "
+                "loop-owned state via %s — asyncio structures are "
+                "not thread-safe; marshal it through "
+                "loop.call_soon_threadsafe" % (fn.display, bad))
+
+
+def _lock_across_await_pass(prog: _Program):
+    for qual in sorted(prog.fns):
+        fn = prog.fns[qual]
+        if not fn.is_async:
+            continue
+        own = set(map(id, _own_nodes(fn.node)))
+        for n in _own_nodes(fn.node):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            lock = None
+            for item in n.items:
+                if prog.recv_type(fn, item.context_expr) == \
+                        "thread_lock":
+                    lock = _dotted(item.context_expr)
+            if lock is None:
+                continue
+            if any(isinstance(c, ast.Await) and id(c) in own
+                   for s in n.body for c in ast.walk(s)):
+                prog._add(
+                    "async-lock-across-await", fn.mod, n.lineno,
+                    fn.display,
+                    "threading lock %s is held across an await "
+                    "point in %s — the loop can interleave another "
+                    "coroutine that blocks on it, deadlocking the "
+                    "loop thread against itself" % (lock, fn.display))
+
+
+# ---------------------------------------------------------------------------
+# exit-edge obligations: tasks and stream writers
+# ---------------------------------------------------------------------------
+def _settles_task(stmt: ast.AST, name: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Await) and _mentions(n.value, name):
+            return True
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in ("cancel", "add_done_callback") and \
+                _mentions(n.func.value, name):
+            return True
+    return False
+
+
+def _escapes_task(stmt: ast.AST, name: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Return) and n.value is not None and \
+                _mentions(n.value, name):
+            return True
+        if isinstance(n, ast.Assign) and _mentions(n.value, name):
+            return True
+        if isinstance(n, (ast.Yield, ast.YieldFrom)) and \
+                n.value is not None and _mentions(n.value, name):
+            return True
+        if isinstance(n, ast.Call):
+            if any(_mentions(a, name) for a in n.args) or \
+                    any(_mentions(k.value, name) for k in n.keywords):
+                return True
+    return False
+
+
+def _settles_writer(stmt: ast.AST, name: str) -> bool:
+    """Only ``await name.wait_closed()`` settles — ``close()`` alone
+    merely schedules the close and the reset path never drains."""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Await) and isinstance(n.value, ast.Call) \
+                and isinstance(n.value.func, ast.Attribute) \
+                and n.value.func.attr == "wait_closed" \
+                and _mentions(n.value.func.value, name):
+            return True
+    return False
+
+
+def _escapes_writer(stmt: ast.AST, name: str) -> bool:
+    """Returning or storing the writer transfers ownership; passing
+    it to a helper call is a BORROW and does not settle."""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Return) and n.value is not None and \
+                _mentions(n.value, name):
+            return True
+        if isinstance(n, ast.Assign) and _mentions(n.value, name):
+            return True
+    return False
+
+
+class _Obligation:
+    __slots__ = ("name", "kind", "line", "settles", "escapes")
+
+    def __init__(self, name, kind, line):
+        self.name = name
+        self.kind = kind                # "task" | "writer"
+        self.line = line
+        if kind == "task":
+            self.settles, self.escapes = _settles_task, _escapes_task
+        else:
+            self.settles, self.escapes = (_settles_writer,
+                                          _escapes_writer)
+
+
+class _ExitScanner:
+    """Protolint's resource exit-edge walk, generalized: every path
+    from the obligation's origin — returns, raises, unprotected
+    may-raise calls, and the fall-through — must settle or escape
+    it."""
+
+    RULES = {"task": "async-task-exception",
+             "writer": "async-writer-lifecycle"}
+
+    def __init__(self, prog: _Program, fn: _Fn):
+        self.prog = prog
+        self.fn = fn
+        self._reported = False
+
+    def _add(self, line, ob: _Obligation, msg):
+        self._reported = True
+        self.prog._add(self.RULES[ob.kind], self.fn.mod, line,
+                       "%s.%s" % (self.fn.display, ob.name), msg)
+
+    def scan(self):
+        fn = self.fn
+        # writer params of the start_server callback: the obligation
+        # exists from the first statement on
+        if fn.server_cb:
+            params = [a.arg for a in fn.node.args.args
+                      if a.arg != "self"]
+            if len(params) >= 2:
+                ob = _Obligation(params[1], "writer",
+                                 fn.node.lineno)
+                self._run(ob, fn.node.body, [])
+        # bare create_task/ensure_future expression statements
+        for n in _own_nodes(fn.node):
+            if isinstance(n, ast.Expr) and \
+                    isinstance(n.value, ast.Call) and \
+                    _is_task_ctor(n.value):
+                ob = _Obligation("<dropped>", "task", n.lineno)
+                self._add(n.lineno, ob,
+                          "task created and immediately dropped — "
+                          "its exception is silently lost; store "
+                          "and await/cancel it or add a "
+                          "done-callback")
+        self._scan_block(fn.node.body, [])
+
+    def _acquire(self, stmt) -> Optional[_Obligation]:
+        if not isinstance(stmt, ast.Assign) or \
+                len(stmt.targets) != 1:
+            return None
+        v = stmt.value
+        tgt = stmt.targets[0]
+        if isinstance(v, ast.Call) and _is_task_ctor(v) and \
+                isinstance(tgt, ast.Name):
+            return _Obligation(tgt.id, "task", stmt.lineno)
+        # reader, writer = await asyncio.open_connection(...)
+        if isinstance(v, ast.Await) and \
+                isinstance(v.value, ast.Call) and \
+                _dotted(v.value.func).endswith("open_connection") and \
+                isinstance(tgt, ast.Tuple) and \
+                len(tgt.elts) == 2 and \
+                isinstance(tgt.elts[1], ast.Name):
+            return _Obligation(tgt.elts[1].id, "writer",
+                               stmt.lineno)
+        return None
+
+    def _run(self, ob: _Obligation, stmts, conts):
+        self._reported = False
+        settled = self._track(stmts, ob, protected=False)
+        for cont in conts:
+            if settled:
+                break
+            settled = self._track(cont, ob, protected=False)
+        if not settled and not self._reported:
+            if ob.kind == "task":
+                self._add(ob.line, ob,
+                          "the task bound to %r at line %d is "
+                          "never awaited, cancelled, or given a "
+                          "done-callback on the fall-through path "
+                          "— its exception is silently lost"
+                          % (ob.name, ob.line))
+            else:
+                self._add(ob.line, ob,
+                          "the StreamWriter %r never reaches "
+                          "close() + await wait_closed() (nor "
+                          "escapes) on the fall-through path — the "
+                          "transport is left half-closed"
+                          % ob.name)
+
+    def _scan_block(self, body, conts):
+        for i, stmt in enumerate(body):
+            ob = self._acquire(stmt)
+            if ob is not None:
+                self._run(ob, body[i + 1:], conts)
+            sub_conts = [body[i + 1:]] + conts
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._scan_block(sub, sub_conts)
+            for h in getattr(stmt, "handlers", ()):
+                self._scan_block(h.body, sub_conts)
+
+    def _track(self, stmts, ob: _Obligation, protected) -> bool:
+        for stmt in stmts:
+            # settle-by-containment applies to LEAF statements only:
+            # a compound statement settling in one branch must still
+            # have its other branches walked (protolint's reply-walk
+            # refinement — an `if: settle()` must not cover the else)
+            if not isinstance(stmt, (ast.If, ast.Try, ast.For,
+                                     ast.AsyncFor, ast.While,
+                                     ast.With, ast.AsyncWith)) and \
+                    (ob.settles(stmt, ob.name)
+                     or ob.escapes(stmt, ob.name)):
+                return True
+            if isinstance(stmt, ast.Try):
+                if any(ob.settles(s, ob.name)
+                       for s in stmt.finalbody):
+                    return True           # every path runs finally
+                prot = protected or _try_protects(stmt)
+                if self._track(stmt.body, ob, prot):
+                    return True
+                continue
+            if isinstance(stmt, ast.If):
+                t = self._track(stmt.body, ob, protected)
+                e = self._track(stmt.orelse, ob, protected)
+                if t and (stmt.orelse and e):
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While,
+                                 ast.With, ast.AsyncWith)):
+                if self._track(stmt.body, ob, protected):
+                    return True
+                continue
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break,
+                                 ast.Raise)):
+                if ob.kind == "task":
+                    self._add(stmt.lineno, ob,
+                              "exit before the task bound to %r at "
+                              "line %d is awaited/cancelled — its "
+                              "exception is silently lost on this "
+                              "path" % (ob.name, ob.line))
+                else:
+                    self._add(stmt.lineno, ob,
+                              "exit leaves the StreamWriter %r "
+                              "without close() + await "
+                              "wait_closed() — close() alone only "
+                              "schedules the close; the transport "
+                              "never drains on this path" % ob.name)
+                return True
+            if not protected:
+                line = _may_raise(stmt)
+                if line is not None:
+                    if ob.kind == "task":
+                        self._add(line, ob,
+                                  "call may raise before the task "
+                                  "bound to %r (line %d) is "
+                                  "awaited/cancelled — the "
+                                  "exception edge drops it"
+                                  % (ob.name, ob.line))
+                    else:
+                        self._add(line, ob,
+                                  "call may raise before the "
+                                  "StreamWriter %r reaches close() "
+                                  "+ await wait_closed() — the "
+                                  "exception edge leaks the "
+                                  "half-closed transport" % ob.name)
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def build_model(modules: Dict[str, str]) -> _Program:
+    return _Program(modules)
+
+
+def analyze(modules: Dict[str, str]) -> List[Finding]:
+    """Analyze ``{rel_path: source}`` as one program; findings are
+    pragma-filtered per module."""
+    prog = build_model(modules)
+    _blocking_pass(prog)
+    _unawaited_pass(prog)
+    _threadsafe_pass(prog)
+    _lock_across_await_pass(prog)
+    for qual in sorted(prog.fns):
+        _ExitScanner(prog, prog.fns[qual]).scan()
+    out: List[Finding] = []
+    for rel, mod in prog.modules.items():
+        fs = [f for f in prog.findings if f.path == rel]
+        out.extend(apply_pragmas(fs, mod.source))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_source(source: str, rel_path: str) -> List[Finding]:
+    """Single-module entry (fixtures drive this directly)."""
+    return analyze({rel_path: source})
+
+
+def _load_modules(root: str) -> Dict[str, str]:
+    modules: Dict[str, str] = {}
+    for pkg in PACKAGES:
+        d = os.path.join(root, pkg)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            rel = "%s/%s" % (pkg, name)
+            with open(os.path.join(root, rel)) as f:
+                modules[rel] = f.read()
+    return modules
+
+
+def triggered(only: Optional[Set[str]]) -> bool:
+    """Does the change set intersect the loop's trigger scope?"""
+    if only is None:
+        return True
+    return any(p.startswith(TRIGGER_PREFIXES) for p in only)
+
+
+def run(root: str, only: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint the live event-loop code.  ``only`` (--changed-only):
+    skipped unless serving/, obs/, or tools/analysis/ changed; when
+    it runs, reporting is restricted to changed files (pylocklint's
+    convention — tier-1 always runs full scope)."""
+    if not triggered(only):
+        return []
+    findings = analyze(_load_modules(root))
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    return findings

@@ -1,0 +1,163 @@
+"""Env-var documentation drift check (ISSUE 19 satellite).
+
+``docs/env_vars.md`` is the operator's contract: every runtime switch
+the tree actually reads must have a row there.  The table has been
+kept current by hand through 24 rounds; this rider makes the drift
+machine-checked the same way graphlint pins the sharding audit.
+
+One rule, ``env-doc-drift``: every read of a literal ``MXNET_*`` key
+in ``mxnet_tpu/`` — ``os.environ.get("K")``, ``os.environ["K"]``,
+``"K" in os.environ``, ``os.environ.setdefault("K", ...)``, or a call
+to one of the repo's env helpers (``env_flag`` / ``env_int`` in
+``base.py``, the ``_env_default`` / ``_env_int`` module-local clones)
+with a literal first argument — must appear in a backticked
+``MXNET_*`` token somewhere in ``docs/env_vars.md``.  Dynamic key
+construction (prefix + name) is invisible to the AST scan and out of
+scope; docstring mentions of a key are not reads (the scan is
+AST-based precisely so prose can't satisfy — or trip — the rule).
+
+The reverse direction (documented-but-never-read) is deliberately not
+a rule: keys read by tools/ and tests/ (``MXNET_SERVE_PREFILL``,
+``MXNET_TEST_SEED``) legitimately live in the table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_pragmas
+
+PACKAGES = ["mxnet_tpu"]
+DOC = "docs/env_vars.md"
+
+TRIGGER_PREFIXES = ("mxnet_tpu/", "tools/analysis/")
+TRIGGER_FILES = (DOC,)
+
+_ENV_HELPERS = {"env_flag", "env_int", "_env_default", "_env_int"}
+_KEY_RE = re.compile(r"`(MXNET_[A-Z0-9_]+)`")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_environ(node: ast.AST) -> bool:
+    return _dotted(node).endswith("environ")
+
+
+def _literal_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("MXNET_"):
+        return node.value
+    return None
+
+
+def _reads(tree: ast.AST) -> List[Tuple[str, int]]:
+    """Every (key, line) a module reads with a literal MXNET_* key."""
+    out: List[Tuple[str, int]] = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("get", "setdefault", "pop") and \
+                    _is_environ(f.value) and n.args:
+                k = _literal_key(n.args[0])
+                if k:
+                    out.append((k, n.lineno))
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if name in _ENV_HELPERS and n.args:
+                k = _literal_key(n.args[0])
+                if k:
+                    out.append((k, n.lineno))
+        elif isinstance(n, ast.Subscript) and _is_environ(n.value):
+            k = _literal_key(n.slice)
+            if k:
+                out.append((k, n.lineno))
+        elif isinstance(n, ast.Compare) and \
+                any(isinstance(op, (ast.In, ast.NotIn))
+                    for op in n.ops) and \
+                any(_is_environ(c) for c in n.comparators):
+            k = _literal_key(n.left)
+            if k:
+                out.append((k, n.lineno))
+    return out
+
+
+def documented_keys(doc_text: str) -> Set[str]:
+    """The backticked ``MXNET_*`` tokens in docs/env_vars.md."""
+    return set(_KEY_RE.findall(doc_text))
+
+
+def analyze(modules: Dict[str, str],
+            documented: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in sorted(modules):
+        source = modules[rel]
+        try:
+            tree = ast.parse(source, rel)
+        except SyntaxError:
+            continue
+        fs = []
+        for key, line in _reads(tree):
+            if key in documented:
+                continue
+            fs.append(Finding(
+                "env", "env-doc-drift", rel, line, key,
+                "%s is read here but has no row in %s — every "
+                "runtime switch must be documented for the operator "
+                "(add the row: variable, default, effect)"
+                % (key, DOC)))
+        out.extend(apply_pragmas(fs, source))
+    return sorted(out, key=lambda f: (f.path, f.line, f.symbol))
+
+
+def lint_source(source: str, rel_path: str,
+                documented: Set[str]) -> List[Finding]:
+    """Single-module entry (the drift test drives this directly)."""
+    return analyze({rel_path: source}, documented)
+
+
+def _load_modules(root: str) -> Dict[str, str]:
+    modules: Dict[str, str] = {}
+    for pkg in PACKAGES:
+        top = os.path.join(root, pkg)
+        for dirpath, _dirnames, filenames in os.walk(top):
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full) as f:
+                    modules[rel] = f.read()
+    return modules
+
+
+def triggered(only: Optional[Set[str]]) -> bool:
+    if only is None:
+        return True
+    return any(p in TRIGGER_FILES
+               or p.startswith(TRIGGER_PREFIXES) for p in only)
+
+
+def run(root: str, only: Optional[Set[str]] = None) -> List[Finding]:
+    """Check every mxnet_tpu/ env read against docs/env_vars.md."""
+    if not triggered(only):
+        return []
+    doc_path = os.path.join(root, DOC)
+    documented: Set[str] = set()
+    if os.path.exists(doc_path):
+        with open(doc_path) as f:
+            documented = documented_keys(f.read())
+    findings = analyze(_load_modules(root), documented)
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    return findings

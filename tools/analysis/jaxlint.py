@@ -153,7 +153,7 @@ HOT_REGIONS: List[Tuple[str, str]] = [
     ("mxnet_tpu/serving/http_frontend.py",
      r"(?:.*\.)?(_stream_sse|_respond_json|_run_request"
      r"|_cancel_disconnected|_serve_conn|_conn_loop"
-     r"|_handle_generate)$"),
+     r"|_handle_generate|_handle_statusz|_handle_trace)$"),
     ("benchmark/http_bench.py", r".*"),
     # round 22: the zero-copy put transport and its cluster data-plane
     # callers run per page frame between the prefill and decode engine

@@ -1,4 +1,4 @@
-"""mxlint entry point — run all six analyzers against the live repo.
+"""mxlint entry point — run all seven analyzers against the live repo.
 
 Usage (from the repo root)::
 
@@ -35,8 +35,8 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Set
 
-from . import (abi, graphlint, jaxlint, native_lint, protolint,
-               pylocklint)
+from . import (abi, asynclint, envlint, graphlint, jaxlint,
+               native_lint, protolint, pylocklint)
 from .findings import Finding, load_baseline, split_new
 
 __all__ = ["REPO_ROOT", "changed_files", "run_all", "fingerprint",
@@ -113,6 +113,8 @@ def run_all(root: str = None, baseline_path: str = None,
     findings += pylocklint.run(root, only=only)
     findings += graphlint.run(root, only=only)
     findings += protolint.run(root, only=only)
+    findings += asynclint.run(root, only=only)
+    findings += envlint.run(root, only=only)
     baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
     new, old = split_new(findings, baseline)
     return {"findings": findings, "new": new, "baselined": old,
@@ -149,7 +151,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mxlint", description="repo static-analysis suite "
         "(C-ABI / JAX hazards / native + Python concurrency / "
-        "compiled-program graphs / serving wire protocol)")
+        "compiled-program graphs / serving wire protocol / asyncio "
+        "event-loop hazards + env-var doc drift)")
     ap.add_argument("--root", default=REPO_ROOT)
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--json", action="store_true",

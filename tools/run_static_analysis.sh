@@ -1,8 +1,9 @@
 #!/bin/sh
 # Single entry point for the mxlint static-analysis suite (ISSUE 4/7/8):
-#   1. the six analyzers (C-ABI / JAX hazards / native concurrency /
+#   1. the seven analyzers (C-ABI / JAX hazards / native concurrency /
 #      Python concurrency / compiled-program graphs / serving wire
-#      protocol) — fails on any NEW
+#      protocol / asyncio event-loop hazards, plus the envlint
+#      env-var doc-drift rider) — fails on any NEW
 #      violation vs baseline/pragmas.  DEFAULT SCOPE: --changed-only
 #      (files changed vs the merge-base + working tree; graphlint
 #      re-traces only programs whose recorded trace closure changed),
